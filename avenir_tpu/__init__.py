@@ -1,0 +1,40 @@
+"""avenir_tpu — a TPU-native predictive-analytics framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of biddyweb/avenir
+(batch + streaming classical ML: Naive Bayes, KNN, decision trees, Markov
+chain / HMM, logistic regression, Fisher discriminant, mutual-information
+feature selection, categorical correlation, and multi-armed-bandit
+reinforcement learners).
+
+Where the reference runs Hadoop MapReduce jobs whose state flows through
+HDFS CSV files and an MR sort/shuffle, avenir_tpu runs jit-compiled array
+programs over a `jax.sharding.Mesh`:
+
+- map-side row sharding        -> batch axis sharded over the ``data`` mesh axis
+- combiner + shuffle + reduce  -> on-device one-hot/segment reductions + XLA
+                                  ``psum`` collectives over ICI
+- secondary sort / top-K       -> ``jax.lax.top_k``
+- HDFS side-file broadcast     -> replicated device arrays
+- Storm/Redis streaming bolt   -> host queue loop around a donated, jitted
+                                  update step (see ``avenir_tpu.stream``)
+
+Contracts preserved from the reference: CSV in/out, the JSON feature-schema
+metadata format (resource/churn.json, resource/elearnActivity.json), flat
+``.properties`` configuration, validation-mode confusion-matrix metrics, and
+the model-artifact wire formats.
+"""
+
+__version__ = "0.1.0"
+
+from avenir_tpu.utils.schema import FeatureField, FeatureSchema
+from avenir_tpu.utils.config import JobConfig
+from avenir_tpu.utils.metrics import ConfusionMatrix, MetricsRegistry
+
+__all__ = [
+    "FeatureField",
+    "FeatureSchema",
+    "JobConfig",
+    "ConfusionMatrix",
+    "MetricsRegistry",
+    "__version__",
+]

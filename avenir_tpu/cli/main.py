@@ -1,0 +1,150 @@
+"""Driver entrypoints mirroring the reference's ``hadoop jar <Class>`` verbs.
+
+The reference runs each algorithm as
+``hadoop jar avenir-1.0.jar <ClassName> -Dconf.path=<props> <in> <out>``
+(resource/knn.sh:67-81). Here the same verb names dispatch to jitted jobs:
+
+    python -m avenir_tpu BayesianDistribution --conf churn.properties IN OUT
+    python -m avenir_tpu BayesianPredictor    --conf churn.properties IN OUT
+
+Config keys keep their reference names (``feature.schema.file.path``,
+``field.delim.regex``, ``bayesian.model.file.path``, ...), so existing
+property files drive the TPU backend unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Callable, Dict, List
+
+from avenir_tpu.utils.config import JobConfig
+from avenir_tpu.utils.dataset import Featurizer, read_csv_lines
+from avenir_tpu.utils.schema import FeatureSchema
+
+
+def _schema_is_data_dependent(schema: FeatureSchema) -> bool:
+    """True when featurization depends on the rows it is fitted on (a
+    categorical without a cardinality list, or a bucketed numeric without
+    min/max) — in that case predict-time fitting must reuse the training
+    data or vocabularies would drift from the saved model."""
+    fields = schema.get_feature_fields()
+    try:
+        fields = fields + [schema.find_class_attr_field()]
+    except ValueError:
+        pass
+    for f in fields:
+        if f.is_categorical and f.cardinality is None:
+            return True
+        if f.is_numeric and f.bucket_width is not None and (
+                f.min is None or f.max is None):
+            return True
+    return False
+
+
+def _load_table(conf: JobConfig, in_path: str, for_predict: bool = False):
+    schema = FeatureSchema.from_file(conf.get_required("feature.schema.file.path"))
+    delim = conf.get("field.delim.regex", ",")
+    rows = read_csv_lines(in_path, delim)
+    fz = Featurizer(schema, unseen=conf.get("unseen.value.handling", "error"))
+    fit_rows = rows
+    if for_predict and _schema_is_data_dependent(schema):
+        fit_path = conf.get("featurizer.fit.data.path")
+        if fit_path is None:
+            raise ValueError(
+                "schema has data-dependent vocabularies (categorical without "
+                "cardinality or bucketed numeric without min/max); set "
+                "featurizer.fit.data.path to the training data so predict-time "
+                "encoding matches the saved model")
+        fit_rows = read_csv_lines(fit_path, delim)
+    fz.fit(fit_rows)
+    return fz, rows
+
+
+def run_bayesian_distribution(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Train Naive Bayes distributions (reference BayesianDistribution job)."""
+    from avenir_tpu.models import naive_bayes as nb
+    fz, rows = _load_table(conf, in_path)
+    table = fz.transform(rows)
+    model, meta, metrics = nb.train(table)
+    nb.save_model(model, meta, out_path, delim=conf.get("field.delim", ","))
+    print(metrics.to_json())
+
+
+def run_bayesian_predictor(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Predict with a trained model (reference BayesianPredictor job).
+
+    Honors the reference's config keys: ``field.delim.out``,
+    ``bp.predict.class`` (neg,pos ordering), ``bp.predict.class.cost``
+    (falseNegCost,falsePosCost — presence switches on cost-based
+    arbitration), ``class.prob.diff.threshold``, ``output.feature.prob.only``
+    (BayesianPredictor.java:125-165).
+    """
+    from avenir_tpu.models import naive_bayes as nb
+    fz, rows = _load_table(conf, in_path, for_predict=True)
+    table = fz.transform(rows)
+    meta = nb.BayesModelMeta.from_table(table)
+    model = nb.load_model(conf.get_required("bayesian.model.file.path"), meta,
+                          delim=conf.get("field.delim", ","))
+    delim = conf.get("field.delim.out", ",")
+    predicting = conf.get_list("bp.predict.class", None, delim)
+    costs = conf.get_int_list("bp.predict.class.cost", None, delim)
+    diff_threshold = conf.get_int("class.prob.diff.threshold", -1)
+    pred = nb.predict(
+        model, meta, table,
+        laplace=conf.get_float("laplace.smoothing", 0.0),
+        predicting_classes=tuple(predicting) if predicting else None,
+        class_cost=tuple(costs) if costs else None,
+        class_prob_diff_threshold=diff_threshold)
+    feature_prob_only = conf.get_bool("output.feature.prob.only", False)
+    with open(out_path, "w") as fh:
+        for i in range(table.n_rows):
+            if feature_prob_only:
+                # itemID, featurePriorProb, (classVal, postProb)*, classAttrVal
+                parts = [table.ids[i], str(pred.feature_prior[i])]
+                for ci, cls in enumerate(table.class_values):
+                    parts += [cls, str(pred.feature_post[i, ci])]
+                if table.labels is not None:
+                    parts.append(table.class_values[int(table.labels[i])])
+            else:
+                parts = [delim.join(rows[i]),
+                         table.class_values[int(pred.predicted[i])],
+                         str(int(pred.prob[i]))]
+                if diff_threshold > 0 and pred.ambiguous is not None:
+                    parts.append(
+                        "ambiguous" if pred.ambiguous[i] else "classified")
+            fh.write(delim.join(parts) + "\n")
+    if conf.get_bool("validation.mode", False) and table.labels is not None:
+        cm = nb.validate(pred, table,
+                         positive_class=conf.get("positive.class.value"))
+        print(cm.report().to_json())
+
+
+VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
+    "BayesianDistribution": run_bayesian_distribution,
+    "BayesianPredictor": run_bayesian_predictor,
+}
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="avenir_tpu",
+        description="TPU-native drivers for avenir jobs")
+    parser.add_argument("verb", choices=sorted(VERBS.keys()))
+    parser.add_argument("input", help="input CSV path")
+    parser.add_argument("output", help="output path")
+    parser.add_argument("--conf", required=True, help="properties file")
+    parser.add_argument("-D", action="append", default=[], metavar="key=val",
+                        help="config overrides")
+    args = parser.parse_args(argv)
+
+    conf = JobConfig.from_file(args.conf)
+    for override in args.D:
+        key, _, value = override.partition("=")
+        conf.set(key, value)
+    VERBS[args.verb](conf, args.input, args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

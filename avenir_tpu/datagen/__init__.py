@@ -1,0 +1,23 @@
+"""Seeded synthetic data generators with planted ground truth.
+
+The reference's entire test strategy (SURVEY.md §4) is generator scripts with
+known structure — resource/usage.rb (churn), resource/elearn.py (student
+outcome planted on activity Gaussians), resource/price_opt.py (concave revenue
+curve with a known peak), resource/lead_gen.py (known CTR per action). These
+are their seeded NumPy equivalents, used as test fixtures and bench inputs.
+"""
+
+from avenir_tpu.datagen.generators import (
+    churn_rows, churn_schema,
+    elearn_rows, elearn_schema,
+    price_opt_arms,
+    markov_sequences,
+    retarget_rows, retarget_schema,
+)
+
+__all__ = [
+    "churn_rows", "churn_schema",
+    "elearn_rows", "elearn_schema",
+    "price_opt_arms", "markov_sequences",
+    "retarget_rows", "retarget_schema",
+]

@@ -1,0 +1,208 @@
+"""Synthetic workload generators (seeded, with planted signal)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from avenir_tpu.utils.schema import FeatureSchema
+
+
+# --------------------------------------------------------------------------
+# churn (Naive Bayes tutorial: resource/churn.json + usage.rb-style data)
+# --------------------------------------------------------------------------
+
+_CHURN_SCHEMA_JSON = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "minUsed", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["low", "med", "high", "overage"], "feature": True},
+        {"name": "dataUsed", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["low", "med", "high"], "feature": True},
+        {"name": "CSCalls", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["low", "med", "high"], "feature": True},
+        {"name": "payment", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["poor", "average", "good"], "feature": True},
+        {"name": "acctAge", "ordinal": 5, "dataType": "categorical",
+         "cardinality": ["1", "2", "3", "4", "5"], "feature": True},
+        {"name": "status", "ordinal": 6, "dataType": "categorical",
+         "cardinality": ["open", "closed"]},
+    ]
+}
+
+
+def churn_schema() -> FeatureSchema:
+    return FeatureSchema.from_json(_CHURN_SCHEMA_JSON)
+
+
+def churn_rows(n: int, seed: int = 42, churn_rate: float = 0.3
+               ) -> List[List[str]]:
+    """Planted signal: churners skew to high CSCalls, poor payment, low
+    acctAge — the structure usage.rb plants for the churn tutorial."""
+    rng = np.random.default_rng(seed)
+    closed = rng.random(n) < churn_rate
+
+    def pick(options, p_open, p_closed):
+        out = np.empty(n, dtype=object)
+        idx_open = rng.choice(len(options), size=n, p=p_open)
+        idx_closed = rng.choice(len(options), size=n, p=p_closed)
+        chosen = np.where(closed, idx_closed, idx_open)
+        for i, opt in enumerate(options):
+            out[chosen == i] = opt
+        return out
+
+    min_used = pick(["low", "med", "high", "overage"],
+                    [0.2, 0.4, 0.3, 0.1], [0.45, 0.3, 0.15, 0.1])
+    data_used = pick(["low", "med", "high"],
+                     [0.25, 0.45, 0.3], [0.5, 0.3, 0.2])
+    cs_calls = pick(["low", "med", "high"],
+                    [0.6, 0.3, 0.1], [0.15, 0.3, 0.55])
+    payment = pick(["poor", "average", "good"],
+                   [0.1, 0.35, 0.55], [0.5, 0.35, 0.15])
+    acct_age = pick(["1", "2", "3", "4", "5"],
+                    [0.1, 0.15, 0.2, 0.25, 0.3], [0.4, 0.25, 0.15, 0.12, 0.08])
+
+    rows = []
+    for i in range(n):
+        rows.append([
+            f"C{i:07d}", str(min_used[i]), str(data_used[i]),
+            str(cs_calls[i]), str(payment[i]), str(acct_age[i]),
+            "closed" if closed[i] else "open",
+        ])
+    return rows
+
+
+# --------------------------------------------------------------------------
+# elearn (KNN tutorial: resource/elearnActivity.json + elearn.py)
+# --------------------------------------------------------------------------
+
+_ELEARN_FIELDS = [
+    ("contentTime", 0, 600), ("discussTime", 0, 200), ("organizerTime", 0, 100),
+    ("emailCount", 0, 28), ("testScore", 0, 100), ("assignmentScore", 0, 100),
+    ("chatMsgCount", 0, 280), ("searchTime", 0, 180), ("bookMarkCount", 0, 26),
+]
+
+
+def elearn_schema() -> FeatureSchema:
+    fields = [{"name": "studentID", "ordinal": 0, "id": True,
+               "dataType": "string"}]
+    for i, (name, lo, hi) in enumerate(_ELEARN_FIELDS):
+        fields.append({"name": name, "ordinal": i + 1, "dataType": "int",
+                       "min": lo, "max": hi})
+    fields.append({"name": "status", "ordinal": len(_ELEARN_FIELDS) + 1,
+                   "dataType": "categorical", "classAttribute": True,
+                   "cardinality": ["pass", "fail"]})
+    return FeatureSchema.from_json({
+        "distAlgorithm": "euclidean",
+        "numericDiffThreshold": 0.2,
+        "entity": {"name": "studentActivity", "fields": fields},
+    })
+
+
+def elearn_rows(n: int, seed: int = 7, fail_rate: float = 0.25
+                ) -> List[List[str]]:
+    """Per-feature Gaussians whose means shift down for failing students —
+    resource/elearn.py's planted structure (mean activity drives outcome)."""
+    rng = np.random.default_rng(seed)
+    fail = rng.random(n) < fail_rate
+    rows = []
+    for i in range(n):
+        scale = 0.45 if fail[i] else 0.75
+        row = [f"S{i:07d}"]
+        for name, lo, hi in _ELEARN_FIELDS:
+            mean = lo + scale * (hi - lo)
+            std = 0.18 * (hi - lo)
+            v = int(np.clip(rng.normal(mean, std), lo, hi))
+            row.append(str(v))
+        row.append("fail" if fail[i] else "pass")
+        rows.append(row)
+    return rows
+
+
+# --------------------------------------------------------------------------
+# price optimization (bandit tutorial: resource/price_opt.py)
+# --------------------------------------------------------------------------
+
+def price_opt_arms(n_groups: int = 100, n_arms_lo: int = 6,
+                   n_arms_hi: int = 12, seed: int = 11
+                   ) -> Dict[str, Tuple[List[str], np.ndarray]]:
+    """Per-product candidate prices with a concave expected-revenue curve and
+    a known peak (resource/price_opt.py:7-27). Returns
+    {group: (arm_names, expected_reward[arm])}."""
+    rng = np.random.default_rng(seed)
+    groups = {}
+    for g in range(n_groups):
+        n_arms = int(rng.integers(n_arms_lo, n_arms_hi + 1))
+        base = rng.uniform(20, 80)
+        prices = np.round(base * (1 + 0.08 * np.arange(n_arms)), 2)
+        peak = rng.integers(0, n_arms)
+        # concave revenue curve peaking at `peak`
+        reward = 100 - 8.0 * (np.arange(n_arms) - peak) ** 2
+        reward = np.maximum(reward, 5.0) + rng.uniform(0, 1, n_arms)
+        groups[f"P{g:04d}"] = ([str(p) for p in prices], reward)
+    return groups
+
+
+# --------------------------------------------------------------------------
+# Markov state sequences (resource/xaction_state.rb / event_seq.rb)
+# --------------------------------------------------------------------------
+
+def markov_sequences(n: int, states: List[str], trans: np.ndarray,
+                     min_len: int = 5, max_len: int = 30, seed: int = 3
+                     ) -> List[Tuple[str, List[str]]]:
+    """Sample (id, state sequence) rows from a known transition matrix, so
+    tests can recover the planted matrix."""
+    rng = np.random.default_rng(seed)
+    n_states = len(states)
+    rows = []
+    for i in range(n):
+        length = int(rng.integers(min_len, max_len + 1))
+        seq = [int(rng.integers(0, n_states))]
+        for _ in range(length - 1):
+            seq.append(int(rng.choice(n_states, p=trans[seq[-1]])))
+        rows.append((f"X{i:06d}", [states[s] for s in seq]))
+    return rows
+
+
+# --------------------------------------------------------------------------
+# retarget (decision-tree tutorial: resource/retarget.py)
+# --------------------------------------------------------------------------
+
+_RETARGET_SCHEMA_JSON = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "cartValue", "ordinal": 1, "dataType": "int",
+         "min": 0, "max": 500, "maxSplit": 4, "feature": True},
+        {"name": "visitCount", "ordinal": 2, "dataType": "int",
+         "min": 0, "max": 40, "maxSplit": 4, "feature": True},
+        {"name": "loyalty", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["bronze", "silver", "gold"], "maxSplit": 3,
+         "feature": True},
+        {"name": "converted", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["yes", "no"]},
+    ]
+}
+
+
+def retarget_schema() -> FeatureSchema:
+    return FeatureSchema.from_json(_RETARGET_SCHEMA_JSON)
+
+
+def retarget_rows(n: int, seed: int = 5) -> List[List[str]]:
+    """Conversion is planted on cartValue > 250 and loyalty == gold, so a
+    depth-2 tree recovers the rule."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        cart = int(rng.integers(0, 501))
+        visits = int(rng.integers(0, 41))
+        loyalty = ["bronze", "silver", "gold"][int(rng.integers(0, 3))]
+        p = 0.15
+        if cart > 250:
+            p += 0.45
+        if loyalty == "gold":
+            p += 0.25
+        converted = "yes" if rng.random() < p else "no"
+        rows.append([f"R{i:06d}", str(cart), str(visits), loyalty, converted])
+    return rows

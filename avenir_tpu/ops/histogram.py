@@ -1,0 +1,104 @@
+"""One-hot / segment histogram reductions — the framework's "shuffle".
+
+Every counting MR job in the reference (Naive Bayes distributions, Markov
+bigrams, split-gain class histograms, mutual-information distributions) is a
+map-side emit of small count keys + a keyed shuffle + reduce-side sum. On TPU
+the same computation is a one-hot encode followed by an einsum contraction
+over the row axis: the contraction maps onto the MXU, and when rows are
+sharded over the ``data`` mesh axis XLA finishes it with a ``psum`` over ICI —
+combiner, shuffle, and reducer in one compiled op.
+
+All functions take an optional per-row ``weights`` vector; padding rows get
+weight 0 so statically-padded batches never contaminate counts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def class_counts(labels: jnp.ndarray, n_classes: int,
+                 weights: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """[N] int labels -> [C] counts (the class-prior reduction)."""
+    oh = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
+    if weights is not None:
+        oh = oh * weights[:, None]
+    return jnp.sum(oh, axis=0)
+
+
+def feature_bin_counts(bins: jnp.ndarray, n_bins: int,
+                       weights: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """[N, F] bin ids -> [F, B] counts (the feature-prior reduction)."""
+    oh = jax.nn.one_hot(bins, n_bins, dtype=jnp.float32)      # [N, F, B]
+    if weights is not None:
+        oh = oh * weights[:, None, None]
+    return jnp.sum(oh, axis=0)
+
+
+def class_feature_bin_counts(bins: jnp.ndarray, labels: jnp.ndarray,
+                             n_classes: int, n_bins: int,
+                             weights: Optional[jnp.ndarray] = None
+                             ) -> jnp.ndarray:
+    """[N, F] bins × [N] labels -> [C, F, B] joint counts.
+
+    This single einsum is the whole BayesianDistribution train job
+    (mapper emit (classVal, ord, bin)→1 at BayesianDistribution.java:166-173 +
+    reducer sum): contraction over N on the MXU, psum across the data axis.
+    """
+    oh_label = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)  # [N, C]
+    oh_bins = jax.nn.one_hot(bins, n_bins, dtype=jnp.float32)        # [N, F, B]
+    if weights is not None:
+        oh_label = oh_label * weights[:, None]
+    return jnp.einsum("nc,nfb->cfb", oh_label, oh_bins)
+
+
+def per_class_moments(values: jnp.ndarray, labels: jnp.ndarray,
+                      n_classes: int,
+                      weights: Optional[jnp.ndarray] = None
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-(class, feature) count / sum / sum-of-squares for continuous
+    features — the Gaussian sufficient statistics the reference accumulates at
+    BayesianDistribution.java:283-285. Returns ([C,F], [C,F], [C,F])."""
+    oh = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)        # [N, C]
+    if weights is not None:
+        oh = oh * weights[:, None]
+    count = jnp.einsum("nc,nf->cf", oh, jnp.ones_like(values))
+    vsum = jnp.einsum("nc,nf->cf", oh, values)
+    vsq = jnp.einsum("nc,nf->cf", oh, values * values)
+    return count, vsum, vsq
+
+
+def pair_counts(a: jnp.ndarray, b: jnp.ndarray, n_a: int, n_b: int,
+                weights: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """[N] × [N] ids -> [n_a, n_b] contingency counts (Cramér, MI pairs,
+    Markov bigrams all reduce to this)."""
+    oh_a = jax.nn.one_hot(a, n_a, dtype=jnp.float32)
+    oh_b = jax.nn.one_hot(b, n_b, dtype=jnp.float32)
+    if weights is not None:
+        oh_a = oh_a * weights[:, None]
+    return jnp.einsum("na,nb->ab", oh_a, oh_b)
+
+
+def transition_counts(sequences: jnp.ndarray, n_states: int,
+                      lengths: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Bigram transition counts over a batch of padded state sequences.
+
+    ``sequences`` is [B, T] int state ids; ``lengths`` [B] marks the valid
+    prefix (a row of the reference's per-line sliding bigram at
+    MarkovStateTransitionModel.java:116-133). Returns [S, S] counts.
+    """
+    src = sequences[:, :-1]
+    dst = sequences[:, 1:]
+    bsz, tm1 = src.shape
+    if lengths is not None:
+        pos = jnp.arange(tm1)[None, :]
+        mask = (pos + 1 < lengths[:, None]).astype(jnp.float32)
+    else:
+        mask = jnp.ones((bsz, tm1), dtype=jnp.float32)
+    oh_src = jax.nn.one_hot(src.reshape(-1), n_states, dtype=jnp.float32)
+    oh_dst = jax.nn.one_hot(dst.reshape(-1), n_states, dtype=jnp.float32)
+    oh_src = oh_src * mask.reshape(-1)[:, None]
+    return jnp.einsum("ns,nt->st", oh_src, oh_dst)

@@ -1,0 +1,11 @@
+"""Device mesh, shardings, and collective helpers."""
+
+from avenir_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    shard_rows,
+    replicate,
+    pad_to_multiple,
+)
+
+__all__ = ["MeshSpec", "make_mesh", "shard_rows", "replicate", "pad_to_multiple"]

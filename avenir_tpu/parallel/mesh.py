@@ -1,0 +1,121 @@
+"""Mesh + sharding helpers: the framework's "cluster".
+
+Where the reference's parallel substrate is HDFS splits → mapper JVMs → a
+keyed sort/shuffle → reducer JVMs (SURVEY.md §2.10), avenir_tpu lays a
+``jax.sharding.Mesh`` over the available chips and expresses the same
+decompositions as shardings:
+
+- map-side row sharding     -> batch dims sharded over the ``data`` axis
+- shuffle + reduce          -> contractions over the sharded axis; XLA inserts
+                               ``psum``/``reduce_scatter`` over ICI
+- side-file broadcast       -> replicated arrays (NamedSharding(P()))
+- model-dim sharding        -> the ``model`` axis for wide bin/class axes
+
+Multi-host: ``initialize_distributed`` wraps ``jax.distributed.initialize``
+so the same code runs on a multi-host pod slice, with DCN used only for the
+input pipeline and checkpoints (the reference's analogue: HDFS I/O).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape; (-1) means "all remaining devices"."""
+
+    axes: Tuple[str, ...] = (DATA_AXIS,)
+    shape: Tuple[int, ...] = (-1,)
+
+    def resolve(self, n_devices: int) -> Tuple[int, ...]:
+        shape = list(self.shape)
+        fixed = 1
+        wild = None
+        for i, s in enumerate(shape):
+            if s == -1:
+                if wild is not None:
+                    raise ValueError("only one -1 axis allowed")
+                wild = i
+            else:
+                fixed *= s
+        if wild is not None:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            shape[wild] = n_devices // fixed
+        elif fixed > n_devices:
+            raise ValueError(
+                f"mesh shape {self.shape} needs {fixed} devices, "
+                f"only {n_devices} available")
+        return tuple(shape)
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    shape = spec.resolve(len(devices))
+    n = int(np.prod(shape))
+    grid = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(grid, spec.axes)
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1,
+                  axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard dim 0 over the data axis, replicate the rest."""
+    spec = [axis] + [None] * (ndim - 1)
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_rows(array: jax.Array, mesh: Mesh,
+               axis: str = DATA_AXIS) -> jax.Array:
+    """Place ``array`` with dim 0 sharded over ``axis`` (rows → devices,
+    the mapper-split analogue). Pads are the caller's job; see
+    :func:`pad_to_multiple`."""
+    return jax.device_put(array, data_sharding(mesh, array.ndim, axis))
+
+
+def replicate(array: jax.Array, mesh: Mesh) -> jax.Array:
+    """Replicate across the mesh (the side-file broadcast analogue)."""
+    return jax.device_put(array, NamedSharding(mesh, P()))
+
+
+def pad_to_multiple(array: np.ndarray, multiple: int,
+                    axis: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad ``array`` along ``axis`` to a multiple; returns (padded, mask).
+
+    The mask is 1.0 for real rows, 0.0 for padding — weight every reduction by
+    it so padding never contaminates counts.
+    """
+    n = array.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    mask = np.zeros((target,), dtype=np.float32)
+    mask[:n] = 1.0
+    if target == n:
+        return array, mask
+    pad_widths = [(0, 0)] * array.ndim
+    pad_widths[axis] = (0, target - n)
+    return np.pad(array, pad_widths, mode="edge"), mask
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (DCN). No-op when single-process."""
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

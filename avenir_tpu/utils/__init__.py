@@ -1,0 +1,1 @@
+"""Core substrate: feature schema, config, dataset encoding, metrics, tables."""

@@ -1,0 +1,255 @@
+"""CSV → dense device arrays.
+
+The reference streams CSV lines through mapper JVMs, re-parsing and re-binning
+every row per job (e.g. BayesianDistribution.java:138-179). Here featurization
+happens once, into dense integer/float arrays that every downstream kernel
+gathers from:
+
+- categorical feature  -> vocabulary index (schema ``cardinality`` list when
+  present, else a vocabulary built from the data; unseen values are either an
+  error or a reserved OOV bin — ``unseen='error'|'oov'``)
+- numeric feature with ``bucketWidth`` -> ``value // bucketWidth`` bin id,
+  matching the reference's binning (BayesianDistribution.java:153)
+- numeric feature without bucket width -> continuous float column (Gaussian
+  path in Naive Bayes; normalized path in the KNN distance kernel)
+
+The encoded table is a plain pytree of jnp arrays (static shapes, padding
+mask) so it can be sharded over the ``data`` mesh axis and consumed inside
+``jit`` without host round-trips.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from avenir_tpu.utils.schema import FeatureField, FeatureSchema
+
+
+def read_csv_lines(path: str, delim_regex: str = ",") -> List[List[str]]:
+    """Read CSV rows, splitting on a regex like the reference's
+    ``field.delim.regex`` (every mapper does ``value.split(fieldDelimRegex)``)."""
+    splitter = re.compile(delim_regex)
+    rows: List[List[str]] = []
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.rstrip("\n")
+            if line:
+                rows.append([t.strip() for t in splitter.split(line)])
+    return rows
+
+
+@dataclass
+class FieldEncoder:
+    """Per-column encoder derived from a :class:`FeatureField` (+ data)."""
+
+    field: FeatureField
+    vocab: Optional[Dict[str, int]] = None      # categorical value -> index
+    n_bins: int = 0                             # discrete bins (0 if continuous)
+    bin_offset: int = 0                         # min-bin shift for bucketed numerics
+    continuous: bool = False
+    oov_index: Optional[int] = None
+
+    def encode(self, token: str) -> Tuple[int, float]:
+        """Return (bin_id, float_value) for one raw CSV token."""
+        f = self.field
+        if f.is_categorical:
+            idx = self.vocab.get(token)
+            if idx is None:
+                if self.oov_index is None:
+                    raise KeyError(
+                        f"unseen categorical value {token!r} for field {f.name}")
+                idx = self.oov_index
+            return idx, float(idx)
+        value = float(token)
+        if self.continuous:
+            return 0, value
+        return int(value // f.bucket_width) - self.bin_offset, value
+
+
+@dataclass
+class EncodedTable:
+    """Dense featurized dataset.
+
+    ``binned``/``numeric`` are [N, F] aligned with ``feature_fields`` order;
+    continuous fields hold 0 in ``binned`` and their raw value in ``numeric``
+    (and vice versa binned fields also record their raw value in ``numeric``
+    when the source token was numeric, else the vocab index).
+    """
+
+    binned: jnp.ndarray            # [N, F] int32 bin ids
+    numeric: jnp.ndarray           # [N, F] float32 raw values
+    labels: Optional[jnp.ndarray]  # [N] int32 class indices (None if no class col)
+    ids: List[str]                 # row ids (host side)
+    feature_fields: List[FeatureField]
+    bins_per_feature: Tuple[int, ...]
+    is_continuous: Tuple[bool, ...]
+    class_values: List[str]        # label vocabulary, index-aligned
+    bin_labels: List[List[str]] = dc_field(default_factory=list)
+    # per feature, the wire-format label of each bin id: the categorical value
+    # string, or the reference's absolute bin number str(id + offset) for
+    # bucketed numerics (empty list for continuous features)
+    n_rows: int = 0
+
+    def __post_init__(self):
+        if not self.n_rows:
+            self.n_rows = int(self.binned.shape[0])
+
+    @property
+    def n_features(self) -> int:
+        return len(self.feature_fields)
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.class_values)
+
+    @property
+    def max_bins(self) -> int:
+        return max(self.bins_per_feature) if self.bins_per_feature else 0
+
+    def label_name(self, index: int) -> str:
+        return self.class_values[index]
+
+
+class Featurizer:
+    """Schema-driven row encoder; fit builds vocabularies, transform encodes."""
+
+    def __init__(self, schema: FeatureSchema, unseen: str = "error"):
+        if unseen not in ("error", "oov"):
+            raise ValueError("unseen must be 'error' or 'oov'")
+        self.schema = schema
+        self.unseen = unseen
+        self.encoders: List[FieldEncoder] = []
+        self.class_values: List[str] = []
+        self._fitted = False
+
+    # -- fitting -------------------------------------------------------------
+    def fit(self, rows: Sequence[Sequence[str]]) -> "Featurizer":
+        feature_fields = self.schema.get_feature_fields()
+        try:
+            class_field = self.schema.find_class_attr_field()
+        except ValueError:
+            class_field = None
+
+        self.encoders = []
+        for f in feature_fields:
+            if f.is_categorical:
+                if f.cardinality is not None:
+                    vocab = {v: i for i, v in enumerate(f.cardinality)}
+                else:
+                    values = sorted({row[f.ordinal] for row in rows})
+                    vocab = {v: i for i, v in enumerate(values)}
+                n_bins = len(vocab)
+                oov = None
+                if self.unseen == "oov":
+                    oov = n_bins
+                    n_bins += 1
+                self.encoders.append(FieldEncoder(
+                    field=f, vocab=vocab, n_bins=n_bins, oov_index=oov))
+            elif f.bucket_width is not None:
+                if f.min is not None and f.max is not None:
+                    lo = int(f.min // f.bucket_width)
+                    hi = int(f.max // f.bucket_width)
+                else:
+                    vals = [float(row[f.ordinal]) for row in rows]
+                    lo = int(min(vals) // f.bucket_width)
+                    hi = int(max(vals) // f.bucket_width)
+                self.encoders.append(FieldEncoder(
+                    field=f, n_bins=hi - lo + 1, bin_offset=lo))
+            else:
+                self.encoders.append(FieldEncoder(field=f, continuous=True))
+
+        if class_field is not None:
+            if class_field.cardinality is not None:
+                self.class_values = list(class_field.cardinality)
+            else:
+                self.class_values = sorted(
+                    {row[class_field.ordinal] for row in rows
+                     if len(row) > class_field.ordinal})
+        self._fitted = True
+        return self
+
+    # -- encoding ------------------------------------------------------------
+    def transform(self, rows: Sequence[Sequence[str]],
+                  with_labels: bool = True) -> EncodedTable:
+        if not self._fitted:
+            raise RuntimeError("call fit() (or fit_transform) first")
+        n = len(rows)
+        nf = len(self.encoders)
+        binned = np.zeros((n, nf), dtype=np.int32)
+        numeric = np.zeros((n, nf), dtype=np.float32)
+
+        id_field = self.schema.find_id_field()
+        try:
+            class_field = self.schema.find_class_attr_field()
+        except ValueError:
+            class_field = None
+
+        ids: List[str] = []
+        labels = np.zeros((n,), dtype=np.int32) if (
+            with_labels and class_field is not None) else None
+        class_index = {v: i for i, v in enumerate(self.class_values)}
+
+        for r, row in enumerate(rows):
+            ids.append(row[id_field.ordinal] if id_field is not None else str(r))
+            for c, enc in enumerate(self.encoders):
+                b, v = enc.encode(row[enc.field.ordinal])
+                binned[r, c] = b
+                numeric[r, c] = v
+            if labels is not None:
+                token = row[class_field.ordinal]
+                if token not in class_index:
+                    raise KeyError(f"unseen class value {token!r}")
+                labels[r] = class_index[token]
+
+        return EncodedTable(
+            binned=jnp.asarray(binned),
+            numeric=jnp.asarray(numeric),
+            labels=jnp.asarray(labels) if labels is not None else None,
+            ids=ids,
+            feature_fields=[e.field for e in self.encoders],
+            bins_per_feature=tuple(e.n_bins for e in self.encoders),
+            is_continuous=tuple(e.continuous for e in self.encoders),
+            class_values=list(self.class_values),
+            bin_labels=[self._bin_labels(e) for e in self.encoders],
+        )
+
+    @staticmethod
+    def _bin_labels(enc: FieldEncoder) -> List[str]:
+        if enc.continuous:
+            return []
+        if enc.field.is_categorical:
+            labels = [""] * enc.n_bins
+            for value, idx in enc.vocab.items():
+                labels[idx] = value
+            if enc.oov_index is not None:
+                labels[enc.oov_index] = "__OOV__"
+            return labels
+        return [str(b + enc.bin_offset) for b in range(enc.n_bins)]
+
+    def fit_transform(self, rows: Sequence[Sequence[str]],
+                      with_labels: bool = True) -> EncodedTable:
+        return self.fit(rows).transform(rows, with_labels=with_labels)
+
+
+def normalize_numeric(table: EncodedTable) -> jnp.ndarray:
+    """Range-normalize numeric features to [0, 1] using schema min/max (falling
+    back to data min/max). This is the scaling the external sifarish distance
+    job applies before computing euclidean distance (knn.sh:44-47 contract)."""
+    mins, maxs = [], []
+    data_min = np.asarray(jnp.min(table.numeric, axis=0))
+    data_max = np.asarray(jnp.max(table.numeric, axis=0))
+    for i, f in enumerate(table.feature_fields):
+        lo = f.min if f.min is not None else float(data_min[i])
+        hi = f.max if f.max is not None else float(data_max[i])
+        if hi <= lo:
+            hi = lo + 1.0
+        mins.append(lo)
+        maxs.append(hi)
+    mins_a = jnp.asarray(mins, dtype=jnp.float32)
+    span = jnp.asarray(maxs, dtype=jnp.float32) - mins_a
+    return (table.numeric - mins_a) / span

@@ -1,0 +1,129 @@
+"""Metrics: the counter/validation system.
+
+The reference's metrics are Hadoop counters — semantic names like
+``("Validation","TruePositive")`` (NearestNeighbor.java:300-312) and record
+counts — plus a ``validation.mode`` flag that keeps ground truth flowing so a
+confusion matrix can be accumulated (BayesianPredictor.java:170-180).
+
+Here each job returns a :class:`MetricsRegistry` (dict of named numbers) and
+classification jobs fill a vectorized :class:`ConfusionMatrix`. Counters are
+computed from device arrays *after* the jitted step returns, so nothing breaks
+tracing.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class MetricsRegistry:
+    """Named counters, grouped like Hadoop counter groups."""
+
+    def __init__(self):
+        self._counters: Dict[str, float] = {}
+
+    def incr(self, group: str, name: str, amount: float = 1) -> None:
+        key = f"{group}.{name}"
+        self._counters[key] = self._counters.get(key, 0) + float(amount)
+
+    def set(self, group: str, name: str, value: float) -> None:
+        self._counters[f"{group}.{name}"] = float(value)
+
+    def get(self, group: str, name: str) -> float:
+        return self._counters.get(f"{group}.{name}", 0.0)
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self._counters)
+
+    def to_json(self) -> str:
+        return json.dumps(self._counters, sort_keys=True)
+
+    def __repr__(self) -> str:
+        return f"MetricsRegistry({self._counters})"
+
+
+class ConfusionMatrix:
+    """Multi-class confusion matrix with the reference's validation counters.
+
+    For the binary case, ``positive_class`` selects which label maps to
+    TP/FP/TN/FN exactly as the reference's per-record counter increments do.
+    """
+
+    def __init__(self, class_values: Sequence[str],
+                 positive_class: Optional[str] = None):
+        self.class_values: List[str] = list(class_values)
+        self.positive_class = positive_class
+        n = len(self.class_values)
+        self.matrix = np.zeros((n, n), dtype=np.int64)  # [truth, predicted]
+
+    def update(self, predicted: jnp.ndarray, truth: jnp.ndarray) -> None:
+        """Accumulate from index arrays (one histogram op, no per-row loop)."""
+        n = len(self.class_values)
+        pred = np.asarray(predicted).astype(np.int64).ravel()
+        true = np.asarray(truth).astype(np.int64).ravel()
+        flat = np.bincount(true * n + pred, minlength=n * n)
+        self.matrix += flat.reshape(n, n)
+
+    # -- derived metrics -----------------------------------------------------
+    @property
+    def total(self) -> int:
+        return int(self.matrix.sum())
+
+    @property
+    def accuracy(self) -> float:
+        t = self.total
+        return float(np.trace(self.matrix)) / t if t else 0.0
+
+    def _pos_index(self) -> int:
+        if self.positive_class is None:
+            raise ValueError("positive_class not set")
+        return self.class_values.index(self.positive_class)
+
+    @property
+    def true_positive(self) -> int:
+        p = self._pos_index()
+        return int(self.matrix[p, p])
+
+    @property
+    def false_positive(self) -> int:
+        p = self._pos_index()
+        return int(self.matrix[:, p].sum() - self.matrix[p, p])
+
+    @property
+    def false_negative(self) -> int:
+        p = self._pos_index()
+        return int(self.matrix[p, :].sum() - self.matrix[p, p])
+
+    @property
+    def true_negative(self) -> int:
+        p = self._pos_index()
+        return int(self.total - self.matrix[p, :].sum()
+                   - self.matrix[:, p].sum() + self.matrix[p, p])
+
+    @property
+    def precision(self) -> float:
+        denom = self.true_positive + self.false_positive
+        return self.true_positive / denom if denom else 0.0
+
+    @property
+    def recall(self) -> float:
+        denom = self.true_positive + self.false_negative
+        return self.true_positive / denom if denom else 0.0
+
+    def report(self, metrics: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+        """Fill a registry with the reference's Validation counter names."""
+        metrics = metrics or MetricsRegistry()
+        metrics.set("Validation", "Total", self.total)
+        metrics.set("Validation", "Accuracy", self.accuracy)
+        if self.positive_class is not None:
+            metrics.set("Validation", "TruePositive", self.true_positive)
+            metrics.set("Validation", "FalsePositive", self.false_positive)
+            metrics.set("Validation", "TrueNegative", self.true_negative)
+            metrics.set("Validation", "FalseNegative", self.false_negative)
+            metrics.set("Validation", "Precision", self.precision)
+            metrics.set("Validation", "Recall", self.recall)
+        return metrics

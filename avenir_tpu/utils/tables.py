@@ -1,0 +1,87 @@
+"""Labeled matrices with text (de)serialization.
+
+Re-provides the chombo ``TabularData`` / ``DoubleTable`` surface that the
+reference's Markov/HMM/correlation models build on (StateTransitionProbability
+.java:28, MarkovModel.java:32, ContingencyMatrix.java:28): a 2-D array with
+row/column string labels, serialized one row per CSV line so the matrix can be
+written into / parsed out of a model text file.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class LabeledMatrix:
+    """Row/column-labeled dense matrix (host side; device ops take ``.values``)."""
+
+    def __init__(self, row_labels: Sequence[str], col_labels: Sequence[str],
+                 values: Optional[np.ndarray] = None, dtype=np.float64):
+        self.row_labels = list(row_labels)
+        self.col_labels = list(col_labels)
+        if values is None:
+            values = np.zeros((len(self.row_labels), len(self.col_labels)),
+                              dtype=dtype)
+        self.values = np.asarray(values, dtype=dtype)
+        if self.values.shape != (len(self.row_labels), len(self.col_labels)):
+            raise ValueError("values shape does not match labels")
+
+    # -- element access by label --------------------------------------------
+    def row_index(self, label: str) -> int:
+        return self.row_labels.index(label)
+
+    def col_index(self, label: str) -> int:
+        return self.col_labels.index(label)
+
+    def get(self, row: str, col: str) -> float:
+        return float(self.values[self.row_index(row), self.col_index(col)])
+
+    def add(self, row: str, col: str, amount: float = 1) -> None:
+        self.values[self.row_index(row), self.col_index(col)] += amount
+
+    # -- transforms ----------------------------------------------------------
+    def laplace_correct(self, pseudo_count: float = 1.0) -> "LabeledMatrix":
+        """Add pseudo-count to any all-zero row (the reference's correction in
+        StateTransitionProbability.java:65-95 guards rows never observed)."""
+        zero_rows = self.values.sum(axis=1) == 0
+        self.values[zero_rows, :] += pseudo_count
+        return self
+
+    def row_normalize(self, scale: Optional[int] = None) -> "LabeledMatrix":
+        """Normalize each row to sum 1 (or to ``scale`` as rounded ints, the
+        reference's scaled-int probability wire format, e.g.
+        ``trans.prob.scale=100``)."""
+        sums = self.values.sum(axis=1, keepdims=True)
+        sums[sums == 0] = 1.0
+        probs = self.values / sums
+        if scale is not None:
+            self.values = np.rint(probs * scale)
+        else:
+            self.values = probs
+        return self
+
+    # -- serialization (one CSV line per row) --------------------------------
+    def serialize_rows(self, delim: str = ",", as_int: bool = False) -> List[str]:
+        lines = []
+        for r in range(self.values.shape[0]):
+            vals = self.values[r]
+            if as_int:
+                lines.append(delim.join(str(int(round(v))) for v in vals))
+            else:
+                lines.append(delim.join(format(v, "g") for v in vals))
+        return lines
+
+    def deserialize_row(self, row_label: str, line: str,
+                        delim: str = ",") -> None:
+        tokens = [t for t in line.split(delim) if t != ""]
+        self.values[self.row_index(row_label), :] = [float(t) for t in tokens]
+
+    @staticmethod
+    def from_lines(row_labels: Sequence[str], col_labels: Sequence[str],
+                   lines: Sequence[str], delim: str = ",") -> "LabeledMatrix":
+        m = LabeledMatrix(row_labels, col_labels)
+        for label, line in zip(row_labels, lines):
+            m.deserialize_row(label, line, delim)
+        return m

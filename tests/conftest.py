@@ -1,0 +1,37 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+
+The analogue of the reference's pseudo-distributed single-host Hadoop testing
+(SURVEY.md §4): multi-"chip" semantics without hardware. The real-TPU bench
+path does not import this.
+"""
+
+import os
+
+# Force CPU regardless of any inherited JAX_PLATFORMS (the live session may
+# point at a real TPU; tests must run on the virtual 8-device mesh). The
+# environment's sitecustomize pre-imports jax, so besides the env vars we must
+# also update the already-loaded config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh(devices):
+    from avenir_tpu.parallel import make_mesh
+    return make_mesh()

@@ -1,0 +1,198 @@
+"""Core substrate tests: schema, config, featurizer, metrics, tables, mesh."""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from avenir_tpu.utils.schema import FeatureSchema
+from avenir_tpu.utils.config import JobConfig, parse_properties
+from avenir_tpu.utils.dataset import Featurizer, normalize_numeric
+from avenir_tpu.utils.metrics import ConfusionMatrix, MetricsRegistry
+from avenir_tpu.utils.tables import LabeledMatrix
+from avenir_tpu.parallel import make_mesh, shard_rows, pad_to_multiple, MeshSpec
+
+
+CHURN_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "minUsed", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["low", "med", "high", "overage"], "feature": True},
+        {"name": "dataUsed", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["low", "med", "high"], "feature": True},
+        {"name": "income", "ordinal": 3, "dataType": "int",
+         "min": 0, "max": 100, "bucketWidth": 10, "feature": True},
+        {"name": "age", "ordinal": 4, "dataType": "int", "feature": True},
+        {"name": "status", "ordinal": 5, "dataType": "categorical",
+         "cardinality": ["open", "closed"]},
+    ]
+}
+
+ENTITY_SCHEMA = {
+    "distAlgorithm": "euclidean",
+    "numericDiffThreshold": 0.2,
+    "entity": {
+        "name": "studentActivity",
+        "fields": [
+            {"name": "studentID", "ordinal": 0, "id": True, "dataType": "string"},
+            {"name": "contentTime", "ordinal": 1, "dataType": "int",
+             "min": 0, "max": 600},
+            {"name": "status", "ordinal": 2, "dataType": "categorical",
+             "classAttribute": True},
+        ],
+    },
+}
+
+
+class TestSchema:
+    def test_flat_schema(self):
+        s = FeatureSchema.from_json(CHURN_SCHEMA)
+        assert [f.name for f in s.get_feature_fields()] == [
+            "minUsed", "dataUsed", "income", "age"]
+        cls = s.find_class_attr_field()  # implicit: non-feature categorical
+        assert cls.name == "status"
+        assert s.find_id_field().name == "id"
+        assert s.find_field_by_ordinal(1).cardinality_index("high") == 2
+        assert s.find_field_by_ordinal(1).num_bins() == 4
+        assert s.find_field_by_ordinal(3).num_bins() == 11
+        assert s.find_field_by_ordinal(3).is_binned
+        assert not s.find_field_by_ordinal(4).is_binned
+
+    def test_entity_schema(self):
+        s = FeatureSchema.from_json(ENTITY_SCHEMA)
+        assert s.entity_name == "studentActivity"
+        assert s.dist_algorithm == "euclidean"
+        assert s.find_class_attr_field().name == "status"
+        # no explicit feature flags -> all non-id non-class typed fields
+        assert [f.name for f in s.get_feature_fields()] == ["contentTime"]
+
+
+class TestConfig:
+    def test_parse_properties(self):
+        props = parse_properties(
+            "# comment\nfield.delim=,\nnum.reducer=1\nnum.reducer=3\n"
+            "kernel.function=gaussian\nflag.on=true\nweights=0.1,0.9\n")
+        assert props["num.reducer"] == "3"  # last wins
+        conf = JobConfig(props)
+        assert conf.get_int("num.reducer") == 3
+        assert conf.get("kernel.function") == "gaussian"
+        assert conf.get_bool("flag.on")
+        assert conf.get_float_list("weights") == [0.1, 0.9]
+        assert conf.get_int("missing", 7) == 7
+        with pytest.raises(KeyError):
+            conf.get_required("missing")
+
+    def test_real_reference_properties_file(self):
+        conf = JobConfig.from_file("/root/reference/resource/knn.properties")
+        assert conf.get("field.delim.regex") == ","
+        assert conf.get_int("top.match.count") == 5
+        assert conf.get_int("distance.scale") == 1000
+        assert conf.get_bool("class.condtion.weighted")
+
+
+class TestFeaturizer:
+    ROWS = [
+        ["u1", "low", "med", "35", "22", "open"],
+        ["u2", "overage", "high", "99", "67", "closed"],
+        ["u3", "med", "low", "0", "45", "open"],
+    ]
+
+    def test_encoding(self):
+        s = FeatureSchema.from_json(CHURN_SCHEMA)
+        table = Featurizer(s).fit_transform(self.ROWS)
+        assert table.n_rows == 3 and table.n_features == 4
+        assert table.bins_per_feature == (4, 3, 11, 0)
+        assert table.is_continuous == (False, False, False, True)
+        np.testing.assert_array_equal(
+            np.asarray(table.binned[:, 0]), [0, 3, 1])       # vocab index
+        np.testing.assert_array_equal(
+            np.asarray(table.binned[:, 2]), [3, 9, 0])       # value // 10
+        np.testing.assert_allclose(
+            np.asarray(table.numeric[:, 3]), [22.0, 67.0, 45.0])
+        np.testing.assert_array_equal(np.asarray(table.labels), [0, 1, 0])
+        assert table.ids == ["u1", "u2", "u3"]
+        assert table.class_values == ["open", "closed"]
+
+    def test_unseen_categorical(self):
+        s = FeatureSchema.from_json(CHURN_SCHEMA)
+        fz = Featurizer(s).fit(self.ROWS)
+        bad = [["u4", "mystery", "med", "1", "1", "open"]]
+        with pytest.raises(KeyError):
+            fz.transform(bad)
+        fz_oov = Featurizer(s, unseen="oov").fit(self.ROWS)
+        t = fz_oov.transform(bad)
+        assert int(t.binned[0, 0]) == 4  # reserved OOV bin
+        assert t.bins_per_feature[0] == 5
+
+    def test_normalize_numeric(self):
+        s = FeatureSchema.from_json(CHURN_SCHEMA)
+        table = Featurizer(s).fit_transform(self.ROWS)
+        norm = normalize_numeric(table)
+        col = np.asarray(norm[:, 2])  # income has schema min=0 max=100
+        np.testing.assert_allclose(col, [0.35, 0.99, 0.0], atol=1e-6)
+
+
+class TestMetrics:
+    def test_confusion(self):
+        cm = ConfusionMatrix(["open", "closed"], positive_class="closed")
+        #                 pred          truth
+        cm.update(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 0, 1]))
+        assert cm.true_positive == 2
+        assert cm.false_positive == 1
+        assert cm.true_negative == 1
+        assert cm.false_negative == 0
+        assert cm.accuracy == pytest.approx(0.75)
+        assert cm.precision == pytest.approx(2 / 3)
+        assert cm.recall == pytest.approx(1.0)
+        reg = cm.report()
+        assert reg.get("Validation", "TruePositive") == 2
+
+    def test_registry(self):
+        m = MetricsRegistry()
+        m.incr("Distribution Data", "Class prior")
+        m.incr("Distribution Data", "Class prior", 2)
+        assert m.get("Distribution Data", "Class prior") == 3
+        assert json.loads(m.to_json())
+
+
+class TestTables:
+    def test_roundtrip_and_normalize(self):
+        m = LabeledMatrix(["A", "B"], ["A", "B"])
+        m.add("A", "B", 3)
+        m.add("A", "A", 1)
+        m.laplace_correct(1.0)          # row B is all zero
+        assert m.get("B", "A") == 1.0
+        m.row_normalize(scale=100)
+        assert m.get("A", "B") == 75.0
+        lines = m.serialize_rows(as_int=True)
+        m2 = LabeledMatrix.from_lines(["A", "B"], ["A", "B"], lines)
+        np.testing.assert_allclose(m2.values, m.values)
+
+
+class TestMesh:
+    def test_shard_rows(self, mesh):
+        x = jnp.arange(32.0).reshape(16, 2)
+        xs = shard_rows(x, mesh)
+        assert xs.sharding.is_equivalent_to(
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("data", None)), 2)
+        # a sharded contraction still gives the right answer
+        assert float(jnp.sum(xs)) == float(jnp.sum(x))
+
+    def test_pad_to_multiple(self):
+        arr = np.arange(10).reshape(5, 2)
+        padded, mask = pad_to_multiple(arr, 8)
+        assert padded.shape == (8, 2)
+        assert mask.sum() == 5
+
+    def test_mesh_spec_resolve(self):
+        assert MeshSpec(("data", "model"), (-1, 2)).resolve(8) == (4, 2)
+        assert MeshSpec(("data",), (3,)).resolve(8) == (3,)  # device subset ok
+        with pytest.raises(ValueError):
+            MeshSpec(("data",), (16,)).resolve(8)
+        with pytest.raises(ValueError):
+            MeshSpec(("data", "model"), (-1, 3)).resolve(8)
+        m = make_mesh(MeshSpec(("data", "model"), (4, 2)))
+        assert m.shape == {"data": 4, "model": 2}

@@ -1,0 +1,197 @@
+"""Naive Bayes: hand-computed values, E2E churn accuracy, wire round-trip,
+sharded == unsharded."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from avenir_tpu.datagen import churn_rows, churn_schema
+from avenir_tpu.models import naive_bayes as nb
+from avenir_tpu.parallel import shard_rows, pad_to_multiple
+from avenir_tpu.utils.dataset import Featurizer
+from avenir_tpu.utils.schema import FeatureSchema
+
+
+TINY_SCHEMA = FeatureSchema.from_json({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "color", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["red", "blue"], "feature": True},
+        {"name": "size", "ordinal": 2, "dataType": "double", "feature": True},
+        {"name": "label", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["no", "yes"]},
+    ]
+})
+
+TINY_ROWS = [
+    ["a", "red", "1.0", "yes"],
+    ["b", "red", "2.0", "yes"],
+    ["c", "blue", "3.0", "yes"],
+    ["d", "blue", "4.0", "no"],
+    ["e", "blue", "6.0", "no"],
+]
+
+
+class TestTrainCounts:
+    def test_hand_computed(self):
+        table = Featurizer(TINY_SCHEMA).fit_transform(TINY_ROWS)
+        model, meta, metrics = nb.train(table)
+        # class counts: no=2, yes=3 (class_values order from cardinality)
+        np.testing.assert_allclose(np.asarray(model.class_counts), [2, 3])
+        # P(red|yes) count = 2, P(blue|yes) = 1, P(blue|no) = 2
+        post = np.asarray(model.post_counts)
+        yes, no = 1, 0
+        assert post[yes, 0, 0] == 2 and post[yes, 0, 1] == 1
+        assert post[no, 0, 0] == 0 and post[no, 0, 1] == 2
+        # continuous moments for size: yes -> (3, 6, 14), no -> (2, 10, 52)
+        assert float(model.cont_count[yes, 0]) == 3
+        assert float(model.cont_sum[yes, 0]) == 6
+        assert float(model.cont_sumsq[yes, 0]) == 14
+        assert float(model.cont_sum[no, 0]) == 10
+        assert metrics.get("Distribution Data", "Records") == 5
+
+    def test_bayes_rule_prediction(self):
+        table = Featurizer(TINY_SCHEMA).fit_transform(TINY_ROWS)
+        model, meta, _ = nb.train(table)
+        pred = nb.predict(model, meta, table)
+        # red + small size is firmly "yes"
+        assert pred.predicted[0] == 1
+        # the int-percent posterior follows BayesianPredictor.java:416
+        # P(yes|red,1.0) via counts: post=2/3 * N(1; mean=2,std) ...
+        assert pred.class_percent.shape == (5, 2)
+
+    def test_weighted_padding_rows_ignored(self):
+        table = Featurizer(TINY_SCHEMA).fit_transform(TINY_ROWS)
+        binned, mask = pad_to_multiple(np.asarray(table.binned), 8)
+        numeric, _ = pad_to_multiple(np.asarray(table.numeric), 8)
+        labels, _ = pad_to_multiple(np.asarray(table.labels), 8)
+        padded = type(table)(
+            binned=jnp.asarray(binned), numeric=jnp.asarray(numeric),
+            labels=jnp.asarray(labels), ids=table.ids + ["pad"] * 3,
+            feature_fields=table.feature_fields,
+            bins_per_feature=table.bins_per_feature,
+            is_continuous=table.is_continuous,
+            class_values=table.class_values, bin_labels=table.bin_labels)
+        model_p, _, _ = nb.train(padded, weights=jnp.asarray(mask))
+        model, _, _ = nb.train(table)
+        np.testing.assert_allclose(np.asarray(model_p.class_counts),
+                                   np.asarray(model.class_counts))
+        np.testing.assert_allclose(np.asarray(model_p.post_counts),
+                                   np.asarray(model.post_counts))
+
+
+class TestChurnEndToEnd:
+    @pytest.fixture(scope="class")
+    def split(self):
+        rows = churn_rows(4000, seed=42)
+        fz = Featurizer(churn_schema())
+        train_t = fz.fit_transform(rows[:3000])
+        test_t = fz.transform(rows[3000:])
+        return train_t, test_t
+
+    def test_recovers_planted_signal(self, split):
+        train_t, test_t = split
+        model, meta, _ = nb.train(train_t)
+        pred = nb.predict(model, meta, test_t, laplace=1.0)
+        cm = nb.validate(pred, test_t, positive_class="closed")
+        assert cm.accuracy > 0.75, f"accuracy {cm.accuracy}"
+        assert cm.recall > 0.5
+
+    def test_sharded_matches_unsharded(self, split, mesh):
+        train_t, _ = split
+        model, _, _ = nb.train(train_t)
+        sharded = type(train_t)(
+            binned=shard_rows(train_t.binned, mesh),
+            numeric=shard_rows(train_t.numeric, mesh),
+            labels=shard_rows(train_t.labels, mesh),
+            ids=train_t.ids, feature_fields=train_t.feature_fields,
+            bins_per_feature=train_t.bins_per_feature,
+            is_continuous=train_t.is_continuous,
+            class_values=train_t.class_values, bin_labels=train_t.bin_labels)
+        model_s, _, _ = nb.train(sharded)
+        np.testing.assert_allclose(np.asarray(model_s.post_counts),
+                                   np.asarray(model.post_counts), rtol=1e-5)
+
+    def test_cost_based_arbitration(self, split):
+        train_t, test_t = split
+        model, meta, _ = nb.train(train_t)
+        # heavy false-negative cost must not reduce churner recall
+        pred_default = nb.predict(model, meta, test_t, laplace=1.0)
+        pred_cost = nb.predict(model, meta, test_t, laplace=1.0,
+                               predicting_classes=("open", "closed"),
+                               class_cost=(5, 1))
+        cm_d = nb.validate(pred_default, test_t, positive_class="closed")
+        cm_c = nb.validate(pred_cost, test_t, positive_class="closed")
+        assert cm_c.recall >= cm_d.recall
+
+    def test_cost_arbitration_uses_class_names(self, split):
+        # with (fnc=5, fpc=1) the reference formula picks the positive class
+        # whenever its prob is nonzero: posCost-negCost = -4*posProb. Naming
+        # either class as positive must therefore select exactly the rows
+        # where that class has nonzero percent — proving name lookup, not
+        # fixed indices.
+        train_t, test_t = split
+        model, meta, _ = nb.train(train_t)
+        closed_i = meta.class_values.index("closed")
+        open_i = meta.class_values.index("open")
+        p1 = nb.predict(model, meta, test_t, laplace=1.0,
+                        predicting_classes=("open", "closed"),
+                        class_cost=(5, 1))
+        np.testing.assert_array_equal(
+            p1.predicted == closed_i, p1.class_percent[:, closed_i] > 0)
+        p2 = nb.predict(model, meta, test_t, laplace=1.0,
+                        predicting_classes=("closed", "open"),
+                        class_cost=(5, 1))
+        np.testing.assert_array_equal(
+            p2.predicted == open_i, p2.class_percent[:, open_i] > 0)
+
+    def test_out_of_range_bin_scores_zero(self, split):
+        # a bin id outside the trained range must behave like a never-seen
+        # bin (zero counts), not wrap around to another bin's counts
+        train_t, _ = split
+        model, meta, _ = nb.train(train_t)
+        t = train_t
+        bad = type(t)(
+            binned=t.binned.at[0, 0].set(99),
+            numeric=t.numeric, labels=t.labels, ids=t.ids,
+            feature_fields=t.feature_fields,
+            bins_per_feature=t.bins_per_feature,
+            is_continuous=t.is_continuous, class_values=t.class_values,
+            bin_labels=t.bin_labels)
+        pred = nb.predict(model, meta, bad)   # no smoothing
+        assert (pred.class_percent[0] == 0).all()
+
+
+class TestWireFormat:
+    def test_round_trip(self, tmp_path):
+        table = Featurizer(TINY_SCHEMA).fit_transform(TINY_ROWS)
+        model, meta, _ = nb.train(table)
+        path = str(tmp_path / "bayes_model.txt")
+        nb.save_model(model, meta, path)
+
+        lines = open(path).read().splitlines()
+        # tagged-union line shapes (BayesianPredictor.loadModel :186-224)
+        assert any(l.startswith("yes,,,") for l in lines)      # class prior
+        assert any(l.startswith(",1,red,") for l in lines)     # feature prior
+        assert any(l.startswith("yes,1,red,") for l in lines)  # posterior
+        assert any(l.startswith("yes,2,,") for l in lines)     # cont posterior
+
+        loaded = nb.load_model(path, meta)
+        np.testing.assert_allclose(np.asarray(loaded.class_counts),
+                                   np.asarray(model.class_counts))
+        np.testing.assert_allclose(np.asarray(loaded.post_counts),
+                                   np.asarray(model.post_counts))
+        np.testing.assert_allclose(np.asarray(loaded.prior_counts),
+                                   np.asarray(model.prior_counts))
+
+    def test_loaded_model_predicts(self, tmp_path):
+        rows = churn_rows(1000, seed=1)
+        fz = Featurizer(churn_schema())
+        table = fz.fit_transform(rows)
+        model, meta, _ = nb.train(table)
+        path = str(tmp_path / "m.txt")
+        nb.save_model(model, meta, path)
+        loaded = nb.load_model(path, meta)
+        p1 = nb.predict(model, meta, table, laplace=1.0)
+        p2 = nb.predict(loaded, meta, table, laplace=1.0)
+        assert (p1.predicted == p2.predicted).mean() > 0.99

@@ -1,0 +1,97 @@
+"""ops layer: histograms and info-theory stats vs hand-computed values."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from avenir_tpu.ops import histogram as H
+from avenir_tpu.ops import infotheory as it
+
+
+class TestHistogram:
+    def test_class_counts(self):
+        out = H.class_counts(jnp.asarray([0, 1, 1, 2]), 3)
+        np.testing.assert_allclose(np.asarray(out), [1, 2, 1])
+
+    def test_class_feature_bin_counts(self):
+        bins = jnp.asarray([[0, 1], [1, 1], [0, 0]])
+        labels = jnp.asarray([0, 1, 0])
+        out = np.asarray(H.class_feature_bin_counts(bins, labels, 2, 2))
+        # class 0 rows: bins (0,1),(0,0) -> feature0 bin0 x2; feature1 bin1,bin0
+        assert out[0, 0, 0] == 2 and out[0, 1, 1] == 1 and out[0, 1, 0] == 1
+        assert out[1, 0, 1] == 1 and out[1, 1, 1] == 1
+        assert out.sum() == 6  # 3 rows x 2 features
+
+    def test_weights_mask_padding(self):
+        bins = jnp.asarray([[0], [1], [1]])
+        labels = jnp.asarray([0, 0, 0])
+        w = jnp.asarray([1.0, 1.0, 0.0])
+        out = np.asarray(H.class_feature_bin_counts(bins, labels, 1, 2, w))
+        np.testing.assert_allclose(out[0, 0], [1, 1])
+
+    def test_per_class_moments(self):
+        vals = jnp.asarray([[1.0], [2.0], [4.0]])
+        labels = jnp.asarray([0, 0, 1])
+        cnt, s, sq = H.per_class_moments(vals, labels, 2)
+        assert float(cnt[0, 0]) == 2 and float(s[0, 0]) == 3
+        assert float(sq[0, 0]) == 5 and float(sq[1, 0]) == 16
+
+    def test_pair_counts(self):
+        out = H.pair_counts(jnp.asarray([0, 0, 1]), jnp.asarray([1, 1, 0]), 2, 2)
+        np.testing.assert_allclose(np.asarray(out), [[0, 2], [1, 0]])
+
+    def test_transition_counts_with_lengths(self):
+        seqs = jnp.asarray([[0, 1, 1, 0], [1, 0, 0, 0]])
+        lengths = jnp.asarray([4, 2])  # second row: only 1->0 is valid
+        out = np.asarray(H.transition_counts(seqs, 2, lengths))
+        # row0 bigrams: 01,11,10 ; row1: 10
+        np.testing.assert_allclose(out, [[0, 1], [2, 1]])
+
+
+class TestInfoTheory:
+    def test_entropy_uniform(self):
+        assert float(it.entropy(jnp.asarray([5.0, 5.0]))) == pytest.approx(1.0)
+        assert float(it.entropy(jnp.asarray([4.0, 0.0]))) == pytest.approx(0.0)
+
+    def test_gini(self):
+        assert float(it.gini(jnp.asarray([5.0, 5.0]))) == pytest.approx(0.5)
+        assert float(it.gini(jnp.asarray([4.0, 0.0]))) == pytest.approx(0.0)
+
+    def test_split_info_content_weighted_average(self):
+        # two segments: (4,0) pure -> 0 bits, (2,2) -> 1 bit; weights 4 and 4
+        counts = jnp.asarray([[4.0, 0.0], [2.0, 2.0]])
+        assert float(it.split_info_content(counts, "entropy")) == \
+            pytest.approx(0.5)
+
+    def test_intrinsic_info(self):
+        counts = jnp.asarray([[4.0, 0.0], [2.0, 2.0]])
+        assert float(it.intrinsic_info_content(counts)) == pytest.approx(1.0)
+
+    def test_hellinger(self):
+        # perfectly separating split: class0 all in seg0, class1 all in seg1
+        counts = jnp.asarray([[6.0, 0.0], [0.0, 3.0]])
+        assert float(it.hellinger_distance(counts)) == pytest.approx(
+            np.sqrt(2.0))
+        # identical distributions -> 0
+        counts = jnp.asarray([[3.0, 3.0], [3.0, 3.0]])
+        assert float(it.hellinger_distance(counts)) == pytest.approx(0.0)
+
+    def test_class_confidence_ratio_pure_split(self):
+        counts = jnp.asarray([[6.0, 0.0], [0.0, 3.0]])
+        assert float(it.class_confidence_ratio(counts)) == pytest.approx(0.0)
+
+    def test_mutual_information(self):
+        # independent -> 0
+        joint = jnp.asarray([[1.0, 1.0], [1.0, 1.0]])
+        assert float(it.mutual_information(joint)) == pytest.approx(0.0)
+        # perfectly dependent -> 1 bit
+        joint = jnp.asarray([[2.0, 0.0], [0.0, 2.0]])
+        assert float(it.mutual_information(joint)) == pytest.approx(1.0)
+
+    def test_split_stat_dispatch(self):
+        counts = jnp.asarray([[4.0, 0.0], [2.0, 2.0]])
+        for algo in it.SPLIT_ALGORITHMS:
+            v = float(it.split_stat(counts, algo))
+            assert np.isfinite(v)
+        with pytest.raises(ValueError):
+            it.split_stat(counts, "bogus")
