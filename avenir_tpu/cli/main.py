@@ -120,9 +120,122 @@ def run_bayesian_predictor(conf: JobConfig, in_path: str, out_path: str) -> None
         print(cm.report().to_json())
 
 
+def run_same_type_similarity(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Pairwise scaled-int distance matrix — the in-framework replacement for
+    the external sifarish SameTypeSimilarity MR the reference shells out to
+    (resource/knn.sh:44-47). Output lines: ``testId,trainId,distance``."""
+    import numpy as np
+    from avenir_tpu.ops.distance import pairwise_full
+    from avenir_tpu.models.knn import _split_features
+    fz, rows = _load_table(conf, in_path)
+    table = fz.transform(rows)
+    num, cat, n_bins = _split_features(table)
+    dist = np.asarray(pairwise_full(
+        num, num, cat, cat,
+        algorithm=fz.schema.dist_algorithm or "euclidean",
+        n_cat_bins=n_bins,
+        distance_scale=conf.get_int("distance.scale", 1000)))
+    delim = conf.get("field.delim.out", ",")
+    with open(out_path, "w") as fh:
+        for i in range(table.n_rows):
+            for j in range(table.n_rows):
+                if i != j:
+                    fh.write(delim.join(
+                        [table.ids[i], table.ids[j], str(dist[i, j])]) + "\n")
+
+
+def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """KNN classify/regress (reference NearestNeighbor job, fused with the
+    distance computation). ``in_path`` is the test data;
+    ``train.data.path`` points at the training data.
+
+    Honors ``prediction.mode`` / ``regression.method``
+    (NearestNeighbor.java:122-123) and both spellings of the class-weighting
+    key (``class.condition.weighted`` :121, and the ``class.condtion.weighted``
+    typo actually used in resource/knn.properties:34). Test data may omit the
+    class column unless ``validation.mode`` is on. For linearRegression the
+    numeric input variable comes from ``regr.input.field.ordinal`` (an
+    adaptation: the reference reads it from precomputed neighbor records,
+    :162-169, which this fused pipeline no longer has).
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from avenir_tpu.models import knn
+    delim_in = conf.get("field.delim.regex", ",")
+    validation = conf.get_bool("validation.mode", False)
+    fz, train_rows = _load_table(conf, conf.get_required("train.data.path"))
+    test_rows = read_csv_lines(in_path, delim_in)
+    regression = conf.get("prediction.mode", "classification") == "regression"
+    train = fz.transform(train_rows, with_labels=not regression)
+    test = fz.transform(test_rows, with_labels=validation and not regression)
+    cfg = knn.KnnConfig(
+        top_match_count=conf.get_int("top.match.count", 5),
+        kernel_function=conf.get("kernel.function", "none"),
+        kernel_param=conf.get_int("kernel.param", 100),
+        class_cond_weighted=(conf.get_bool("class.condition.weighted", False)
+                             or conf.get_bool("class.condtion.weighted", False)),
+        inverse_distance_weighted=conf.get_bool("inverse.distance.weighted",
+                                                False),
+        decision_threshold=conf.get_float("decision.threshold", -1.0),
+        positive_class=conf.get("positive.class.value"),
+        distance_scale=conf.get_int("distance.scale", 1000),
+        algorithm=fz.schema.dist_algorithm or "euclidean",
+        prediction_mode="regression" if regression else "classification",
+        regression_method=conf.get("regression.method", "average"))
+    delim = conf.get("field.delim.out", ",")
+
+    if regression:
+        # the class-attribute column holds the numeric target
+        target_ord = fz.schema.find_class_attr_field().ordinal
+        targets = jnp.asarray([float(r[target_ord]) for r in train_rows],
+                              jnp.float32)
+        regr_input = None
+        if cfg.regression_method == "linearRegression":
+            x_ord = conf.get_int("regr.input.field.ordinal")
+            if x_ord is None:
+                raise ValueError("linearRegression needs "
+                                 "regr.input.field.ordinal")
+            regr_input = (
+                jnp.asarray([float(r[x_ord]) for r in train_rows]),
+                jnp.asarray([float(r[x_ord]) for r in test_rows]))
+        pred = knn.regress(train, test, cfg, targets, regr_input=regr_input)
+        with open(out_path, "w") as fh:
+            for i in range(test.n_rows):
+                fh.write(delim.join(
+                    [test.ids[i], str(int(pred.predicted[i]))]) + "\n")
+        if validation:
+            truth = np.asarray([float(r[target_ord]) for r in test_rows])
+            mae = float(np.abs(pred.predicted - truth).mean())
+            print(f'{{"Validation.MeanAbsoluteError": {mae}}}')
+        return
+
+    feature_post = None
+    if cfg.class_cond_weighted:
+        # fuse the knn.sh bayesianDistr/bayesianPredictor/join legs in-memory
+        from avenir_tpu.models import naive_bayes as nb
+        model, meta, _ = nb.train(train)
+        bp = nb.predict(model, meta, train, laplace=1.0)
+        feature_post = jnp.asarray(bp.feature_post)
+    pred = knn.classify(train, test, cfg, feature_post=feature_post)
+    output_distr = conf.get_bool("output.class.distr", False)
+    with open(out_path, "w") as fh:
+        for i in range(test.n_rows):
+            parts = [test.ids[i], train.class_values[int(pred.predicted[i])]]
+            if output_distr and pred.class_prob is not None:
+                for ci, cls in enumerate(train.class_values):
+                    parts += [cls, str(int(pred.class_prob[i, ci]))]
+            fh.write(delim.join(parts) + "\n")
+    if validation and test.labels is not None:
+        cm = knn.validate(pred, test,
+                          positive_class=conf.get("positive.class.value"))
+        print(cm.report().to_json())
+
+
 VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "BayesianDistribution": run_bayesian_distribution,
     "BayesianPredictor": run_bayesian_predictor,
+    "SameTypeSimilarity": run_same_type_similarity,
+    "NearestNeighbor": run_nearest_neighbor,
 }
 
 

@@ -1,0 +1,230 @@
+"""K-nearest-neighbor classifier/regressor, fused end-to-end.
+
+Collapses the reference's five-job pipeline (resource/knn.sh:44-131 —
+external sifarish distance MR, BayesianDistribution, BayesianPredictor in
+feature-prob mode, FeatureCondProbJoiner, NearestNeighbor) into one device
+program: pairwise distance → ``lax.top_k`` (replacing the secondary-sort
+shuffle, NearestNeighbor.java:80-81) → kernel weighting → one-hot class vote
+→ arbitration, with the class-conditional probability join becoming an
+in-memory gather from the Naive Bayes model instead of an MR join.
+
+Kernel/score semantics mirror Neighborhood.java:150-218 exactly, including
+the integer arithmetic (KERNEL_SCALE=100, truncating division):
+
+- none:                 score = 1
+- linearMultiplicative: score = dist==0 ? 200 : 100 // dist
+- linearAdditive:       score = 100 - dist
+- gaussian:             score = int(100 * exp(-0.5 (dist/param)^2))
+
+Distances enter these formulas as the reference's scaled ints
+(``distance.scale``). Class-conditional weighting multiplies the score by the
+neighbor's P(features|class) and optionally by inverse distance
+(Neighborhood.Neighbor.setScore :393-404).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.utils.dataset import EncodedTable, normalize_numeric
+from avenir_tpu.utils.metrics import ConfusionMatrix
+
+
+KERNEL_SCALE = 100
+PROB_SCALE = 100
+
+KERNELS = ("none", "linearMultiplicative", "linearAdditive", "gaussian")
+
+
+@dataclass(frozen=True)
+class KnnConfig:
+    """Knobs, named after their reference property keys."""
+
+    top_match_count: int = 5                 # top.match.count
+    kernel_function: str = "none"            # kernel.function
+    kernel_param: int = 100                  # kernel.param
+    class_cond_weighted: bool = False        # class.condtion.weighted (sic)
+    inverse_distance_weighted: bool = False  # inverse.distance.weighted
+    decision_threshold: float = -1.0         # decision.threshold
+    positive_class: Optional[str] = None     # positive.class.value
+    distance_scale: int = 1000               # distance.scale
+    algorithm: str = "euclidean"             # schema distAlgorithm
+    block_size: int = 65536
+    mode: str = "fast"                       # "fast" (bf16+approx) | "exact"
+    recall_target: float = 0.99
+    prediction_mode: str = "classification"  # prediction.mode
+    regression_method: str = "average"       # regression.method
+
+
+def _split_features(table: EncodedTable
+                    ) -> Tuple[Optional[jnp.ndarray], Optional[jnp.ndarray], int]:
+    """(numeric [N, Fn] normalized, categorical codes [N, Fc], max cat bins)."""
+    num_idx = [i for i, f in enumerate(table.feature_fields)
+               if f.is_numeric or table.is_continuous[i]]
+    cat_idx = [i for i, f in enumerate(table.feature_fields) if f.is_categorical]
+    norm = normalize_numeric(table)
+    x_num = norm[:, num_idx] if num_idx else None
+    x_cat = table.binned[:, cat_idx] if cat_idx else None
+    n_cat_bins = max((table.bins_per_feature[i] for i in cat_idx), default=0)
+    return x_num, x_cat, n_cat_bins
+
+
+def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(distances [M, k] scaled int32, train indices [M, k])."""
+    tr_num, tr_cat, n_bins = _split_features(train)
+    te_num, te_cat, _ = _split_features(test)
+    return pairwise_topk(
+        te_num, tr_num, te_cat, tr_cat,
+        k=config.top_match_count, block_size=config.block_size,
+        algorithm=config.algorithm, n_cat_bins=n_bins,
+        distance_scale=config.distance_scale, mode=config.mode,
+        recall_target=config.recall_target)
+
+
+@partial(jax.jit, static_argnames=("kernel_function", "kernel_param",
+                                   "n_classes", "class_cond_weighted",
+                                   "inverse_distance_weighted"))
+def _vote_kernel(dist: jnp.ndarray, nbr_labels: jnp.ndarray,
+                 nbr_post: Optional[jnp.ndarray],
+                 kernel_function: str, kernel_param: int, n_classes: int,
+                 class_cond_weighted: bool, inverse_distance_weighted: bool
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Kernel scores + per-class vote. Returns (scores [M,C], raw_scores [M,k])."""
+    if kernel_function == "none":
+        score = jnp.ones_like(dist)
+    elif kernel_function == "linearMultiplicative":
+        score = jnp.where(dist == 0, 2 * KERNEL_SCALE,
+                          KERNEL_SCALE // jnp.maximum(dist, 1))
+    elif kernel_function == "linearAdditive":
+        score = KERNEL_SCALE - dist
+    elif kernel_function == "gaussian":
+        t = dist.astype(jnp.float32) / kernel_param
+        score = jnp.asarray(KERNEL_SCALE * jnp.exp(-0.5 * t * t), jnp.int32)
+    else:
+        raise ValueError(f"unknown kernel function {kernel_function!r}")
+
+    w = score.astype(jnp.float32)
+    if class_cond_weighted and nbr_post is not None:
+        w = jnp.where(nbr_post > 0, w * nbr_post, w)
+    if inverse_distance_weighted:
+        w = w / jnp.maximum(dist.astype(jnp.float32), 1.0)
+
+    oh = jax.nn.one_hot(nbr_labels, n_classes, dtype=jnp.float32)  # [M, k, C]
+    votes = jnp.einsum("mk,mkc->mc", w, oh)
+    return votes, score
+
+
+@dataclass
+class KnnPrediction:
+    predicted: np.ndarray            # [M] class index or regressed value
+    class_votes: Optional[np.ndarray]  # [M, C] kernel-weighted votes
+    class_prob: Optional[np.ndarray]   # [M, C] int percent (PROB_SCALE)
+    neighbor_idx: np.ndarray         # [M, k]
+    neighbor_dist: np.ndarray        # [M, k] scaled int
+
+
+def classify(train: EncodedTable, test: EncodedTable, config: KnnConfig,
+             feature_post: Optional[jnp.ndarray] = None) -> KnnPrediction:
+    """End-to-end KNN classification.
+
+    ``feature_post`` is the optional [N_train, C] class-conditional
+    probability table from the Naive Bayes feature-prob output — the in-memory
+    replacement for FeatureCondProbJoiner. Each neighbor contributes
+    P(features | its own class) as its weight multiplier.
+    """
+    dist, idx = neighbors(train, test, config)
+    nbr_labels = train.labels[idx]                              # [M, k]
+    nbr_post = None
+    if config.class_cond_weighted and feature_post is not None:
+        nbr_post = jnp.take_along_axis(
+            feature_post[idx.reshape(-1)].reshape(
+                idx.shape + (feature_post.shape[1],)),
+            nbr_labels[..., None], axis=2)[..., 0]              # [M, k]
+
+    votes, _ = _vote_kernel(
+        dist, nbr_labels, nbr_post,
+        config.kernel_function, config.kernel_param, train.n_classes,
+        config.class_cond_weighted and feature_post is not None,
+        config.inverse_distance_weighted)
+    votes_np = np.asarray(votes)
+
+    if config.decision_threshold > 0:
+        if config.positive_class is None or train.n_classes != 2:
+            raise ValueError("decision threshold needs binary classes and "
+                             "positive.class.value")
+        pos = train.class_values.index(config.positive_class)
+        neg = 1 - pos
+        ratio = votes_np[:, pos] / np.maximum(votes_np[:, neg], 1e-9)
+        predicted = np.where(ratio > config.decision_threshold, pos, neg)
+    else:
+        predicted = np.argmax(votes_np, axis=1)
+
+    total = votes_np.sum(axis=1, keepdims=True)
+    prob = np.floor(votes_np * PROB_SCALE /
+                    np.maximum(total, 1e-9)).astype(np.int64)
+
+    return KnnPrediction(predicted=predicted.astype(np.int64),
+                         class_votes=votes_np, class_prob=prob,
+                         neighbor_idx=np.asarray(idx),
+                         neighbor_dist=np.asarray(dist))
+
+
+def regress(train: EncodedTable, test: EncodedTable, config: KnnConfig,
+            train_targets: jnp.ndarray,
+            regr_input: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
+            ) -> KnnPrediction:
+    """KNN regression: average / median / per-neighborhood linear fit
+    (Neighborhood.doRegression :223-250; multi-linear is TODO in the
+    reference and omitted here too).
+
+    ``regr_input`` = (train_x [N], test_x [M]) for the linear mode, matching
+    the reference's regrInputVar.
+    """
+    dist, idx = neighbors(train, test, config)
+    nbr_y = train_targets[idx].astype(jnp.float32)              # [M, k]
+
+    if config.regression_method == "average":
+        pred = jnp.asarray(jnp.sum(nbr_y, axis=1), jnp.int32) // nbr_y.shape[1]
+    elif config.regression_method == "median":
+        sorted_y = jnp.sort(nbr_y, axis=1)
+        k = nbr_y.shape[1]
+        mid = k // 2
+        if k % 2 == 1:
+            pred = jnp.asarray(sorted_y[:, mid], jnp.int32)
+        else:
+            pred = jnp.asarray(
+                (sorted_y[:, mid - 1] + sorted_y[:, mid]) / 2, jnp.int32)
+    elif config.regression_method == "linearRegression":
+        if regr_input is None:
+            raise ValueError("linearRegression needs regr_input")
+        train_x, test_x = regr_input
+        nbr_x = train_x[idx].astype(jnp.float32)                # [M, k]
+        mx = jnp.mean(nbr_x, axis=1, keepdims=True)
+        my = jnp.mean(nbr_y, axis=1, keepdims=True)
+        sxx = jnp.sum((nbr_x - mx) ** 2, axis=1)
+        sxy = jnp.sum((nbr_x - mx) * (nbr_y - my), axis=1)
+        slope = sxy / jnp.where(sxx > 0, sxx, 1.0)
+        intercept = my[:, 0] - slope * mx[:, 0]
+        pred = jnp.asarray(intercept + slope * test_x, jnp.int32)
+    else:
+        raise ValueError(
+            f"unknown regression method {config.regression_method!r}")
+
+    return KnnPrediction(predicted=np.asarray(pred), class_votes=None,
+                         class_prob=None, neighbor_idx=np.asarray(idx),
+                         neighbor_dist=np.asarray(dist))
+
+
+def validate(pred: KnnPrediction, test: EncodedTable,
+             positive_class: Optional[str] = None) -> ConfusionMatrix:
+    cm = ConfusionMatrix(test.class_values, positive_class=positive_class)
+    cm.update(jnp.asarray(pred.predicted), test.labels)
+    return cm

@@ -53,6 +53,8 @@ class FieldEncoder:
     bin_offset: int = 0                         # min-bin shift for bucketed numerics
     continuous: bool = False
     oov_index: Optional[int] = None
+    norm_min: float = 0.0                       # fit-time range for [0,1]
+    norm_max: float = 1.0                       # normalization (schema else data)
 
     def encode(self, token: str) -> Tuple[int, float]:
         """Return (bin_id, float_value) for one raw CSV token."""
@@ -93,6 +95,8 @@ class EncodedTable:
     # per feature, the wire-format label of each bin id: the categorical value
     # string, or the reference's absolute bin number str(id + offset) for
     # bucketed numerics (empty list for continuous features)
+    norm_min: Tuple[float, ...] = ()   # fit-time per-feature range, so train
+    norm_max: Tuple[float, ...] = ()   # and test normalize on the SAME scale
     n_rows: int = 0
 
     def __post_init__(self):
@@ -135,6 +139,14 @@ class Featurizer:
         except ValueError:
             class_field = None
 
+        def numeric_range(f: FeatureField) -> Tuple[float, float]:
+            if f.min is not None and f.max is not None:
+                lo, hi = float(f.min), float(f.max)
+            else:
+                vals = [float(row[f.ordinal]) for row in rows]
+                lo, hi = (min(vals), max(vals)) if vals else (0.0, 1.0)
+            return lo, (hi if hi > lo else lo + 1.0)
+
         self.encoders = []
         for f in feature_fields:
             if f.is_categorical:
@@ -151,17 +163,16 @@ class Featurizer:
                 self.encoders.append(FieldEncoder(
                     field=f, vocab=vocab, n_bins=n_bins, oov_index=oov))
             elif f.bucket_width is not None:
-                if f.min is not None and f.max is not None:
-                    lo = int(f.min // f.bucket_width)
-                    hi = int(f.max // f.bucket_width)
-                else:
-                    vals = [float(row[f.ordinal]) for row in rows]
-                    lo = int(min(vals) // f.bucket_width)
-                    hi = int(max(vals) // f.bucket_width)
+                nlo, nhi = numeric_range(f)
+                lo = int(nlo // f.bucket_width)
+                hi = int(nhi // f.bucket_width)
                 self.encoders.append(FieldEncoder(
-                    field=f, n_bins=hi - lo + 1, bin_offset=lo))
+                    field=f, n_bins=hi - lo + 1, bin_offset=lo,
+                    norm_min=nlo, norm_max=nhi))
             else:
-                self.encoders.append(FieldEncoder(field=f, continuous=True))
+                nlo, nhi = numeric_range(f)
+                self.encoders.append(FieldEncoder(
+                    field=f, continuous=True, norm_min=nlo, norm_max=nhi))
 
         if class_field is not None:
             if class_field.cardinality is not None:
@@ -201,6 +212,11 @@ class Featurizer:
                 binned[r, c] = b
                 numeric[r, c] = v
             if labels is not None:
+                if len(row) <= class_field.ordinal:
+                    raise ValueError(
+                        f"row {r} has no class column (ordinal "
+                        f"{class_field.ordinal}); pass with_labels=False for "
+                        "unlabeled data")
                 token = row[class_field.ordinal]
                 if token not in class_index:
                     raise KeyError(f"unseen class value {token!r}")
@@ -216,6 +232,8 @@ class Featurizer:
             is_continuous=tuple(e.continuous for e in self.encoders),
             class_values=list(self.class_values),
             bin_labels=[self._bin_labels(e) for e in self.encoders],
+            norm_min=tuple(e.norm_min for e in self.encoders),
+            norm_max=tuple(e.norm_max for e in self.encoders),
         )
 
     @staticmethod
@@ -237,19 +255,14 @@ class Featurizer:
 
 
 def normalize_numeric(table: EncodedTable) -> jnp.ndarray:
-    """Range-normalize numeric features to [0, 1] using schema min/max (falling
-    back to data min/max). This is the scaling the external sifarish distance
-    job applies before computing euclidean distance (knn.sh:44-47 contract)."""
-    mins, maxs = [], []
-    data_min = np.asarray(jnp.min(table.numeric, axis=0))
-    data_max = np.asarray(jnp.max(table.numeric, axis=0))
-    for i, f in enumerate(table.feature_fields):
-        lo = f.min if f.min is not None else float(data_min[i])
-        hi = f.max if f.max is not None else float(data_max[i])
-        if hi <= lo:
-            hi = lo + 1.0
-        mins.append(lo)
-        maxs.append(hi)
-    mins_a = jnp.asarray(mins, dtype=jnp.float32)
-    span = jnp.asarray(maxs, dtype=jnp.float32) - mins_a
+    """Range-normalize numeric features to [0, 1] on the FIT-time scale
+    (schema min/max, else the fitted data's range) recorded in the table —
+    train and test therefore always normalize in the same coordinate system.
+    This is the scaling the external sifarish distance job applies before
+    computing euclidean distance (knn.sh:44-47 contract)."""
+    if not table.norm_min:
+        return table.numeric
+    mins_a = jnp.asarray(table.norm_min, dtype=jnp.float32)
+    span = jnp.asarray(table.norm_max, dtype=jnp.float32) - mins_a
+    span = jnp.where(span > 0, span, 1.0)
     return (table.numeric - mins_a) / span

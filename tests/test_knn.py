@@ -1,0 +1,186 @@
+"""KNN: distance kernel golden values, streaming top-k == full matrix,
+kernel semantics, E2E elearn accuracy, regression modes."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from avenir_tpu.datagen import elearn_rows, elearn_schema
+from avenir_tpu.models import knn
+from avenir_tpu.models import naive_bayes as nb
+from avenir_tpu.ops import distance as D
+from avenir_tpu.utils.dataset import Featurizer
+
+
+class TestDistanceOp:
+    def test_euclidean_golden(self):
+        x = jnp.asarray([[0.0, 0.0], [1.0, 1.0]])
+        y = jnp.asarray([[0.0, 0.0], [0.0, 1.0]])
+        d = D.block_distance(x, y, None, None)
+        # per-attribute rms: d(x0,y0)=0; d(x0,y1)=sqrt(1/2); d(x1,y0)=1
+        np.testing.assert_allclose(
+            np.asarray(d),
+            [[0.0, np.sqrt(0.5)], [1.0, np.sqrt(0.5)]], atol=1e-6)
+
+    def test_categorical_mismatch(self):
+        x = jnp.asarray([[0, 1], [2, 1]])
+        y = jnp.asarray([[0, 1], [1, 0]])
+        mm = D.categorical_mismatch(x, y, 3)
+        np.testing.assert_allclose(np.asarray(mm), [[0, 2], [1, 2]])
+
+    def test_mixed_distance(self):
+        x_num = jnp.asarray([[0.5]])
+        y_num = jnp.asarray([[0.5], [1.0]])
+        x_cat = jnp.asarray([[1]])
+        y_cat = jnp.asarray([[1], [0]])
+        d = D.block_distance(x_num, y_num, x_cat, y_cat, 2)
+        # 2 attrs: [0, sqrt((0.25+1)/2)]
+        np.testing.assert_allclose(
+            np.asarray(d), [[0.0, np.sqrt(1.25 / 2)]], atol=1e-6)
+
+    def test_topk_matches_full(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.random((40, 6), dtype=np.float32))
+        y = jnp.asarray(rng.random((333, 6), dtype=np.float32))
+        full = np.asarray(D.pairwise_full(x, y))
+        dist, idx = D.pairwise_topk(x, y, k=7, block_size=64, mode="exact")
+        dist, idx = np.asarray(dist), np.asarray(idx)
+        for i in range(40):
+            expect = np.sort(full[i])[:7]
+            np.testing.assert_allclose(np.sort(dist[i]), expect, atol=1)
+            assert len(set(idx[i].tolist())) == 7  # distinct neighbors
+
+    def test_fast_mode_high_recall(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.random((64, 8), dtype=np.float32))
+        y = jnp.asarray(rng.random((2048, 8), dtype=np.float32))
+        _, idx_e = D.pairwise_topk(x, y, k=5, mode="exact")
+        _, idx_f = D.pairwise_topk(x, y, k=5, mode="fast",
+                                   recall_target=0.95)
+        exact = [set(r.tolist()) for r in np.asarray(idx_e)]
+        fast = [set(r.tolist()) for r in np.asarray(idx_f)]
+        recall = np.mean([len(a & b) / 5 for a, b in zip(exact, fast)])
+        assert recall > 0.9, recall
+
+    def test_topk_self_distance_zero(self):
+        rng = np.random.default_rng(1)
+        y = jnp.asarray(rng.random((50, 4), dtype=np.float32))
+        dist, idx = D.pairwise_topk(y, y, k=1, block_size=16, mode="exact")
+        np.testing.assert_array_equal(np.asarray(dist)[:, 0], 0)
+        np.testing.assert_array_equal(np.asarray(idx)[:, 0], np.arange(50))
+
+    def test_k_larger_than_train(self):
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.random((4, 3), dtype=np.float32))
+        y = jnp.asarray(rng.random((3, 3), dtype=np.float32))
+        dist, idx = D.pairwise_topk(x, y, k=5, mode="exact")
+        assert dist.shape == (4, 3)  # clamped to n_train
+
+
+class TestKernels:
+    def _votes(self, kernel, dist, labels, n_classes=2, **kw):
+        votes, _ = knn._vote_kernel(
+            jnp.asarray(dist), jnp.asarray(labels), None, kernel,
+            kw.get("kernel_param", 100), n_classes, False,
+            kw.get("inverse_distance_weighted", False))
+        return np.asarray(votes)
+
+    def test_none_counts(self):
+        v = self._votes("none", [[1, 2, 3]], [[0, 0, 1]])
+        np.testing.assert_allclose(v, [[2, 1]])
+
+    def test_linear_multiplicative_int_division(self):
+        # Neighborhood.java:170: dist==0 -> 200 else 100/dist (int div)
+        v = self._votes("linearMultiplicative", [[0, 3, 40]], [[0, 0, 1]])
+        np.testing.assert_allclose(v, [[200 + 33, 2]])
+
+    def test_linear_additive(self):
+        v = self._votes("linearAdditive", [[10, 30, 99]], [[0, 1, 1]])
+        np.testing.assert_allclose(v, [[90, 70 + 1]])
+
+    def test_gaussian(self):
+        v = self._votes("gaussian", [[0, 100]], [[0, 1]], kernel_param=100)
+        assert v[0, 0] == 100
+        assert v[0, 1] == int(100 * np.exp(-0.5))
+
+    def test_inverse_distance_weighting(self):
+        v = self._votes("none", [[2, 4]], [[0, 1]],
+                        inverse_distance_weighted=True)
+        np.testing.assert_allclose(v, [[0.5, 0.25]])
+
+
+class TestElearnEndToEnd:
+    @pytest.fixture(scope="class")
+    def split(self):
+        rows = elearn_rows(3000, seed=7)
+        fz = Featurizer(elearn_schema())
+        return fz.fit_transform(rows[:2500]), fz.transform(rows[2500:])
+
+    def test_recovers_planted_signal(self, split):
+        train, test = split
+        cfg = knn.KnnConfig(top_match_count=5)
+        pred = knn.classify(train, test, cfg)
+        cm = knn.validate(pred, test, positive_class="fail")
+        assert cm.accuracy > 0.85, cm.accuracy
+
+    def test_gaussian_kernel_at_least_as_good(self, split):
+        train, test = split
+        pred = knn.classify(train, test, knn.KnnConfig(
+            top_match_count=7, kernel_function="gaussian", kernel_param=300))
+        cm = knn.validate(pred, test, positive_class="fail")
+        assert cm.accuracy > 0.8
+
+    def test_class_cond_weighting_pipeline(self, split):
+        # full knn.sh pipeline: bayes feature probs -> weighted knn
+        train, test = split
+        model, meta, _ = nb.train(train)
+        bp = nb.predict(model, meta, train, laplace=1.0)
+        feature_post = jnp.asarray(bp.feature_post)        # [N_train, C]
+        cfg = knn.KnnConfig(top_match_count=5, class_cond_weighted=True)
+        pred = knn.classify(train, test, cfg, feature_post=feature_post)
+        cm = knn.validate(pred, test, positive_class="fail")
+        assert cm.accuracy > 0.8
+
+    def test_decision_threshold(self, split):
+        train, test = split
+        cfg_lo = knn.KnnConfig(top_match_count=5, decision_threshold=0.2,
+                               positive_class="fail")
+        cfg_hi = knn.KnnConfig(top_match_count=5, decision_threshold=3.0,
+                               positive_class="fail")
+        p_lo = knn.classify(train, test, cfg_lo)
+        p_hi = knn.classify(train, test, cfg_hi)
+        fail_i = test.class_values.index("fail")
+        # lower threshold -> more positives
+        assert (p_lo.predicted == fail_i).sum() >= (p_hi.predicted == fail_i).sum()
+
+
+class TestRegression:
+    def _tables(self):
+        rows = elearn_rows(500, seed=13)
+        fz = Featurizer(elearn_schema())
+        train = fz.fit_transform(rows[:400])
+        test = fz.transform(rows[400:])
+        # regress testScore (feature 4 of the numeric block) from the rest
+        targets = jnp.asarray(np.asarray(train.numeric[:, 4]), jnp.int32)
+        truth = np.asarray(test.numeric[:, 4])
+        return train, test, targets, truth
+
+    def test_average_and_median(self):
+        train, test, targets, truth = self._tables()
+        for method in ("average", "median"):
+            cfg = knn.KnnConfig(top_match_count=7, prediction_mode="regression",
+                                regression_method=method)
+            pred = knn.regress(train, test, cfg, targets)
+            mae = np.abs(pred.predicted - truth).mean()
+            assert mae < 20, (method, mae)
+
+    def test_linear(self):
+        train, test, targets, truth = self._tables()
+        cfg = knn.KnnConfig(top_match_count=10, prediction_mode="regression",
+                            regression_method="linearRegression")
+        train_x = jnp.asarray(train.numeric[:, 5])   # assignmentScore
+        test_x = jnp.asarray(test.numeric[:, 5])
+        pred = knn.regress(train, test, cfg, targets,
+                           regr_input=(train_x, test_x))
+        mae = np.abs(pred.predicted - truth).mean()
+        assert mae < 25, mae
