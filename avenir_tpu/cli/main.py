@@ -231,11 +231,185 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
         print(cm.report().to_json())
 
 
+def run_class_partition_generator(conf: JobConfig, in_path: str,
+                                  out_path: str) -> None:
+    """Candidate-split gains (reference ClassPartitionGenerator /
+    tree.SplitGenerator job). With ``at.root=true`` emits only the node's
+    info content (the parent.info bootstrap, ClassPartitionGenerator.java
+    :161-163); otherwise one ``attr;splitKey;gainRatio`` line per candidate
+    split, sorted input for DataPartitioner."""
+    from avenir_tpu.models import tree as T
+    fz, rows = _load_table(conf, in_path)
+    table = fz.transform(rows)
+    algorithm = conf.get("split.algorithm", "giniIndex")
+    delim = conf.get("field.delim.out", ";")
+    if conf.get_bool("at.root", False):
+        with open(out_path, "w") as fh:
+            fh.write(repr(T.root_info(table, algorithm)) + "\n")
+        return
+    attrs = conf.get_int_list("split.attributes")
+    if attrs is None:
+        attrs = [f.ordinal for f in table.feature_fields
+                 if f.is_categorical or f.bucket_width is not None]
+    parent = conf.get_float("parent.info")
+    splits = T.split_gains(
+        table, attrs, algorithm, parent,
+        conf.get_int("max.cat.attr.split.groups", 3))
+    T.write_candidate_splits(splits, out_path, delim)
+
+
+def run_data_partitioner(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Partition node data by the best candidate split (reference
+    tree.DataPartitioner): reads the sibling ``splits`` artifact, sorts by
+    stat descending, routes rows into
+    ``<out>/split=<rank>/segment=<j>/data/partition.txt`` (DataPartitioner
+    .java:59-129). ``in_path`` is the node's data file; ``out_path`` the
+    node directory."""
+    import os
+    import numpy as np
+    from avenir_tpu.models import tree as T
+    fz, rows = _load_table(conf, in_path)
+    table = fz.transform(rows)
+    delim = conf.get("field.delim.out", ";")
+    splits_path = conf.get("candidate.splits.path") or os.path.join(
+        os.path.dirname(os.path.dirname(in_path)), "splits", "part-r-00000")
+    candidates = T.read_candidate_splits(splits_path, delim)
+    split_index, (attr, key, _stat) = T.select_split(
+        candidates, conf.get("split.selection.strategy", "best"),
+        conf.get_int("num.top.splits", 5))
+    segs = T.segment_of_rows(table, attr, key)
+    # emit the ORIGINAL input lines unchanged (the reference mapper writes
+    # `value` verbatim) — rejoining parsed tokens would corrupt data whose
+    # delimiter regex is not its literal delimiter
+    with open(in_path) as fh:
+        raw_lines = [l.rstrip("\n") for l in fh if l.strip()]
+    for seg in sorted(set(int(s) for s in np.asarray(segs))):
+        seg_dir = os.path.join(out_path, f"split={split_index}",
+                               f"segment={seg}", "data")
+        os.makedirs(seg_dir, exist_ok=True)
+        with open(os.path.join(seg_dir, "partition.txt"), "w") as fh:
+            for i in np.nonzero(np.asarray(segs) == seg)[0]:
+                fh.write(raw_lines[i] + "\n")
+    print(f'{{"split.attribute": {attr}, "split.key": "{key}", '
+          f'"split.index": {split_index}}}')
+
+
+def run_markov_state_transition_model(conf: JobConfig, in_path: str,
+                                      out_path: str) -> None:
+    """Train a (optionally class-conditional) Markov transition model
+    (reference MarkovStateTransitionModel). Input rows:
+    ``id[,classLabel],state,state,...`` — controlled by ``skip.field.count``
+    and ``class.label.field.ord`` like the reference mapper (:99-133)."""
+    from avenir_tpu.models import markov as M
+    delim = conf.get("field.delim.regex", ",")
+    skip = conf.get_int("skip.field.count", 0)
+    class_ord = conf.get_int("class.label.field.ord", -1)
+    states = conf.get_list("model.states")
+    if states is None:
+        raise ValueError("model.states must list the state symbols")
+    rows = read_csv_lines(in_path, delim)
+    eff_skip = skip + (1 if class_ord >= 0 else 0)
+    seqs = [r[eff_skip:] for r in rows]
+    labels = [r[class_ord] for r in rows] if class_ord >= 0 else None
+    model = M.train(seqs, states, class_labels=labels,
+                    scale=conf.get_int("trans.prob.scale", 1000))
+    M.save_model(model, out_path,
+                 output_states=conf.get_bool("output.states", True),
+                 delim=conf.get("field.delim.out", ","))
+
+
+def run_markov_model_classifier(conf: JobConfig, in_path: str,
+                                out_path: str) -> None:
+    """Classify sequences by class-conditional log odds
+    (reference MarkovModelClassifier.java:121-144)."""
+    from avenir_tpu.models import markov as M
+    delim = conf.get("field.delim.regex", ",")
+    delim_out = conf.get("field.delim.out", ",")
+    skip = conf.get_int("skip.field.count", 1)
+    id_ord = conf.get_int("id.field.ord", 0)
+    validation = conf.get_bool("validation.mode", False)
+    class_ord = conf.get_int("class.label.field.ord", -1)
+    if validation and class_ord < 0:
+        raise ValueError("in validation mode actual class labels must be "
+                         "provided (class.label.field.ord)")
+    labels = conf.get_list("class.labels")
+    model = M.load_model(conf.get_required("mm.model.path"),
+                         class_label_based=True,
+                         scale=conf.get_int("trans.prob.scale", 1000))
+    rows = read_csv_lines(in_path, delim)
+    eff_skip = skip + (1 if validation else 0)
+    seqs = [r[eff_skip:] for r in rows]
+    pred, odds = M.classify(model, seqs, (labels[0], labels[1]))
+    with open(out_path, "w") as fh:
+        for i, row in enumerate(rows):
+            parts = [row[id_ord]]
+            if validation:
+                parts.append(row[class_ord])
+            parts += [str(pred[i]), str(float(odds[i]))]
+            fh.write(delim_out.join(parts) + "\n")
+    if validation:
+        truth = [r[class_ord] for r in rows]
+        cm = M.validate(pred, truth, labels, positive_class=labels[0])
+        print(cm.report().to_json())
+
+
+def run_hmm_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Build an HMM from tagged data (reference HiddenMarkovModelBuilder)."""
+    from avenir_tpu.models import hmm as H
+    delim = conf.get("field.delim.regex", ",")
+    states = conf.get_list("model.states")
+    observations = conf.get_list("model.observations")
+    if states is None or observations is None:
+        raise ValueError("model.states and model.observations are required")
+    rows = read_csv_lines(in_path, delim)
+    # the reference builder scales with trans.prob.scale, default 1000
+    # (HiddenMarkovModelBuilder.java:293)
+    scale = conf.get_int("trans.prob.scale", 1000)
+    if conf.get_bool("partially.tagged", False):
+        wf = conf.get_int_list("window.function", [1])
+        model = H.train_partially_tagged(rows, states, observations, wf,
+                                         scale=scale)
+    else:
+        model = H.train_fully_tagged(
+            rows, states, observations,
+            sub_field_delim=conf.get("sub.field.delim", ":"),
+            scale=scale,
+            skip_field_count=conf.get_int("skip.field.count", 0))
+    H.save_model(model, out_path, delim=conf.get("field.delim.out", ","))
+
+
+def run_viterbi_state_predictor(conf: JobConfig, in_path: str,
+                                out_path: str) -> None:
+    """Most-likely state path per row (reference ViterbiStatePredictor);
+    emits the reversed path like the reference (:136-140). The model file's
+    scale is irrelevant to the arg-max (a uniform per-step factor), so both
+    float and scaled-int model files decode identically."""
+    from avenir_tpu.models import hmm as H
+    delim = conf.get("field.delim.regex", ",")
+    delim_out = conf.get("field.delim.out", ",")
+    skip = conf.get_int("skip.field.count", 1)
+    id_ord = conf.get_int("id.field.ordinal", 0)
+    model = H.load_model(conf.get_required("hmm.model.path"), scale=1)
+    rows = read_csv_lines(in_path, delim)
+    obs_rows = [r[skip:] for r in rows]
+    paths = H.predict_states(model, obs_rows, reversed_output=True)
+    with open(out_path, "w") as fh:
+        for row, path in zip(rows, paths):
+            fh.write(delim_out.join([row[id_ord]] + path) + "\n")
+
+
 VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "BayesianDistribution": run_bayesian_distribution,
     "BayesianPredictor": run_bayesian_predictor,
     "SameTypeSimilarity": run_same_type_similarity,
     "NearestNeighbor": run_nearest_neighbor,
+    "ClassPartitionGenerator": run_class_partition_generator,
+    "SplitGenerator": run_class_partition_generator,
+    "DataPartitioner": run_data_partitioner,
+    "MarkovStateTransitionModel": run_markov_state_transition_model,
+    "MarkovModelClassifier": run_markov_model_classifier,
+    "HiddenMarkovModelBuilder": run_hmm_builder,
+    "ViterbiStatePredictor": run_viterbi_state_predictor,
 }
 
 

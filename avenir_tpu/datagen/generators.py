@@ -173,9 +173,11 @@ _RETARGET_SCHEMA_JSON = {
     "fields": [
         {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
         {"name": "cartValue", "ordinal": 1, "dataType": "int",
-         "min": 0, "max": 500, "maxSplit": 4, "feature": True},
+         "min": 0, "max": 500, "bucketWidth": 50, "maxSplit": 4,
+         "feature": True},
         {"name": "visitCount", "ordinal": 2, "dataType": "int",
-         "min": 0, "max": 40, "maxSplit": 4, "feature": True},
+         "min": 0, "max": 40, "bucketWidth": 10, "maxSplit": 4,
+         "feature": True},
         {"name": "loyalty", "ordinal": 3, "dataType": "categorical",
          "cardinality": ["bronze", "silver", "gold"], "maxSplit": 3,
          "feature": True},

@@ -1,0 +1,193 @@
+"""Markov-chain state-transition model + classifier.
+
+Replaces the reference's MR pair:
+
+- **train** (MarkovStateTransitionModel, src/main/java/org/avenir/markov/
+  MarkovStateTransitionModel.java:116-133): per-row sliding bigrams + shuffle
+  + reducer matrix build become one masked one-hot einsum over the padded
+  [B, T] sequence batch — optionally per class label (:246-270) via a class
+  one-hot in the same contraction. Sequences shard over the ``data`` mesh
+  axis; within a row, arbitrarily long sequences can be time-sharded because
+  bigram counting is a segment sum (SURVEY.md §5).
+- **normalize**: the reference's Laplace rule (+1 to every cell of a row
+  containing any zero, StateTransitionProbability.java:65-78) and scaled-int
+  division ``count*scale // rowSum`` (:85-95) are preserved exactly for wire
+  parity; ``scale=1`` produces float probabilities.
+- **classify** (MarkovModelClassifier.java:121-144): cumulative log-odds
+  between the two class-conditional matrices, vectorized as one gather-sum
+  over bigram pairs; sign picks the class.
+
+Wire format (reducer cleanup :201-241): optional states line, then for a
+class-based model ``classLabel:<label>`` followed by S matrix rows, repeated
+per label; global model is just the S rows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.utils.metrics import ConfusionMatrix
+from avenir_tpu.utils.tables import laplace_and_scale
+
+
+@dataclass
+class MarkovModel:
+    states: List[str]
+    scale: int                      # trans.prob.scale (1 -> float probs)
+    trans: Optional[np.ndarray] = None             # [S, S] global
+    class_trans: Optional[Dict[str, np.ndarray]] = None  # per class label
+
+
+def encode_sequences(sequences: Sequence[Sequence[str]], states: List[str]
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pad string state sequences to [B, T] int codes + lengths."""
+    index = {s: i for i, s in enumerate(states)}
+    t_max = max((len(s) for s in sequences), default=1)
+    batch = np.zeros((len(sequences), max(t_max, 2)), np.int32)
+    lengths = np.zeros(len(sequences), np.int32)
+    for b, seq in enumerate(sequences):
+        codes = [index[s] for s in seq]
+        batch[b, :len(codes)] = codes
+        lengths[b] = len(codes)
+    return jnp.asarray(batch), jnp.asarray(lengths)
+
+
+@partial(jax.jit, static_argnames=("n_states", "n_classes"))
+def _bigram_counts(seqs: jnp.ndarray, lengths: jnp.ndarray,
+                   class_ids: Optional[jnp.ndarray],
+                   n_states: int, n_classes: int) -> jnp.ndarray:
+    """[B, T] padded sequences -> [n_classes, S, S] transition counts
+    (n_classes=1 for the global model). One fused contraction: combiner,
+    shuffle and reducer of the reference in a single einsum."""
+    src, dst = seqs[:, :-1], seqs[:, 1:]
+    bsz, tm1 = src.shape
+    pos = jnp.arange(tm1)[None, :]
+    mask = (pos + 1 < lengths[:, None]).astype(jnp.float32)      # [B, T-1]
+    oh_src = jax.nn.one_hot(src, n_states, dtype=jnp.float32) * mask[..., None]
+    oh_dst = jax.nn.one_hot(dst, n_states, dtype=jnp.float32)
+    if class_ids is None:
+        oh_cls = jnp.ones((bsz, 1), jnp.float32)
+    else:
+        oh_cls = jax.nn.one_hot(class_ids, n_classes, dtype=jnp.float32)
+    return jnp.einsum("bc,bts,btu->csu", oh_cls, oh_src, oh_dst)
+
+
+def train(sequences: Sequence[Sequence[str]], states: List[str],
+          class_labels: Optional[Sequence[str]] = None,
+          label_values: Optional[List[str]] = None,
+          scale: int = 1000) -> MarkovModel:
+    """Build the (optionally class-conditional) transition model."""
+    seqs, lengths = encode_sequences(sequences, states)
+    if class_labels is None:
+        counts = _bigram_counts(seqs, lengths, None, len(states), 1)
+        trans = laplace_and_scale(np.asarray(counts[0]), scale)
+        return MarkovModel(states=list(states), scale=scale, trans=trans)
+    label_values = label_values or sorted(set(class_labels))
+    lab_index = {v: i for i, v in enumerate(label_values)}
+    class_ids = jnp.asarray([lab_index[c] for c in class_labels], jnp.int32)
+    counts = _bigram_counts(seqs, lengths, class_ids, len(states),
+                            len(label_values))
+    per_class = {
+        label: laplace_and_scale(np.asarray(counts[i]), scale)
+        for i, label in enumerate(label_values)}
+    return MarkovModel(states=list(states), scale=scale,
+                       class_trans=per_class)
+
+
+# --------------------------------------------------------------------------
+# wire format
+# --------------------------------------------------------------------------
+
+def _fmt(v: float, scale: int) -> str:
+    return str(int(v)) if scale > 1 else format(v, "g")
+
+
+def save_model(model: MarkovModel, path: str, output_states: bool = True,
+               delim: str = ",") -> None:
+    lines: List[str] = []
+    if output_states:
+        lines.append(delim.join(model.states))
+    if model.class_trans is not None:
+        for label, mat in model.class_trans.items():
+            lines.append(f"classLabel:{label}")
+            for row in mat:
+                lines.append(delim.join(_fmt(v, model.scale) for v in row))
+    else:
+        for row in model.trans:
+            lines.append(delim.join(_fmt(v, model.scale) for v in row))
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def load_model(path: str, class_label_based: bool = False,
+               scale: int = 1000, delim: str = ",") -> MarkovModel:
+    """Parse the MarkovModel.java:38-63 line layout (first line = states)."""
+    with open(path) as fh:
+        lines = [l.rstrip("\n") for l in fh if l.strip()]
+    states = lines[0].split(delim)
+    n = len(states)
+    pos = 1
+    if class_label_based:
+        class_trans: Dict[str, np.ndarray] = {}
+        while pos < len(lines):
+            if lines[pos].startswith("classLabel"):
+                label = lines[pos].split(":")[1]
+                pos += 1
+                mat = np.asarray(
+                    [[float(v) for v in lines[pos + i].split(delim)]
+                     for i in range(n)])
+                pos += n
+                class_trans[label] = mat
+            else:
+                pos += 1
+        return MarkovModel(states=states, scale=scale,
+                           class_trans=class_trans)
+    mat = np.asarray([[float(v) for v in lines[pos + i].split(delim)]
+                      for i in range(n)])
+    return MarkovModel(states=states, scale=scale, trans=mat)
+
+
+# --------------------------------------------------------------------------
+# classify
+# --------------------------------------------------------------------------
+
+@jax.jit
+def _log_odds_kernel(seqs: jnp.ndarray, lengths: jnp.ndarray,
+                     log_ratio: jnp.ndarray) -> jnp.ndarray:
+    """Σ_t log(P0[s_{t-1},s_t] / P1[...]) per sequence — one gather-sum."""
+    src, dst = seqs[:, :-1], seqs[:, 1:]
+    pos = jnp.arange(src.shape[1])[None, :]
+    mask = (pos + 1 < lengths[:, None]).astype(jnp.float32)
+    return jnp.sum(log_ratio[src, dst] * mask, axis=1)
+
+
+def classify(model: MarkovModel, sequences: Sequence[Sequence[str]],
+             class_labels: Tuple[str, str]
+             ) -> Tuple[np.ndarray, np.ndarray]:
+    """(predicted labels, log odds). Positive log-odds -> class_labels[0]
+    (MarkovModelClassifier.java:130-144)."""
+    if model.class_trans is None:
+        raise ValueError("classification needs a class-label-based model")
+    m0 = np.maximum(model.class_trans[class_labels[0]], 1e-12)
+    m1 = np.maximum(model.class_trans[class_labels[1]], 1e-12)
+    log_ratio = jnp.asarray(np.log(m0 / m1), jnp.float32)
+    seqs, lengths = encode_sequences(sequences, model.states)
+    odds = np.asarray(_log_odds_kernel(seqs, lengths, log_ratio))
+    pred = np.where(odds > 0, class_labels[0], class_labels[1])
+    return pred, odds
+
+
+def validate(pred: np.ndarray, truth: Sequence[str],
+             class_labels: Sequence[str],
+             positive_class: Optional[str] = None) -> ConfusionMatrix:
+    cm = ConfusionMatrix(list(class_labels), positive_class=positive_class)
+    index = {v: i for i, v in enumerate(class_labels)}
+    cm.update(jnp.asarray([index[p] for p in pred]),
+              jnp.asarray([index[t] for t in truth]))
+    return cm

@@ -1,0 +1,99 @@
+"""Sequential DP as scans: Viterbi and its sequence-parallel formulation.
+
+The reference's Viterbi is a per-row Java loop over observations
+(ViterbiDecoder.java:66-105: path-prob DP + back-pointers, backtrack at
+:111-143). Here it is a ``lax.scan`` over time, vmapped over a batch of
+padded sequences — and, for long sequences, a ``lax.associative_scan`` over
+max-plus matrices: max-plus matrix product is associative, so the DP can be
+split across time shards/devices (the moral analogue of ring-attention /
+context parallelism for this workload, SURVEY.md §5).
+
+All probabilities are log-space (the reference multiplies raw probabilities,
+which underflows on long sequences — deviation documented; arg-max paths are
+identical where the reference doesn't underflow).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+@partial(jax.jit, static_argnames=())
+def viterbi_path(log_init: jnp.ndarray, log_trans: jnp.ndarray,
+                 log_emit: jnp.ndarray, obs: jnp.ndarray,
+                 length: Optional[jnp.ndarray] = None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Most-likely state path for one padded observation sequence.
+
+    log_init [S], log_trans [S, S] (src→dst), log_emit [S, O], obs [T] int
+    (padding may be any id when ``length`` masks it). Returns
+    (path [T] int32 — entries past ``length`` repeat the last state,
+    best log-prob scalar).
+    """
+    n_states = log_init.shape[0]
+    t_len = obs.shape[0]
+    length = jnp.asarray(t_len if length is None else length)
+
+    def step(carry, t):
+        alpha, _ = carry                                 # [S] path log-probs
+        scores = alpha[:, None] + log_trans              # [S_prev, S]
+        back = jnp.argmax(scores, axis=0)                # [S]
+        best = jnp.max(scores, axis=0) + log_emit[:, obs[t]]
+        # freeze the recursion past the true sequence length
+        active = t < length
+        new_alpha = jnp.where(active, best, alpha)
+        back = jnp.where(active, back, jnp.arange(n_states))
+        return (new_alpha, t), back
+
+    alpha0 = log_init + log_emit[:, obs[0]]
+    (alpha_T, _), backs = lax.scan(step, (alpha0, 0),
+                                   jnp.arange(1, t_len))  # backs [T-1, S]
+
+    last_state = jnp.argmax(alpha_T)
+
+    def backtrack(state, t):
+        # t runs T-2 .. 0; state at t+1 -> state at t
+        active = t + 1 < length
+        prev = jnp.where(active, backs[t, state], state)
+        return prev, prev
+
+    _, rev_path = lax.scan(backtrack, last_state,
+                           jnp.arange(t_len - 2, -1, -1))
+    path = jnp.concatenate([rev_path[::-1], jnp.asarray([last_state])])
+    return path.astype(jnp.int32), jnp.max(alpha_T)
+
+
+def viterbi_batch(log_init, log_trans, log_emit, obs_batch, lengths):
+    """vmap over a [B, T] batch of padded sequences."""
+    return jax.vmap(viterbi_path, in_axes=(None, None, None, 0, 0))(
+        log_init, log_trans, log_emit, obs_batch, lengths)
+
+
+@jax.jit
+def viterbi_scores_associative(log_init: jnp.ndarray, log_trans: jnp.ndarray,
+                               log_emit: jnp.ndarray, obs: jnp.ndarray
+                               ) -> jnp.ndarray:
+    """Final Viterbi scores via an associative max-plus scan over time.
+
+    Builds per-step max-plus matrices M_t[i,j] = trans[i,j] + emit[j, o_t]
+    and combines them with ``lax.associative_scan`` (log-depth parallel over
+    time instead of a sequential scan) — the formulation that lets a long
+    sequence be split across devices by sharding the time axis. Returns the
+    final [S] score vector (argmax = Viterbi end state; full path recovery
+    still uses the sequential backtrack).
+    """
+    def maxplus(a, b):
+        # (a ⊗ b)[i, j] = max_k a[i, k] + b[k, j]
+        return jnp.max(a[..., :, :, None] + b[..., None, :, :], axis=-2)
+
+    mats = log_trans[None, :, :] + log_emit.T[obs[1:], None, :]  # [T-1, S, S]
+    prefix = lax.associative_scan(maxplus, mats)                 # [T-1, S, S]
+    alpha0 = log_init + log_emit[:, obs[0]]
+    return jnp.max(alpha0[:, None] + prefix[-1], axis=0)

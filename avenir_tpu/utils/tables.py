@@ -14,6 +14,23 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 
+def laplace_and_scale(counts: np.ndarray, scale: int) -> np.ndarray:
+    """The reference's row normalization (StateTransitionProbability.java
+    :65-95), shared by every model that emits probability matrices: +1 to
+    every cell of any row containing a zero, then integer floor division
+    ``count*scale // rowSum`` (scale>1) or plain division (scale=1).
+    Operates on the last axis; leading axes batch."""
+    counts = counts.copy()
+    rows_with_zero = (counts == 0).any(axis=-1)
+    counts[rows_with_zero] += 1
+    row_sum = counts.sum(axis=-1, keepdims=True)
+    row_sum[row_sum == 0] = 1
+    if scale > 1:
+        return np.floor_divide(counts.astype(np.int64) * scale,
+                               row_sum.astype(np.int64)).astype(np.float64)
+    return counts / row_sum
+
+
 class LabeledMatrix:
     """Row/column-labeled dense matrix (host side; device ops take ``.values``)."""
 
@@ -43,23 +60,25 @@ class LabeledMatrix:
 
     # -- transforms ----------------------------------------------------------
     def laplace_correct(self, pseudo_count: float = 1.0) -> "LabeledMatrix":
-        """Add pseudo-count to any all-zero row (the reference's correction in
-        StateTransitionProbability.java:65-95 guards rows never observed)."""
-        zero_rows = self.values.sum(axis=1) == 0
-        self.values[zero_rows, :] += pseudo_count
+        """Add pseudo-count to every cell of any row containing a zero — the
+        reference's correction (StateTransitionProbability.java:65-78 bumps
+        the whole row when any cell is 0, keeping all log-probs finite)."""
+        rows_with_zero = (self.values == 0).any(axis=1)
+        self.values[rows_with_zero, :] += pseudo_count
         return self
 
     def row_normalize(self, scale: Optional[int] = None) -> "LabeledMatrix":
-        """Normalize each row to sum 1 (or to ``scale`` as rounded ints, the
-        reference's scaled-int probability wire format, e.g.
-        ``trans.prob.scale=100``)."""
+        """Normalize each row to sum 1, or to ``scale`` via the reference's
+        integer floor division (same semantics as :func:`laplace_and_scale`
+        minus the Laplace step, which :meth:`laplace_correct` applies)."""
         sums = self.values.sum(axis=1, keepdims=True)
         sums[sums == 0] = 1.0
-        probs = self.values / sums
         if scale is not None:
-            self.values = np.rint(probs * scale)
+            self.values = np.floor_divide(
+                self.values.astype(np.int64) * scale,
+                sums.astype(np.int64)).astype(np.float64)
         else:
-            self.values = probs
+            self.values = self.values / sums
         return self
 
     # -- serialization (one CSV line per row) --------------------------------

@@ -162,8 +162,9 @@ class TestTables:
         m = LabeledMatrix(["A", "B"], ["A", "B"])
         m.add("A", "B", 3)
         m.add("A", "A", 1)
-        m.laplace_correct(1.0)          # row B is all zero
+        m.laplace_correct(1.0)          # row B is all zero -> +1 everywhere
         assert m.get("B", "A") == 1.0
+        assert m.get("A", "A") == 1.0   # row A had no zero, unchanged
         m.row_normalize(scale=100)
         assert m.get("A", "B") == 75.0
         lines = m.serialize_rows(as_int=True)

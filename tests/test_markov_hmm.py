@@ -1,0 +1,173 @@
+"""Markov chain + HMM: planted-matrix recovery, wire round-trips, classifier,
+Viterbi vs brute force on the tutorial's 3-state loyalty model."""
+
+import itertools
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from avenir_tpu.datagen import markov_sequences
+from avenir_tpu.models import hmm as H
+from avenir_tpu.models import markov as M
+from avenir_tpu.ops.scanops import (
+    viterbi_batch, viterbi_path, viterbi_scores_associative)
+
+
+class TestMarkovTrain:
+    def test_recovers_planted_matrix(self):
+        states = ["A", "B", "C"]
+        planted = np.asarray([[0.1, 0.6, 0.3],
+                              [0.5, 0.2, 0.3],
+                              [0.3, 0.3, 0.4]])
+        rows = markov_sequences(2000, states, planted, 10, 40, seed=3)
+        model = M.train([seq for _, seq in rows], states, scale=1)
+        np.testing.assert_allclose(model.trans, planted, atol=0.03)
+
+    def test_scaled_int_division(self):
+        # counts A->A:1 A->B:2, row has no zero after laplace? it has C=0
+        # -> +1 everywhere: (2,3,1) sum 6 -> scaled 1000: 333, 500, 166
+        model = M.train([["A", "A", "B", "A", "B"]], ["A", "B", "C"],
+                        scale=1000)
+        np.testing.assert_allclose(model.trans[0], [333, 500, 166])
+
+    def test_class_conditional_and_classify(self):
+        states = ["A", "B"]
+        churn = np.asarray([[0.8, 0.2], [0.7, 0.3]])
+        loyal = np.asarray([[0.2, 0.8], [0.3, 0.7]])
+        churn_rows = markov_sequences(300, states, churn, 10, 30, seed=1)
+        loyal_rows = markov_sequences(300, states, loyal, 10, 30, seed=2)
+        seqs = [s for _, s in churn_rows] + [s for _, s in loyal_rows]
+        labels = ["churn"] * 300 + ["loyal"] * 300
+        model = M.train(seqs, states, class_labels=labels, scale=1000)
+        pred, odds = M.classify(model, seqs, ("churn", "loyal"))
+        acc = (pred == np.asarray(labels)).mean()
+        assert acc > 0.95, acc
+        cm = M.validate(pred, labels, ["churn", "loyal"],
+                        positive_class="churn")
+        assert cm.accuracy > 0.95
+
+    def test_wire_round_trip(self, tmp_path):
+        states = ["A", "B"]
+        seqs = [["A", "B", "A"], ["B", "B", "A"]]
+        model = M.train(seqs, states, class_labels=["x", "y"],
+                        label_values=["x", "y"], scale=1000)
+        path = str(tmp_path / "markov.txt")
+        M.save_model(model, path)
+        lines = open(path).read().splitlines()
+        assert lines[0] == "A,B"
+        assert "classLabel:x" in lines
+        loaded = M.load_model(path, class_label_based=True, scale=1000)
+        np.testing.assert_allclose(loaded.class_trans["x"],
+                                   model.class_trans["x"])
+
+
+# the tutorial's concrete model
+# (resource/customer_loyalty_trajectory_tutorial.txt:18-30)
+LOYALTY_STATES = ["L", "N", "H"]
+LOYALTY_OBS = ["SL", "SS", "SM", "ML", "MS", "MM", "LL", "LS", "LM"]
+LOYALTY_TRANS = np.asarray([[.30, .45, .25], [.35, .40, .25], [.25, .35, .40]])
+LOYALTY_EMIT = np.asarray([
+    [.08, .05, .01, .15, .12, .07, .21, .17, .14],
+    [.10, .09, .08, .17, .15, .12, .11, .10, .08],
+    [.13, .18, .21, .08, .12, .14, .03, .04, .07]])
+LOYALTY_INIT = np.asarray([.38, .36, .26])
+
+
+def brute_force_viterbi(init, trans, emit, obs):
+    best, best_p = None, -1
+    for path in itertools.product(range(len(init)), repeat=len(obs)):
+        p = init[path[0]] * emit[path[0], obs[0]]
+        for t in range(1, len(obs)):
+            p *= trans[path[t - 1], path[t]] * emit[path[t], obs[t]]
+        if p > best_p:
+            best, best_p = path, p
+    return list(best), best_p
+
+
+class TestViterbi:
+    def _logs(self):
+        return (jnp.log(jnp.asarray(LOYALTY_INIT, jnp.float32)),
+                jnp.log(jnp.asarray(LOYALTY_TRANS, jnp.float32)),
+                jnp.log(jnp.asarray(LOYALTY_EMIT, jnp.float32)))
+
+    def test_matches_brute_force(self):
+        li, lt, le = self._logs()
+        rng = np.random.default_rng(0)
+        for _ in range(5):
+            obs = rng.integers(0, 9, size=6)
+            path, score = viterbi_path(li, lt, le, jnp.asarray(obs))
+            bf_path, bf_p = brute_force_viterbi(
+                LOYALTY_INIT, LOYALTY_TRANS, LOYALTY_EMIT, obs)
+            assert list(np.asarray(path)) == bf_path
+            assert float(score) == pytest.approx(np.log(bf_p), rel=1e-4)
+
+    def test_batch_with_padding(self):
+        li, lt, le = self._logs()
+        obs = jnp.asarray([[0, 3, 6, 0, 0], [1, 2, 4, 7, 8]])
+        lengths = jnp.asarray([3, 5])
+        paths, scores = viterbi_batch(li, lt, le, obs, lengths)
+        # padded row must match its unpadded solo run on the valid prefix
+        solo, solo_score = viterbi_path(li, lt, le, jnp.asarray([0, 3, 6]))
+        assert list(np.asarray(paths)[0, :3]) == list(np.asarray(solo))
+        assert float(scores[0]) == pytest.approx(float(solo_score), rel=1e-5)
+
+    def test_associative_scan_matches_sequential(self):
+        li, lt, le = self._logs()
+        rng = np.random.default_rng(1)
+        obs = jnp.asarray(rng.integers(0, 9, size=64))
+        _, seq_score = viterbi_path(li, lt, le, obs)
+        assoc = viterbi_scores_associative(li, lt, le, obs)
+        assert float(jnp.max(assoc)) == pytest.approx(float(seq_score),
+                                                      rel=1e-4)
+
+
+class TestHmm:
+    def test_fully_tagged_counts(self):
+        rows = [["o1:S", "o2:T", "o1:T"],
+                ["o2:S", "o1:S", "o2:T"]]
+        model = H.train_fully_tagged(rows, ["S", "T"], ["o1", "o2"], scale=1)
+        # raw counts before normalize: trans S->T:2, S->S:1, T->T:1
+        # initial: S twice, T zero -> laplace bumps the row to (3,1)
+        np.testing.assert_allclose(model.initial, [0.75, 0.25])
+        assert model.trans[0, 1] > model.trans[1, 0]
+        assert model.emit[0, 0] == pytest.approx(2 / 3)
+
+    def test_wire_round_trip_tutorial_format(self, tmp_path):
+        model = H.HmmModel(states=LOYALTY_STATES, observations=LOYALTY_OBS,
+                           trans=LOYALTY_TRANS, emit=LOYALTY_EMIT,
+                           initial=LOYALTY_INIT, scale=1)
+        path = str(tmp_path / "loyalty_model.txt")
+        H.save_model(model, path)
+        lines = open(path).read().splitlines()
+        assert lines[0] == "L,N,H"
+        assert lines[1].startswith("SL,SS,")
+        assert len(lines) == 2 + 3 + 3 + 1
+        loaded = H.load_model(path)
+        np.testing.assert_allclose(loaded.trans, LOYALTY_TRANS)
+        np.testing.assert_allclose(loaded.initial, LOYALTY_INIT)
+
+    def test_predict_states_reversed(self):
+        model = H.HmmModel(states=LOYALTY_STATES, observations=LOYALTY_OBS,
+                           trans=LOYALTY_TRANS, emit=LOYALTY_EMIT,
+                           initial=LOYALTY_INIT, scale=1)
+        rows = [["SL", "ML", "LL"], ["SM", "SS"]]
+        rev = H.predict_states(model, rows, reversed_output=True)
+        fwd = H.predict_states(model, rows, reversed_output=False)
+        assert rev[0] == fwd[0][::-1]
+        assert len(rev[1]) == 2
+        # brute-force check forward path
+        obs = [LOYALTY_OBS.index(o) for o in rows[0]]
+        bf_path, _ = brute_force_viterbi(LOYALTY_INIT, LOYALTY_TRANS,
+                                         LOYALTY_EMIT, obs)
+        assert fwd[0] == [LOYALTY_STATES[s] for s in bf_path]
+
+    def test_partially_tagged(self):
+        # states S/T planted among observations; o1 near S, o2 near T
+        rows = [["o1", "S", "o1", "o2", "T", "o2"],
+                ["o1", "S", "o1", "o2", "T", "o2"]]
+        model = H.train_partially_tagged(rows, ["S", "T"], ["o1", "o2"],
+                                         window_function=[3, 2, 1], scale=1)
+        assert model.emit[0, 0] > model.emit[0, 1]  # S emits o1 more
+        assert model.emit[1, 1] > model.emit[1, 0]  # T emits o2 more
+        assert model.trans[0, 1] > model.trans[1, 0]
