@@ -398,6 +398,83 @@ def run_viterbi_state_predictor(conf: JobConfig, in_path: str,
             fh.write(delim_out.join([row[id_ord]] + path) + "\n")
 
 
+def _run_batch_bandit(algorithm: str, conf: JobConfig, in_path: str,
+                      out_path: str) -> None:
+    """Shared driver for the four MR batch bandits: input sorted
+    ``group,item,count,reward`` rows, output ``group,item`` selections."""
+    from avenir_tpu.models import bandits as B
+    delim = conf.get("field.delim.regex", ",")
+    rows = read_csv_lines(in_path, delim)
+    count_ord = conf.get_int("count.ordinal", 2)
+    reward_ord = conf.get_int("reward.ordinal", 3)
+    groups: Dict[str, list] = {}
+    for r in rows:
+        groups.setdefault(r[0], []).append(r)
+    group_items = {g: B.GroupItems.from_rows(rs, count_ord, reward_ord)
+                   for g, rs in groups.items()}
+    batch_sizes = None
+    bc_path = conf.get("group.item.count.path")
+    if bc_path:
+        batch_sizes = {r[0]: int(r[1]) for r in read_csv_lines(bc_path, ",")}
+    cfg = B.BanditConfig(
+        round_num=conf.get_int("current.round.num", 1),
+        batch_size=conf.get_int("batch.size", 1),
+        random_selection_prob=conf.get_float("random.selection.prob", 0.5),
+        prob_reduction_constant=conf.get_float("prob.reduction.constant", 1.0),
+        prob_reduction_algorithm=conf.get("prob.reduction.algorithm", "linear"),
+        auer_greedy_constant=conf.get_int("auer.greedy.constant", 5),
+        temp_constant=conf.get_float("temp.constant", 0.1),
+        exploration_count_factor=conf.get_int("exploration.count.factor", 2),
+        exploration_count_strategy=conf.get("exploration.count.strategy",
+                                            "simple"),
+        reward_diff=conf.get_float("reward.diff", 0.1),
+        prob_diff=conf.get_float("prob.diff", 0.1))
+    selections = B.select_all_groups(algorithm, group_items, cfg,
+                                     batch_sizes,
+                                     seed=conf.get_int("random.seed", 0))
+    delim_out = conf.get("field.delim", ",")
+    with open(out_path, "w") as fh:
+        for gid, item in selections:
+            fh.write(delim_out.join([gid, item]) + "\n")
+
+
+def run_reinforcement_learner(conf: JobConfig, in_path: str,
+                              out_path: str) -> None:
+    """Online RL loop (reference ReinforcementLearnerTopology): events in
+    from ``in_path`` (one event id per line), actions out to ``out_path``
+    as ``eventID,action[,action...]``; rewards drained from
+    ``reward.data.path`` lines ``action,reward`` before each event, like
+    the bolt (ReinforcementLearnerBolt.java:93-125). A Redis deployment
+    uses avenir_tpu.stream.RedisQueues instead of files."""
+    from avenir_tpu.stream.loop import InProcQueues, OnlineLearnerLoop
+    learner_type = conf.get_required("learner.type")
+    actions = conf.get_list("action.list")
+    if not actions:
+        raise ValueError("action.list must name the candidate actions")
+    queues = InProcQueues()
+    for row in read_csv_lines(in_path, conf.get("field.delim.regex", ",")):
+        queues.push_event(row[0])
+    reward_path = conf.get("reward.data.path")
+    if reward_path:
+        for row in read_csv_lines(reward_path,
+                                  conf.get("field.delim.regex", ",")):
+            queues.push_reward(row[0], float(row[1]))
+    loop = OnlineLearnerLoop(
+        learner_type, actions, conf.as_dict(), queues,
+        seed=conf.get_int("random.seed", 0))
+    stats = loop.run()
+    delim_out = conf.get("field.delim", ",")
+    with open(out_path, "w") as fh:
+        while True:
+            entry = queues.pop_action()
+            if entry is None:
+                break
+            event_id, selections = entry
+            fh.write(delim_out.join([event_id] + selections) + "\n")
+    print(f'{{"events": {stats.events}, "rewards": {stats.rewards}, '
+          f'"actions": {stats.actions_written}}}')
+
+
 VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "BayesianDistribution": run_bayesian_distribution,
     "BayesianPredictor": run_bayesian_predictor,
@@ -410,6 +487,15 @@ VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "MarkovModelClassifier": run_markov_model_classifier,
     "HiddenMarkovModelBuilder": run_hmm_builder,
     "ViterbiStatePredictor": run_viterbi_state_predictor,
+    "GreedyRandomBandit": lambda c, i, o: _run_batch_bandit(
+        "GreedyRandomBandit", c, i, o),
+    "AuerDeterministic": lambda c, i, o: _run_batch_bandit(
+        "AuerDeterministic", c, i, o),
+    "SoftMaxBandit": lambda c, i, o: _run_batch_bandit(
+        "SoftMaxBandit", c, i, o),
+    "RandomFirstGreedyBandit": lambda c, i, o: _run_batch_bandit(
+        "RandomFirstGreedyBandit", c, i, o),
+    "ReinforcementLearnerTopology": run_reinforcement_learner,
 }
 
 

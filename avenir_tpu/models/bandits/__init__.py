@@ -1,1 +1,13 @@
+"""Multi-armed bandits: batch MR-style selectors + streaming learners."""
 
+from avenir_tpu.models.bandits.learners import (
+    ALGORITHMS, Learner, LearnerConfig, LearnerState, create,
+)
+from avenir_tpu.models.bandits.batch import (
+    BanditConfig, GroupItems, SELECTORS, select_all_groups,
+)
+
+__all__ = [
+    "ALGORITHMS", "Learner", "LearnerConfig", "LearnerState", "create",
+    "BanditConfig", "GroupItems", "SELECTORS", "select_all_groups",
+]

@@ -1,0 +1,582 @@
+"""The 10 streaming multi-armed-bandit learners, TPU-functional.
+
+Re-designs the reference's ``ReinforcementLearner`` hierarchy
+(src/main/java/org/avenir/reinforce/ReinforcementLearner.java:35-167 and the
+ten subclasses) as pure functions over jnp pytree states:
+
+    state = ALGO.init(key, n_actions, cfg)
+    state, action = ALGO.next_action(state)        # jittable
+    state = ALGO.set_reward(state, action, reward) # jittable
+
+Because states are fixed-shape pytrees, a *group* of learners (the
+reference's ReinforcementLearnerGroup, one learner per context) advances in a
+single ``jax.vmap``-ed jitted step — the Storm bolt's per-event loop becomes
+one device dispatch for every context at once (see ``avenir_tpu.stream``).
+
+Faithfulness notes:
+- factory names match ReinforcementLearnerFactory.java:35-63 exactly.
+- min-trial override (ReinforcementLearner.selectActionBasedOnMinTrial
+  :142-152) is honored by every learner that honors it in the reference.
+- DEVIATION (documented): the reference's ε-greedy branch is inverted —
+  ``if (curProb < Math.random()) select random`` (RandomGreedyLearner.java
+  and GreedyRandomBandit.java:283) makes the *random* branch more likely as
+  the exploration probability decays toward 0. This build implements the
+  evident intent: explore with probability curProb.
+- SoftMax temperature decay compounds exactly as written in the reference
+  (``tempConstant /= round`` per selection, SoftMaxLearner.java) — quirky
+  but preserved, with the min.temp.constant floor.
+- IntervalEstimatorLearner's histogram confidence bounds follow chombo
+  HistogramStat.getConfidenceBounds' percentile contract: the upper bound is
+  the bin value at the (50 + limit/2) percentile of the reward histogram.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+BIG = 1e30
+
+
+@dataclass(frozen=True)
+class LearnerConfig:
+    """Config keys straight from the reference (ConfigUtility reads)."""
+
+    batch_size: int = 1                    # batch.size
+    min_trial: int = -1                    # min.trial
+    reward_scale: int = 100                # reward.scale
+    # randomGreedy
+    random_selection_prob: float = 0.5     # random.selection.prob
+    prob_reduction_algorithm: str = "linear"  # prob.reduction.algorithm
+    prob_reduction_constant: float = 1.0   # prob.reduction.constant
+    min_prob: float = -1.0                 # min.prob
+    # softMax
+    temp_constant: float = 100.0           # temp.constant
+    min_temp_constant: float = -1.0        # min.temp.constant
+    temp_reduction_algorithm: str = "linear"  # temp.reduction.algorithm
+    # ucb2
+    ucb2_alpha: float = 0.1                # ucb2.alpha
+    # actionPursuit
+    pursuit_learning_rate: float = 0.05    # pursuit.learning.rate
+    # rewardComparison
+    preference_change_rate: float = 0.01   # preference.change.rate
+    reference_reward_change_rate: float = 0.01  # reference.reward.change.rate
+    initial_reference_reward: float = 100.0     # intial.reference.reward (sic)
+    # exponentialWeight
+    distr_constant: float = 0.1            # distr.constant (EXP3 gamma)
+    # sampsonSampler
+    min_sample_size: int = 5               # min.sample.size
+    max_reward: int = 100                  # max.reward
+    reward_buffer_size: int = 256          # device ring-buffer capacity
+    # intervalEstimator
+    bin_width: int = 10                    # bin.width
+    confidence_limit: int = 90             # confidence.limit
+    min_confidence_limit: int = 50         # min.confidence.limit
+    confidence_limit_reduction_step: int = 5    # confidence.limit.reduction.step
+    confidence_limit_reduction_round_interval: int = 50  # ...round.interval
+    min_distr_sample: int = 10             # min.reward.distr.sample
+
+    @staticmethod
+    def from_dict(conf: Dict[str, Any]) -> "LearnerConfig":
+        mapping = {
+            "batch.size": "batch_size", "min.trial": "min_trial",
+            "reward.scale": "reward_scale",
+            "random.selection.prob": "random_selection_prob",
+            "prob.reduction.algorithm": "prob_reduction_algorithm",
+            "prob.reduction.constant": "prob_reduction_constant",
+            "min.prob": "min_prob", "temp.constant": "temp_constant",
+            "min.temp.constant": "min_temp_constant",
+            "temp.reduction.algorithm": "temp_reduction_algorithm",
+            "ucb2.alpha": "ucb2_alpha",
+            "pursuit.learning.rate": "pursuit_learning_rate",
+            "preference.change.rate": "preference_change_rate",
+            "reference.reward.change.rate": "reference_reward_change_rate",
+            "intial.reference.reward": "initial_reference_reward",
+            "distr.constant": "distr_constant",
+            "min.sample.size": "min_sample_size", "max.reward": "max_reward",
+            "bin.width": "bin_width", "confidence.limit": "confidence_limit",
+            "min.confidence.limit": "min_confidence_limit",
+            "confidence.limit.reduction.step":
+                "confidence_limit_reduction_step",
+            "confidence.limit.reduction.round.interval":
+                "confidence_limit_reduction_round_interval",
+            "min.reward.distr.sample": "min_distr_sample",
+        }
+        kwargs = {}
+        for key, attr in mapping.items():
+            if key in conf:
+                default = getattr(LearnerConfig, attr)
+                cast = type(default)
+                kwargs[attr] = cast(conf[key])
+        return LearnerConfig(**kwargs)
+
+
+@struct.dataclass
+class LearnerState:
+    """Superset state pytree; each algorithm uses the slices it needs.
+
+    All per-action arrays are [A]; buffers are [A, R]; histogram [A, B].
+    """
+
+    key: jax.Array                    # PRNG
+    total_trials: jnp.ndarray         # scalar int32
+    trial_counts: jnp.ndarray         # [A] int32  (Action.trialCount)
+    reward_sum: jnp.ndarray           # [A] float32 (SimpleStat sum)
+    reward_count: jnp.ndarray         # [A] float32
+    probs: jnp.ndarray                # [A] sampler distribution
+    weights: jnp.ndarray              # [A] EXP3 weights / action prefs
+    scalar_a: jnp.ndarray             # algo scalar (temp / refReward / ...)
+    scalar_b: jnp.ndarray             # algo scalar (epoch size / conf limit)
+    scalar_c: jnp.ndarray             # algo scalar (epoch trials / lastRound)
+    current_action: jnp.ndarray       # scalar int32 (ucb2 epoch arm)
+    epochs: jnp.ndarray               # [A] int32 (ucb2)
+    buffer: jnp.ndarray               # [A, R] float32 reward samples
+    buffer_len: jnp.ndarray           # [A] int32
+    hist: jnp.ndarray                 # [A, B] float32 reward histogram
+
+
+def _blank_state(key, n_actions: int, cfg: LearnerConfig,
+                 n_bins: int = 1) -> LearnerState:
+    r = cfg.reward_buffer_size
+    return LearnerState(
+        key=key,
+        total_trials=jnp.zeros((), jnp.int32),
+        trial_counts=jnp.zeros(n_actions, jnp.int32),
+        reward_sum=jnp.zeros(n_actions, jnp.float32),
+        reward_count=jnp.zeros(n_actions, jnp.float32),
+        probs=jnp.full((n_actions,), 1.0 / n_actions, jnp.float32),
+        weights=jnp.ones(n_actions, jnp.float32),
+        scalar_a=jnp.zeros((), jnp.float32),
+        scalar_b=jnp.zeros((), jnp.float32),
+        scalar_c=jnp.zeros((), jnp.float32),
+        current_action=jnp.asarray(-1, jnp.int32),
+        epochs=jnp.zeros(n_actions, jnp.int32),
+        buffer=jnp.zeros((n_actions, r), jnp.float32),
+        buffer_len=jnp.zeros(n_actions, jnp.int32),
+        hist=jnp.zeros((n_actions, n_bins), jnp.float32),
+    )
+
+
+def _avg_reward(state: LearnerState) -> jnp.ndarray:
+    return state.reward_sum / jnp.maximum(state.reward_count, 1.0)
+
+
+def _min_trial_override(state: LearnerState, cfg: LearnerConfig,
+                        chosen: jnp.ndarray) -> jnp.ndarray:
+    """selectActionBasedOnMinTrial: if the least-tried arm is under
+    min.trial, it takes precedence (ReinforcementLearner.java:142-152)."""
+    if cfg.min_trial <= 0:
+        return chosen
+    least = jnp.argmin(state.trial_counts)
+    return jnp.where(state.trial_counts[least] <= cfg.min_trial,
+                     least, chosen)
+
+
+def _select(state: LearnerState, action: jnp.ndarray) -> LearnerState:
+    return state.replace(
+        total_trials=state.total_trials + 1,
+        trial_counts=state.trial_counts.at[action].add(1))
+
+
+def _base_reward(state: LearnerState, action, reward,
+                 cfg: Optional[LearnerConfig] = None) -> LearnerState:
+    return state.replace(
+        reward_sum=state.reward_sum.at[action].add(reward),
+        reward_count=state.reward_count.at[action].add(1.0))
+
+
+# --------------------------------------------------------------------------
+# algorithms
+# --------------------------------------------------------------------------
+
+class randomGreedy:
+    """ε-greedy with linear/logLinear ε decay + min.prob floor
+    (RandomGreedyLearner.java; ε branch corrected, see module docstring)."""
+
+    @staticmethod
+    def init(key, n_actions: int, cfg: LearnerConfig) -> LearnerState:
+        return _blank_state(key, n_actions, cfg)
+
+    @staticmethod
+    def next_action(state: LearnerState, cfg: LearnerConfig):
+        t = (state.total_trials + 1).astype(jnp.float32)
+        p0 = cfg.random_selection_prob
+        if cfg.prob_reduction_algorithm == "none":
+            cur = jnp.asarray(p0, jnp.float32)
+        elif cfg.prob_reduction_algorithm == "linear":
+            cur = p0 * cfg.prob_reduction_constant / t
+        elif cfg.prob_reduction_algorithm == "logLinear":
+            cur = p0 * cfg.prob_reduction_constant * jnp.log(t) / t
+        else:
+            raise ValueError("invalid probability reduction algorithm")
+        cur = jnp.minimum(cur, p0)
+        if cfg.min_prob > 0:
+            cur = jnp.maximum(cur, cfg.min_prob)
+        key, k1, k2 = jax.random.split(state.key, 3)
+        explore = jax.random.uniform(k1) < cur
+        random_arm = jax.random.randint(k2, (), 0, state.probs.shape[0])
+        # reference floors the average to int before comparing (:92)
+        best = jnp.argmax(jnp.floor(_avg_reward(state)))
+        action = jnp.where(explore, random_arm, best)
+        action = _min_trial_override(state, cfg, action)
+        return _select(state.replace(key=key), action), action
+
+    set_reward = staticmethod(_base_reward)
+
+
+class upperConfidenceBoundOne:
+    """UCB1: avg + sqrt(2 ln T / n); untried arms first
+    (UpperConfidenceBoundOneLearner.java)."""
+
+    @staticmethod
+    def init(key, n_actions, cfg):
+        return _blank_state(key, n_actions, cfg)
+
+    @staticmethod
+    def next_action(state: LearnerState, cfg: LearnerConfig):
+        t = (state.total_trials + 1).astype(jnp.float32)
+        n = state.trial_counts.astype(jnp.float32)
+        bonus = jnp.where(n > 0, jnp.sqrt(2.0 * jnp.log(t) /
+                                          jnp.maximum(n, 1.0)), BIG)
+        action = jnp.argmax(_avg_reward(state) + bonus)
+        action = _min_trial_override(state, cfg, action)
+        return _select(state, action), action
+
+    @staticmethod
+    def set_reward(state, action, reward, cfg: LearnerConfig = LearnerConfig()):
+        return _base_reward(state, action, reward / cfg.reward_scale)
+
+
+class upperConfidenceBoundTwo:
+    """UCB2 epochs: τ(r) = (1+α)^r, bonus sqrt((1+α) ln(eT/τ) / 2τ); the
+    chosen arm plays for an epoch (UpperConfidenceBoundTwoLearner.java)."""
+
+    @staticmethod
+    def init(key, n_actions, cfg):
+        return _blank_state(key, n_actions, cfg)
+
+    @staticmethod
+    def next_action(state: LearnerState, cfg: LearnerConfig):
+        alpha = cfg.ucb2_alpha
+
+        def in_epoch(state):
+            return state.replace(scalar_c=state.scalar_c + 1), \
+                state.current_action.astype(jnp.int32)
+
+        def new_epoch(state):
+            # close the previous epoch
+            epochs = jnp.where(
+                state.current_action >= 0,
+                state.epochs.at[jnp.maximum(state.current_action, 0)].add(1),
+                state.epochs)
+            t = (state.total_trials + 1).astype(jnp.float32)
+            tao = jnp.where(epochs == 0, 1.0,
+                            jnp.power(1.0 + alpha, epochs.astype(jnp.float32)))
+            a = (1 + alpha) * jnp.log(jnp.e * t / tao) / (2.0 * tao)
+            n = state.trial_counts.astype(jnp.float32)
+            score = jnp.where(n > 0, _avg_reward(state) + jnp.sqrt(a), BIG)
+            action = jnp.argmax(score)
+            ep = epochs[action].astype(jnp.float32)
+            size = jnp.round(jnp.power(1 + alpha, ep + 1) -
+                             jnp.power(1 + alpha, ep))
+            size = jnp.maximum(size, 1.0)
+            return state.replace(epochs=epochs,
+                                 current_action=action.astype(jnp.int32),
+                                 scalar_b=size,
+                                 scalar_c=jnp.ones((), jnp.float32)), \
+                action.astype(jnp.int32)
+
+        cont = (state.current_action >= 0) & (state.scalar_c < state.scalar_b)
+        state, action = jax.lax.cond(cont, in_epoch, new_epoch, state)
+        action = _min_trial_override(state, cfg, action)
+        return _select(state, action), action
+
+    @staticmethod
+    def set_reward(state, action, reward, cfg: LearnerConfig = LearnerConfig()):
+        return _base_reward(state, action, reward / cfg.reward_scale)
+
+
+class softMax:
+    """Boltzmann over average rewards with the reference's compounding
+    temperature decay + floor (SoftMaxLearner.java)."""
+
+    @staticmethod
+    def init(key, n_actions, cfg):
+        state = _blank_state(key, n_actions, cfg)
+        return state.replace(scalar_a=jnp.asarray(cfg.temp_constant,
+                                                  jnp.float32))
+
+    @staticmethod
+    def next_action(state: LearnerState, cfg: LearnerConfig):
+        temp = jnp.maximum(state.scalar_a, 1e-6)
+        logits = _avg_reward(state) / temp
+        key, k1 = jax.random.split(state.key)
+        action = jax.random.categorical(k1, logits)
+        action = _min_trial_override(state, cfg, action)
+        # temperature reduction (as written in the reference)
+        rnd = (state.total_trials + 1 - jnp.maximum(cfg.min_trial, 0)
+               ).astype(jnp.float32)
+        if cfg.temp_reduction_algorithm == "linear":
+            new_temp = jnp.where(rnd > 1, state.scalar_a / rnd, state.scalar_a)
+        elif cfg.temp_reduction_algorithm == "logLinear":
+            new_temp = jnp.where(rnd > 1,
+                                 state.scalar_a * jnp.log(rnd) / rnd,
+                                 state.scalar_a)
+        else:
+            new_temp = state.scalar_a
+        if cfg.min_temp_constant > 0:
+            new_temp = jnp.maximum(new_temp, cfg.min_temp_constant)
+        state = state.replace(key=key, scalar_a=new_temp)
+        return _select(state, action), action
+
+    set_reward = staticmethod(_base_reward)
+
+
+class actionPursuit:
+    """Pursuit: winner prob += lr (1-p), losers -= lr p
+    (ActionPursuitLearner.java:55-80)."""
+
+    @staticmethod
+    def init(key, n_actions, cfg):
+        return _blank_state(key, n_actions, cfg)
+
+    @staticmethod
+    def next_action(state: LearnerState, cfg: LearnerConfig):
+        key, k1 = jax.random.split(state.key)
+        action = jax.random.choice(k1, state.probs.shape[0], p=state.probs)
+        return _select(state.replace(key=key), action), action
+
+    @staticmethod
+    def set_reward(state, action, reward, cfg: LearnerConfig = LearnerConfig()):
+        state = _base_reward(state, action, reward)
+        lr = cfg.pursuit_learning_rate
+        best = jnp.argmax(_avg_reward(state))
+        is_best = jnp.arange(state.probs.shape[0]) == best
+        probs = jnp.where(is_best, state.probs + lr * (1.0 - state.probs),
+                          state.probs - lr * state.probs)
+        return state.replace(probs=probs / jnp.sum(probs))
+
+
+class rewardComparison:
+    """Preference learning vs an adaptive reference reward; softmax over
+    preferences (RewardComparisonLearner.java)."""
+
+    @staticmethod
+    def init(key, n_actions, cfg):
+        state = _blank_state(key, n_actions, cfg)
+        return state.replace(
+            weights=jnp.zeros(n_actions, jnp.float32),        # actionPrefs
+            scalar_a=jnp.asarray(cfg.initial_reference_reward, jnp.float32))
+
+    @staticmethod
+    def next_action(state: LearnerState, cfg: LearnerConfig):
+        key, k1 = jax.random.split(state.key)
+        action = jax.random.categorical(k1, state.weights)
+        return _select(state.replace(key=key), action), action
+
+    @staticmethod
+    def set_reward(state, action, reward, cfg: LearnerConfig = LearnerConfig()):
+        state = _base_reward(state, action, reward)
+        mean = _avg_reward(state)[action]
+        pref = state.weights[action] + cfg.preference_change_rate * (
+            mean - state.scalar_a)
+        ref = state.scalar_a + cfg.reference_reward_change_rate * (
+            mean - state.scalar_a)
+        return state.replace(weights=state.weights.at[action].set(pref),
+                             scalar_a=ref)
+
+
+class exponentialWeight:
+    """EXP3 (ExponentialWeightLearner.java): p = (1-γ) w/Σw + γ/K;
+    w *= exp(γ (r/p)/K)."""
+
+    @staticmethod
+    def init(key, n_actions, cfg):
+        return _blank_state(key, n_actions, cfg)
+
+    @staticmethod
+    def next_action(state: LearnerState, cfg: LearnerConfig):
+        gamma = cfg.distr_constant
+        k_arms = state.probs.shape[0]
+        probs = (1.0 - gamma) * state.weights / jnp.sum(state.weights) \
+            + gamma / k_arms
+        key, k1 = jax.random.split(state.key)
+        action = jax.random.choice(k1, k_arms, p=probs)
+        state = state.replace(key=key, probs=probs)
+        return _select(state, action), action
+
+    @staticmethod
+    def set_reward(state, action, reward, cfg: LearnerConfig = LearnerConfig()):
+        state = _base_reward(state, action, reward)
+        gamma = cfg.distr_constant
+        k_arms = state.probs.shape[0]
+        scaled = reward / cfg.reward_scale
+        w = state.weights[action] * jnp.exp(
+            gamma * (scaled / jnp.maximum(state.probs[action], 1e-9)) / k_arms)
+        return state.replace(weights=state.weights.at[action].set(w))
+
+
+class sampsonSampler:
+    """Thompson sampling by resampling observed rewards from a per-arm
+    device ring buffer (SampsonSamplerLearner.java); under min.sample.size
+    an arm draws uniform in [0, max.reward)."""
+
+    enforce_mean_floor = False
+
+    @classmethod
+    def init(cls, key, n_actions, cfg):
+        return _blank_state(key, n_actions, cfg)
+
+    @classmethod
+    def next_action(cls, state: LearnerState, cfg: LearnerConfig):
+        key, k1, k2 = jax.random.split(state.key, 3)
+        n_actions, r = state.buffer.shape
+        # sample within the ring-buffer window: past capacity, older rewards
+        # have been overwritten, so the index bound clamps to the buffer size
+        idx = jax.random.randint(
+            k1, (n_actions,), 0,
+            jnp.maximum(jnp.minimum(state.buffer_len, r), 1))
+        sampled = state.buffer[jnp.arange(n_actions), idx]
+        if cls.enforce_mean_floor:
+            sampled = jnp.maximum(sampled, _avg_reward(state))
+        uniform = jax.random.uniform(k2, (n_actions,)) * cfg.max_reward
+        scores = jnp.where(state.buffer_len > cfg.min_sample_size,
+                           sampled, uniform)
+        action = jnp.argmax(scores)
+        return _select(state.replace(key=key), action), action
+
+    @classmethod
+    def set_reward(cls, state, action, reward,
+                   cfg: LearnerConfig = LearnerConfig()):
+        state = _base_reward(state, action, reward)
+        slot = jnp.mod(state.buffer_len[action], state.buffer.shape[1])
+        return state.replace(
+            buffer=state.buffer.at[action, slot].set(reward),
+            buffer_len=state.buffer_len.at[action].add(1))
+
+
+class optimisticSampsonSampler(sampsonSampler):
+    """Thompson with rewards floored at the arm's mean
+    (OptimisticSampsonSamplerLearner.java:30-54)."""
+
+    enforce_mean_floor = True
+
+
+class intervalEstimator:
+    """Histogram upper-confidence-bound with a shrinking confidence limit
+    (IntervalEstimatorLearner.java:80-154): random until every arm has
+    min.reward.distr.sample samples, then pick the arm whose histogram
+    upper bound at the current confidence limit is highest; the limit
+    decays by step every interval rounds down to the minimum."""
+
+    @staticmethod
+    def init(key, n_actions, cfg):
+        n_bins = max(cfg.max_reward // max(cfg.bin_width, 1) + 1, 1)
+        state = _blank_state(key, n_actions, cfg, n_bins=n_bins)
+        return state.replace(
+            scalar_b=jnp.asarray(cfg.confidence_limit, jnp.float32),
+            scalar_c=jnp.ones((), jnp.float32))   # lastRoundNum
+
+    @staticmethod
+    def next_action(state: LearnerState, cfg: LearnerConfig):
+        key, k1 = jax.random.split(state.key)
+        n_actions, n_bins = state.hist.shape
+        counts = jnp.sum(state.hist, axis=1)
+        low_sample = jnp.any(counts < cfg.min_distr_sample)
+
+        t = (state.total_trials + 1).astype(jnp.float32)
+        red_step = jnp.floor((t - state.scalar_c) /
+                             cfg.confidence_limit_reduction_round_interval)
+        new_limit = jnp.where(
+            red_step > 0,
+            jnp.maximum(state.scalar_b -
+                        red_step * cfg.confidence_limit_reduction_step,
+                        cfg.min_confidence_limit),
+            state.scalar_b)
+        new_last = jnp.where(red_step > 0, t, state.scalar_c)
+
+        # upper confidence bound: bin value at percentile (50 + limit/2)
+        target = (50.0 + new_limit / 2.0) / 100.0
+        cum = jnp.cumsum(state.hist, axis=1) / jnp.maximum(
+            counts[:, None], 1.0)
+        first_bin = jnp.argmax(cum >= target, axis=1)
+        upper = (first_bin + 1) * cfg.bin_width
+        ie_action = jnp.argmax(jnp.where(counts > 0, upper, -1))
+        random_action = jax.random.randint(k1, (), 0, n_actions)
+        action = jnp.where(low_sample, random_action, ie_action)
+        state = state.replace(
+            key=key,
+            scalar_b=jnp.where(low_sample, state.scalar_b, new_limit),
+            scalar_c=jnp.where(low_sample, state.scalar_c, new_last))
+        return _select(state, action), action
+
+    @staticmethod
+    def set_reward(state, action, reward,
+                   cfg: LearnerConfig = LearnerConfig()):
+        state = _base_reward(state, action, reward)
+        n_bins = state.hist.shape[1]
+        bin_id = jnp.clip(jnp.asarray(reward // cfg.bin_width, jnp.int32),
+                          0, n_bins - 1)
+        return state.replace(hist=state.hist.at[action, bin_id].add(1.0))
+
+
+ALGORITHMS = {
+    "intervalEstimator": intervalEstimator,
+    "sampsonSampler": sampsonSampler,
+    "optimisticSampsonSampler": optimisticSampsonSampler,
+    "randomGreedy": randomGreedy,
+    "upperConfidenceBoundOne": upperConfidenceBoundOne,
+    "upperConfidenceBoundTwo": upperConfidenceBoundTwo,
+    "softMax": softMax,
+    "actionPursuit": actionPursuit,
+    "rewardComparison": rewardComparison,
+    "exponentialWeight": exponentialWeight,
+}
+
+
+class Learner:
+    """Host-side wrapper with the reference's API (string action ids,
+    nextActions batch, setReward) around the jitted functional core —
+    the drop-in for ReinforcementLearnerFactory.create."""
+
+    def __init__(self, learner_type: str, actions, config: Dict[str, Any],
+                 seed: int = 0):
+        if learner_type not in ALGORITHMS:
+            raise ValueError(f"invalid learner type:{learner_type}")
+        self.learner_type = learner_type
+        self.algo = ALGORITHMS[learner_type]
+        self.actions = list(actions)
+        self.cfg = (config if isinstance(config, LearnerConfig)
+                    else LearnerConfig.from_dict(config))
+        self.state = self.algo.init(jax.random.PRNGKey(seed),
+                                    len(self.actions), self.cfg)
+        cfg = self.cfg
+        self._next = jax.jit(lambda s: self.algo.next_action(s, cfg))
+        self._reward = jax.jit(
+            lambda s, a, r: self.algo.set_reward(s, a, r, cfg=cfg))
+
+    def next_action(self) -> str:
+        self.state, action = self._next(self.state)
+        return self.actions[int(action)]
+
+    def next_actions(self):
+        return [self.next_action() for _ in range(self.cfg.batch_size)]
+
+    def set_reward(self, action_id: str, reward: float) -> None:
+        idx = self.actions.index(action_id)
+        self.state = self._reward(self.state, jnp.asarray(idx),
+                                  jnp.asarray(float(reward)))
+
+    def get_stat(self) -> str:
+        counts = ",".join(str(int(c)) for c in self.state.trial_counts)
+        return f"trialCounts:{counts}"
+
+
+def create(learner_type: str, actions, config: Dict[str, Any],
+           seed: int = 0) -> Learner:
+    """ReinforcementLearnerFactory.create equivalent (same type names)."""
+    return Learner(learner_type, actions, config, seed)

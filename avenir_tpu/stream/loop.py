@@ -1,0 +1,202 @@
+"""Online RL serving loop — the Storm topology, TPU-native.
+
+The reference's always-on path is a Storm topology (ReinforcementLearner
+Topology.java:42-85): RedisSpout polls an event queue, shuffle-groups tuples
+to ReinforcementLearnerBolt instances which drain rewards, call
+``learner.nextActions()`` and push selections to an action queue
+(ReinforcementLearnerBolt.java:93-125). Here the topology collapses to a
+host queue loop around the jitted learner step:
+
+    queues in -> drain rewards (setReward) -> next actions -> queue out
+
+following the bolt's reward-drain-then-select order, with micro-batching of
+events per dispatch (the bolt's own batching pattern, SURVEY.md §7 "online-
+loop latency"). Multi-context bandits (the reference's
+ReinforcementLearnerGroup) run as a ``GroupedLearner``: one stacked state
+pytree, one vmapped jitted step advancing every context at once.
+
+Queue adapters: in-process deques (testing/serving in one process) and a
+Redis adapter wire-compatible with the reference's lists (event rpop,
+action lpush ``eventID,action[,action...]``, reward lindex cursor —
+RedisSpout.java / RedisActionWriter.java / RedisRewardReader.java), gated on
+the ``redis`` package being importable.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.models.bandits.learners import (
+    ALGORITHMS, Learner, LearnerConfig)
+
+
+# --------------------------------------------------------------------------
+# queue adapters
+# --------------------------------------------------------------------------
+
+class InProcQueues:
+    """Event/action/reward queues in one process (deque-backed)."""
+
+    def __init__(self):
+        self.events: deque = deque()
+        self.actions: deque = deque()
+        self.rewards: deque = deque()
+
+    def push_event(self, event_id: str) -> None:
+        self.events.appendleft(event_id)
+
+    def pop_event(self) -> Optional[str]:
+        return self.events.pop() if self.events else None
+
+    def push_reward(self, action_id: str, reward: float) -> None:
+        self.rewards.appendleft((action_id, reward))
+
+    def drain_rewards(self) -> List[Tuple[str, float]]:
+        out = []
+        while self.rewards:
+            out.append(self.rewards.pop())
+        return out
+
+    def write_actions(self, event_id: str, actions: Sequence[str]) -> None:
+        self.actions.appendleft((event_id, list(actions)))
+
+    def pop_action(self):
+        return self.actions.pop() if self.actions else None
+
+
+class RedisQueues:
+    """Wire-compatible with the reference's Redis lists; requires ``redis``."""
+
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 event_queue: str = "eventQueue",
+                 action_queue: str = "actionQueue",
+                 reward_queue: str = "rewardQueue",
+                 field_delim: str = ","):
+        try:
+            import redis  # type: ignore
+        except ImportError as exc:  # pragma: no cover - env without redis
+            raise RuntimeError(
+                "RedisQueues needs the 'redis' package; use InProcQueues "
+                "or install redis") from exc
+        self._r = redis.StrictRedis(host=host, port=port)
+        self.event_queue = event_queue
+        self.action_queue = action_queue
+        self.reward_queue = reward_queue
+        self.delim = field_delim
+        # the reference's RedisRewardReader walks the list from the tail
+        # (oldest under lpush producers) with a negative decrementing cursor
+        self._reward_cursor = -1
+
+    def pop_event(self) -> Optional[str]:
+        raw = self._r.rpop(self.event_queue)
+        return raw.decode() if raw is not None else None
+
+    def drain_rewards(self) -> List[Tuple[str, float]]:
+        """lindex-cursor scan like RedisRewardReader: read tail-first
+        (oldest), decrementing, so lpush-ed new rewards are picked up next
+        drain and nothing is re-read."""
+        out = []
+        while True:
+            raw = self._r.lindex(self.reward_queue, self._reward_cursor)
+            if raw is None:
+                break
+            action_id, _, reward = raw.decode().partition(self.delim)
+            out.append((action_id, float(reward)))
+            self._reward_cursor -= 1
+        return out
+
+    def write_actions(self, event_id: str, actions: Sequence[str]) -> None:
+        self._r.lpush(self.action_queue,
+                      self.delim.join([event_id] + list(actions)))
+
+
+# --------------------------------------------------------------------------
+# single-learner loop (the bolt)
+# --------------------------------------------------------------------------
+
+@dataclass
+class LoopStats:
+    events: int = 0
+    rewards: int = 0
+    actions_written: int = 0
+
+
+class OnlineLearnerLoop:
+    """The ReinforcementLearnerBolt loop around one jitted learner."""
+
+    def __init__(self, learner_type: str, actions: Sequence[str],
+                 config: Dict[str, Any], queues, seed: int = 0):
+        self.learner = Learner(learner_type, actions, config, seed)
+        self.queues = queues
+        self.stats = LoopStats()
+
+    def step(self) -> bool:
+        """Process one event (rewards drained first, like the bolt
+        :96-99). Returns False when the event queue is empty."""
+        for action_id, reward in self.queues.drain_rewards():
+            self.learner.set_reward(action_id, reward)
+            self.stats.rewards += 1
+        event_id = self.queues.pop_event()
+        if event_id is None:
+            return False
+        selections = self.learner.next_actions()
+        self.queues.write_actions(event_id, selections)
+        self.stats.events += 1
+        self.stats.actions_written += len(selections)
+        return True
+
+    def run(self, max_events: Optional[int] = None) -> LoopStats:
+        processed = 0
+        while max_events is None or processed < max_events:
+            if not self.step():
+                break
+            processed += 1
+        return self.stats
+
+
+# --------------------------------------------------------------------------
+# grouped (multi-context) learner: one vmapped step for all contexts
+# --------------------------------------------------------------------------
+
+class GroupedLearner:
+    """ReinforcementLearnerGroup as a stacked state + vmapped jitted step.
+
+    All contexts share one algorithm/config/action-set; their states are
+    leaves stacked on axis 0, so ``next_for`` and ``reward_for`` on a batch
+    of context ids are single device dispatches.
+    """
+
+    def __init__(self, learner_type: str, n_groups: int,
+                 actions: Sequence[str], config: Dict[str, Any],
+                 seed: int = 0):
+        if learner_type not in ALGORITHMS:
+            raise ValueError(f"invalid learner type:{learner_type}")
+        self.algo = ALGORITHMS[learner_type]
+        self.actions = list(actions)
+        self.n_groups = n_groups
+        cfg = (config if isinstance(config, LearnerConfig)
+               else LearnerConfig.from_dict(config))
+        self.cfg = cfg
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_groups)
+        self.states = jax.vmap(
+            lambda k: self.algo.init(k, len(self.actions), cfg))(keys)
+        self._next = jax.jit(jax.vmap(
+            lambda s: self.algo.next_action(s, cfg)))
+        self._reward = jax.jit(jax.vmap(
+            lambda s, a, r: self.algo.set_reward(s, a, r, cfg=cfg)))
+
+    def next_all(self) -> List[str]:
+        """One action per context — single dispatch for every context."""
+        self.states, actions = self._next(self.states)
+        return [self.actions[int(a)] for a in actions]
+
+    def reward_all(self, action_ids: Sequence[str],
+                   rewards: Sequence[float]) -> None:
+        idx = jnp.asarray([self.actions.index(a) for a in action_ids])
+        self.states = self._reward(self.states, idx,
+                                   jnp.asarray(rewards, jnp.float32))

@@ -1,0 +1,160 @@
+"""Bandits: all 10 streaming learners converge on a planted best arm,
+factory parity, grouped vmapped learners, batch bandits, online loop."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.datagen import price_opt_arms
+from avenir_tpu.models import bandits as B
+from avenir_tpu.stream.loop import GroupedLearner, InProcQueues, OnlineLearnerLoop
+
+
+ACTIONS = ["a0", "a1", "a2", "a3"]
+BEST = "a2"
+TRUE_REWARDS = {"a0": 20, "a1": 35, "a2": 80, "a3": 45}
+
+CONFIG = {
+    "min.trial": 2, "reward.scale": 100, "max.reward": 100,
+    "min.sample.size": 3, "bin.width": 10, "confidence.limit": 90,
+    "min.confidence.limit": 50, "confidence.limit.reduction.step": 5,
+    "confidence.limit.reduction.round.interval": 20,
+    "min.reward.distr.sample": 5, "random.selection.prob": 0.3,
+    "min.prob": 0.05, "temp.constant": 30.0, "min.temp.constant": 1.0,
+    "distr.constant": 0.2, "pursuit.learning.rate": 0.05,
+    "preference.change.rate": 0.05, "reference.reward.change.rate": 0.05,
+    "intial.reference.reward": 50.0, "ucb2.alpha": 0.3,
+}
+
+
+def run_learner(learner_type, rounds=600, seed=3):
+    rng = np.random.default_rng(seed)
+    learner = B.create(learner_type, ACTIONS, CONFIG, seed=seed)
+    picks = []
+    for _ in range(rounds):
+        action = learner.next_action()
+        picks.append(action)
+        reward = max(int(rng.normal(TRUE_REWARDS[action], 8)), 0)
+        learner.set_reward(action, reward)
+    return picks
+
+
+class TestStreamingLearners:
+    @pytest.mark.parametrize("learner_type", sorted(B.ALGORITHMS.keys()))
+    def test_converges_to_best_arm(self, learner_type):
+        picks = run_learner(learner_type)
+        late = picks[-200:]
+        frac_best = late.count(BEST) / len(late)
+        assert frac_best > 0.4, (learner_type, frac_best)
+
+    def test_factory_rejects_unknown(self):
+        with pytest.raises(ValueError, match="invalid learner type"):
+            B.create("bogus", ACTIONS, CONFIG)
+
+    def test_factory_names_match_reference(self):
+        # ReinforcementLearnerFactory.java:35-63 registry
+        assert set(B.ALGORITHMS.keys()) == {
+            "intervalEstimator", "sampsonSampler", "optimisticSampsonSampler",
+            "randomGreedy", "upperConfidenceBoundOne",
+            "upperConfidenceBoundTwo", "softMax", "actionPursuit",
+            "rewardComparison", "exponentialWeight"}
+
+    def test_min_trial_forces_exploration(self):
+        learner = B.create("upperConfidenceBoundOne", ACTIONS,
+                           {**CONFIG, "min.trial": 5})
+        picks = [learner.next_action() for _ in range(20)]
+        # every arm must be tried at least min.trial times early on
+        assert all(picks.count(a) >= 5 for a in ACTIONS)
+
+
+class TestGroupedLearner:
+    def test_vmapped_contexts_converge_independently(self):
+        # context g's best arm is g % len(ACTIONS)
+        n_groups = 8
+        rng = np.random.default_rng(0)
+        gl = GroupedLearner("upperConfidenceBoundOne", n_groups, ACTIONS,
+                            CONFIG, seed=1)
+        for _ in range(400):
+            selections = gl.next_all()
+            rewards = []
+            for g, a in enumerate(selections):
+                best = ACTIONS[g % len(ACTIONS)]
+                mean = 80 if a == best else 30
+                rewards.append(max(int(rng.normal(mean, 8)), 0))
+            gl.reward_all(selections, rewards)
+        final = gl.next_all()
+        correct = sum(1 for g, a in enumerate(final)
+                      if a == ACTIONS[g % len(ACTIONS)])
+        assert correct >= n_groups - 2, final
+
+
+class TestBatchBandits:
+    def _group(self, counts, rewards):
+        return B.GroupItems(items=[f"i{j}" for j in range(len(counts))],
+                            counts=np.asarray(counts),
+                            rewards=np.asarray(rewards))
+
+    @pytest.mark.parametrize("algo", sorted(B.SELECTORS.keys()))
+    def test_selectors_return_batch(self, algo):
+        group = self._group([3, 5, 0, 2], [10, 60, 0, 30])
+        cfg = B.BanditConfig(round_num=3, batch_size=2)
+        out = B.SELECTORS[algo](group, cfg, np.random.default_rng(0))
+        assert len(out) == 2 and len(set(out)) == 2
+
+    def test_untried_first(self):
+        group = self._group([3, 0, 2, 0], [50, 0, 30, 0])
+        cfg = B.BanditConfig(round_num=2, batch_size=2)
+        out = B.SELECTORS["AuerDeterministic"](group, cfg,
+                                               np.random.default_rng(0))
+        assert set(out) == {"i1", "i3"}
+
+    def test_price_opt_converges(self):
+        """The price-optimization tutorial loop: per-round select ->
+        observe planted concave revenue -> aggregate -> next round."""
+        groups_spec = price_opt_arms(n_groups=20, seed=11)
+        rng = np.random.default_rng(5)
+        state = {g: B.GroupItems(items=arms, counts=np.zeros(len(arms), int),
+                                 rewards=np.zeros(len(arms), int))
+                 for g, (arms, _) in groups_spec.items()}
+        for round_num in range(1, 40):
+            cfg = B.BanditConfig(round_num=round_num, batch_size=1,
+                                 prob_reduction_algorithm="linear",
+                                 random_selection_prob=0.8,
+                                 prob_reduction_constant=8.0)
+            selections = B.select_all_groups("GreedyRandomBandit", state, cfg,
+                                             seed=7)
+            for gid, item in selections:
+                arms, expect = groups_spec[gid]
+                j = arms.index(item)
+                reward = max(int(rng.normal(expect[j], 2)), 1)
+                g = state[gid]
+                # running average like the tutorial's RunningAggregator
+                total = g.rewards[j] * g.counts[j] + reward
+                g.counts[j] += 1
+                g.rewards[j] = total // g.counts[j]
+        # most groups should have found their peak arm
+        hits = 0
+        for gid, (arms, expect) in groups_spec.items():
+            best_arm = int(np.argmax(expect))
+            picked = int(np.argmax(state[gid].rewards))
+            hits += int(picked == best_arm)
+        assert hits >= 14, hits
+
+
+class TestOnlineLoop:
+    def test_bolt_semantics(self):
+        queues = InProcQueues()
+        loop = OnlineLearnerLoop("randomGreedy", ACTIONS,
+                                 {**CONFIG, "batch.size": 2}, queues, seed=2)
+        rng = np.random.default_rng(1)
+        for i in range(50):
+            queues.push_event(f"e{i:03d}")
+            processed = loop.step()
+            assert processed
+            event_id, selections = queues.pop_action()
+            assert event_id == f"e{i:03d}" and len(selections) == 2
+            for a in selections:
+                queues.push_reward(
+                    a, max(int(rng.normal(TRUE_REWARDS[a], 5)), 0))
+        assert loop.stats.events == 50
+        assert loop.stats.rewards > 0
+        assert not loop.step()  # empty queue -> False
