@@ -475,6 +475,142 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
           f'"actions": {stats.actions_written}}}')
 
 
+def run_mutual_information(conf: JobConfig, in_path: str,
+                           out_path: str) -> None:
+    """All seven MI distribution families + feature-selection scores
+    (reference MutualInformation job). Output: per-feature class MI lines,
+    pair MI lines, then the chosen selection algorithm's ranking
+    (``mi.score.algorithms`` names match the reference registry)."""
+    from avenir_tpu.explore import mutual_information as mi
+    fz, rows = _load_table(conf, in_path)
+    table = fz.transform(rows)
+    scores = mi.compute_scores(mi.compute_distributions(table))
+    delim = conf.get("field.delim.out", ",")
+    algos = conf.get_list("mi.score.algorithms",
+                          ["mutualInfoMaximizer"])
+    rf = conf.get_float("mi.redundancy.factor", 1.0)
+    with open(out_path, "w") as fh:
+        for ordinal, value in sorted(scores.feature_class_mi.items()):
+            fh.write(delim.join(["featureClass", str(ordinal),
+                                 repr(value)]) + "\n")
+        for (a, b), value in sorted(scores.feature_pair_mi.items()):
+            fh.write(delim.join(["featurePair", str(a), str(b),
+                                 repr(value)]) + "\n")
+        for algo in algos:
+            ranked = mi.SCORE_ALGORITHMS[algo](scores, redundancy_factor=rf)
+            for rank, (ordinal, value) in enumerate(ranked):
+                fh.write(delim.join([algo, str(rank), str(ordinal),
+                                     repr(value)]) + "\n")
+
+
+def run_correlation(conf: JobConfig, in_path: str, out_path: str,
+                    default_stat: str = "cramerIndex") -> None:
+    """Categorical correlation (reference CramerCorrelation /
+    HeterogeneityReductionCorrelation). ``correlation.attr.pairs`` lists
+    srcOrd:dstOrd pairs; output ``src,dst,stat``."""
+    from avenir_tpu.explore import correlation as C
+    fz, rows = _load_table(conf, in_path)
+    table = fz.transform(rows)
+    pair_spec = conf.get_list("correlation.attr.pairs")
+    if pair_spec:
+        pairs = [tuple(int(v) for v in p.split(":")) for p in pair_spec]
+    else:
+        ords = [f.ordinal for f in table.feature_fields if f.is_categorical]
+        pairs = [(a, b) for i, a in enumerate(ords) for b in ords[i + 1:]]
+    algo = conf.get("correlation.algorithm", default_stat)
+    out = C.correlate_pairs(table, pairs, algo)
+    delim = conf.get("field.delim.out", ",")
+    with open(out_path, "w") as fh:
+        for (a, b), value in out.items():
+            fh.write(delim.join([str(a), str(b), repr(value)]) + "\n")
+
+
+def run_under_sampling(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Majority-class undersampling (reference UnderSamplingBalancer)."""
+    import re
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from avenir_tpu.explore.sampling import under_sample
+    class_ord = conf.get_int("class.attr.ord")
+    if class_ord is None:
+        raise ValueError("class.attr.ord is required")
+    # single read: raw lines and parsed labels stay index-aligned
+    splitter = re.compile(conf.get("field.delim.regex", ","))
+    with open(in_path) as fh:
+        raw = [l.rstrip("\n") for l in fh if l.rstrip("\n")]
+    tokens = [splitter.split(l)[class_ord].strip() for l in raw]
+    values = sorted(set(tokens))
+    index = {v: i for i, v in enumerate(values)}
+    labels = jnp.asarray([index[t] for t in tokens])
+    keep = np.asarray(under_sample(
+        labels, jax.random.PRNGKey(conf.get_int("random.seed", 0)),
+        len(values)))
+    with open(out_path, "w") as fh:
+        for line, k in zip(raw, keep):
+            if k:
+                fh.write(line + "\n")
+
+
+def run_bagging(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Per-window bootstrap sampling (reference BaggingSampler)."""
+    import jax
+    import numpy as np
+    from avenir_tpu.explore.sampling import bagging_sample
+    with open(in_path) as fh:
+        raw = [l.rstrip("\n") for l in fh if l.strip()]
+    idx = np.asarray(bagging_sample(
+        len(raw), jax.random.PRNGKey(conf.get_int("random.seed", 0)),
+        batch_size=conf.get_int("batch.size", 10000)))
+    with open(out_path, "w") as fh:
+        for i in idx:
+            fh.write(raw[i] + "\n")
+
+
+def run_logistic_regression(conf: JobConfig, in_path: str,
+                            out_path: str) -> None:
+    """Iterative logistic regression with the append-only coefficient
+    history file (reference LogisticRegressionJob; gradient step corrected
+    per SURVEY.md §2.7)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from avenir_tpu.models import logistic
+    delim = conf.get("field.delim.regex", ",")
+    rows = read_csv_lines(in_path, delim)
+    feat_ords = conf.get_int_list("feature.field.ordinals")
+    class_ord = conf.get_int("class.attr.ord")
+    pos_class = conf.get_required("positive.class.value")
+    if feat_ords is None or class_ord is None:
+        raise ValueError("feature.field.ordinals and class.attr.ord required")
+    x = np.asarray([[float(r[o]) for o in feat_ords] for r in rows],
+                   np.float32)
+    y = np.asarray([1.0 if r[class_ord] == pos_class else 0.0 for r in rows],
+                   np.float32)
+    cfg = logistic.LogisticConfig(
+        learning_rate=conf.get_float("learning.rate", 0.5),
+        max_iterations=conf.get_int("iteration.limit", 100),
+        convergence_threshold=conf.get_float("convergence.threshold", 1.0),
+        convergence_criteria=conf.get("convergence.criteria", "average"))
+    w, iters, conv = logistic.train(
+        jnp.asarray(x), jnp.asarray(y), cfg,
+        coeff_file_path=conf.get("coeff.file.path"))
+    with open(out_path, "w") as fh:
+        fh.write(",".join(repr(float(v)) for v in w) + "\n")
+    print(f'{{"iterations": {iters}, "converged": {str(conv).lower()}}}')
+
+
+def run_fisher_discriminant(conf: JobConfig, in_path: str,
+                            out_path: str) -> None:
+    """Univariate Fisher LDA per attribute (reference FisherDiscriminant)."""
+    from avenir_tpu.models import fisher
+    fz, rows = _load_table(conf, in_path)
+    table = fz.transform(rows)
+    model = fisher.train(table)
+    with open(out_path, "w") as fh:
+        fh.write("\n".join(fisher.serialize(
+            model, conf.get("field.delim.out", ","))) + "\n")
+
+
 VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "BayesianDistribution": run_bayesian_distribution,
     "BayesianPredictor": run_bayesian_predictor,
@@ -496,6 +632,15 @@ VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "RandomFirstGreedyBandit": lambda c, i, o: _run_batch_bandit(
         "RandomFirstGreedyBandit", c, i, o),
     "ReinforcementLearnerTopology": run_reinforcement_learner,
+    "MutualInformation": run_mutual_information,
+    "CramerCorrelation": lambda c, i, o: run_correlation(
+        c, i, o, "cramerIndex"),
+    "HeterogeneityReductionCorrelation": lambda c, i, o: run_correlation(
+        c, i, o, "concentrationCoeff"),
+    "UnderSamplingBalancer": run_under_sampling,
+    "BaggingSampler": run_bagging,
+    "LogisticRegressionJob": run_logistic_regression,
+    "FisherDiscriminant": run_fisher_discriminant,
 }
 
 

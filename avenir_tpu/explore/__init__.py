@@ -1,1 +1,1 @@
-
+"""Feature exploration: mutual information, correlation, sampling."""

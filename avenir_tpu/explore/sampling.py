@@ -1,0 +1,48 @@
+"""Class-balancing and bagging samplers.
+
+- ``under_sample``: UnderSamplingBalancer (src/main/java/org/avenir/explore/
+  UnderSamplingBalancer.java:92-164) — majority-class rows are kept with
+  probability minClassCount/classCount. The reference streams with running
+  counts bootstrapped over the first ``distr.batch.size`` rows; here the
+  keep-probability uses the exact class counts over the whole (device-
+  resident) table, which is the limit the reference's running estimate
+  converges to — one vectorized bernoulli draw instead of a row loop.
+- ``bagging_sample``: BaggingSampler (:90-122) — within each consecutive
+  ``batch.size`` window, sample ``batch`` rows with replacement.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def under_sample(labels: jnp.ndarray, key: jax.Array,
+                 n_classes: int) -> jnp.ndarray:
+    """Boolean keep-mask balancing classes toward the minority count."""
+    counts = jnp.sum(jax.nn.one_hot(labels, n_classes, dtype=jnp.float32),
+                     axis=0)
+    present = counts > 0
+    min_count = jnp.min(jnp.where(present, counts, jnp.inf))
+    keep_prob = jnp.where(counts > min_count, min_count / counts, 1.0)
+    row_prob = keep_prob[labels]
+    return jax.random.uniform(key, labels.shape) < row_prob
+
+
+def bagging_sample(n_rows: int, key: jax.Array,
+                   batch_size: int = 10000) -> jnp.ndarray:
+    """Row indices: per window of ``batch_size``, uniform with replacement
+    within the window (the last partial window samples within itself)."""
+    n_full = n_rows // batch_size
+    rem = n_rows - n_full * batch_size
+    keys = jax.random.split(key, n_full + (1 if rem else 0))
+    parts = []
+    for w in range(n_full):
+        idx = jax.random.randint(keys[w], (batch_size,), 0, batch_size)
+        parts.append(w * batch_size + idx)
+    if rem:
+        idx = jax.random.randint(keys[-1], (rem,), 0, rem)
+        parts.append(n_full * batch_size + idx)
+    return jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.int32)

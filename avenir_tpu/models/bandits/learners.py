@@ -165,15 +165,21 @@ def _avg_reward(state: LearnerState) -> jnp.ndarray:
     return state.reward_sum / jnp.maximum(state.reward_count, 1.0)
 
 
+def _min_trial_forced(state: LearnerState, cfg: LearnerConfig
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """selectActionBasedOnMinTrial (ReinforcementLearner.java:142-152):
+    returns (forced?, least-tried arm). When forced, the reference
+    short-circuits — no algorithm state is touched."""
+    least = jnp.argmin(state.trial_counts)
+    if cfg.min_trial <= 0:
+        return jnp.asarray(False), least.astype(jnp.int32)
+    return state.trial_counts[least] <= cfg.min_trial, least.astype(jnp.int32)
+
+
 def _min_trial_override(state: LearnerState, cfg: LearnerConfig,
                         chosen: jnp.ndarray) -> jnp.ndarray:
-    """selectActionBasedOnMinTrial: if the least-tried arm is under
-    min.trial, it takes precedence (ReinforcementLearner.java:142-152)."""
-    if cfg.min_trial <= 0:
-        return chosen
-    least = jnp.argmin(state.trial_counts)
-    return jnp.where(state.trial_counts[least] <= cfg.min_trial,
-                     least, chosen)
+    forced, least = _min_trial_forced(state, cfg)
+    return jnp.where(forced, least, chosen)
 
 
 def _select(state: LearnerState, action: jnp.ndarray) -> LearnerState:
@@ -290,9 +296,19 @@ class upperConfidenceBoundTwo:
                                  scalar_c=jnp.ones((), jnp.float32)), \
                 action.astype(jnp.int32)
 
-        cont = (state.current_action >= 0) & (state.scalar_c < state.scalar_b)
-        state, action = jax.lax.cond(cont, in_epoch, new_epoch, state)
-        action = _min_trial_override(state, cfg, action)
+        forced, least = _min_trial_forced(state, cfg)
+
+        def forced_branch(state):
+            # reference short-circuits: no epoch bookkeeping (:60-62)
+            return state, least
+
+        def epoch_branch(state):
+            cont = (state.current_action >= 0) & \
+                (state.scalar_c < state.scalar_b)
+            return jax.lax.cond(cont, in_epoch, new_epoch, state)
+
+        state, action = jax.lax.cond(forced, forced_branch, epoch_branch,
+                                     state)
         return _select(state, action), action
 
     @staticmethod
@@ -315,9 +331,11 @@ class softMax:
         temp = jnp.maximum(state.scalar_a, 1e-6)
         logits = _avg_reward(state) / temp
         key, k1 = jax.random.split(state.key)
-        action = jax.random.categorical(k1, logits)
-        action = _min_trial_override(state, cfg, action)
-        # temperature reduction (as written in the reference)
+        sampled = jax.random.categorical(k1, logits)
+        forced, least = _min_trial_forced(state, cfg)
+        action = jnp.where(forced, least, sampled)
+        # temperature reduction (as written in the reference); skipped on
+        # min-trial-forced steps like the reference's short-circuit
         rnd = (state.total_trials + 1 - jnp.maximum(cfg.min_trial, 0)
                ).astype(jnp.float32)
         if cfg.temp_reduction_algorithm == "linear":
@@ -330,6 +348,7 @@ class softMax:
             new_temp = state.scalar_a
         if cfg.min_temp_constant > 0:
             new_temp = jnp.maximum(new_temp, cfg.min_temp_constant)
+        new_temp = jnp.where(forced, state.scalar_a, new_temp)
         state = state.replace(key=key, scalar_a=new_temp)
         return _select(state, action), action
 

@@ -1,1 +1,8 @@
+"""Online serving: the Storm/Redis topology replacement."""
 
+from avenir_tpu.stream.loop import (
+    GroupedLearner, InProcQueues, LoopStats, OnlineLearnerLoop, RedisQueues,
+)
+
+__all__ = ["GroupedLearner", "InProcQueues", "LoopStats",
+           "OnlineLearnerLoop", "RedisQueues"]

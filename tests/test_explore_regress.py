@@ -1,0 +1,219 @@
+"""Explore (MI, correlation, sampling) + logistic + Fisher."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from avenir_tpu.explore import correlation as corr
+from avenir_tpu.explore import mutual_information as mi
+from avenir_tpu.explore import sampling
+from avenir_tpu.models import fisher, logistic
+from avenir_tpu.utils.dataset import Featurizer
+from avenir_tpu.utils.schema import FeatureSchema
+
+
+MI_SCHEMA = FeatureSchema.from_json({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "f1", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["a", "b"], "feature": True},
+        {"name": "f2", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["x", "y"], "feature": True},
+        {"name": "f3", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["p", "q"], "feature": True},
+        {"name": "cls", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["0", "1"]},
+    ]
+})
+
+
+def _mi_table(n=2000, seed=0):
+    """f1 fully determines the class; f2 = copy of f1 (redundant);
+    f3 independent noise."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        c = rng.integers(0, 2)
+        f1 = "a" if c == 0 else "b"
+        f2 = "x" if c == 0 else "y"
+        f3 = "p" if rng.random() < 0.5 else "q"
+        rows.append([f"r{i}", f1, f2, f3, str(c)])
+    return Featurizer(MI_SCHEMA).fit_transform(rows)
+
+
+class TestMutualInformation:
+    @pytest.fixture(scope="class")
+    def scores(self):
+        return mi.compute_scores(mi.compute_distributions(_mi_table()))
+
+    def test_informative_feature_has_high_mi(self, scores):
+        assert scores.feature_class_mi[1] == pytest.approx(1.0, abs=0.02)
+        assert scores.feature_class_mi[3] == pytest.approx(0.0, abs=0.02)
+
+    def test_redundant_pair_mi(self, scores):
+        assert scores.feature_pair_mi[(1, 2)] == pytest.approx(1.0, abs=0.02)
+        assert scores.feature_pair_mi[(1, 3)] == pytest.approx(0.0, abs=0.02)
+
+    def test_mim_ranks_informative_first(self, scores):
+        ranked = mi.mim(scores)
+        assert ranked[0][0] in (1, 2) and ranked[-1][0] == 3
+
+    def test_mifs_penalizes_redundancy(self, scores):
+        selected = mi.mifs(scores, redundancy_factor=2.0)
+        order = [f for f, _ in selected]
+        # the copy of the first-chosen feature must NOT be chosen second
+        # (its redundancy-penalized score goes negative; noise f3 stays ~0)
+        assert order[0] in (1, 2)
+        assert order[1] == 3
+
+    def test_mrmr_and_jmi_and_disr_run(self, scores):
+        for algo in ("minRedundancyMaxRelevance", "jointMutualInfo",
+                     "doubleInputSymmetricalRelevance"):
+            ranked = mi.SCORE_ALGORITHMS[algo](scores)
+            assert len(ranked) == 3
+
+    def test_continuous_feature_rejected(self):
+        schema = FeatureSchema.from_json({
+            "fields": [
+                {"name": "x", "ordinal": 0, "dataType": "double",
+                 "feature": True},
+                {"name": "cls", "ordinal": 1, "dataType": "categorical",
+                 "cardinality": ["0", "1"]},
+            ]})
+        table = Featurizer(schema).fit_transform(
+            [["1.5", "0"], ["2.5", "1"]])
+        with pytest.raises(ValueError, match="binned"):
+            mi.compute_distributions(table)
+
+
+class TestCorrelation:
+    def test_cramer_perfect_dependence(self):
+        counts = np.asarray([[50.0, 0.0], [0.0, 50.0]])
+        assert corr.cramer_index(counts) == pytest.approx(1.0)
+
+    def test_cramer_independence(self):
+        counts = np.asarray([[25.0, 25.0], [25.0, 25.0]])
+        assert corr.cramer_index(counts) == pytest.approx(0.0, abs=1e-9)
+
+    def test_concentration_and_uncertainty(self):
+        dep = np.asarray([[50.0, 0.0], [0.0, 50.0]])
+        ind = np.asarray([[25.0, 25.0], [25.0, 25.0]])
+        assert corr.concentration_coeff(dep) == pytest.approx(1.0)
+        assert corr.concentration_coeff(ind) == pytest.approx(0.0, abs=1e-9)
+        assert corr.uncertainty_coeff(dep) == pytest.approx(1.0)
+        assert corr.uncertainty_coeff(ind) == pytest.approx(0.0, abs=1e-9)
+
+    def test_correlate_pairs_on_table(self):
+        table = _mi_table(500)
+        out = corr.correlate_pairs(table, [(1, 2), (1, 3)], "cramerIndex")
+        assert out[(1, 2)] > 0.9
+        assert out[(1, 3)] < 0.1
+
+
+class TestSampling:
+    def test_under_sample_balances(self):
+        labels = jnp.asarray([0] * 900 + [1] * 100)
+        keep = np.asarray(sampling.under_sample(
+            labels, jax.random.PRNGKey(0), 2))
+        kept0 = keep[:900].sum()
+        kept1 = keep[900:].sum()
+        assert kept1 == 100                       # minority fully kept
+        assert 60 < kept0 < 150                   # majority ~minCount
+
+    def test_bagging_within_windows(self):
+        idx = np.asarray(sampling.bagging_sample(250, jax.random.PRNGKey(1),
+                                                 batch_size=100))
+        assert idx.shape == (250,)
+        assert (idx[:100] < 100).all()
+        assert ((idx[100:200] >= 100) & (idx[100:200] < 200)).all()
+        assert (idx[200:] >= 200).all()
+
+
+class TestLogistic:
+    def _data(self, n=2000, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, 3)).astype(np.float32)
+        true_w = np.asarray([1.5, -2.0, 0.5])
+        p = 1 / (1 + np.exp(-(x @ true_w + 0.3)))
+        y = (rng.random(n) < p).astype(np.float32)
+        return x, y
+
+    def test_learns_signal(self):
+        x, y = self._data()
+        cfg = logistic.LogisticConfig(learning_rate=1.0, max_iterations=300,
+                                      convergence_threshold=0.01)
+        w, iters, _ = logistic.train(jnp.asarray(x), jnp.asarray(y), cfg)
+        pred = logistic.predict(jnp.asarray(x), w, cfg)
+        # ~0.81 is the Bayes rate for this noisy generator
+        assert (pred == y).mean() > 0.78
+        # true coefficients (1.5, -2.0, 0.5; intercept 0.3) recovered
+        np.testing.assert_allclose(w, [0.3, 1.5, -2.0, 0.5], atol=0.25)
+
+    def test_coeff_history_resume(self, tmp_path):
+        x, y = self._data(500)
+        path = str(tmp_path / "coeffs.txt")
+        cfg = logistic.LogisticConfig(learning_rate=0.5, max_iterations=5,
+                                      convergence_threshold=1e-9)
+        w5, it5, _ = logistic.train(jnp.asarray(x), jnp.asarray(y), cfg, path)
+        assert it5 == 5
+        assert len(open(path).read().splitlines()) == 5
+        # resume: 5 more iterations continue from the file
+        cfg10 = logistic.LogisticConfig(learning_rate=0.5, max_iterations=10,
+                                        convergence_threshold=1e-9)
+        w10, it10, _ = logistic.train(jnp.asarray(x), jnp.asarray(y), cfg10,
+                                      path)
+        assert it10 == 10
+        # equals an uninterrupted 10-iteration run
+        w10_direct, _, _ = logistic.train(
+            jnp.asarray(x), jnp.asarray(y), cfg10, None)
+        np.testing.assert_allclose(w10, w10_direct, rtol=1e-5)
+
+    def test_convergence_stops_early(self):
+        x, y = self._data(500)
+        cfg = logistic.LogisticConfig(learning_rate=0.01, max_iterations=500,
+                                      convergence_threshold=5.0,
+                                      convergence_criteria="average")
+        _, iters, conv = logistic.train(jnp.asarray(x), jnp.asarray(y), cfg)
+        assert conv and iters < 500
+
+
+FISHER_SCHEMA = FeatureSchema.from_json({
+    "fields": [
+        {"name": "x", "ordinal": 0, "dataType": "double", "feature": True},
+        {"name": "cls", "ordinal": 1, "dataType": "categorical",
+         "cardinality": ["pos", "neg"]},
+    ]})
+
+
+class TestFisher:
+    def test_boundary_separates_gaussians(self):
+        rng = np.random.default_rng(0)
+        rows = []
+        for i in range(1000):
+            if i % 2 == 0:
+                rows.append([str(rng.normal(10, 1.5)), "pos"])
+            else:
+                rows.append([str(rng.normal(2, 1.5)), "neg"])
+        table = Featurizer(FISHER_SCHEMA).fit_transform(rows)
+        model = fisher.train(table)
+        # equal priors -> boundary near midpoint 6
+        assert 5 < model.boundary[0] < 7
+        pred = fisher.classify(model, table.numeric[:, 0])
+        truth = np.asarray(table.labels)
+        assert (pred == truth).mean() > 0.95
+        lines = fisher.serialize(model)
+        assert len(lines) == 1 and lines[0].startswith("0,")
+
+    def test_unequal_priors_shift_boundary(self):
+        rng = np.random.default_rng(1)
+        rows = []
+        for i in range(1000):
+            if i < 900:
+                rows.append([str(rng.normal(10, 1.5)), "pos"])
+            else:
+                rows.append([str(rng.normal(2, 1.5)), "neg"])
+        table = Featurizer(FISHER_SCHEMA).fit_transform(rows)
+        model = fisher.train(table)
+        # prior favors pos (class0 here) -> boundary moves toward neg mean
+        assert model.boundary[0] < 6
