@@ -62,8 +62,21 @@ def _load_table(conf: JobConfig, in_path: str, for_predict: bool = False):
 
 
 def run_bayesian_distribution(conf: JobConfig, in_path: str, out_path: str) -> None:
-    """Train Naive Bayes distributions (reference BayesianDistribution job)."""
+    """Train Naive Bayes distributions (reference BayesianDistribution job).
+
+    ``tabular.input=false`` switches to text mode (BayesianDistribution.java
+    :115-131): rows are ``text<delim>classVal`` and every token becomes a bin
+    of the text feature at ordinal 1.
+    """
     from avenir_tpu.models import naive_bayes as nb
+    if not conf.get_bool("tabular.input", True):
+        from avenir_tpu.text import text_bayes
+        rows = read_csv_lines(in_path, conf.get("field.delim.regex", ","))
+        model, metrics = text_bayes.train(rows)
+        text_bayes.save_model(model, out_path,
+                              delim=conf.get("field.delim", ","))
+        print(metrics.to_json())
+        return
     fz, rows = _load_table(conf, in_path)
     table = fz.transform(rows)
     model, meta, metrics = nb.train(table)
@@ -81,6 +94,30 @@ def run_bayesian_predictor(conf: JobConfig, in_path: str, out_path: str) -> None
     (BayesianPredictor.java:125-165).
     """
     from avenir_tpu.models import naive_bayes as nb
+    if not conf.get_bool("tabular.input", True):
+        from avenir_tpu.text import text_bayes
+        delim = conf.get("field.delim.out", ",")
+        rows = read_csv_lines(in_path, conf.get("field.delim.regex", ","))
+        model = text_bayes.load_model(
+            conf.get_required("bayesian.model.file.path"),
+            delim=conf.get("field.delim", ","))
+        truth = None
+        if conf.get_bool("validation.mode", False):
+            short = [i for i, r in enumerate(rows) if len(r) < 2]
+            if short:
+                raise ValueError(
+                    f"validation.mode=true but rows {short[:5]} have no "
+                    "class column (expected text<delim>classVal)")
+            truth = [r[1] for r in rows]
+        labels, _, cm = text_bayes.predict(
+            model, [r[0] for r in rows],
+            laplace=conf.get_float("laplace.smoothing", 1.0), truth=truth)
+        with open(out_path, "w") as fh:
+            for row, label in zip(rows, labels):
+                fh.write(delim.join([delim.join(row), label]) + "\n")
+        if cm is not None:
+            print(cm.report().to_json())
+        return
     fz, rows = _load_table(conf, in_path, for_predict=True)
     table = fz.transform(rows)
     meta = nb.BayesModelMeta.from_table(table)
@@ -496,6 +533,12 @@ def run_mutual_information(conf: JobConfig, in_path: str,
         for (a, b), value in sorted(scores.feature_pair_mi.items()):
             fh.write(delim.join(["featurePair", str(a), str(b),
                                  repr(value)]) + "\n")
+        for (a, b), value in sorted(scores.feature_pair_class_mi.items()):
+            fh.write(delim.join(["featurePairClass", str(a), str(b),
+                                 repr(value)]) + "\n")
+        for (a, b), value in sorted(scores.class_cond_pair_mi.items()):
+            fh.write(delim.join(["classCondPair", str(a), str(b),
+                                 repr(value)]) + "\n")
         for algo in algos:
             ranked = mi.SCORE_ALGORITHMS[algo](scores, redundancy_factor=rf)
             for rank, (ordinal, value) in enumerate(ranked):
@@ -611,7 +654,21 @@ def run_fisher_discriminant(conf: JobConfig, in_path: str,
             model, conf.get("field.delim.out", ","))) + "\n")
 
 
+def run_word_counter(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Lucene-style word count (reference text.WordCounter MR): honors
+    ``text.field.ordinal`` (< 0 means the whole line) and
+    ``field.delim.out`` for the ``token,count`` output lines."""
+    from avenir_tpu.text.word_count import word_count_lines
+    rows = read_csv_lines(in_path, conf.get("field.delim.regex", ","))
+    lines = word_count_lines(
+        rows, text_field_ordinal=conf.get_int("text.field.ordinal", -1),
+        delim_out=conf.get("field.delim.out", ","))
+    with open(out_path, "w") as fh:
+        fh.write("\n".join(lines) + ("\n" if lines else ""))
+
+
 VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
+    "WordCounter": run_word_counter,
     "BayesianDistribution": run_bayesian_distribution,
     "BayesianPredictor": run_bayesian_predictor,
     "SameTypeSimilarity": run_same_type_similarity,

@@ -84,33 +84,35 @@ class MiScores:
 
 
 def compute_scores(d: MiDistributions) -> MiScores:
+    """One batched device call per score family (``mutual_information`` and
+    ``entropy`` broadcast over leading dims), unpacked into the reducer's
+    per-feature/per-pair output dicts host-side."""
     n_f = d.feature.shape[0]
     ords = d.feature_ordinals
-    fc_mi, fp_mi, fpc_mi, fpc_h, ccp_mi = {}, {}, {}, {}, {}
-    total = d.class_counts.sum()
 
-    for i in range(n_f):
-        fc_mi[ords[i]] = float(mutual_information(
-            jnp.asarray(d.feature_class[i])))        # [B, C]
+    fc = np.asarray(mutual_information(jnp.asarray(d.feature_class)))  # [F]
+    fp = np.asarray(mutual_information(jnp.asarray(d.feature_pair)))   # [F,F]
+    pc = d.feature_pair_class                         # [F, F, B, B, C]
+    f1, f2, b1, b2, c = pc.shape
+    fpc = np.asarray(mutual_information(
+        jnp.asarray(pc.reshape(f1, f2, b1 * b2, c))))                  # [F,F]
+    fpc_ent = np.asarray(entropy(
+        jnp.asarray(pc.reshape(f1, f2, b1 * b2 * c))))                 # [F,F]
+    # class-conditional pair MI: sum_c p(c) I(Xi;Xj|c)
+    per_class = mutual_information(
+        jnp.asarray(np.moveaxis(pc, -1, 2)))                           # [F,F,C]
+    weights = jnp.asarray(d.class_counts / max(d.class_counts.sum(), 1))
+    ccp = np.asarray(jnp.einsum("ijc,c->ij", per_class, weights))
 
+    fc_mi = {ords[i]: float(fc[i]) for i in range(n_f)}
+    fp_mi, fpc_mi, fpc_h, ccp_mi = {}, {}, {}, {}
     for i in range(n_f):
         for j in range(i + 1, n_f):
-            pair = d.feature_pair[i, j]              # [B, B]
-            fp_mi[(ords[i], ords[j])] = float(
-                mutual_information(jnp.asarray(pair)))
-            pc = d.feature_pair_class[i, j]          # [B, B, C]
-            b1, b2, c = pc.shape
-            fpc_mi[(ords[i], ords[j])] = float(mutual_information(
-                jnp.asarray(pc.reshape(b1 * b2, c))))
-            fpc_h[(ords[i], ords[j])] = float(entropy(
-                jnp.asarray(pc.reshape(-1))))
-            # class-conditional pair MI: sum_c p(c) I(Xi;Xj|c)
-            cond = 0.0
-            for ci in range(c):
-                weight = d.class_counts[ci] / max(total, 1)
-                cond += weight * float(mutual_information(
-                    jnp.asarray(pc[:, :, ci])))
-            ccp_mi[(ords[i], ords[j])] = cond
+            key = (ords[i], ords[j])
+            fp_mi[key] = float(fp[i, j])
+            fpc_mi[key] = float(fpc[i, j])
+            fpc_h[key] = float(fpc_ent[i, j])
+            ccp_mi[key] = float(ccp[i, j])
     return MiScores(fc_mi, fp_mi, fpc_mi, fpc_h, ccp_mi)
 
 

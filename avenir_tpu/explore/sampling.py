@@ -37,12 +37,14 @@ def bagging_sample(n_rows: int, key: jax.Array,
     within the window (the last partial window samples within itself)."""
     n_full = n_rows // batch_size
     rem = n_rows - n_full * batch_size
-    keys = jax.random.split(key, n_full + (1 if rem else 0))
+    key_full, key_rem = jax.random.split(key)
     parts = []
-    for w in range(n_full):
-        idx = jax.random.randint(keys[w], (batch_size,), 0, batch_size)
-        parts.append(w * batch_size + idx)
+    if n_full:
+        # one vectorized draw for all full windows, offset per window
+        idx = jax.random.randint(key_full, (n_full, batch_size), 0, batch_size)
+        offsets = jnp.arange(n_full, dtype=idx.dtype)[:, None] * batch_size
+        parts.append((idx + offsets).reshape(-1))
     if rem:
-        idx = jax.random.randint(keys[-1], (rem,), 0, rem)
+        idx = jax.random.randint(key_rem, (rem,), 0, rem)
         parts.append(n_full * batch_size + idx)
     return jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.int32)

@@ -43,12 +43,17 @@ def train(table: EncodedTable) -> FisherModel:
         raise ValueError("Fisher discriminant needs a binary class attribute")
     cnt, vsum, vsq = per_class_moments(table.numeric, table.labels, 2)
     cnt_n, vsum_n, vsq_n = (np.asarray(a) for a in (cnt, vsum, vsq))
+    if cnt_n.shape[1] and (cnt_n[0, 0] == 0 or cnt_n[1, 0] == 0):
+        missing = table.class_values[0 if cnt_n[0, 0] == 0 else 1]
+        raise ValueError(
+            f"class {missing!r} has no rows — both classes need samples "
+            "for a discriminant boundary")
     n0, n1 = np.maximum(cnt_n[0], 1.0), np.maximum(cnt_n[1], 1.0)
     m0, m1 = vsum_n[0] / n0, vsum_n[1] / n1
     v0 = np.maximum(vsq_n[0] / n0 - m0 * m0, 1e-12)
     v1 = np.maximum(vsq_n[1] / n1 - m1 * m1, 1e-12)
     pooled = (v0 * n0 + v1 * n1) / (n0 + n1)
-    log_odds = float(np.log(n0[0] / n1[0])) if n1[0] > 0 else 0.0
+    log_odds = float(np.log(n0[0] / n1[0])) if cnt_n.shape[1] else 0.0
     mean_diff = m0 - m1
     safe_diff = np.where(np.abs(mean_diff) > 1e-12, mean_diff, 1e-12)
     boundary = (m0 + m1) / 2.0 - log_odds * pooled / safe_diff

@@ -1,0 +1,12 @@
+"""Text analysis: tokenizer, word count, text-mode Naive Bayes.
+
+Covers the reference's ``org.avenir.text`` package (WordCounter.java) and the
+text branch of BayesianDistribution/BayesianPredictor.
+"""
+
+from avenir_tpu.text.analyzer import StandardAnalyzer, tokenize
+from avenir_tpu.text.word_count import count_words, word_count_lines
+from avenir_tpu.text import text_bayes
+
+__all__ = ["StandardAnalyzer", "tokenize", "count_words",
+           "word_count_lines", "text_bayes"]

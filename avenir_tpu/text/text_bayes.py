@@ -1,0 +1,206 @@
+"""Text-mode Naive Bayes — bag-of-words classifier over tokenized text.
+
+The reference's text path lives inside BayesianDistribution: when the input
+is not tabular, each row is ``text<delim>classVal`` and ``mapText``
+(BayesianDistribution.java:187-196) tokenizes the text with a Lucene
+analyzer and emits (classVal, ordinal=1, token) -> 1, i.e. every token is a
+"bin" of the single text feature at ordinal 1. Prediction then flows through
+the same Bayes rule as tabular mode (BayesianPredictor.java:396-421), with
+P(token|class) in place of P(bin|class).
+
+Here the per-token shuffle is one device scatter-add into a [C, V] count
+matrix, and prediction is a jitted padded-gather of token log-probs:
+
+    train:   counts[c, v] += 1 for every (class c, token v) occurrence
+    predict: argmax_c  log P(c) + sum_tokens log P(token|class c)
+
+with Laplace smoothing over the vocabulary (the reference's zero-count
+tokens would zero the product; smoothing is the documented deviation).
+
+Wire format preserved: the model file uses the reference's 4-field
+empty-column tagged union (BayesianPredictor.java:194-218) with the text
+feature at ordinal ``TEXT_ORDINAL`` = 1 and the token as the bin label.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.text.analyzer import StandardAnalyzer
+from avenir_tpu.utils.metrics import ConfusionMatrix, MetricsRegistry
+
+TEXT_ORDINAL = 1   # BayesianDistribution.java:127 ``featureAttrOrdinal = 1``
+
+
+@dataclass
+class TextBayesModel:
+    """Vocab + count tensors. Counts live on device; names host-side."""
+
+    class_values: Tuple[str, ...]
+    vocab: Dict[str, int]
+    class_counts: jnp.ndarray     # [C]   documents per class
+    token_counts: jnp.ndarray     # [C, V] token occurrences per class
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.class_values)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+
+@partial(jax.jit, static_argnames=("n_classes", "vocab_size"))
+def _count_kernel(doc_class: jnp.ndarray, token_class: jnp.ndarray,
+                  token_ids: jnp.ndarray, n_classes: int, vocab_size: int):
+    cls = jnp.zeros((n_classes,), jnp.float32).at[doc_class].add(1.0)
+    tok = jnp.zeros((n_classes, vocab_size), jnp.float32
+                    ).at[token_class, token_ids].add(1.0)
+    return cls, tok
+
+
+def train(rows: Sequence[Sequence[str]],
+          text_ordinal: int = 0, class_ordinal: int = 1,
+          analyzer: Optional[StandardAnalyzer] = None
+          ) -> Tuple[TextBayesModel, MetricsRegistry]:
+    """Rows are parsed CSV records, text at ``text_ordinal`` and class label
+    at ``class_ordinal`` (the reference hardwires 0/1, mapText :188-189)."""
+    analyzer = analyzer or StandardAnalyzer()
+    class_index: Dict[str, int] = {}
+    vocab: Dict[str, int] = {}
+    doc_class: List[int] = []
+    token_class: List[int] = []
+    token_ids: List[int] = []
+    for row in rows:
+        cls = row[class_ordinal]
+        ci = class_index.setdefault(cls, len(class_index))
+        doc_class.append(ci)
+        for tok in analyzer.tokenize(row[text_ordinal]):
+            vi = vocab.setdefault(tok, len(vocab))
+            token_class.append(ci)
+            token_ids.append(vi)
+
+    n_classes, vocab_size = len(class_index), max(len(vocab), 1)
+    cls, tok = _count_kernel(
+        jnp.asarray(doc_class, jnp.int32),
+        jnp.asarray(token_class or [0], jnp.int32),
+        jnp.asarray(token_ids or [0], jnp.int32),
+        n_classes, vocab_size)
+    if not token_ids:   # degenerate: no tokens at all
+        tok = jnp.zeros_like(tok)
+
+    metrics = MetricsRegistry()
+    metrics.set("Distribution Data", "Records", len(doc_class))
+    metrics.set("Distribution Data", "Vocabulary", len(vocab))
+    model = TextBayesModel(
+        class_values=tuple(class_index), vocab=dict(vocab),
+        class_counts=cls, token_counts=tok)
+    return model, metrics
+
+
+@partial(jax.jit, static_argnames=("laplace",))
+def _predict_kernel(class_counts, token_counts, ids, mask, laplace=1.0):
+    # log P(c)
+    log_prior = jnp.log(class_counts + 1e-30) - jnp.log(
+        jnp.sum(class_counts) + 1e-30)
+    # log P(v|c) with Laplace smoothing over the vocab (+1 col for OOV)
+    vocab_size = token_counts.shape[1]
+    smoothed = token_counts + laplace
+    log_cond = jnp.log(smoothed) - jnp.log(
+        jnp.sum(token_counts, axis=1, keepdims=True) + laplace * vocab_size)
+    # ids: [N, L] padded token ids (OOV/pad clamped to 0, masked out)
+    doc_ll = jnp.einsum("cnl->nc",
+                        log_cond[:, ids] * mask[None, :, :])
+    scores = doc_ll + log_prior[None, :]
+    return jnp.argmax(scores, axis=1), scores
+
+
+def predict(model: TextBayesModel, texts: Sequence[str],
+            analyzer: Optional[StandardAnalyzer] = None,
+            laplace: float = 1.0,
+            truth: Optional[Sequence[str]] = None
+            ) -> Tuple[List[str], np.ndarray, Optional[ConfusionMatrix]]:
+    """Classify texts; returns (labels, log-score matrix, confusion)."""
+    analyzer = analyzer or StandardAnalyzer()
+    token_lists = [[model.vocab[t] for t in analyzer.tokenize(x)
+                    if t in model.vocab] for x in texts]
+    max_len = max((len(t) for t in token_lists), default=0) or 1
+    n = len(texts)
+    ids = np.zeros((n, max_len), np.int32)
+    mask = np.zeros((n, max_len), np.float32)
+    for i, toks in enumerate(token_lists):
+        ids[i, :len(toks)] = toks
+        mask[i, :len(toks)] = 1.0
+    pred_idx, scores = _predict_kernel(
+        model.class_counts, model.token_counts,
+        jnp.asarray(ids), jnp.asarray(mask), laplace=laplace)
+    pred_idx = np.asarray(pred_idx)
+    labels = [model.class_values[i] for i in pred_idx]
+
+    confusion = None
+    if truth is not None:
+        confusion = ConfusionMatrix(model.class_values)
+        cls_index = {c: i for i, c in enumerate(model.class_values)}
+        unknown = sorted({t for t in truth if t not in cls_index})
+        if unknown:
+            raise ValueError(
+                f"truth labels {unknown} not among model classes "
+                f"{list(model.class_values)}")
+        truth_idx = np.asarray([cls_index[t] for t in truth], np.int32)
+        confusion.update(pred_idx, truth_idx)
+    return labels, np.asarray(scores), confusion
+
+
+def save_model(model: TextBayesModel, path: str, delim: str = ",") -> None:
+    """Reference 4-field tagged-union lines, token as bin label."""
+    cls_counts = np.asarray(model.class_counts)
+    tok_counts = np.asarray(model.token_counts)
+    inv_vocab = {i: t for t, i in model.vocab.items()}
+    lines: List[str] = []
+    for ci, cls in enumerate(model.class_values):
+        for vi in np.nonzero(tok_counts[ci])[0]:
+            lines.append(delim.join([cls, str(TEXT_ORDINAL), inv_vocab[int(vi)],
+                                     str(int(round(tok_counts[ci, vi])))]))
+        lines.append(delim.join([cls, "", "", str(int(round(cls_counts[ci])))]))
+    marginal = tok_counts.sum(axis=0)
+    for vi in np.nonzero(marginal)[0]:
+        lines.append(delim.join(["", str(TEXT_ORDINAL), inv_vocab[int(vi)],
+                                 str(int(round(marginal[vi])))]))
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def load_model(path: str, delim: str = ",") -> TextBayesModel:
+    class_index: Dict[str, int] = {}
+    vocab: Dict[str, int] = {}
+    cls_rows: List[Tuple[int, float]] = []
+    tok_rows: List[Tuple[int, int, float]] = []
+    with open(path) as fh:
+        for line in fh:
+            items = line.rstrip("\n").split(delim)
+            if not any(items):
+                continue
+            if items[0] == "":
+                continue   # feature-prior marginal: rebuilt from posteriors
+            ci = class_index.setdefault(items[0], len(class_index))
+            if items[1] == "" and items[2] == "":
+                cls_rows.append((ci, float(items[3])))
+            else:
+                vi = vocab.setdefault(items[2], len(vocab))
+                tok_rows.append((ci, vi, float(items[3])))
+    n_classes, vocab_size = len(class_index), max(len(vocab), 1)
+    cls = np.zeros((n_classes,), np.float32)
+    tok = np.zeros((n_classes, vocab_size), np.float32)
+    for ci, v in cls_rows:
+        cls[ci] = v
+    for ci, vi, v in tok_rows:
+        tok[ci, vi] = v
+    return TextBayesModel(class_values=tuple(class_index), vocab=dict(vocab),
+                          class_counts=jnp.asarray(cls),
+                          token_counts=jnp.asarray(tok))

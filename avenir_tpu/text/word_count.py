@@ -1,0 +1,68 @@
+"""Word counting — the reference's ``text.WordCounter`` MR, TPU-native.
+
+The reference job (src/main/java/org/avenir/text/WordCounter.java:54-109)
+tokenizes one text column (``text.field.ordinal``; whole line when < 0) with
+a Lucene analyzer, shuffles (token -> 1) pairs and counts per token in the
+reducer. Here the tokens are vocab-encoded host-side and the count is one
+``segment_sum``-style bincount on device — the shuffle disappears into an
+integer histogram, sharded over rows when a mesh is active.
+
+Output contract preserved: ``token<delim>count`` lines.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from avenir_tpu.text.analyzer import StandardAnalyzer
+
+
+def count_words(texts: Iterable[str],
+                analyzer: Optional[StandardAnalyzer] = None
+                ) -> Dict[str, int]:
+    """Token -> count over an iterable of texts.
+
+    Tokenization and vocab assignment are host work (string processing);
+    the count itself is a device bincount over the encoded id stream, which
+    is the analogue of the reference's reducer-side sum.
+    """
+    analyzer = analyzer or StandardAnalyzer()
+    vocab: Dict[str, int] = {}
+    ids: List[int] = []
+    for text in texts:
+        for tok in analyzer.tokenize(text):
+            idx = vocab.get(tok)
+            if idx is None:
+                idx = len(vocab)
+                vocab[tok] = idx
+            ids.append(idx)
+    if not vocab:
+        return {}
+    counts = np.asarray(
+        jnp.bincount(jnp.asarray(ids, dtype=jnp.int32), length=len(vocab)))
+    return {tok: int(counts[idx]) for tok, idx in vocab.items()}
+
+
+def word_count_lines(rows: Sequence[Sequence[str]],
+                     text_field_ordinal: int = -1,
+                     delim_out: str = ",",
+                     analyzer: Optional[StandardAnalyzer] = None
+                     ) -> List[str]:
+    """Full job contract: parsed CSV rows in, ``token,count`` lines out.
+
+    ``text_field_ordinal`` selects the text column; negative means the whole
+    (re-joined) line is the text, matching WordCounter.java:101-106.
+    """
+    if text_field_ordinal >= 0:
+        texts = (row[text_field_ordinal] for row in rows)
+    else:
+        # whole-line mode: re-join split fields with a space so no two
+        # fields can merge into one token (joining with a configurable
+        # delimiter like "." or "'" would, since _WORD_RE keeps those
+        # intra-word)
+        texts = (" ".join(row) for row in rows)
+    counts = count_words(texts, analyzer)
+    return [f"{tok}{delim_out}{n}" for tok, n in sorted(counts.items())]

@@ -1,0 +1,161 @@
+"""Text package: analyzer, word count, text-mode Naive Bayes.
+
+Covers the reference's text.WordCounter MR and the text branch of
+BayesianDistribution (mapText :187-196) / BayesianPredictor.
+"""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.text.analyzer import StandardAnalyzer, tokenize
+from avenir_tpu.text.word_count import count_words, word_count_lines
+from avenir_tpu.text import text_bayes
+
+
+class TestAnalyzer:
+    def test_lowercase_and_split(self):
+        assert tokenize("Hello, World!") == ["hello", "world"]
+
+    def test_stopwords_removed(self):
+        # "the", "is", "a" are in Lucene's default English stop set
+        assert tokenize("The price is a bargain") == ["price", "bargain"]
+
+    def test_apostrophe_and_numbers(self):
+        toks = tokenize("O'Neil bought 42 shares")
+        assert "o'neil" in toks and "42" in toks
+
+    def test_no_stopwords_analyzer(self):
+        an = StandardAnalyzer(stop_words=())
+        assert an.tokenize("the cat") == ["the", "cat"]
+
+
+class TestWordCount:
+    def test_counts(self):
+        counts = count_words(["spam spam ham", "ham eggs"])
+        assert counts == {"spam": 2, "ham": 2, "eggs": 1}
+
+    def test_empty(self):
+        assert count_words([]) == {}
+        assert count_words(["", "the and of"]) == {}
+
+    def test_lines_with_field_ordinal(self):
+        rows = [["id1", "good good"], ["id2", "bad"]]
+        lines = word_count_lines(rows, text_field_ordinal=1)
+        assert lines == ["bad,1", "good,2"]
+
+    def test_lines_whole_line(self):
+        rows = [["alpha beta"], ["beta"]]
+        lines = word_count_lines(rows, text_field_ordinal=-1)
+        assert lines == ["alpha,1", "beta,2"]
+
+
+class TestTextBayes:
+    ROWS = [
+        ["cheap viagra offer offer", "spam"],
+        ["cheap pills offer", "spam"],
+        ["meeting agenda tomorrow", "ham"],
+        ["lunch meeting tomorrow", "ham"],
+        ["project agenda review", "ham"],
+    ]
+
+    def test_train_counts(self):
+        model, metrics = text_bayes.train(self.ROWS)
+        assert model.n_classes == 2
+        ci = model.class_values.index("spam")
+        vi = model.vocab["offer"]
+        assert float(model.token_counts[ci, vi]) == 3.0
+        assert float(model.class_counts[ci]) == 2.0
+        assert metrics.get("Distribution Data", "Records") == 5
+
+    def test_predict_separates_classes(self):
+        model, _ = text_bayes.train(self.ROWS)
+        labels, scores, _ = text_bayes.predict(
+            model, ["cheap offer today", "agenda for the meeting"])
+        assert labels == ["spam", "ham"]
+        assert scores.shape == (2, 2)
+
+    def test_predict_confusion(self):
+        model, _ = text_bayes.train(self.ROWS)
+        _, _, cm = text_bayes.predict(
+            model, ["cheap offer", "meeting tomorrow"],
+            truth=["spam", "ham"])
+        assert cm.accuracy == 1.0
+
+    def test_oov_tokens_ignored(self):
+        model, _ = text_bayes.train(self.ROWS)
+        labels, _, _ = text_bayes.predict(
+            model, ["zzz qqq agenda"])  # only "agenda" known
+        assert labels == ["ham"]
+
+    def test_model_roundtrip(self, tmp_path):
+        model, _ = text_bayes.train(self.ROWS)
+        path = str(tmp_path / "model.txt")
+        text_bayes.save_model(model, path)
+        loaded = text_bayes.load_model(path)
+        assert set(loaded.vocab) == set(model.vocab)
+        for cls in model.class_values:
+            ci, li = (model.class_values.index(cls),
+                      loaded.class_values.index(cls))
+            assert float(loaded.class_counts[li]) == float(
+                model.class_counts[ci])
+            for tok, vi in model.vocab.items():
+                got = float(loaded.token_counts[li, loaded.vocab[tok]])
+                assert got == float(model.token_counts[ci, vi])
+
+    def test_wire_format_tagged_union(self, tmp_path):
+        """Model file keeps the reference's 4-field empty-column format
+        (BayesianPredictor.java:194-218): posterior = cls,1,token,count;
+        class prior = cls,,,count; feature prior = ,1,token,count."""
+        model, _ = text_bayes.train(self.ROWS)
+        path = str(tmp_path / "model.txt")
+        text_bayes.save_model(model, path)
+        kinds = {"post": 0, "cls": 0, "prior": 0}
+        for line in open(path):
+            f = line.rstrip("\n").split(",")
+            if f[0] and f[1]:
+                assert f[1] == "1" and f[2] and int(f[3]) > 0
+                kinds["post"] += 1
+            elif f[0]:
+                assert f[1] == "" and f[2] == ""
+                kinds["cls"] += 1
+            else:
+                assert f[1] == "1" and f[2]
+                kinds["prior"] += 1
+        assert kinds["cls"] == 2 and kinds["post"] > 0 and kinds["prior"] > 0
+
+
+class TestCliTextMode:
+    def test_word_counter_verb(self, tmp_path):
+        from avenir_tpu.cli.main import main
+        inp = tmp_path / "in.txt"
+        inp.write_text("good morning team\ngood news\n")
+        conf = tmp_path / "job.properties"
+        conf.write_text("text.field.ordinal=-1\n")
+        out = tmp_path / "out.txt"
+        assert main(["WordCounter", str(inp), str(out),
+                     "--conf", str(conf)]) == 0
+        assert "good,2" in out.read_text().splitlines()
+
+    def test_text_bayes_train_predict_verbs(self, tmp_path, capsys):
+        from avenir_tpu.cli.main import main
+        train = tmp_path / "train.csv"
+        train.write_text(
+            "cheap viagra offer offer,spam\n"
+            "cheap pills offer,spam\n"
+            "meeting agenda tomorrow,ham\n"
+            "lunch meeting tomorrow,ham\n")
+        model_path = tmp_path / "model.txt"
+        conf = tmp_path / "job.properties"
+        conf.write_text(
+            "tabular.input=false\n"
+            f"bayesian.model.file.path={model_path}\n"
+            "validation.mode=true\n")
+        assert main(["BayesianDistribution", str(train), str(model_path),
+                     "--conf", str(conf)]) == 0
+        test = tmp_path / "test.csv"
+        test.write_text("cheap offer,spam\nagenda meeting,ham\n")
+        out = tmp_path / "pred.txt"
+        assert main(["BayesianPredictor", str(test), str(out),
+                     "--conf", str(conf)]) == 0
+        lines = out.read_text().splitlines()
+        assert lines[0].endswith(",spam") and lines[1].endswith(",ham")
