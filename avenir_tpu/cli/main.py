@@ -496,10 +496,13 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
         for row in read_csv_lines(reward_path,
                                   conf.get("field.delim.regex", ",")):
             queues.push_reward(row[0], float(row[1]))
-    loop = OnlineLearnerLoop(
-        learner_type, actions, conf.as_dict(), queues,
-        seed=conf.get_int("random.seed", 0))
-    stats = loop.run()
+    with OnlineLearnerLoop(
+            learner_type, actions, conf.as_dict(), queues,
+            seed=conf.get_int("random.seed", 0),
+            checkpoint_dir=conf.get("checkpoint.dir"),
+            checkpoint_interval=conf.get_int("checkpoint.interval", 100)
+            ) as loop:
+        stats = loop.run()
     delim_out = conf.get("field.delim", ",")
     with open(out_path, "w") as fh:
         while True:

@@ -127,18 +127,64 @@ class LoopStats:
 
 
 class OnlineLearnerLoop:
-    """The ReinforcementLearnerBolt loop around one jitted learner."""
+    """The ReinforcementLearnerBolt loop around one jitted learner.
+
+    With ``checkpoint_dir`` the loop periodically checkpoints the learner
+    state pytree + counters (every ``checkpoint_interval`` events) and a new
+    loop over the same directory resumes from the latest step — recovery the
+    reference's always-on Storm path lacks (its bolt state dies with the
+    worker; ``replay.failed.message=false``)."""
 
     def __init__(self, learner_type: str, actions: Sequence[str],
-                 config: Dict[str, Any], queues, seed: int = 0):
+                 config: Dict[str, Any], queues, seed: int = 0,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_interval: int = 100):
         self.learner = Learner(learner_type, actions, config, seed)
         self.queues = queues
         self.stats = LoopStats()
+        self._ckpt = None
+        self._ckpt_mod = None
+        self._ckpt_interval = max(int(checkpoint_interval), 1)
+        # rewards already folded into a restored state must not be
+        # re-applied when an append-only reward source (reward file,
+        # Redis list read from a reset cursor) is re-drained on restart
+        self._skip_rewards = 0
+        if checkpoint_dir:
+            from avenir_tpu.utils import checkpoint as C
+            self._ckpt_mod = C
+            self._ckpt = C.Checkpointer(checkpoint_dir, max_to_keep=2,
+                                        use_async=True)
+            if self._ckpt.latest_step() is not None:
+                state, stats, _ = C.restore_loop_state(
+                    self._ckpt, self.learner.state)
+                self.learner.state = state
+                self.stats = LoopStats(**stats)
+                self._skip_rewards = self.stats.rewards
+
+    def _maybe_checkpoint(self) -> None:
+        if self._ckpt and self.stats.events % self._ckpt_interval == 0:
+            self._ckpt_mod.save_loop_state(
+                self._ckpt, self.stats.events, self.learner.state,
+                vars(self.stats))
+
+    def close(self) -> None:
+        if self._ckpt:
+            self._ckpt.close()
+            self._ckpt = None
+
+    def __enter__(self) -> "OnlineLearnerLoop":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def step(self) -> bool:
         """Process one event (rewards drained first, like the bolt
         :96-99). Returns False when the event queue is empty."""
         for action_id, reward in self.queues.drain_rewards():
+            if self._skip_rewards > 0:
+                self._skip_rewards -= 1
+                continue
             self.learner.set_reward(action_id, reward)
             self.stats.rewards += 1
         event_id = self.queues.pop_event()
@@ -148,6 +194,7 @@ class OnlineLearnerLoop:
         self.queues.write_actions(event_id, selections)
         self.stats.events += 1
         self.stats.actions_written += len(selections)
+        self._maybe_checkpoint()
         return True
 
     def run(self, max_events: Optional[int] = None) -> LoopStats:

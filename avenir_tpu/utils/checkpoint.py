@@ -1,0 +1,113 @@
+"""Checkpoint / resume for iterative drivers and the online loop.
+
+The reference checkpoints implicitly — every inter-job artifact is a durable
+HDFS file (SURVEY.md §5): LogisticRegression appends each iteration's
+coefficients to ``coeff.file.path`` and re-reads the last line on restart
+(LogisticRegressionJob.java:154-160, 238-255); the decision tree persists
+each level under ``split=…/segment=…/data/`` (DataPartitioner.java:114-129);
+bandit rounds persist the running reward aggregate between rounds.
+
+Those file-per-stage contracts are kept by the respective jobs (see
+``models.logistic.load_coefficients`` and the DataPartitioner verb). This
+module adds the piece the reference never had: a typed checkpoint of
+**(device-array pytree, step counter)** for the always-on online loop and
+any iterative driver, backed by orbax — so a killed process resumes with
+bit-identical learner state instead of replaying its reward history.
+
+    ckpt = Checkpointer(dir, max_to_keep=3)
+    ckpt.save(step, state_pytree)
+    state = ckpt.restore(like=state_pytree)   # latest step
+    step  = ckpt.latest_step()
+
+Restore with ``like=`` reproduces the exact leaf types/shapes (including
+jnp arrays); without it, leaves come back as host numpy arrays.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover - orbax is baked into the image
+    _HAVE_ORBAX = False
+
+
+class Checkpointer:
+    """Step-numbered pytree checkpoints under one directory."""
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None,
+                 use_async: bool = False):
+        """``use_async=True`` makes ``save`` return immediately (orbax
+        serializes in the background, waiting on the previous save at the
+        next one) — the right mode inside a serving loop where a blocking
+        device-to-disk write would spike action latency."""
+        if not _HAVE_ORBAX:  # pragma: no cover
+            raise RuntimeError("orbax.checkpoint is unavailable")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._use_async = use_async
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=use_async))
+
+    def save(self, step: int, pytree: Any) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(pytree))
+        if not self._use_async:
+            self._mgr.wait_until_finished()
+
+    def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
+        self._mgr.wait_until_finished()   # flush any in-flight async save
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        if like is not None:
+            abstract = jax.tree.map(
+                ocp.utils.to_shape_dtype_struct, like)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        return self._mgr.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+_COUNTER_NAMES = ("events", "rewards", "actions_written")
+
+
+def save_loop_state(ckpt: Checkpointer, step: int, learner_state: Any,
+                    stats: Optional[dict] = None) -> None:
+    """Checkpoint an online-loop learner state pytree plus LoopStats
+    counters (fixed order: events, rewards, actions_written)."""
+    stats = stats or {}
+    counters = np.asarray([int(stats.get(k, 0)) for k in _COUNTER_NAMES],
+                          np.int64)
+    ckpt.save(step, {"learner": learner_state, "counters": counters})
+
+
+def restore_loop_state(ckpt: Checkpointer, learner_state_like: Any,
+                       step: Optional[int] = None):
+    """Returns (learner_state, stats dict, step restored)."""
+    if step is None:
+        step = ckpt.latest_step()
+    payload = ckpt.restore(
+        step, like={"learner": learner_state_like,
+                    "counters": np.zeros(3, np.int64)})
+    stats = {k: int(v) for k, v in
+             zip(_COUNTER_NAMES, payload["counters"])}
+    return payload["learner"], stats, step

@@ -1,0 +1,90 @@
+"""Tracing / profiling / structured logging hooks.
+
+The reference has no profiler (SURVEY.md §5): log4j levels gated by a
+``debug.on`` config (BayesianPredictor.java:127-129 pattern) and Hadoop's
+job UI are all it offers. This module supplies the TPU-native equivalents:
+
+- ``trace(dir)``: context manager around ``jax.profiler`` emitting an XLA
+  trace viewable in TensorBoard/Perfetto.
+- ``StepTimer``: wall-clock per-step timing that blocks on device results,
+  accumulating into a ``MetricsRegistry``-compatible dict (mean/min/max).
+- ``get_logger(name, debug_on)``: the ``debug.on`` switch — DEBUG level when
+  on, WARNING otherwise, one stderr handler, structured ``key=value`` text.
+- ``annotate(name)``: ``jax.profiler.TraceAnnotation`` wrapper so host-side
+  pipeline stages show up as named spans in the trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Profile everything inside the block into ``log_dir``."""
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span inside an active trace (host-side stage marker)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Times jitted steps honestly: blocks until device results are ready.
+
+    >>> timer = StepTimer("train")
+    >>> with timer.step():
+    ...     out = train_step(batch)
+    ...     timer.block_on(out)
+    >>> timer.summary()   # {'train.steps': N, 'train.mean_ms': ..., ...}
+    """
+
+    def __init__(self, name: str = "step"):
+        self.name = name
+        self.times_ms: list = []
+        self._t0: Optional[float] = None
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator["StepTimer"]:
+        t0 = time.perf_counter()
+        yield self
+        self.times_ms.append((time.perf_counter() - t0) * 1e3)
+
+    @staticmethod
+    def block_on(tree: Any) -> Any:
+        return jax.block_until_ready(tree)
+
+    def summary(self) -> Dict[str, float]:
+        if not self.times_ms:
+            return {f"{self.name}.steps": 0}
+        arr = self.times_ms
+        return {
+            f"{self.name}.steps": len(arr),
+            f"{self.name}.mean_ms": sum(arr) / len(arr),
+            f"{self.name}.min_ms": min(arr),
+            f"{self.name}.max_ms": max(arr),
+        }
+
+
+def get_logger(name: str, debug_on: bool = False) -> logging.Logger:
+    """The reference's per-class ``debug.on`` switch as a logger factory."""
+    logger = logging.getLogger(f"avenir_tpu.{name}")
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s level=%(levelname)s logger=%(name)s %(message)s"))
+        logger.addHandler(handler)
+        logger.propagate = False
+    logger.setLevel(logging.DEBUG if debug_on else logging.WARNING)
+    return logger

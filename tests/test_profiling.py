@@ -1,0 +1,46 @@
+"""Profiling hooks: step timer, debug.on logger, trace smoke test."""
+
+import glob
+import logging
+import os
+
+import jax.numpy as jnp
+
+from avenir_tpu.utils.profiling import StepTimer, get_logger, trace, annotate
+
+
+class TestStepTimer:
+    def test_times_steps(self):
+        timer = StepTimer("train")
+        for _ in range(3):
+            with timer.step():
+                out = jnp.sum(jnp.arange(1000.0))
+                timer.block_on(out)
+        s = timer.summary()
+        assert s["train.steps"] == 3
+        assert s["train.mean_ms"] >= 0.0
+        assert s["train.min_ms"] <= s["train.max_ms"]
+
+    def test_empty_summary(self):
+        assert StepTimer("x").summary() == {"x.steps": 0}
+
+
+class TestLogger:
+    def test_debug_on_off(self):
+        on = get_logger("job.a", debug_on=True)
+        off = get_logger("job.b", debug_on=False)
+        assert on.level == logging.DEBUG
+        assert off.level == logging.WARNING
+        # same name returns the same configured logger, no handler pileup
+        again = get_logger("job.a", debug_on=False)
+        assert again is on and len(again.handlers) == 1
+
+
+class TestTrace:
+    def test_trace_writes_files(self, tmp_path):
+        log_dir = str(tmp_path / "trace")
+        with trace(log_dir):
+            with annotate("stage"):
+                jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+        found = glob.glob(os.path.join(log_dir, "**", "*"), recursive=True)
+        assert any(os.path.isfile(f) for f in found)
