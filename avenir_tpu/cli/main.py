@@ -489,19 +489,24 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
     if not actions:
         raise ValueError("action.list must name the candidate actions")
     queues = InProcQueues()
-    for row in read_csv_lines(in_path, conf.get("field.delim.regex", ",")):
-        queues.push_event(row[0])
-    reward_path = conf.get("reward.data.path")
-    if reward_path:
-        for row in read_csv_lines(reward_path,
-                                  conf.get("field.delim.regex", ",")):
-            queues.push_reward(row[0], float(row[1]))
     with OnlineLearnerLoop(
             learner_type, actions, conf.as_dict(), queues,
             seed=conf.get_int("random.seed", 0),
             checkpoint_dir=conf.get("checkpoint.dir"),
             checkpoint_interval=conf.get_int("checkpoint.interval", 100)
             ) as loop:
+        # the event file is re-read in full on restart; skip the lines a
+        # restored checkpoint already served (rewards are skipped inside
+        # the loop, which sees the re-drained reward stream itself)
+        event_rows = read_csv_lines(in_path,
+                                    conf.get("field.delim.regex", ","))
+        for row in event_rows[loop.resumed_events:]:
+            queues.push_event(row[0])
+        reward_path = conf.get("reward.data.path")
+        if reward_path:
+            for row in read_csv_lines(reward_path,
+                                      conf.get("field.delim.regex", ",")):
+                queues.push_reward(row[0], float(row[1]))
         stats = loop.run()
     delim_out = conf.get("field.delim", ",")
     with open(out_path, "w") as fh:

@@ -1,0 +1,90 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+``avt_io`` is the CSV featurizer (native/avt_io.cpp): one C++ pass over the
+file bytes replaces the Python per-row/per-field encode loop. The shared
+library is built on demand with g++ (rebuilt when the source is newer) and
+everything degrades to the pure-Python path when no compiler is available —
+call :func:`available` to check.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "avt_io.cpp")
+_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_avt_io.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: str = ""
+
+
+def _build() -> bool:
+    global _build_error
+    if os.path.exists(_SO) and (not os.path.exists(_SRC) or
+                                os.path.getmtime(_SO) >=
+                                os.path.getmtime(_SRC)):
+        return True
+    if not os.path.exists(_SRC):
+        _build_error = f"source not found: {_SRC}"
+        return False
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-o", _SO + ".tmp", _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        _build_error = f"g++ unavailable: {exc}"
+        return False
+    if proc.returncode != 0:
+        _build_error = f"g++ failed: {proc.stderr[-2000:]}"
+        return False
+    os.replace(_SO + ".tmp", _SO)
+    return True
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.avt_encode.restype = ctypes.c_void_p
+        lib.avt_encode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int8),     # kinds
+            ctypes.POINTER(ctypes.c_int32),    # feat_slot
+            ctypes.POINTER(ctypes.c_double),   # bucket_width
+            ctypes.POINTER(ctypes.c_int64),    # bin_offset
+            ctypes.c_char_p,                   # vocab_blob
+            ctypes.POINTER(ctypes.c_int32),    # vocab_counts
+            ctypes.c_int32, ctypes.c_int32]    # oov, n_feat
+        lib.avt_rows.restype = ctypes.c_int64
+        lib.avt_rows.argtypes = [ctypes.c_void_p]
+        lib.avt_error_msg.restype = ctypes.c_char_p
+        lib.avt_error_msg.argtypes = [ctypes.c_void_p]
+        lib.avt_fill.restype = None
+        lib.avt_fill.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+        lib.avt_free.restype = None
+        lib.avt_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native loader compiled and loaded."""
+    return _load() is not None
+
+
+def build_error() -> str:
+    return _build_error

@@ -1,0 +1,163 @@
+"""Native CSV → EncodedTable: the C++ fast path for Featurizer.transform.
+
+Builds the column-spec arrays from a *fitted* Featurizer (vocabularies, bin
+offsets, class values), hands the raw file bytes to ``avt_encode`` and wraps
+the filled numpy buffers in the same :class:`EncodedTable` the Python path
+produces — bit-identical bins/values (asserted in tests/test_native.py).
+
+Applicability: single-character field delimiter and a fitted featurizer;
+``encode_file`` raises :class:`NativeUnavailable` otherwise and callers fall
+back to the pure-Python ``Featurizer.transform``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from avenir_tpu import native
+from avenir_tpu.utils.dataset import EncodedTable, Featurizer
+
+_KIND_IGNORE, _KIND_ID, _KIND_CLASS = -1, 0, 1
+_KIND_CATEGORICAL, _KIND_BUCKETED, _KIND_CONTINUOUS = 2, 3, 4
+
+
+class NativeUnavailable(RuntimeError):
+    """The native path cannot handle this request; use the Python path."""
+
+
+def _single_char_delim(delim_regex: str) -> Optional[str]:
+    if len(delim_regex) == 1 and delim_regex not in r".^$*+?{}[]\|()":
+        return delim_regex
+    if delim_regex == r"\t":
+        return "\t"
+    return None
+
+
+def encode_file(fz: Featurizer, path: str, delim_regex: str = ",",
+                with_labels: bool = True) -> EncodedTable:
+    lib = native._load()
+    if lib is None:
+        raise NativeUnavailable(native.build_error())
+    delim = _single_char_delim(delim_regex)
+    if delim is None:
+        raise NativeUnavailable(
+            f"native loader needs a single-char delimiter, got "
+            f"{delim_regex!r}")
+    if not fz._fitted:
+        raise RuntimeError("call fit() first")
+
+    id_field = fz.schema.find_id_field()
+    try:
+        class_field = fz.schema.find_class_attr_field()
+    except ValueError:
+        class_field = None
+    use_labels = with_labels and class_field is not None
+
+    n_ord = 0
+    specs = {}   # ordinal -> (kind, feat_slot, bucket_width, bin_offset, vocab list)
+    if id_field is not None:
+        specs[id_field.ordinal] = (_KIND_ID, -1, 0.0, 0, [])
+    if use_labels:
+        specs[class_field.ordinal] = (
+            _KIND_CLASS, -1, 0.0, 0, list(fz.class_values))
+    for slot, enc in enumerate(fz.encoders):
+        f = enc.field
+        if f.is_categorical:
+            vocab = [""] * len(enc.vocab)
+            for tok, idx in enc.vocab.items():
+                vocab[idx] = tok
+            specs[f.ordinal] = (_KIND_CATEGORICAL, slot, 0.0, 0, vocab)
+        elif enc.continuous:
+            specs[f.ordinal] = (_KIND_CONTINUOUS, slot, 0.0, 0, [])
+        else:
+            specs[f.ordinal] = (_KIND_BUCKETED, slot,
+                                float(f.bucket_width), enc.bin_offset, [])
+    n_ord = max(specs) + 1
+
+    kinds = np.full(n_ord, _KIND_IGNORE, np.int8)
+    feat_slot = np.full(n_ord, -1, np.int32)
+    bucket_width = np.zeros(n_ord, np.float64)
+    bin_offset = np.zeros(n_ord, np.int64)
+    vocab_counts = np.zeros(n_ord, np.int32)
+    blob_parts = []
+    for ordinal, (kind, slot, bw, off, vocab) in sorted(specs.items()):
+        kinds[ordinal] = kind
+        feat_slot[ordinal] = slot
+        bucket_width[ordinal] = bw
+        bin_offset[ordinal] = off
+        vocab_counts[ordinal] = len(vocab)
+        for tok in vocab:
+            blob_parts.append(tok.encode() + b"\0")
+    vocab_blob = b"".join(blob_parts)
+
+    with open(path, "rb") as fh:
+        buf = fh.read()
+
+    n_feat = len(fz.encoders)
+    oov = 1 if fz.unseen == "oov" else 0
+    handle = lib.avt_encode(
+        buf, len(buf), delim.encode(),
+        n_ord,
+        kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        feat_slot.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        bucket_width.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        bin_offset.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        vocab_blob,
+        vocab_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        oov, n_feat)
+    try:
+        n_rows = lib.avt_rows(handle)
+        if n_rows < 0:
+            raise ValueError(
+                "native loader: " + lib.avt_error_msg(handle).decode())
+        binned = np.zeros((n_rows, n_feat), np.int32)
+        numeric = np.zeros((n_rows, n_feat), np.float32)
+        labels = np.zeros((n_rows,), np.int32) if use_labels else None
+        id_spans = np.zeros((n_rows, 2), np.int64)
+        lib.avt_fill(
+            handle,
+            binned.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            (labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+             if labels is not None else None),
+            id_spans.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    finally:
+        lib.avt_free(handle)
+
+    if id_field is not None:
+        ids = [buf[a:b].decode() for a, b in id_spans]
+    else:
+        ids = [str(i) for i in range(n_rows)]
+
+    return EncodedTable(
+        binned=jnp.asarray(binned),
+        numeric=jnp.asarray(numeric),
+        labels=jnp.asarray(labels) if labels is not None else None,
+        ids=ids,
+        feature_fields=[e.field for e in fz.encoders],
+        bins_per_feature=tuple(e.n_bins for e in fz.encoders),
+        is_continuous=tuple(e.continuous for e in fz.encoders),
+        class_values=list(fz.class_values),
+        bin_labels=[Featurizer._bin_labels(e) for e in fz.encoders],
+        norm_min=tuple(e.norm_min for e in fz.encoders),
+        norm_max=tuple(e.norm_max for e in fz.encoders),
+    )
+
+
+def transform_file(fz: Featurizer, path: str, delim_regex: str = ",",
+                   with_labels: bool = True,
+                   force_python: bool = False) -> EncodedTable:
+    """Featurize a CSV file: native C++ pass when possible, else the
+    Python ``read_csv_lines`` + ``transform`` path with identical output."""
+    if not force_python:
+        try:
+            return encode_file(fz, path, delim_regex, with_labels)
+        except NativeUnavailable:
+            pass
+    from avenir_tpu.utils.dataset import read_csv_lines
+    return fz.transform(read_csv_lines(path, delim_regex),
+                        with_labels=with_labels)

@@ -149,6 +149,9 @@ class OnlineLearnerLoop:
         # re-applied when an append-only reward source (reward file,
         # Redis list read from a reset cursor) is re-drained on restart
         self._skip_rewards = 0
+        # events applied before the restored checkpoint; callers replaying
+        # an event *file* (not a destructive queue) skip this many lines
+        self.resumed_events = 0
         if checkpoint_dir:
             from avenir_tpu.utils import checkpoint as C
             self._ckpt_mod = C
@@ -160,6 +163,7 @@ class OnlineLearnerLoop:
                 self.learner.state = state
                 self.stats = LoopStats(**stats)
                 self._skip_rewards = self.stats.rewards
+                self.resumed_events = self.stats.events
 
     def _maybe_checkpoint(self) -> None:
         if self._ckpt and self.stats.events % self._ckpt_interval == 0:

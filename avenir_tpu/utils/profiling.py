@@ -53,7 +53,6 @@ class StepTimer:
     def __init__(self, name: str = "step"):
         self.name = name
         self.times_ms: list = []
-        self._t0: Optional[float] = None
 
     @contextlib.contextmanager
     def step(self) -> Iterator["StepTimer"]:
@@ -77,8 +76,14 @@ class StepTimer:
         }
 
 
-def get_logger(name: str, debug_on: bool = False) -> logging.Logger:
-    """The reference's per-class ``debug.on`` switch as a logger factory."""
+def get_logger(name: str,
+               debug_on: Optional[bool] = None) -> logging.Logger:
+    """The reference's per-class ``debug.on`` switch as a logger factory.
+
+    ``debug_on=None`` leaves an already-configured logger's level alone
+    (first configuration defaults to WARNING) so a later default-args call
+    cannot silently disable DEBUG enabled by an earlier caller.
+    """
     logger = logging.getLogger(f"avenir_tpu.{name}")
     if not logger.handlers:
         handler = logging.StreamHandler()
@@ -86,5 +91,7 @@ def get_logger(name: str, debug_on: bool = False) -> logging.Logger:
             "%(asctime)s level=%(levelname)s logger=%(name)s %(message)s"))
         logger.addHandler(handler)
         logger.propagate = False
-    logger.setLevel(logging.DEBUG if debug_on else logging.WARNING)
+        logger.setLevel(logging.WARNING)
+    if debug_on is not None:
+        logger.setLevel(logging.DEBUG if debug_on else logging.WARNING)
     return logger

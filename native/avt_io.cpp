@@ -1,0 +1,281 @@
+// avt_io: native CSV featurizer for avenir_tpu.
+//
+// The reference's data path re-parses CSV in every mapper JVM
+// (BayesianDistribution.java:138-179 et al.); the TPU build featurizes once
+// into dense arrays (avenir_tpu/utils/dataset.py). This library is the
+// native runtime component of that loader: one pass over the file bytes
+// doing field split, categorical vocab lookup, numeric parse, bucket
+// binning, and class-label coding straight into caller-allocated numpy
+// buffers — the Python FieldEncoder.encode loop collapses into C++.
+//
+// Contract mirrors Featurizer.transform exactly (same bin ids, same
+// numeric values, same error conditions); tests/test_native.py asserts
+// parity against the Python path.
+//
+// C ABI (ctypes): avt_encode -> opaque handle; avt_rows/avt_error_msg
+// inspect; avt_fill copies into numpy buffers; avt_free releases.
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// per-CSV-ordinal column roles
+enum Kind : int8_t {
+  kIgnore = -1,
+  kId = 0,
+  kClass = 1,
+  kCategorical = 2,
+  kBucketed = 3,
+  kContinuous = 4,
+};
+
+struct ColumnSpec {
+  Kind kind = kIgnore;
+  int32_t feat_slot = -1;   // output feature column (kind >= 2)
+  double bucket_width = 0.0;
+  int64_t bin_offset = 0;
+  std::unordered_map<std::string, int32_t> vocab;  // categorical
+  int32_t oov_index = -1;   // -1: unseen is an error
+};
+
+struct Table {
+  int64_t rows = 0;
+  int32_t n_feat = 0;
+  std::vector<int32_t> binned;    // [rows, n_feat]
+  std::vector<float> numeric;     // [rows, n_feat]
+  std::vector<int32_t> labels;    // [rows] (only when a class column exists)
+  std::vector<int64_t> id_spans;  // [rows, 2] byte offsets of the id token
+  bool has_labels = false;
+  std::string error;
+};
+
+inline std::string_view trim(const char* begin, const char* end) {
+  while (begin < end && std::isspace(static_cast<unsigned char>(*begin)))
+    ++begin;
+  while (end > begin && std::isspace(static_cast<unsigned char>(end[-1])))
+    --end;
+  return std::string_view(begin, static_cast<size_t>(end - begin));
+}
+
+bool parse_double(std::string_view tok, double* out) {
+  // strtod needs NUL termination; tokens are short, copy to a small buffer
+  char buf[64];
+  if (tok.size() == 0 || tok.size() >= sizeof(buf)) return false;
+  std::memcpy(buf, tok.data(), tok.size());
+  buf[tok.size()] = '\0';
+  char* endp = nullptr;
+  double v = std::strtod(buf, &endp);
+  if (endp != buf + tok.size()) return false;
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse + encode the whole buffer.
+//
+//   buf, len        : file bytes
+//   delim           : single-character field delimiter
+//   n_ordinals      : number of CSV columns described below
+//   kinds           : [n_ordinals] Kind per CSV ordinal
+//   feat_slot       : [n_ordinals] output feature column index (or -1)
+//   bucket_width    : [n_ordinals] bucket width for kBucketed
+//   bin_offset      : [n_ordinals] minimum bin id subtracted after division
+//   vocab_blob      : NUL-separated tokens, per-ordinal runs concatenated
+//   vocab_counts    : [n_ordinals] number of vocab tokens per ordinal
+//                     (class column vocab rides the same blob)
+//   oov             : nonzero -> unseen categorical maps to vocab_count
+//   n_feat          : number of output feature columns
+//
+// Returns a Table handle (check avt_error_msg; rows < 0 on failure).
+void* avt_encode(const char* buf, int64_t len, char delim,
+                 int32_t n_ordinals, const int8_t* kinds,
+                 const int32_t* feat_slot, const double* bucket_width,
+                 const int64_t* bin_offset, const char* vocab_blob,
+                 const int32_t* vocab_counts, int32_t oov, int32_t n_feat) {
+  auto* t = new Table();
+  t->n_feat = n_feat;
+
+  std::vector<ColumnSpec> cols(static_cast<size_t>(n_ordinals));
+  const char* vp = vocab_blob;
+  int32_t class_ord = -1, id_ord = -1;
+  for (int32_t i = 0; i < n_ordinals; ++i) {
+    ColumnSpec& c = cols[static_cast<size_t>(i)];
+    c.kind = static_cast<Kind>(kinds[i]);
+    c.feat_slot = feat_slot[i];
+    c.bucket_width = bucket_width[i];
+    c.bin_offset = bin_offset[i];
+    for (int32_t v = 0; v < vocab_counts[i]; ++v) {
+      std::string tok(vp);
+      vp += tok.size() + 1;
+      c.vocab.emplace(std::move(tok), v);
+    }
+    if (c.kind == kCategorical && oov)
+      c.oov_index = vocab_counts[i];
+    if (c.kind == kClass) class_ord = i;
+    if (c.kind == kId) id_ord = i;
+  }
+  t->has_labels = class_ord >= 0;
+
+  // count rows (non-empty lines) to size the output vectors once
+  int64_t rows = 0;
+  for (int64_t p = 0; p < len;) {
+    int64_t eol = p;
+    while (eol < len && buf[eol] != '\n') ++eol;
+    if (trim(buf + p, buf + eol).size() > 0) ++rows;
+    p = eol + 1;
+  }
+  t->binned.assign(static_cast<size_t>(rows * n_feat), 0);
+  t->numeric.assign(static_cast<size_t>(rows * n_feat), 0.0f);
+  if (t->has_labels) t->labels.assign(static_cast<size_t>(rows), 0);
+  t->id_spans.assign(static_cast<size_t>(rows * 2), 0);
+
+  int64_t r = 0;
+  char msg[256];
+  for (int64_t p = 0; p < len;) {
+    int64_t eol = p;
+    while (eol < len && buf[eol] != '\n') ++eol;
+    if (trim(buf + p, buf + eol).size() == 0) { p = eol + 1; continue; }
+
+    int32_t ord = 0;
+    const char* field_begin = buf + p;
+    const char* line_end = buf + eol;
+    const char* cursor = field_begin;
+    bool row_done = false;
+    while (!row_done) {
+      const char* field_end = cursor;
+      while (field_end < line_end && *field_end != delim) ++field_end;
+      std::string_view tok = trim(cursor, field_end);
+
+      if (ord < n_ordinals) {
+        const ColumnSpec& c = cols[static_cast<size_t>(ord)];
+        switch (c.kind) {
+          case kIgnore:
+            break;
+          case kId:
+            t->id_spans[static_cast<size_t>(r * 2)] = tok.data() - buf;
+            t->id_spans[static_cast<size_t>(r * 2 + 1)] =
+                tok.data() - buf + static_cast<int64_t>(tok.size());
+            break;
+          case kClass: {
+            auto it = c.vocab.find(std::string(tok));
+            if (it == c.vocab.end()) {
+              std::snprintf(msg, sizeof(msg),
+                            "row %lld: unseen class value '%.*s'",
+                            static_cast<long long>(r),
+                            static_cast<int>(tok.size()), tok.data());
+              t->error = msg;
+              return t;
+            }
+            t->labels[static_cast<size_t>(r)] = it->second;
+            break;
+          }
+          case kCategorical: {
+            auto it = c.vocab.find(std::string(tok));
+            int32_t idx;
+            if (it != c.vocab.end()) {
+              idx = it->second;
+            } else if (c.oov_index >= 0) {
+              idx = c.oov_index;
+            } else {
+              std::snprintf(msg, sizeof(msg),
+                            "row %lld ordinal %d: unseen categorical "
+                            "value '%.*s'",
+                            static_cast<long long>(r), ord,
+                            static_cast<int>(tok.size()), tok.data());
+              t->error = msg;
+              return t;
+            }
+            const size_t o =
+                static_cast<size_t>(r * n_feat + c.feat_slot);
+            t->binned[o] = idx;
+            t->numeric[o] = static_cast<float>(idx);
+            break;
+          }
+          case kBucketed:
+          case kContinuous: {
+            double v;
+            if (!parse_double(tok, &v)) {
+              std::snprintf(msg, sizeof(msg),
+                            "row %lld ordinal %d: non-numeric value '%.*s'",
+                            static_cast<long long>(r), ord,
+                            static_cast<int>(tok.size()), tok.data());
+              t->error = msg;
+              return t;
+            }
+            const size_t o =
+                static_cast<size_t>(r * n_feat + c.feat_slot);
+            t->numeric[o] = static_cast<float>(v);
+            if (c.kind == kBucketed)
+              t->binned[o] = static_cast<int32_t>(
+                  static_cast<int64_t>(std::floor(v / c.bucket_width)) -
+                  c.bin_offset);
+            break;
+          }
+        }
+      }
+      ++ord;
+      if (field_end >= line_end) {
+        row_done = true;
+        if (ord < n_ordinals) {
+          // a needed column is missing in this row?
+          for (int32_t rest = ord; rest < n_ordinals; ++rest) {
+            if (cols[static_cast<size_t>(rest)].kind != kIgnore) {
+              std::snprintf(msg, sizeof(msg),
+                            "row %lld has %d fields, needs ordinal %d",
+                            static_cast<long long>(r), ord, rest);
+              t->error = msg;
+              return t;
+            }
+          }
+        }
+      } else {
+        cursor = field_end + 1;
+      }
+    }
+    if (id_ord < 0) {  // no id column: span is empty, Python uses row index
+      t->id_spans[static_cast<size_t>(r * 2)] = 0;
+      t->id_spans[static_cast<size_t>(r * 2 + 1)] = 0;
+    }
+    ++r;
+    p = eol + 1;
+  }
+  t->rows = r;
+  return t;
+}
+
+int64_t avt_rows(void* handle) {
+  auto* t = static_cast<Table*>(handle);
+  return t->error.empty() ? t->rows : -1;
+}
+
+const char* avt_error_msg(void* handle) {
+  return static_cast<Table*>(handle)->error.c_str();
+}
+
+// Copy encoded data into caller buffers (sized from avt_rows * n_feat).
+// labels may be NULL when no class column was declared.
+void avt_fill(void* handle, int32_t* binned, float* numeric,
+              int32_t* labels, int64_t* id_spans) {
+  auto* t = static_cast<Table*>(handle);
+  std::memcpy(binned, t->binned.data(), t->binned.size() * sizeof(int32_t));
+  std::memcpy(numeric, t->numeric.data(), t->numeric.size() * sizeof(float));
+  if (labels && t->has_labels)
+    std::memcpy(labels, t->labels.data(), t->labels.size() * sizeof(int32_t));
+  std::memcpy(id_spans, t->id_spans.data(),
+              t->id_spans.size() * sizeof(int64_t));
+}
+
+void avt_free(void* handle) { delete static_cast<Table*>(handle); }
+
+}  // extern "C"

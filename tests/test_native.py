@@ -1,0 +1,126 @@
+"""Native C++ CSV featurizer: parity with the Python path + error handling.
+
+The native loader (native/avt_io.cpp via avenir_tpu.native.loader) must
+produce bit-identical EncodedTables to Featurizer.transform.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu import native
+from avenir_tpu.datagen.generators import (churn_rows, churn_schema,
+                                           elearn_rows, elearn_schema)
+from avenir_tpu.native.loader import (NativeUnavailable, encode_file,
+                                      transform_file)
+from avenir_tpu.utils.dataset import Featurizer
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason=f"native loader unavailable: "
+                                       f"{native.build_error()}")
+
+
+def _write(tmp_path, rows, name="data.csv", delim=","):
+    path = str(tmp_path / name)
+    with open(path, "w") as fh:
+        fh.write("\n".join(delim.join(r) for r in rows) + "\n")
+    return path
+
+
+def _assert_tables_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.binned), np.asarray(b.binned))
+    np.testing.assert_allclose(np.asarray(a.numeric), np.asarray(b.numeric))
+    if a.labels is None:
+        assert b.labels is None
+    else:
+        np.testing.assert_array_equal(np.asarray(a.labels),
+                                      np.asarray(b.labels))
+    assert a.ids == b.ids
+    assert a.bins_per_feature == b.bins_per_feature
+    assert a.bin_labels == b.bin_labels
+    assert a.class_values == b.class_values
+
+
+class TestParity:
+    def test_churn_parity(self, tmp_path):
+        rows = churn_rows(500, seed=3)
+        path = _write(tmp_path, rows)
+        fz = Featurizer(churn_schema()).fit(rows)
+        _assert_tables_equal(transform_file(fz, path, force_python=True),
+                             encode_file(fz, path))
+
+    def test_elearn_parity(self, tmp_path):
+        rows = elearn_rows(300, seed=5)
+        path = _write(tmp_path, rows)
+        fz = Featurizer(elearn_schema()).fit(rows)
+        _assert_tables_equal(transform_file(fz, path, force_python=True),
+                             encode_file(fz, path))
+
+    def test_without_labels(self, tmp_path):
+        rows = churn_rows(100, seed=9)
+        path = _write(tmp_path, rows)
+        fz = Featurizer(churn_schema()).fit(rows)
+        table = encode_file(fz, path, with_labels=False)
+        assert table.labels is None
+        py = transform_file(fz, path, with_labels=False, force_python=True)
+        np.testing.assert_array_equal(np.asarray(table.binned),
+                                      np.asarray(py.binned))
+
+    def test_blank_lines_skipped(self, tmp_path):
+        rows = churn_rows(20, seed=1)
+        path = str(tmp_path / "gaps.csv")
+        body = "\n\n".join(",".join(r) for r in rows) + "\n\n"
+        open(path, "w").write(body)
+        fz = Featurizer(churn_schema()).fit(rows)
+        assert encode_file(fz, path).n_rows == 20
+
+
+class TestErrors:
+    def test_unseen_categorical_errors(self, tmp_path):
+        rows = churn_rows(50, seed=2)
+        fz = Featurizer(churn_schema()).fit(rows)
+        bad = [list(r) for r in rows]
+        bad[10][1] = "NEVER_SEEN"
+        path = _write(tmp_path, bad)
+        with pytest.raises(ValueError, match="unseen categorical"):
+            encode_file(fz, path)
+
+    def test_unseen_categorical_oov_bin(self, tmp_path):
+        rows = churn_rows(50, seed=2)
+        fz = Featurizer(churn_schema(), unseen="oov").fit(rows)
+        bad = [list(r) for r in rows]
+        bad[10][1] = "NEVER_SEEN"
+        path = _write(tmp_path, bad)
+        table = encode_file(fz, path)
+        py = fz.transform(bad)
+        np.testing.assert_array_equal(np.asarray(table.binned),
+                                      np.asarray(py.binned))
+
+    def test_non_numeric_errors(self, tmp_path):
+        rows = elearn_rows(50, seed=2)
+        fz = Featurizer(elearn_schema()).fit(rows)
+        bad = [list(r) for r in rows]
+        bad[5][2] = "not_a_number"   # ordinal 2 is numeric in elearn
+        path = _write(tmp_path, bad)
+        with pytest.raises(ValueError, match="non-numeric"):
+            encode_file(fz, path)
+
+    def test_short_row_errors(self, tmp_path):
+        rows = churn_rows(50, seed=2)
+        fz = Featurizer(churn_schema()).fit(rows)
+        bad = [list(r) for r in rows]
+        bad[7] = bad[7][:2]
+        path = _write(tmp_path, bad)
+        with pytest.raises(ValueError, match="fields"):
+            encode_file(fz, path)
+
+    def test_regex_delim_falls_back(self, tmp_path):
+        rows = churn_rows(30, seed=4)
+        path = _write(tmp_path, rows)
+        fz = Featurizer(churn_schema()).fit(rows)
+        with pytest.raises(NativeUnavailable):
+            encode_file(fz, path, delim_regex=",+")
+        # transform_file silently falls back
+        table = transform_file(fz, path, delim_regex=",+")
+        assert table.n_rows == 30

@@ -31,9 +31,13 @@ class TestLogger:
         off = get_logger("job.b", debug_on=False)
         assert on.level == logging.DEBUG
         assert off.level == logging.WARNING
-        # same name returns the same configured logger, no handler pileup
-        again = get_logger("job.a", debug_on=False)
+        # same name returns the same configured logger, no handler pileup;
+        # default (None) leaves the earlier DEBUG level untouched
+        again = get_logger("job.a")
         assert again is on and len(again.handlers) == 1
+        assert again.level == logging.DEBUG
+        # explicit False is an intentional override
+        assert get_logger("job.a", debug_on=False).level == logging.WARNING
 
 
 class TestTrace:
