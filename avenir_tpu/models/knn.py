@@ -76,11 +76,28 @@ def _split_features(table: EncodedTable
     return x_num, x_cat, n_cat_bins
 
 
+def _on_tpu() -> bool:
+    return jax.devices()[0].platform == "tpu"
+
+
 def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(distances [M, k] scaled int32, train indices [M, k])."""
+    """(distances [M, k] scaled int32, train indices [M, k]).
+
+    On TPU the fast euclidean path runs the hand-scheduled Pallas kernel
+    (ops.pallas_distance); everything else uses the XLA streaming path."""
     tr_num, tr_cat, n_bins = _split_features(train)
     te_num, te_cat, _ = _split_features(test)
+    from avenir_tpu.ops import pallas_distance
+    encoded_width = ((tr_num.shape[1] if tr_num is not None else 0) +
+                     (tr_cat.shape[1] if tr_cat is not None else 0) * n_bins)
+    if _on_tpu() and pallas_distance.supported(
+            algorithm=config.algorithm, k=config.top_match_count,
+            mode=config.mode, encoded_width=encoded_width):
+        return pallas_distance.pairwise_topk_pallas(
+            te_num, tr_num, te_cat, tr_cat,
+            k=config.top_match_count, n_cat_bins=n_bins,
+            distance_scale=config.distance_scale)
     return pairwise_topk(
         te_num, tr_num, te_cat, tr_cat,
         k=config.top_match_count, block_size=config.block_size,

@@ -32,8 +32,9 @@ def _build() -> bool:
     if not os.path.exists(_SRC):
         _build_error = f"source not found: {_SRC}"
         return False
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-           "-o", _SO + ".tmp", _SRC]
+    # per-process temp name: concurrent builders must not write the same file
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -43,7 +44,7 @@ def _build() -> bool:
     if proc.returncode != 0:
         _build_error = f"g++ failed: {proc.stderr[-2000:]}"
         return False
-    os.replace(_SO + ".tmp", _SO)
+    os.replace(tmp, _SO)
     return True
 
 
@@ -54,7 +55,23 @@ def _load():
             return _lib
         if not _build():
             return None
-        lib = ctypes.CDLL(_SO)
+        global _build_error
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale or arch-mismatched .so: rebuild once from scratch, and
+            # degrade to the Python path if that still doesn't load
+            try:
+                os.remove(_SO)
+            except OSError:
+                pass
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError as exc:
+                _build_error = f"dlopen failed: {exc}"
+                return None
         lib.avt_encode.restype = ctypes.c_void_p
         lib.avt_encode.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,

@@ -1,0 +1,225 @@
+"""Pallas TPU kernel: fused pairwise distance + streaming top-k.
+
+The hand-scheduled version of ``ops.distance.pairwise_topk`` (the headline
+kernel — the computation the reference farms out to the external sifarish
+``SameTypeSimilarity`` MR job plus a secondary-sort shuffle for top-K,
+resource/knn.sh:44-47). The XLA path materializes each [M, block] distance
+slab and runs ``lax.approx_min_k`` over it; here the slab never leaves VMEM:
+
+- grid = (test tiles, train tiles); the train axis is the *inner* grid
+  dimension, so the running per-row best-k lives in VMEM scratch across the
+  whole train sweep of one test tile;
+- the distance block is the matmul expansion ``y² − 2·x@yᵀ`` on the MXU
+  (``|x|²`` is constant per test row, so it is irrelevant for ranking and is
+  added back at finalization on the host side);
+- per 128-lane column chunk, a running elementwise min folds the [TM, TN]
+  block to 128 candidates/row (the same lane-bucketed partial reduction
+  ``lax.approx_min_k`` uses, so the same recall semantics: candidates that
+  collide in a lane within one block can shadow each other);
+- k exact min-extractions over the 256 lanes of (candidates ++ running best)
+  update the scratch; the final tile writes [TM, 128] results to HBM.
+
+Categorical attributes ride the same MXU contraction: a one-hot encoding
+scaled by 1/√2 makes squared euclidean equal the mismatch count
+(``ops.distance.categorical_mismatch`` computes the identical quantity as an
+explicit matmul), so mixed-type rows are a single numeric matrix here.
+
+Euclidean only (the manhattan path has no matmul form); ``mode="exact"``
+callers stay on the XLA path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+BIG = 3.0e38          # float sentinel (fits float32)
+INT_BIG = 2 ** 30
+
+
+def _topk_kernel(x_ref, y_ref, y2_ref, out_d_ref, out_i_ref,
+                 best_d, best_i, *, k: int, tn: int, use_bf16: bool):
+    """One (test tile i, train tile j) grid step; j is the inner dimension."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        best_d[:] = jnp.full(best_d.shape, BIG, jnp.float32)
+        best_i[:] = jnp.full(best_i.shape, -1, jnp.int32)
+
+    x = x_ref[:]
+    y = y_ref[:]
+    if use_bf16:
+        # bf16 MXU inputs (the fast mode's accepted error); the slab and the
+        # min-fold stay f32 — a bf16 fold was tried and sends Mosaic compile
+        # time pathological (per-chunk 16↔32-bit mask relayouts)
+        x = x.astype(jnp.bfloat16)
+        y = y.astype(jnp.bfloat16)
+    cross = lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    metric = y2_ref[:] - 2.0 * cross      # [1, TN] broadcast; padded get BIG
+
+    # fold TN columns to 128 lane-candidates per row: running min over chunks
+    tm = metric.shape[0]
+    n_chunks = tn // LANES
+    cand_d = metric[:, :LANES]
+    cand_c = jnp.zeros((tm, LANES), jnp.int32)
+    for c in range(1, n_chunks):
+        chunk = metric[:, c * LANES:(c + 1) * LANES]
+        better = chunk < cand_d
+        cand_d = jnp.where(better, chunk, cand_d)
+        cand_c = jnp.where(better, c, cand_c)
+    lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+    cand_idx = j * tn + cand_c * LANES + lane
+
+    # k exact extractions over candidates ++ running best (256 lanes)
+    val = jnp.concatenate([cand_d, best_d[:]], axis=1)
+    idx = jnp.concatenate([cand_idx, best_i[:]], axis=1)
+    new_d = jnp.full((tm, LANES), BIG, jnp.float32)
+    new_i = jnp.full((tm, LANES), -1, jnp.int32)
+    slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+    for slot in range(k):
+        min_d = jnp.min(val, axis=1, keepdims=True)               # [TM, 1]
+        min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
+                        axis=1, keepdims=True)
+        new_d = jnp.where(slot_lane == slot, min_d, new_d)
+        new_i = jnp.where(slot_lane == slot, min_i, new_i)
+        val = jnp.where((val == min_d) & (idx == min_i), BIG, val)
+    best_d[:] = new_d
+    best_i[:] = new_i
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        out_d_ref[:] = best_d[:].astype(jnp.float32)
+        out_i_ref[:] = best_i[:]
+
+
+def _pad_rows(a: jnp.ndarray, multiple: int, fill=0.0) -> jnp.ndarray:
+    pad = (-a.shape[0]) % multiple
+    if pad == 0:
+        return a
+    return jnp.pad(a, ((0, pad), (0, 0)), constant_values=fill)
+
+
+@partial(jax.jit, static_argnames=("k", "tile_m", "tile_n", "mode",
+                                   "interpret"))
+def _pallas_topk_raw(x: jnp.ndarray, y: jnp.ndarray, *, k: int,
+                     tile_m: int, tile_n: int, mode: str,
+                     interpret: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Raw kernel launch: returns ([M_pad, 128] metric without |x|²,
+    [M_pad, 128] train indices); only the first k lanes are meaningful."""
+    m, d = x.shape
+    n = y.shape[0]
+    xp = _pad_rows(x, tile_m)
+    yp = _pad_rows(y, tile_n)
+    y2 = jnp.sum(y * y, axis=1)
+    # padded train rows get +BIG so they never win a min
+    y2p = jnp.pad(y2, (0, yp.shape[0] - n), constant_values=BIG)[None, :]
+
+    grid = (xp.shape[0] // tile_m, yp.shape[0] // tile_n)
+    kernel = partial(_topk_kernel, k=k, tn=tile_n, use_bf16=mode == "fast")
+    out_d, out_i = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_m, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, d), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_m, LANES), jnp.float32),
+            pltpu.VMEM((tile_m, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xp, yp, y2p)
+    return out_d[:m], out_i[:m]
+
+
+def encode_mixed(num: Optional[jnp.ndarray], cat: Optional[jnp.ndarray],
+                 n_cat_bins: int) -> jnp.ndarray:
+    """Concatenate numeric features with 1/√2-scaled one-hot categoricals so
+    plain squared euclidean equals numeric² + mismatch count."""
+    parts = []
+    if num is not None and num.shape[1]:
+        parts.append(num.astype(jnp.float32))
+    if cat is not None and cat.shape[1]:
+        fc = cat.shape[1]
+        offsets = (jnp.arange(fc) * n_cat_bins)[None, :]
+        oh = jax.nn.one_hot(cat + offsets, fc * n_cat_bins,
+                            dtype=jnp.float32)          # [B, fc, fc*n_bins]
+        # offsets give each field a disjoint slot range: summing over the
+        # field axis yields the flat multi-hot row
+        parts.append(jnp.sum(oh, axis=1) * np.float32(1.0 / np.sqrt(2.0)))
+    if not parts:
+        raise ValueError("no features")
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
+# beyond this encoded width the fixed train BlockSpec no longer fits VMEM
+# comfortably (tile_n * width * 4B); the streaming XLA path handles it instead
+MAX_ENCODED_WIDTH = 512
+
+
+def supported(*, algorithm: str, k: int, mode: str,
+              encoded_width: int = 0) -> bool:
+    return (algorithm == "euclidean" and mode == "fast" and
+            1 <= k <= LANES and encoded_width <= MAX_ENCODED_WIDTH)
+
+
+@partial(jax.jit, static_argnames=("k", "n_cat_bins", "distance_scale",
+                                   "tile_m", "tile_n", "mode", "interpret"))
+def pairwise_topk_pallas(x_num: Optional[jnp.ndarray],
+                         y_num: Optional[jnp.ndarray],
+                         x_cat: Optional[jnp.ndarray] = None,
+                         y_cat: Optional[jnp.ndarray] = None,
+                         *, k: int, n_cat_bins: int = 0,
+                         distance_scale: int = 1000,
+                         tile_m: int = 512, tile_n: int = 6144,
+                         mode: str = "fast",
+                         interpret: bool = False
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in for ``ops.distance.pairwise_topk`` (euclidean, fast mode):
+    (scaled-int distances [M, k], train indices [M, k]); not-found slots get
+    2^30 / -1. Per-attribute rms normalization like the XLA path."""
+    x = encode_mixed(x_num, x_cat, n_cat_bins)
+    y = encode_mixed(y_num, y_cat, n_cat_bins)
+    n_attrs = ((x_num.shape[1] if x_num is not None else 0) +
+               (x_cat.shape[1] if x_cat is not None else 0))
+    n = y.shape[0]
+    k_eff = min(k, n)
+    tn = min(tile_n, max(LANES, ((n + LANES - 1) // LANES) * LANES))
+    raw_d, raw_i = _pallas_topk_raw(x, y, k=k_eff, tile_m=tile_m,
+                                    tile_n=tn, mode=mode,
+                                    interpret=interpret)
+    raw_d, raw_i = raw_d[:, :k_eff], raw_i[:, :k_eff]
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    found = raw_i >= 0
+    sq = jnp.maximum(raw_d + x2, 0.0) / max(n_attrs, 1)
+    dist = jnp.sqrt(sq)
+    scaled = jnp.where(found,
+                       jnp.asarray(jnp.rint(dist * distance_scale),
+                                   jnp.int32),
+                       INT_BIG)
+    return scaled, jnp.where(found, raw_i, -1)

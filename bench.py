@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas
 
 # bench shape: elearnActivity-like (9 numeric features), scaled up
 N_TRAIN = int(os.environ.get("BENCH_N_TRAIN", 65536))
@@ -35,6 +36,8 @@ M_TEST = int(os.environ.get("BENCH_M_TEST", 8192))
 N_FEATURES = 9
 K = 5
 ITERS = int(os.environ.get("BENCH_ITERS", 100))
+# "auto": hand-scheduled pallas kernel on TPU, XLA path elsewhere
+IMPL = os.environ.get("BENCH_IMPL", "auto")
 
 
 def main() -> None:
@@ -42,10 +45,18 @@ def main() -> None:
     train = jnp.asarray(rng.random((N_TRAIN, N_FEATURES), dtype=np.float32))
     test = jnp.asarray(rng.random((M_TEST, N_FEATURES), dtype=np.float32))
 
+    use_pallas = (IMPL == "pallas" or
+                  (IMPL == "auto" and jax.devices()[0].platform == "tpu"))
+
+    def topk(t, train):
+        if use_pallas:
+            return pairwise_topk_pallas(t, train, k=K)
+        return pairwise_topk(t, train, k=K, mode="fast")
+
     @jax.jit
     def chain(test, train):
         def body(t, _):
-            d, i = pairwise_topk(t, train, k=K, mode="fast")
+            d, i = topk(t, train)
             # data dependency so iterations execute sequentially on-device
             eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
             return t + eps, (d[0, 0], i[0, 0])

@@ -127,12 +127,15 @@ void* avt_encode(const char* buf, int64_t len, char delim,
   }
   t->has_labels = class_ord >= 0;
 
-  // count rows (non-empty lines) to size the output vectors once
+  // count rows to size the output vectors once; a row is any line that is
+  // non-empty after stripping only the '\n' — the exact filter of the Python
+  // read_csv_lines (utils/dataset.py), which keeps whitespace-only lines
+  // (they then fail featurization identically on both paths)
   int64_t rows = 0;
   for (int64_t p = 0; p < len;) {
     int64_t eol = p;
     while (eol < len && buf[eol] != '\n') ++eol;
-    if (trim(buf + p, buf + eol).size() > 0) ++rows;
+    if (eol > p) ++rows;
     p = eol + 1;
   }
   t->binned.assign(static_cast<size_t>(rows * n_feat), 0);
@@ -145,7 +148,7 @@ void* avt_encode(const char* buf, int64_t len, char delim,
   for (int64_t p = 0; p < len;) {
     int64_t eol = p;
     while (eol < len && buf[eol] != '\n') ++eol;
-    if (trim(buf + p, buf + eol).size() == 0) { p = eol + 1; continue; }
+    if (eol == p) { p = eol + 1; continue; }
 
     int32_t ord = 0;
     const char* field_begin = buf + p;

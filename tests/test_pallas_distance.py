@@ -1,0 +1,83 @@
+"""Pallas fused distance+top-k vs the exact XLA path (interpret mode on CPU).
+
+Same validation idea as the reference's eyeball-the-planted-signal strategy
+(SURVEY.md §4) made exact: the Pallas kernel must agree with the bit-stable
+``mode="exact"`` XLA implementation on neighbor sets and distances.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.ops.pallas_distance import (
+    encode_mixed, pairwise_topk_pallas, supported)
+
+
+def _recall(exact_idx, got_idx):
+    hits = total = 0
+    for row_e, row_g in zip(np.asarray(exact_idx), np.asarray(got_idx)):
+        valid = row_e[row_e >= 0]
+        hits += len(set(valid) & set(row_g.tolist()))
+        total += len(valid)
+    return hits / max(total, 1)
+
+
+@pytest.mark.parametrize("m,n,k", [(64, 300, 5), (33, 1000, 3), (8, 4, 5)])
+def test_pallas_matches_exact_numeric(m, n, k):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((m, 9), dtype=np.float32))
+    y = jnp.asarray(rng.random((n, 9), dtype=np.float32))
+    d_exact, i_exact = pairwise_topk(x, y, k=k, mode="exact")
+    d_pal, i_pal = pairwise_topk_pallas(x, y, k=k, interpret=True,
+                                        tile_m=32, tile_n=256)
+    assert d_pal.shape == d_exact.shape
+    assert _recall(i_exact, i_pal) >= 0.95
+    # distances of agreed-on neighbors match within bf16 cross-term error
+    for re, rg, de, dg in zip(np.asarray(i_exact), np.asarray(i_pal),
+                              np.asarray(d_exact), np.asarray(d_pal)):
+        common = {int(t): int(v) for t, v in zip(re, de) if t >= 0}
+        for t, v in zip(rg, dg):
+            if int(t) in common:
+                assert abs(int(v) - common[int(t)]) <= 8  # of scale 1000
+    # not-found slots (n < k) are sentinel-coded like the XLA path
+    if n < k:
+        assert np.all(np.asarray(i_pal)[:, n:] == -1)
+        assert np.all(np.asarray(d_pal)[:, n:] == 2 ** 30)
+
+
+def test_pallas_mixed_categorical():
+    rng = np.random.default_rng(1)
+    m, n, n_bins = 40, 200, 6
+    x_num = jnp.asarray(rng.random((m, 4), dtype=np.float32))
+    y_num = jnp.asarray(rng.random((n, 4), dtype=np.float32))
+    x_cat = jnp.asarray(rng.integers(0, n_bins, (m, 3)), jnp.int32)
+    y_cat = jnp.asarray(rng.integers(0, n_bins, (n, 3)), jnp.int32)
+    d_exact, i_exact = pairwise_topk(x_num, y_num, x_cat, y_cat, k=5,
+                                     n_cat_bins=n_bins, mode="exact")
+    d_pal, i_pal = pairwise_topk_pallas(x_num, y_num, x_cat, y_cat, k=5,
+                                        n_cat_bins=n_bins, interpret=True,
+                                        tile_m=32, tile_n=128)
+    assert _recall(i_exact, i_pal) >= 0.9
+
+
+def test_encode_mixed_identity():
+    # squared euclidean of the encoding == numeric² + mismatch count
+    rng = np.random.default_rng(2)
+    a_num = jnp.asarray(rng.random((1, 2), dtype=np.float32))
+    b_num = jnp.asarray(rng.random((1, 2), dtype=np.float32))
+    a_cat = jnp.asarray([[0, 2]], jnp.int32)
+    b_cat = jnp.asarray([[0, 1]], jnp.int32)
+    ea = encode_mixed(a_num, a_cat, 4)
+    eb = encode_mixed(b_num, b_cat, 4)
+    sq = float(jnp.sum((ea - eb) ** 2))
+    expected = float(jnp.sum((a_num - b_num) ** 2)) + 1.0  # one mismatch
+    assert abs(sq - expected) < 1e-5
+
+
+def test_supported_gate():
+    assert supported(algorithm="euclidean", k=5, mode="fast")
+    assert not supported(algorithm="manhattan", k=5, mode="fast")
+    assert not supported(algorithm="euclidean", k=5, mode="exact")
+    assert not supported(algorithm="euclidean", k=500, mode="fast")
