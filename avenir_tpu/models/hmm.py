@@ -159,6 +159,17 @@ def load_model(path: str, scale: int = 1, delim: str = ",") -> HmmModel:
 # Viterbi prediction
 # --------------------------------------------------------------------------
 
+def _log_params(model: HmmModel):
+    """(log initial, log trans, log emit) as float32, un-scaled and floored
+    at 1e-12 to keep log finite."""
+    norm = float(model.scale) if model.scale > 1 else 1.0
+
+    def safe_log(m):
+        return jnp.asarray(np.log(np.maximum(m / norm, 1e-12)), jnp.float32)
+
+    return safe_log(model.initial), safe_log(model.trans), safe_log(model.emit)
+
+
 def predict_states(model: HmmModel, obs_rows: Sequence[Sequence[str]],
                    reversed_output: bool = True
                    ) -> List[List[str]]:
@@ -174,16 +185,34 @@ def predict_states(model: HmmModel, obs_rows: Sequence[Sequence[str]],
         batch[b, :len(codes)] = codes
         lengths[b] = len(codes)
 
-    def safe_log(m):
-        return jnp.asarray(np.log(np.maximum(m, 1e-12)), jnp.float32)
-
-    norm = float(model.scale) if model.scale > 1 else 1.0
+    li, lt, le = _log_params(model)
     paths, _scores = viterbi_batch(
-        safe_log(model.initial / norm), safe_log(model.trans / norm),
-        safe_log(model.emit / norm), jnp.asarray(batch), jnp.asarray(lengths))
+        li, lt, le, jnp.asarray(batch), jnp.asarray(lengths))
     paths = np.asarray(paths)
     out = []
     for b, row in enumerate(obs_rows):
         seq = [model.states[s] for s in paths[b, :len(row)]]
         out.append(seq[::-1] if reversed_output else seq)
     return out
+
+
+def predict_states_long(model: HmmModel, obs_row: Sequence[str], *,
+                        mesh, axis_name: str = "data") -> List[str]:
+    """Most-likely state path for ONE long observation sequence with the
+    time axis sharded across the device mesh (parallel.seqpar.viterbi_sharded
+    — the sequence-parallel path the per-line reference DP cannot express).
+    The sequence is right-padded to the axis size; padded steps are masked
+    inside the kernel (max-plus identities) and dropped from the result."""
+    from avenir_tpu.parallel.seqpar import viterbi_sharded
+    o_idx = {o: i for i, o in enumerate(model.observations)}
+    codes = [o_idx[o] for o in obs_row]
+    if not codes:
+        return []
+    n_shards = mesh.shape[axis_name]
+    pad = (-len(codes)) % n_shards
+    padded = np.asarray(codes + [0] * pad, np.int32)
+
+    li, lt, le = _log_params(model)
+    path, _score = viterbi_sharded(li, lt, le, jnp.asarray(padded),
+                                   len(codes), mesh=mesh, axis_name=axis_name)
+    return [model.states[s] for s in np.asarray(path)[:len(codes)]]

@@ -7,5 +7,7 @@ from avenir_tpu.parallel.mesh import (
     replicate,
     pad_to_multiple,
 )
+from avenir_tpu.parallel.seqpar import viterbi_sharded
 
-__all__ = ["MeshSpec", "make_mesh", "shard_rows", "replicate", "pad_to_multiple"]
+__all__ = ["MeshSpec", "make_mesh", "shard_rows", "replicate",
+           "pad_to_multiple", "viterbi_sharded"]

@@ -1,0 +1,158 @@
+"""Sequence parallelism: Viterbi decoding with the time axis sharded over
+the device mesh.
+
+The reference's Viterbi is a strictly sequential per-row Java DP
+(ViterbiDecoder.java:66-105) and its sequence length is bounded by one CSV
+line. For long state sequences this module splits ONE sequence across
+devices — the context-parallel / ring-attention analogue for this workload
+(SURVEY.md §5): max-plus matrix products are associative, so each device
+summarizes its time shard independently and only [S, S] summaries cross the
+interconnect.
+
+Three-phase algorithm (two parallel sweeps + O(P) tiny exchange):
+
+1. **Block summary** (parallel): device p folds its local per-step max-plus
+   matrices ``M_t[i, j] = trans[i, j] + emit[j, obs_t]`` into one [S, S]
+   block product — S³·T/P work instead of the sequential S²·T, the classic
+   price of parallel-scan over a linear recurrence.
+2. **Boundary exchange**: ``all_gather`` of the P block products (tiny);
+   every device computes the max-plus prefix entering its shard, giving it
+   the exact DP state ``alpha`` at its left boundary.
+3. **Local DP + path recovery** (parallel): each device re-runs the cheap
+   S²-per-step DP over its shard, recording back-pointers, then backtracks
+   *vectorized over all S possible shard-end states*. A second
+   ``all_gather`` of the [P, S] boundary maps lets every device compose,
+   in P steps, which end state its shard actually has — and emit its local
+   slice of the globally-optimal path.
+
+Padding/ragged sequences stay on the vmapped single-device path
+(ops.scanops.viterbi_batch); this module targets one long sequence.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from avenir_tpu.ops.scanops import NEG_INF
+
+
+def _maxplus(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a ⊗ b)[i, j] = max_k a[i, k] + b[k, j]."""
+    return jnp.max(a[:, :, None] + b[None, :, :], axis=1)
+
+
+def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
+    """shard_map body: returns (path slice [T_local], best score [])."""
+    p = lax.axis_index(axis_name)
+    n_shards = lax.axis_size(axis_name)
+    n_states = log_init.shape[0]
+    t_local = obs_local.shape[0]
+
+    # per-step max-plus matrices; the global t=0 "matrix" is the rank-1
+    # broadcast of alpha0 = init + emit[:, obs_0], making the block fold
+    # uniform across shards
+    mats = log_trans[None, :, :] + log_emit.T[obs_local][:, None, :]
+    alpha0_mat = jnp.broadcast_to(
+        (log_init + log_emit[:, obs_local[0]])[None, :], (n_states, n_states))
+    mats = mats.at[0].set(jnp.where(p == 0, alpha0_mat, mats[0]))
+    # steps past the true sequence length become max-plus identities: they
+    # freeze alpha and backtrack to themselves, so padding never affects the
+    # optimum (the sharded analogue of viterbi_path's active-mask)
+    ident = jnp.where(jnp.eye(n_states, dtype=bool), 0.0,
+                      NEG_INF).astype(mats.dtype)
+    g = p * t_local + jnp.arange(t_local)
+    mats = jnp.where((g < length)[:, None, None], mats, ident[None, :, :])
+
+    # 1. block summary: fold the local mats into one [S, S] product
+    block = lax.associative_scan(jax.vmap(_maxplus), mats)[-1]
+
+    # 2. boundary exchange: prefix of all blocks strictly before this shard
+    blocks = lax.all_gather(block, axis_name)            # [P, S, S]
+    # scan carries must be marked device-varying to match body outputs that
+    # depend on axis_index
+    eye = lax.pcast(jnp.where(jnp.eye(n_states, dtype=bool), 0.0,
+                              NEG_INF).astype(blocks.dtype),
+                    axis_name, to="varying")
+
+    def prefix_step(carry, qb):
+        q, b = qb
+        return jnp.where(q < p, _maxplus(carry, b), carry), None
+    incoming, _ = lax.scan(prefix_step, eye,
+                           (jnp.arange(n_shards), blocks))
+    # alpha entering this shard: a zero row-selector folded into the prefix
+    # (for shard 0 the prefix is the max-plus identity, giving zeros — its
+    # own rank-1 first matrix then injects alpha0)
+    alpha_in = jnp.max(incoming, axis=0)
+
+    # 3a. local DP with back-pointers
+    def dp_step(alpha, mat):
+        scores = alpha[:, None] + mat                     # [S_prev, S]
+        return jnp.max(scores, axis=0), jnp.argmax(scores, axis=0)
+    _, backs = lax.scan(dp_step, alpha_in, mats)          # backs [T_local, S]
+
+    # 3b. backtrack vectorized over ALL S possible shard-end states:
+    # states_all[t, s_end] = state at local time t given end state s_end
+    def bt_step(state_vec, back_row):
+        return back_row[state_vec], state_vec
+    enter_states, rev = lax.scan(
+        bt_step, lax.pcast(jnp.arange(n_states), axis_name, to="varying"),
+        backs[::-1])
+    states_all = rev[::-1]                                # [T_local, S]
+    # enter_states[s_end] = best predecessor in the PREVIOUS shard
+    enter_maps = lax.all_gather(enter_states, axis_name)  # [P, S]
+
+    # total score and global end state (every device computes them; block 0
+    # already folds alpha0 via its rank-1 first matrix, so its rows are
+    # constant and a zero seed selects them)
+    def fold_step(v, b):
+        return jnp.max(v[:, None] + b, axis=0), None
+    alpha_T, _ = lax.scan(
+        fold_step, lax.pcast(jnp.zeros((n_states,)), axis_name, to="varying"),
+        blocks)
+    # every device computed the same scalar; pmax proves replication to the
+    # shard_map type system (semantically a no-op)
+    best_score = lax.pmax(jnp.max(alpha_T), axis_name)
+    s_star = jnp.argmax(alpha_T)
+
+    # compose enter maps right-to-left (shards P-1 .. p+1) to find THIS
+    # shard's end state
+    def compose_step(state, q):
+        return jnp.where(q > p, enter_maps[q][state], state), None
+    s_end, _ = lax.scan(compose_step, s_star,
+                        jnp.arange(n_shards - 1, -1, -1))
+    path_local = states_all[:, s_end].astype(jnp.int32)
+    return path_local, best_score
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis_name"))
+def viterbi_sharded(log_init: jnp.ndarray, log_trans: jnp.ndarray,
+                    log_emit: jnp.ndarray, obs: jnp.ndarray,
+                    length=None, *, mesh: Mesh, axis_name: str = "data"
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Most-likely state path for ONE long observation sequence with the
+    time axis sharded over ``mesh[axis_name]``.
+
+    The padded obs length must divide evenly by the axis size; ``length``
+    masks trailing padding (path entries past it are meaningless). Returns
+    (path [T] int32, best log-prob scalar) — equal to
+    ``ops.scanops.viterbi_path`` up to float-association and argmax ties.
+    """
+    n_shards = mesh.shape[axis_name]
+    if obs.shape[0] % n_shards != 0:
+        raise ValueError(
+            f"sequence length {obs.shape[0]} not divisible by "
+            f"{n_shards}-way axis {axis_name!r}; right-pad and pass length=")
+    length = jnp.asarray(obs.shape[0] if length is None else length)
+    body = partial(_local_body, axis_name=axis_name)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis_name), P()),
+        out_specs=(P(axis_name), P()))
+    obs = jax.device_put(obs, NamedSharding(mesh, P(axis_name)))
+    return fn(log_init, log_trans, log_emit, obs, length)

@@ -127,16 +127,25 @@ void* avt_encode(const char* buf, int64_t len, char delim,
   }
   t->has_labels = class_ord >= 0;
 
-  // count rows to size the output vectors once; a row is any line that is
-  // non-empty after stripping only the '\n' — the exact filter of the Python
-  // read_csv_lines (utils/dataset.py), which keeps whitespace-only lines
-  // (they then fail featurization identically on both paths)
+  // Line splitting replicates Python's universal-newline text mode ('\n',
+  // '\r\n', and lone '\r' all terminate a line) followed by read_csv_lines'
+  // `if line:` filter (utils/dataset.py) — whitespace-only lines are KEPT
+  // and then fail featurization identically on both paths.
+  auto next_line = [&](int64_t p, int64_t* eol, int64_t* next) {
+    int64_t e = p;
+    while (e < len && buf[e] != '\n' && buf[e] != '\r') ++e;
+    *eol = e;
+    *next = (e + 1 < len && buf[e] == '\r' && buf[e + 1] == '\n') ? e + 2
+                                                                  : e + 1;
+  };
+
+  // count rows to size the output vectors once
   int64_t rows = 0;
   for (int64_t p = 0; p < len;) {
-    int64_t eol = p;
-    while (eol < len && buf[eol] != '\n') ++eol;
+    int64_t eol, next;
+    next_line(p, &eol, &next);
     if (eol > p) ++rows;
-    p = eol + 1;
+    p = next;
   }
   t->binned.assign(static_cast<size_t>(rows * n_feat), 0);
   t->numeric.assign(static_cast<size_t>(rows * n_feat), 0.0f);
@@ -145,10 +154,9 @@ void* avt_encode(const char* buf, int64_t len, char delim,
 
   int64_t r = 0;
   char msg[256];
-  for (int64_t p = 0; p < len;) {
-    int64_t eol = p;
-    while (eol < len && buf[eol] != '\n') ++eol;
-    if (eol == p) { p = eol + 1; continue; }
+  for (int64_t p = 0, eol = 0, next = 0; p < len; p = next) {
+    next_line(p, &eol, &next);
+    if (eol == p) continue;
 
     int32_t ord = 0;
     const char* field_begin = buf + p;
@@ -251,7 +259,6 @@ void* avt_encode(const char* buf, int64_t len, char delim,
       t->id_spans[static_cast<size_t>(r * 2 + 1)] = 0;
     }
     ++r;
-    p = eol + 1;
   }
   t->rows = r;
   return t;

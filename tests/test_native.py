@@ -75,6 +75,20 @@ class TestParity:
         fz = Featurizer(churn_schema()).fit(rows)
         assert encode_file(fz, path).n_rows == 20
 
+    def test_crlf_parity(self, tmp_path):
+        # Windows line endings incl. a blank CRLF line: Python's
+        # universal-newline read drops it; the native byte scanner must too
+        rows = churn_rows(20, seed=2)
+        path = str(tmp_path / "crlf.csv")
+        body = "\r\n".join(",".join(r) for r in rows[:10]) + "\r\n\r\n" + \
+               "\r\n".join(",".join(r) for r in rows[10:]) + "\r\n"
+        with open(path, "w", newline="") as fh:
+            fh.write(body)
+        fz = Featurizer(churn_schema()).fit(rows)
+        _assert_tables_equal(transform_file(fz, path, force_python=True),
+                             encode_file(fz, path))
+        assert encode_file(fz, path).n_rows == 20
+
 
 class TestErrors:
     def test_unseen_categorical_errors(self, tmp_path):

@@ -41,10 +41,12 @@ def test_pallas_matches_exact_numeric(m, n, k):
         for t, v in zip(rg, dg):
             if int(t) in common:
                 assert abs(int(v) - common[int(t)]) <= 8  # of scale 1000
-    # not-found slots (n < k) are sentinel-coded like the XLA path
-    if n < k:
-        assert np.all(np.asarray(i_pal)[:, n:] == -1)
-        assert np.all(np.asarray(d_pal)[:, n:] == 2 ** 30)
+    # padded train rows (train tiles round up to tile_n) must never leak
+    # into the results: every index is a real train row, every distance real
+    ip, dp = np.asarray(i_pal), np.asarray(d_pal)
+    assert ip.shape == (m, min(k, n))
+    assert np.all((ip >= 0) & (ip < n))
+    assert np.all(dp < 2 ** 30)
 
 
 def test_pallas_mixed_categorical():
