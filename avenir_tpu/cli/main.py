@@ -23,32 +23,13 @@ from avenir_tpu.utils.dataset import Featurizer, read_csv_lines
 from avenir_tpu.utils.schema import FeatureSchema
 
 
-def _schema_is_data_dependent(schema: FeatureSchema) -> bool:
-    """True when featurization depends on the rows it is fitted on (a
-    categorical without a cardinality list, or a bucketed numeric without
-    min/max) — in that case predict-time fitting must reuse the training
-    data or vocabularies would drift from the saved model."""
-    fields = schema.get_feature_fields()
-    try:
-        fields = fields + [schema.find_class_attr_field()]
-    except ValueError:
-        pass
-    for f in fields:
-        if f.is_categorical and f.cardinality is None:
-            return True
-        if f.is_numeric and f.bucket_width is not None and (
-                f.min is None or f.max is None):
-            return True
-    return False
-
-
 def _load_table(conf: JobConfig, in_path: str, for_predict: bool = False):
     schema = FeatureSchema.from_file(conf.get_required("feature.schema.file.path"))
     delim = conf.get("field.delim.regex", ",")
     rows = read_csv_lines(in_path, delim)
     fz = Featurizer(schema, unseen=conf.get("unseen.value.handling", "error"))
     fit_rows = rows
-    if for_predict and _schema_is_data_dependent(schema):
+    if for_predict and fz.schema_data_dependent:
         fit_path = conf.get("featurizer.fit.data.path")
         if fit_path is None:
             raise ValueError(

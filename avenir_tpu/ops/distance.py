@@ -134,9 +134,11 @@ def pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k nearest train rows for every test row, streaming over blocks.
 
-    Returns (distances [M, k] int32 scaled by ``distance_scale``,
-    indices [M, k] int32 into the train set). Invalid/padding slots get
-    distance 2^30 and index -1.
+    Returns (distances [M, min(k, N)] int32 scaled by ``distance_scale``,
+    indices [M, min(k, N)] int32 into the train set). Slots where no valid
+    neighbor was found get distance 2^30 and index -1 (cannot occur for
+    euclidean/manhattan over a non-empty train set; the sentinel protects
+    future metrics that may mask rows out).
     """
     fast = mode == "fast"
     n = y_num.shape[0] if y_num is not None else y_cat.shape[0]

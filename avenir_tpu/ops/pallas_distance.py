@@ -201,8 +201,9 @@ def pairwise_topk_pallas(x_num: Optional[jnp.ndarray],
                          interpret: bool = False
                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Drop-in for ``ops.distance.pairwise_topk`` (euclidean, fast mode):
-    (scaled-int distances [M, k], train indices [M, k]); not-found slots get
-    2^30 / -1. Per-attribute rms normalization like the XLA path."""
+    (scaled-int distances [M, min(k, N)], train indices [M, min(k, N)]) —
+    the same shape the XLA path returns; tile-padding rows never leak into
+    the results. Per-attribute rms normalization like the XLA path."""
     x = encode_mixed(x_num, x_cat, n_cat_bins)
     y = encode_mixed(y_num, y_cat, n_cat_bins)
     n_attrs = ((x_num.shape[1] if x_num is not None else 0) +

@@ -25,6 +25,17 @@ from jax import lax
 NEG_INF = -1e30
 
 
+def maxplus(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Max-plus matrix product over the last two dims (batched):
+    (a ⊗ b)[..., i, j] = max_k a[..., i, k] + b[..., k, j]."""
+    return jnp.max(a[..., :, :, None] + b[..., None, :, :], axis=-2)
+
+
+def maxplus_eye(n: int, dtype=jnp.float32) -> jnp.ndarray:
+    """The max-plus identity: 0 on the diagonal, -inf off it."""
+    return jnp.where(jnp.eye(n, dtype=bool), 0.0, NEG_INF).astype(dtype)
+
+
 @partial(jax.jit, static_argnames=())
 def viterbi_path(log_init: jnp.ndarray, log_trans: jnp.ndarray,
                  log_emit: jnp.ndarray, obs: jnp.ndarray,
@@ -89,10 +100,6 @@ def viterbi_scores_associative(log_init: jnp.ndarray, log_trans: jnp.ndarray,
     final [S] score vector (argmax = Viterbi end state; full path recovery
     still uses the sequential backtrack).
     """
-    def maxplus(a, b):
-        # (a ⊗ b)[i, j] = max_k a[i, k] + b[k, j]
-        return jnp.max(a[..., :, :, None] + b[..., None, :, :], axis=-2)
-
     mats = log_trans[None, :, :] + log_emit.T[obs[1:], None, :]  # [T-1, S, S]
     prefix = lax.associative_scan(maxplus, mats)                 # [T-1, S, S]
     alpha0 = log_init + log_emit[:, obs[0]]

@@ -1,0 +1,137 @@
+"""Multi-host sharded input pipeline.
+
+The reference's input substrate is HDFS: the JobTracker splits files and
+each mapper JVM reads only its split (SURVEY.md §1 L0). The TPU-native
+equivalent: every host process reads its contiguous row slice of the CSV
+from a shared filesystem, featurizes locally (C++ fast path when available),
+and the slices are assembled into ONE globally-sharded array with
+``jax.make_array_from_process_local_data`` — rows sharded over the ``data``
+mesh axis, with DCN touched only by this input path (and checkpoints),
+never by the compute collectives.
+
+Single-process meshes (tests, one host) degrade to "read everything, shard
+over local devices" with no special casing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from avenir_tpu.parallel.mesh import DATA_AXIS
+from avenir_tpu.utils.dataset import (EncodedTable, Featurizer,
+                                      read_csv_lines)
+
+
+def process_slice(n_global: int, n_processes: Optional[int] = None,
+                  process_id: Optional[int] = None) -> Tuple[int, int]:
+    """Contiguous [start, stop) row range owned by this host process.
+
+    ``n_global`` must divide evenly by the process count (callers pad first
+    with :func:`padded_rows`)."""
+    n_processes = jax.process_count() if n_processes is None else n_processes
+    process_id = jax.process_index() if process_id is None else process_id
+    if n_global % n_processes:
+        raise ValueError(f"{n_global} rows not divisible by "
+                         f"{n_processes} processes; pad first")
+    per = n_global // n_processes
+    return process_id * per, (process_id + 1) * per
+
+
+def padded_rows(n_rows: int, mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    """Global row count padded so every device (and so every process) gets
+    an equal, whole shard."""
+    d = mesh.shape[axis]
+    return ((n_rows + d - 1) // d) * d
+
+
+@dataclass(frozen=True)
+class ShardedTable:
+    """A featurized dataset whose row axis lives sharded across the mesh.
+
+    ``table`` arrays are global jax.Arrays (rows over the data axis, padded
+    with edge rows); ``mask`` is 1.0 for real rows / 0.0 for padding —
+    weight every count/sum reduction by it. ``table.ids`` holds only this
+    process's slice (ids are host-side strings, like the reference's
+    per-split mapper keys)."""
+
+    table: EncodedTable
+    mask: jax.Array
+    n_global: int
+
+
+def _to_global(local: np.ndarray, mesh: Mesh, axis: str) -> jax.Array:
+    spec = P(axis, *([None] * (local.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+def shard_table(table: EncodedTable, mesh: Mesh,
+                axis: str = DATA_AXIS) -> ShardedTable:
+    """Single-host path: place an in-memory EncodedTable onto the mesh with
+    rows sharded and padding masked."""
+    g = padded_rows(table.n_rows, mesh, axis)
+    pad = g - table.n_rows
+
+    def prep(a, fill_edge=True):
+        a = np.asarray(a)
+        if pad:
+            width = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+            a = np.pad(a, width, mode="edge" if fill_edge else "constant")
+        return a
+
+    mask = np.zeros((g,), np.float32)
+    mask[:table.n_rows] = 1.0
+    new = replace(
+        table,
+        binned=_to_global(prep(table.binned), mesh, axis),
+        numeric=_to_global(prep(table.numeric), mesh, axis),
+        labels=(None if table.labels is None else
+                _to_global(prep(table.labels), mesh, axis)),
+        n_rows=g)
+    return ShardedTable(table=new, mask=_to_global(mask, mesh, axis),
+                        n_global=table.n_rows)
+
+
+def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
+                       axis: str = DATA_AXIS, delim_regex: str = ",",
+                       with_labels: bool = True) -> ShardedTable:
+    """Each process reads + featurizes only its row slice of ``path`` (a
+    shared filesystem, the HDFS analogue), then the slices assemble into one
+    globally row-sharded table.
+
+    The featurizer must already be fit from the schema alone (cardinality
+    lists + min/max present): a data-dependent fit on a local slice would
+    give each process a different vocabulary."""
+    if not fz.fitted:
+        raise ValueError("featurizer must be fit before distributed loading")
+    if fz.schema_data_dependent:
+        raise ValueError(
+            "schema has data-dependent vocabularies (categorical without "
+            "cardinality or bucketed numeric without min/max) — per-process "
+            "slice fitting would diverge; complete the schema instead")
+    rows = read_csv_lines(path, delim_regex)
+    n_real = len(rows)
+    g = padded_rows(n_real, mesh, axis)
+    start, stop = process_slice(g)
+    # this process's slice, with global padding rows materialized as copies
+    # of the last real row (masked out of every reduction)
+    local_rows = [rows[min(i, n_real - 1)] for i in range(start, stop)]
+    local = fz.transform(local_rows, with_labels=with_labels)
+    mask = np.asarray([1.0 if i < n_real else 0.0
+                       for i in range(start, stop)], np.float32)
+    new = replace(
+        local,
+        binned=_to_global(np.asarray(local.binned), mesh, axis),
+        numeric=_to_global(np.asarray(local.numeric), mesh, axis),
+        labels=(None if local.labels is None else
+                _to_global(np.asarray(local.labels), mesh, axis)),
+        n_rows=g)
+    return ShardedTable(table=new, mask=_to_global(mask, mesh, axis),
+                        n_global=n_real)

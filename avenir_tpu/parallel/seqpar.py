@@ -39,12 +39,20 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from avenir_tpu.ops.scanops import NEG_INF
+from avenir_tpu.ops.scanops import maxplus, maxplus_eye
 
 
-def _maxplus(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """(a ⊗ b)[i, j] = max_k a[i, k] + b[k, j]."""
-    return jnp.max(a[:, :, None] + b[None, :, :], axis=1)
+def _tree_reduce_maxplus(mats: jnp.ndarray) -> jnp.ndarray:
+    """[T, S, S] -> the single max-plus product, by log-depth pairwise
+    combination (same total combines as a fold, no prefix storage)."""
+    n = mats.shape[0]
+    while n > 1:
+        half = n // 2
+        paired = maxplus(mats[0:2 * half:2], mats[1:2 * half:2])
+        if n % 2:
+            paired = jnp.concatenate([paired, mats[-1:]], axis=0)
+        mats, n = paired, paired.shape[0]
+    return mats[0]
 
 
 def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
@@ -64,25 +72,22 @@ def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
     # steps past the true sequence length become max-plus identities: they
     # freeze alpha and backtrack to themselves, so padding never affects the
     # optimum (the sharded analogue of viterbi_path's active-mask)
-    ident = jnp.where(jnp.eye(n_states, dtype=bool), 0.0,
-                      NEG_INF).astype(mats.dtype)
+    ident = maxplus_eye(n_states, mats.dtype)
     g = p * t_local + jnp.arange(t_local)
     mats = jnp.where((g < length)[:, None, None], mats, ident[None, :, :])
 
-    # 1. block summary: fold the local mats into one [S, S] product
-    block = lax.associative_scan(jax.vmap(_maxplus), mats)[-1]
+    # 1. block summary: combine the local mats into one [S, S] product
+    block = _tree_reduce_maxplus(mats)
 
     # 2. boundary exchange: prefix of all blocks strictly before this shard
     blocks = lax.all_gather(block, axis_name)            # [P, S, S]
     # scan carries must be marked device-varying to match body outputs that
     # depend on axis_index
-    eye = lax.pcast(jnp.where(jnp.eye(n_states, dtype=bool), 0.0,
-                              NEG_INF).astype(blocks.dtype),
-                    axis_name, to="varying")
+    eye = lax.pcast(ident, axis_name, to="varying")
 
     def prefix_step(carry, qb):
         q, b = qb
-        return jnp.where(q < p, _maxplus(carry, b), carry), None
+        return jnp.where(q < p, maxplus(carry, b), carry), None
     incoming, _ = lax.scan(prefix_step, eye,
                            (jnp.arange(n_shards), blocks))
     # alpha entering this shard: a zero row-selector folded into the prefix

@@ -131,6 +131,30 @@ class Featurizer:
         self.class_values: List[str] = []
         self._fitted = False
 
+    @property
+    def fitted(self) -> bool:
+        return self._fitted
+
+    @property
+    def schema_data_dependent(self) -> bool:
+        """True when featurization depends on the rows it is fitted on (a
+        categorical without a cardinality list, or a bucketed numeric
+        without min/max) — such a fit must always see the SAME rows or
+        vocabularies drift (predict-time refits, per-process distributed
+        loads)."""
+        fields = list(self.schema.get_feature_fields())
+        try:
+            fields.append(self.schema.find_class_attr_field())
+        except ValueError:
+            pass
+        for f in fields:
+            if f.is_categorical and f.cardinality is None:
+                return True
+            if f.is_numeric and f.bucket_width is not None and (
+                    f.min is None or f.max is None):
+                return True
+        return False
+
     # -- fitting -------------------------------------------------------------
     def fit(self, rows: Sequence[Sequence[str]]) -> "Featurizer":
         feature_fields = self.schema.get_feature_fields()
