@@ -2,19 +2,22 @@
 
 The reference's input substrate is HDFS: the JobTracker splits files and
 each mapper JVM reads only its split (SURVEY.md §1 L0). The TPU-native
-equivalent: every host process reads its contiguous row slice of the CSV
-from a shared filesystem, featurizes locally (C++ fast path when available),
-and the slices are assembled into ONE globally-sharded array with
+equivalent: every host process scans the raw CSV bytes (line splitting
+only — there is no line index, so the scan is unavoidable) but tokenizes
+and featurizes ONLY its contiguous row slice, and the slices are assembled
+into ONE globally-sharded array with
 ``jax.make_array_from_process_local_data`` — rows sharded over the ``data``
 mesh axis, with DCN touched only by this input path (and checkpoints),
 never by the compute collectives.
 
 Single-process meshes (tests, one host) degrade to "read everything, shard
-over local devices" with no special casing.
+over local devices" (via the native C++ featurizer when applicable) with no
+special casing.
 """
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, replace
 from typing import Optional, Tuple
 
@@ -25,8 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from avenir_tpu.parallel.mesh import DATA_AXIS
-from avenir_tpu.utils.dataset import (EncodedTable, Featurizer,
-                                      read_csv_lines)
+from avenir_tpu.utils.dataset import EncodedTable, Featurizer
 
 
 def process_slice(n_global: int, n_processes: Optional[int] = None,
@@ -75,25 +77,36 @@ def _to_global(local: np.ndarray, mesh: Mesh, axis: str) -> jax.Array:
 def shard_table(table: EncodedTable, mesh: Mesh,
                 axis: str = DATA_AXIS) -> ShardedTable:
     """Single-host path: place an in-memory EncodedTable onto the mesh with
-    rows sharded and padding masked."""
+    rows sharded and padding masked (padding rows repeat the last real row
+    and are masked out; ``ids`` is padded the same way so it stays
+    row-aligned with ``n_rows``)."""
+    if jax.process_count() > 1:
+        # Under multi-process JAX every process would present the FULL table
+        # as its local shard and the assembled array would silently hold
+        # process_count copies — use load_sharded_table instead.
+        raise RuntimeError(
+            "shard_table is single-process only; multi-host runs must use "
+            "load_sharded_table so each process contributes its own slice")
     g = padded_rows(table.n_rows, mesh, axis)
     pad = g - table.n_rows
 
-    def prep(a, fill_edge=True):
+    def prep(a):
         a = np.asarray(a)
         if pad:
             width = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
-            a = np.pad(a, width, mode="edge" if fill_edge else "constant")
+            a = np.pad(a, width, mode="edge")
         return a
 
     mask = np.zeros((g,), np.float32)
     mask[:table.n_rows] = 1.0
+    ids = list(table.ids) + [table.ids[-1]] * pad if table.ids else []
     new = replace(
         table,
         binned=_to_global(prep(table.binned), mesh, axis),
         numeric=_to_global(prep(table.numeric), mesh, axis),
         labels=(None if table.labels is None else
                 _to_global(prep(table.labels), mesh, axis)),
+        ids=ids,
         n_rows=g)
     return ShardedTable(table=new, mask=_to_global(mask, mesh, axis),
                         n_global=table.n_rows)
@@ -108,7 +121,12 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
 
     The featurizer must already be fit from the schema alone (cardinality
     lists + min/max present): a data-dependent fit on a local slice would
-    give each process a different vocabulary."""
+    give each process a different vocabulary.
+
+    Each process scans the raw bytes once to find line boundaries (CSV has
+    no row index) but regex-tokenizes and featurizes only its own slice;
+    single-process meshes take the native C++ featurizer fast path when
+    it applies."""
     if not fz.fitted:
         raise ValueError("featurizer must be fit before distributed loading")
     if fz.schema_data_dependent:
@@ -116,13 +134,22 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
             "schema has data-dependent vocabularies (categorical without "
             "cardinality or bucketed numeric without min/max) — per-process "
             "slice fitting would diverge; complete the schema instead")
-    rows = read_csv_lines(path, delim_regex)
-    n_real = len(rows)
+    if jax.process_count() == 1:
+        from avenir_tpu.native.loader import transform_file
+        return shard_table(
+            transform_file(fz, path, delim_regex, with_labels=with_labels),
+            mesh, axis)
+    splitter = re.compile(delim_regex)
+    with open(path, "r") as fh:
+        lines = [ln for ln in fh.read().split("\n") if ln.strip()]
+    n_real = len(lines)
     g = padded_rows(n_real, mesh, axis)
     start, stop = process_slice(g)
     # this process's slice, with global padding rows materialized as copies
-    # of the last real row (masked out of every reduction)
-    local_rows = [rows[min(i, n_real - 1)] for i in range(start, stop)]
+    # of the last real row (masked out of every reduction); only the slice
+    # is tokenized
+    local_rows = [[t.strip() for t in splitter.split(lines[min(i, n_real - 1)])]
+                  for i in range(start, stop)]
     local = fz.transform(local_rows, with_labels=with_labels)
     mask = np.asarray([1.0 if i < n_real else 0.0
                        for i in range(start, stop)], np.float32)
