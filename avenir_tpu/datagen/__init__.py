@@ -13,6 +13,10 @@ from avenir_tpu.datagen.generators import (
     price_opt_arms,
     markov_sequences,
     retarget_rows, retarget_schema,
+    hosp_readmit_rows, hosp_readmit_schema,
+    event_seq_rows, EVENT_SEQ_EVENTS,
+    hmm_tagged_rows,
+    LeadGenSimulator,
 )
 
 __all__ = [
@@ -20,4 +24,8 @@ __all__ = [
     "elearn_rows", "elearn_schema",
     "price_opt_arms", "markov_sequences",
     "retarget_rows", "retarget_schema",
+    "hosp_readmit_rows", "hosp_readmit_schema",
+    "event_seq_rows", "EVENT_SEQ_EVENTS",
+    "hmm_tagged_rows",
+    "LeadGenSimulator",
 ]

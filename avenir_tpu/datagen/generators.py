@@ -166,6 +166,226 @@ def markov_sequences(n: int, states: List[str], trans: np.ndarray,
 
 
 # --------------------------------------------------------------------------
+# hospital readmission (MI tutorial: resource/hosp_readmit.rb,
+# tutorial_hospital_readmit.txt — 20,000 records)
+# --------------------------------------------------------------------------
+
+_HOSP_SCHEMA_JSON = {
+    "fields": [
+        {"name": "patID", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "age", "ordinal": 1, "dataType": "int",
+         "min": 10, "max": 90, "bucketWidth": 10, "feature": True},
+        {"name": "weight", "ordinal": 2, "dataType": "int",
+         "min": 130, "max": 250, "bucketWidth": 20, "feature": True},
+        {"name": "height", "ordinal": 3, "dataType": "int",
+         "min": 50, "max": 75, "bucketWidth": 5, "feature": True},
+        {"name": "employment", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["employed", "unemployed", "retired"],
+         "feature": True},
+        {"name": "familyStatus", "ordinal": 5, "dataType": "categorical",
+         "cardinality": ["alone", "with partner"], "feature": True},
+        {"name": "diet", "ordinal": 6, "dataType": "categorical",
+         "cardinality": ["poor", "average", "good"], "feature": True},
+        {"name": "exercise", "ordinal": 7, "dataType": "categorical",
+         "cardinality": ["low", "average", "high"], "feature": True},
+        {"name": "followUp", "ordinal": 8, "dataType": "categorical",
+         "cardinality": ["low", "average", "high"], "feature": True},
+        {"name": "smoking", "ordinal": 9, "dataType": "categorical",
+         "cardinality": ["non smoker", "smoker"], "feature": True},
+        {"name": "alcohol", "ordinal": 10, "dataType": "categorical",
+         "cardinality": ["low", "average", "high"], "feature": True},
+        {"name": "readmitted", "ordinal": 11, "dataType": "categorical",
+         "classAttribute": True, "cardinality": ["Y", "N"]},
+    ]
+}
+
+
+def hosp_readmit_schema() -> FeatureSchema:
+    return FeatureSchema.from_json(_HOSP_SCHEMA_JSON)
+
+
+def hosp_readmit_rows(n: int, seed: int = 13) -> List[List[str]]:
+    """Readmission probability is a base rate plus planted bumps for old age,
+    obesity, unemployment/retirement, poor diet and low follow-up — the
+    additive-risk structure hosp_readmit.rb plants, so mutual-information
+    selection ranks age/diet/followUp above the noise fields."""
+    rng = np.random.default_rng(seed)
+
+    def cat(options, weights):
+        w = np.asarray(weights, float)
+        return options[int(rng.choice(len(options), p=w / w.sum()))]
+
+    rows = []
+    for i in range(n):
+        prob = 0.20
+        age = int(rng.choice(
+            [15, 25, 35, 45, 55, 65, 75, 85],
+            p=np.array([2, 3, 6, 10, 14, 19, 25, 21]) / 100))
+        age += int(rng.integers(-4, 5))
+        if age > 80:
+            prob += 0.10
+        elif age > 70:
+            prob += 0.05
+        elif age > 60:
+            prob += 0.03
+        weight = int(rng.integers(130, 251))
+        height = int(rng.integers(50, 76))
+        if weight > 200 and height < 70:
+            prob += 0.05
+        elif weight > 180 and height < 60:
+            prob += 0.03
+        emp = cat(["employed", "unemployed", "retired"], [10, 1, 3])
+        if age > 68 and rng.integers(0, 10) < 8:
+            emp = "retired"
+        if emp == "unemployed":
+            prob += 0.06
+        elif emp == "retired":
+            prob += 0.04
+        family = cat(["alone", "with partner"], [10, 15])
+        if family == "alone":
+            prob += 0.04
+        diet = cat(["average", "poor", "good"], [10, 4, 2])
+        if diet == "poor":
+            prob += 0.06
+        exercise = cat(["average", "low", "high"], [10, 12, 4])
+        if exercise == "low":
+            prob += 0.04
+        follow_up = cat(["average", "low", "high"], [10, 14, 3])
+        if follow_up == "low":
+            prob += 0.08
+        smoking = cat(["non smoker", "smoker"], [10, 3])
+        if smoking == "smoker":
+            prob += 0.05
+        alcohol = cat(["average", "low", "high"], [10, 16, 4])
+        if alcohol == "high":
+            prob += 0.04
+        readmitted = "Y" if rng.random() < prob else "N"
+        rows.append([f"H{i:010d}", str(age), str(weight), str(height), emp,
+                     family, diet, exercise, follow_up, smoking, alcohol,
+                     readmitted])
+    return rows
+
+
+# --------------------------------------------------------------------------
+# customer event sequences (HMM tutorial: resource/event_seq.rb)
+# --------------------------------------------------------------------------
+
+EVENT_SEQ_EVENTS = ["SL", "SS", "SM", "ML", "MS", "MM", "LL", "LS", "LM"]
+
+
+def event_seq_rows(n: int, seed: int = 17, min_events: int = 5,
+                   max_events: int = 24) -> List[List[str]]:
+    """(custID, events...) rows with event_seq.rb's bursty structure: events
+    come in three hidden groups of three (S*/M*/L* prefixes) and ~30% of
+    picks trigger a 1-3 event burst inside the same group — the latent-group
+    persistence an HMM can recover."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        events: List[str] = []
+        for _ in range(int(rng.integers(min_events, max_events + 1))):
+            idx = int(rng.integers(0, len(EVENT_SEQ_EVENTS)))
+            events.append(EVENT_SEQ_EVENTS[idx])
+            if rng.integers(0, 10) < 3:
+                for _ in range(int(rng.integers(1, 4))):
+                    # burst picks only the group's first two members —
+                    # event_seq.rb:21 does `rand(2)`, kept for parity
+                    idx = (idx // 3) * 3 + int(rng.integers(0, 2))
+                    events.append(EVENT_SEQ_EVENTS[idx])
+        rows.append([f"E{i:010d}"] + events)
+    return rows
+
+
+def hmm_tagged_rows(n: int, states: List[str], observations: List[str],
+                    trans: np.ndarray, emit: np.ndarray,
+                    initial: np.ndarray, min_len: int = 8,
+                    max_len: int = 40, seed: int = 19,
+                    sub_field_delim: str = ":") -> List[List[str]]:
+    """Fully tagged ``obs:state`` sequences sampled from a known HMM, so
+    ``hmm.train_fully_tagged`` recovers the planted matrices (the fixture the
+    reference's customer-loyalty tutorial builds by hand,
+    customer_loyalty_trajectory_tutorial.txt:18-30)."""
+    rng = np.random.default_rng(seed)
+    n_states = len(states)
+    rows = []
+    for i in range(n):
+        length = int(rng.integers(min_len, max_len + 1))
+        s = int(rng.choice(n_states, p=initial))
+        row = [f"T{i:08d}"]
+        for _ in range(length):
+            o = int(rng.choice(len(observations), p=emit[s]))
+            row.append(f"{observations[o]}{sub_field_delim}{states[s]}")
+            s = int(rng.choice(n_states, p=trans[s]))
+        rows.append(row)
+    return rows
+
+
+# --------------------------------------------------------------------------
+# lead generation (online RL tutorial: resource/lead_gen.py)
+# --------------------------------------------------------------------------
+
+class LeadGenSimulator:
+    """The lead_gen.py environment: three actions with a known CTR
+    distribution per action (mean, stddev — actionCtrDistr
+    lead_gen.py:13), rewards reported once an action has been selected
+    ``sel_count_threshold`` times (lead_gen.py:14, 50-61). Drives
+    ``stream.loop.OnlineLearnerLoop`` through any queue adapter; tests check
+    the learner converges to ``best_action``."""
+
+    DEFAULT_CTR = {"page1": (30, 12), "page2": (60, 30), "page3": (80, 10)}
+
+    def __init__(self, ctr_distr: Dict[str, Tuple[int, int]] = None,
+                 sel_count_threshold: int = 50, seed: int = 23):
+        self.ctr_distr = dict(ctr_distr or self.DEFAULT_CTR)
+        self.threshold = sel_count_threshold
+        self._rng = np.random.default_rng(seed)
+        self._sel_counts = {a: 0 for a in self.ctr_distr}
+        self._event_num = 0
+
+    @property
+    def actions(self) -> List[str]:
+        return list(self.ctr_distr)
+
+    @property
+    def best_action(self) -> str:
+        return max(self.ctr_distr, key=lambda a: self.ctr_distr[a][0])
+
+    def next_event_id(self) -> str:
+        self._event_num += 1
+        return f"session{self._event_num:08d}"
+
+    def observe_action(self, action: str):
+        """Returns (action, reward) once the selection-count threshold trips
+        (an approximately normal CTR sample like lead_gen.py's 12-uniform
+        sum), else None."""
+        self._sel_counts[action] += 1
+        if self._sel_counts[action] < self.threshold:
+            return None
+        self._sel_counts[action] = 0
+        mean, std = self.ctr_distr[action]
+        reward = int(max(self._rng.normal(0.0, 1.0) * std + mean, 0.0))
+        return action, reward
+
+    def drive(self, loop, n_events: int) -> int:
+        """Pump n_events through an OnlineLearnerLoop: push event, step the
+        loop, consume the action, feed back rewards. Returns rewards sent."""
+        rewards_sent = 0
+        for _ in range(n_events):
+            loop.queues.push_event(self.next_event_id())
+            loop.step()
+            popped = loop.queues.pop_action()
+            if popped is None:
+                continue
+            _, actions = popped
+            for action in actions:
+                result = self.observe_action(action)
+                if result is not None:
+                    loop.queues.push_reward(*result)
+                    rewards_sent += 1
+        return rewards_sent
+
+
+# --------------------------------------------------------------------------
 # retarget (decision-tree tutorial: resource/retarget.py)
 # --------------------------------------------------------------------------
 
