@@ -36,8 +36,15 @@ M_TEST = int(os.environ.get("BENCH_M_TEST", 8192))
 N_FEATURES = 9
 K = 5
 ITERS = int(os.environ.get("BENCH_ITERS", 100))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
 # "auto": hand-scheduled pallas kernel on TPU, XLA path elsewhere
 IMPL = os.environ.get("BENCH_IMPL", "auto")
+
+
+def _timed(chain, test, train) -> float:
+    t0 = time.perf_counter()
+    np.asarray(chain(test, train))          # one final host fetch
+    return time.perf_counter() - t0
 
 
 def main() -> None:
@@ -64,9 +71,10 @@ def main() -> None:
         return outs
 
     np.asarray(chain(test, train))          # compile + warm
-    t0 = time.perf_counter()
-    np.asarray(chain(test, train))          # timed: one final host fetch
-    elapsed = time.perf_counter() - t0
+    # best-of-REPEATS: the tunnel to the chip has time-varying load, so a
+    # single timing draw is ±25%; the min over a few draws tracks the
+    # kernel's actual cost
+    elapsed = min(_timed(chain, test, train) for _ in range(REPEATS))
     rows_per_sec = M_TEST * ITERS / elapsed
 
     vs_baseline = 1.0

@@ -1,0 +1,161 @@
+"""Experiment: lane-accumulator fold variant of the pallas topk kernel.
+
+Instead of k exact extractions per (test tile, train tile) merge, keep
+n_acc x 128 lane-bucketed running minima (value + packed train index) across
+the whole train sweep and extract k only once, in the final grid step.
+Measures throughput + recall vs the exact XLA path.
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+BIG = 3.0e38
+INT_BIG = 2 ** 30
+
+
+def _acc_kernel(x_ref, y_ref, y2_ref, out_d_ref, out_i_ref,
+                acc_d, acc_i, *, k: int, tn: int, n_acc: int,
+                use_bf16: bool):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_d[:] = jnp.full(acc_d.shape, BIG, jnp.float32)
+        acc_i[:] = jnp.full(acc_i.shape, -1, jnp.int32)
+
+    x = x_ref[:]
+    y = y_ref[:]
+    if use_bf16:
+        x = x.astype(jnp.bfloat16)
+        y = y.astype(jnp.bfloat16)
+    cross = lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    metric = y2_ref[:] - 2.0 * cross      # [TM, TN]
+
+    tm = metric.shape[0]
+    n_chunks = tn // LANES
+    lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+    for c in range(n_chunks):
+        s = c % n_acc
+        chunk = metric[:, c * LANES:(c + 1) * LANES]
+        cur_d = acc_d[:, s * LANES:(s + 1) * LANES]
+        better = chunk < cur_d
+        idx = j * tn + c * LANES + lane
+        acc_d[:, s * LANES:(s + 1) * LANES] = jnp.where(better, chunk, cur_d)
+        cur_i = acc_i[:, s * LANES:(s + 1) * LANES]
+        acc_i[:, s * LANES:(s + 1) * LANES] = jnp.where(better, idx, cur_i)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        val = acc_d[:]
+        idx = acc_i[:]
+        new_d = jnp.full((tm, LANES), BIG, jnp.float32)
+        new_i = jnp.full((tm, LANES), -1, jnp.int32)
+        slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+        for slot in range(k):
+            min_d = jnp.min(val, axis=1, keepdims=True)
+            min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
+                            axis=1, keepdims=True)
+            new_d = jnp.where(slot_lane == slot, min_d, new_d)
+            new_i = jnp.where(slot_lane == slot, min_i, new_i)
+            val = jnp.where((val == min_d) & (idx == min_i), BIG, val)
+        out_d_ref[:] = new_d
+        out_i_ref[:] = new_i
+
+
+def _pad_rows(a, multiple, fill=0.0):
+    pad = (-a.shape[0]) % multiple
+    return a if pad == 0 else jnp.pad(a, ((0, pad), (0, 0)),
+                                      constant_values=fill)
+
+
+@partial(jax.jit, static_argnames=("k", "tile_m", "tile_n", "n_acc"))
+def acc_topk(x, y, *, k: int, tile_m: int = 512, tile_n: int = 4096,
+             n_acc: int = 4):
+    m, d = x.shape
+    n = y.shape[0]
+    xp = _pad_rows(x, tile_m)
+    yp = _pad_rows(y, tile_n)
+    y2 = jnp.sum(y * y, axis=1)
+    y2p = jnp.pad(y2, (0, yp.shape[0] - n), constant_values=BIG)[None, :]
+    grid = (xp.shape[0] // tile_m, yp.shape[0] // tile_n)
+    kernel = partial(_acc_kernel, k=k, tn=tile_n, n_acc=n_acc, use_bf16=True)
+    out_d, out_i = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_m, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, d), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_m, n_acc * LANES), jnp.float32),
+            pltpu.VMEM((tile_m, n_acc * LANES), jnp.int32),
+        ],
+    )(xp, yp, y2p)
+    return out_d[:m, :k], out_i[:m, :k]
+
+
+def main():
+    M, N, D, K = 8192, 65536, 9, 5
+    ITERS = 100
+    rng = np.random.default_rng(0)
+    test = jnp.asarray(rng.random((M, D), dtype=np.float32))
+    train = jnp.asarray(rng.random((N, D), dtype=np.float32))
+
+    # correctness/recall vs exact
+    x2 = jnp.sum(test * test, axis=1, keepdims=True)
+    full = x2 + jnp.sum(train * train, axis=1)[None, :] - 2 * test @ train.T
+    _, exact_i = lax.top_k(-full, K)
+
+    for n_acc, tn in [(2, 4096), (4, 4096), (4, 6144), (8, 4096), (4, 8192)]:
+        d_i = acc_topk(test, train, k=K, tile_n=tn, n_acc=n_acc)[1]
+        hits = 0
+        ei = np.asarray(exact_i)
+        ai = np.asarray(d_i)
+        for r in range(M):
+            hits += len(set(ei[r]).intersection(ai[r]))
+        recall = hits / (M * K)
+
+        @jax.jit
+        def chain(test, train, tn=tn, n_acc=n_acc):
+            def body(t, _):
+                d, i = acc_topk(t, train, k=K, tile_n=tn, n_acc=n_acc)
+                eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+                return t + eps, (d[0, 0], i[0, 0])
+            _, outs = jax.lax.scan(body, test, None, length=ITERS)
+            return outs
+
+        np.asarray(chain(test, train))
+        best = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            np.asarray(chain(test, train))
+            best = max(best, M * ITERS / (time.perf_counter() - t0))
+        print(f"n_acc={n_acc} tile_n={tn:5d}  {best/1e6:7.3f} M rows/s  "
+              f"recall={recall:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Confirm the accumulator-fold winner with repeats + tile_m variants."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from scripts.exp_fold import acc_topk
+
+M, N, D, K = 8192, 65536, 9, 5
+ITERS = 100
+rng = np.random.default_rng(0)
+test = jnp.asarray(rng.random((M, D), dtype=np.float32))
+train = jnp.asarray(rng.random((N, D), dtype=np.float32))
+
+CONFIGS = [(512, 8192, 4), (512, 12288, 4), (512, 8192, 2),
+           (256, 8192, 4), (1024, 8192, 4)]
+chains = {}
+for tm, tn, na in CONFIGS:
+    def make(tm=tm, tn=tn, na=na):
+        @jax.jit
+        def chain(test, train):
+            def body(t, _):
+                d, i = acc_topk(t, train, k=K, tile_m=tm, tile_n=tn,
+                                n_acc=na)
+                eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+                return t + eps, (d[0, 0], i[0, 0])
+            _, outs = jax.lax.scan(body, test, None, length=ITERS)
+            return outs
+        return chain
+    try:
+        chains[(tm, tn, na)] = make()
+        np.asarray(chains[(tm, tn, na)](test, train))
+    except Exception as e:
+        print(f"{(tm, tn, na)} FAILED {type(e).__name__}", flush=True)
+
+for rep in range(3):
+    for cfg, chain in chains.items():
+        t0 = time.perf_counter()
+        np.asarray(chain(test, train))
+        dt = time.perf_counter() - t0
+        print(f"rep{rep} tm/tn/na={cfg}  {M*ITERS/dt/1e6:7.3f} M rows/s",
+              flush=True)

@@ -1,0 +1,41 @@
+"""Repeat-measure the promising tile configs interleaved (noise estimate)."""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas
+
+M, N, D, K = 8192, 65536, 9, 5
+ITERS = 100
+
+rng = np.random.default_rng(0)
+test = jnp.asarray(rng.random((M, D), dtype=np.float32))
+train = jnp.asarray(rng.random((N, D), dtype=np.float32))
+
+CONFIGS = [(256, 16384), (512, 4096), (512, 6144), (1024, 16384)]
+chains = {}
+for tm, tn in CONFIGS:
+    def make(tm=tm, tn=tn):
+        @jax.jit
+        def chain(test, train):
+            def body(t, _):
+                d, i = pairwise_topk_pallas(t, train, k=K, tile_m=tm,
+                                            tile_n=tn)
+                eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+                return t + eps, (d[0, 0], i[0, 0])
+            _, outs = jax.lax.scan(body, test, None, length=ITERS)
+            return outs
+        return chain
+    chains[(tm, tn)] = make()
+    np.asarray(chains[(tm, tn)](test, train))      # compile+warm all first
+
+for rep in range(3):
+    for cfg, chain in chains.items():
+        t0 = time.perf_counter()
+        np.asarray(chain(test, train))
+        dt = time.perf_counter() - t0
+        print(f"rep{rep} tile={cfg}  {M*ITERS/dt/1e6:8.3f} M rows/s",
+              flush=True)

@@ -1,0 +1,51 @@
+"""Tile-size sweep for the pallas pairwise-topk kernel (perf experiment).
+
+Times the raw kernel over the bench shape (M=8192, N=65536, D=9, k=5) for a
+grid of (tile_m, tile_n), using the same scan-chained timing trick as
+bench.py to amortize relay latency.
+"""
+
+import itertools
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas
+
+M, N, D, K = 8192, 65536, 9, 5
+ITERS = 50
+
+rng = np.random.default_rng(0)
+test = jnp.asarray(rng.random((M, D), dtype=np.float32))
+train = jnp.asarray(rng.random((N, D), dtype=np.float32))
+
+
+def time_config(tile_m, tile_n):
+    @jax.jit
+    def chain(test, train):
+        def body(t, _):
+            d, i = pairwise_topk_pallas(t, train, k=K, tile_m=tile_m,
+                                        tile_n=tile_n)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, (d[0, 0], i[0, 0])
+        _, outs = jax.lax.scan(body, test, None, length=ITERS)
+        return outs
+
+    np.asarray(chain(test, train))
+    t0 = time.perf_counter()
+    np.asarray(chain(test, train))
+    dt = time.perf_counter() - t0
+    return M * ITERS / dt
+
+
+for tm, tn in itertools.product([256, 512, 1024, 2048],
+                                [2048, 4096, 6144, 8192, 16384]):
+    try:
+        rps = time_config(tm, tn)
+        print(f"tile_m={tm:5d} tile_n={tn:6d}  {rps/1e6:8.3f} M rows/s",
+              flush=True)
+    except Exception as e:  # noqa: BLE001 - sweep survives bad configs
+        print(f"tile_m={tm:5d} tile_n={tn:6d}  FAILED {type(e).__name__}: "
+              f"{str(e)[:120]}", flush=True)
