@@ -34,7 +34,8 @@ def _build() -> bool:
         return False
     # per-process temp name: concurrent builders must not write the same file
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", tmp, _SRC]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -55,11 +56,10 @@ def _load():
             return _lib
         if not _build():
             return None
-        global _build_error
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
-            # stale or arch-mismatched .so: rebuild once from scratch, and
+        lib = _open_and_register()
+        if lib is None:
+            # stale, arch-mismatched, or symbol-incomplete .so (e.g. a
+            # prebuilt from older sources): rebuild once from scratch, and
             # degrade to the Python path if that still doesn't load
             try:
                 os.remove(_SO)
@@ -67,11 +67,19 @@ def _load():
                 pass
             if not _build():
                 return None
-            try:
-                lib = ctypes.CDLL(_SO)
-            except OSError as exc:
-                _build_error = f"dlopen failed: {exc}"
+            lib = _open_and_register()
+            if lib is None:
                 return None
+        _lib = lib
+        return _lib
+
+
+def _open_and_register():
+    """dlopen + declare the C ABI; None when the .so is unloadable or is
+    missing a required symbol (callers rebuild or degrade)."""
+    global _build_error
+    try:
+        lib = ctypes.CDLL(_SO)
         lib.avt_encode.restype = ctypes.c_void_p
         lib.avt_encode.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
@@ -83,6 +91,9 @@ def _load():
             ctypes.c_char_p,                   # vocab_blob
             ctypes.POINTER(ctypes.c_int32),    # vocab_counts
             ctypes.c_int32, ctypes.c_int32]    # oov, n_feat
+        lib.avt_encode_parallel.restype = ctypes.c_void_p
+        lib.avt_encode_parallel.argtypes = (
+            list(lib.avt_encode.argtypes) + [ctypes.c_int32])  # n_threads
         lib.avt_rows.restype = ctypes.c_int64
         lib.avt_rows.argtypes = [ctypes.c_void_p]
         lib.avt_error_msg.restype = ctypes.c_char_p
@@ -94,8 +105,13 @@ def _load():
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
         lib.avt_free.restype = None
         lib.avt_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+        return lib
+    except OSError as exc:
+        _build_error = f"dlopen failed: {exc}"
+        return None
+    except AttributeError as exc:
+        _build_error = f"stale native library (missing symbol): {exc}"
+        return None
 
 
 def available() -> bool:

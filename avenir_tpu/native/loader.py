@@ -1,9 +1,10 @@
 """Native CSV → EncodedTable: the C++ fast path for Featurizer.transform.
 
 Builds the column-spec arrays from a *fitted* Featurizer (vocabularies, bin
-offsets, class values), hands the raw file bytes to ``avt_encode`` and wraps
-the filled numpy buffers in the same :class:`EncodedTable` the Python path
-produces — bit-identical bins/values (asserted in tests/test_native.py).
+offsets, class values), hands the raw file bytes to ``avt_encode_parallel``
+(a thread-pool parse over line-aligned byte ranges; serial under 1 MiB) and
+wraps the filled numpy buffers in the same :class:`EncodedTable` the Python
+path produces — bit-identical bins/values (asserted in tests/test_native.py).
 
 Applicability: single-character field delimiter and a fitted featurizer;
 ``encode_file`` raises :class:`NativeUnavailable` otherwise and callers fall
@@ -38,7 +39,8 @@ def _single_char_delim(delim_regex: str) -> Optional[str]:
 
 
 def encode_file(fz: Featurizer, path: str, delim_regex: str = ",",
-                with_labels: bool = True) -> EncodedTable:
+                with_labels: bool = True, n_threads: int = 0
+                ) -> EncodedTable:
     lib = native._load()
     if lib is None:
         raise NativeUnavailable(native.build_error())
@@ -99,7 +101,7 @@ def encode_file(fz: Featurizer, path: str, delim_regex: str = ",",
 
     n_feat = len(fz.encoders)
     oov = 1 if fz.unseen == "oov" else 0
-    handle = lib.avt_encode(
+    handle = lib.avt_encode_parallel(
         buf, len(buf), delim.encode(),
         n_ord,
         kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
@@ -108,7 +110,7 @@ def encode_file(fz: Featurizer, path: str, delim_regex: str = ",",
         bin_offset.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         vocab_blob,
         vocab_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        oov, n_feat)
+        oov, n_feat, n_threads)
     try:
         n_rows = lib.avt_rows(handle)
         if n_rows < 0:
@@ -150,12 +152,15 @@ def encode_file(fz: Featurizer, path: str, delim_regex: str = ",",
 
 def transform_file(fz: Featurizer, path: str, delim_regex: str = ",",
                    with_labels: bool = True,
-                   force_python: bool = False) -> EncodedTable:
-    """Featurize a CSV file: native C++ pass when possible, else the
-    Python ``read_csv_lines`` + ``transform`` path with identical output."""
+                   force_python: bool = False,
+                   n_threads: int = 0) -> EncodedTable:
+    """Featurize a CSV file: native C++ pass when possible (multi-threaded
+    for files over 1 MiB; ``n_threads=0`` sizes the pool from the host),
+    else the Python ``read_csv_lines`` + ``transform`` path with identical
+    output."""
     if not force_python:
         try:
-            return encode_file(fz, path, delim_regex, with_labels)
+            return encode_file(fz, path, delim_regex, with_labels, n_threads)
         except NativeUnavailable:
             pass
     from avenir_tpu.utils.dataset import read_csv_lines

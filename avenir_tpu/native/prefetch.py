@@ -1,0 +1,73 @@
+"""Prefetching shard reader: overlap CSV featurization with device compute.
+
+The reference's input stage is Hadoop handing each mapper one HDFS split,
+parsed inside the mapper JVM while other splits parse elsewhere
+(SURVEY.md §2.10 "Data parallelism"). Here the analogue is a small
+double-buffered pipeline: shard n+1 (and deeper, up to ``depth``) featurizes
+on background threads — each file through the multi-threaded native C++
+encoder (``native/avt_io.cpp`` avt_encode_parallel) — while the caller's
+device step consumes shard n. Order is preserved.
+
+Intended for driving batch jobs over ``part-*`` style multi-file inputs —
+e.g. hand each host process its per-process shard list and feed the tables
+to ``parallel/data.py`` ``shard_table`` as they arrive.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Iterator, List, Sequence
+
+from avenir_tpu.native.loader import transform_file
+from avenir_tpu.utils.dataset import EncodedTable, Featurizer
+
+
+class PrefetchLoader:
+    """Iterate ``EncodedTable``s over shard files, ``depth`` ahead.
+
+    ``fit_rows`` callers must fit the featurizer up front (a data-dependent
+    fit would need the full pass anyway); the loader only transforms.
+    """
+
+    def __init__(self, fz: Featurizer, paths: Sequence[str],
+                 delim_regex: str = ",", with_labels: bool = True,
+                 depth: int = 2, n_threads: int = 0,
+                 force_python: bool = False):
+        if not fz.fitted:
+            raise RuntimeError("fit the Featurizer before prefetching")
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._fz = fz
+        self._paths: List[str] = list(paths)
+        self._delim = delim_regex
+        self._with_labels = with_labels
+        self._depth = depth
+        self._n_threads = n_threads
+        self._force_python = force_python
+
+    def _load(self, path: str) -> EncodedTable:
+        return transform_file(self._fz, path, self._delim,
+                              self._with_labels,
+                              force_python=self._force_python,
+                              n_threads=self._n_threads)
+
+    def __len__(self) -> int:
+        return len(self._paths)
+
+    def __iter__(self) -> Iterator[EncodedTable]:
+        if not self._paths:
+            return
+        # one worker per outstanding shard; each shard parse is itself
+        # multi-threaded in C++, so more workers would oversubscribe
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._depth) as pool:
+            pending = [pool.submit(self._load, p)
+                       for p in self._paths[:self._depth]]
+            next_submit = self._depth
+            for _ in range(len(self._paths)):
+                fut = pending.pop(0)
+                if next_submit < len(self._paths):
+                    pending.append(
+                        pool.submit(self._load, self._paths[next_submit]))
+                    next_submit += 1
+                yield fut.result()

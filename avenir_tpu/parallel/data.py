@@ -140,8 +140,11 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
             transform_file(fz, path, delim_regex, with_labels=with_labels),
             mesh, axis)
     splitter = re.compile(delim_regex)
+    # same line acceptance as read_csv_lines: drop empty lines only —
+    # whitespace-only lines stay and fail featurization identically on
+    # every path (single-host Python, native C++, multi-host)
     with open(path, "r") as fh:
-        lines = [ln for ln in fh.read().split("\n") if ln.strip()]
+        lines = [ln for ln in fh.read().splitlines() if ln]
     n_real = len(lines)
     g = padded_rows(n_real, mesh, axis)
     start, stop = process_slice(g)

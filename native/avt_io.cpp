@@ -12,9 +12,17 @@
 // numeric values, same error conditions); tests/test_native.py asserts
 // parity against the Python path.
 //
-// C ABI (ctypes): avt_encode -> opaque handle; avt_rows/avt_error_msg
-// inspect; avt_fill copies into numpy buffers; avt_free releases.
+// Two entry points: avt_encode (single pass) and avt_encode_parallel
+// (thread-pool executor: a parallel line-count pass fixes each range's
+// output row base, then ranges parse concurrently straight into the shared
+// output — the mapper-fan-out of the reference's input stage without the
+// JVM-per-split cost).
+//
+// C ABI (ctypes): avt_encode/avt_encode_parallel -> opaque handle;
+// avt_rows/avt_error_msg inspect; avt_fill copies into numpy buffers;
+// avt_free releases.
 
+#include <algorithm>
 #include <cctype>
 #include <cmath>
 #include <cstdint>
@@ -22,6 +30,7 @@
 #include <cstring>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -44,6 +53,15 @@ struct ColumnSpec {
   int64_t bin_offset = 0;
   std::unordered_map<std::string, int32_t> vocab;  // categorical
   int32_t oov_index = -1;   // -1: unseen is an error
+};
+
+struct Spec {
+  std::vector<ColumnSpec> cols;
+  int32_t n_ord = 0;
+  int32_t n_feat = 0;
+  int32_t class_ord = -1;
+  int32_t id_ord = -1;
+  char delim = ',';
 };
 
 struct Table {
@@ -78,6 +96,184 @@ bool parse_double(std::string_view tok, double* out) {
   return true;
 }
 
+Spec build_spec(char delim, int32_t n_ordinals, const int8_t* kinds,
+                const int32_t* feat_slot, const double* bucket_width,
+                const int64_t* bin_offset, const char* vocab_blob,
+                const int32_t* vocab_counts, int32_t oov, int32_t n_feat) {
+  Spec s;
+  s.delim = delim;
+  s.n_ord = n_ordinals;
+  s.n_feat = n_feat;
+  s.cols.resize(static_cast<size_t>(n_ordinals));
+  const char* vp = vocab_blob;
+  for (int32_t i = 0; i < n_ordinals; ++i) {
+    ColumnSpec& c = s.cols[static_cast<size_t>(i)];
+    c.kind = static_cast<Kind>(kinds[i]);
+    c.feat_slot = feat_slot[i];
+    c.bucket_width = bucket_width[i];
+    c.bin_offset = bin_offset[i];
+    for (int32_t v = 0; v < vocab_counts[i]; ++v) {
+      std::string tok(vp);
+      vp += tok.size() + 1;
+      c.vocab.emplace(std::move(tok), v);
+    }
+    if (c.kind == kCategorical && oov)
+      c.oov_index = vocab_counts[i];
+    if (c.kind == kClass) s.class_ord = i;
+    if (c.kind == kId) s.id_ord = i;
+  }
+  return s;
+}
+
+// Line splitting replicates Python's universal-newline text mode ('\n',
+// '\r\n', and lone '\r' all terminate a line) followed by read_csv_lines'
+// `if line:` filter (utils/dataset.py) — whitespace-only lines are KEPT
+// and then fail featurization identically on both paths.
+inline void next_line(const char* buf, int64_t len, int64_t p, int64_t* eol,
+                      int64_t* next) {
+  int64_t e = p;
+  while (e < len && buf[e] != '\n' && buf[e] != '\r') ++e;
+  *eol = e;
+  *next = (e + 1 < len && buf[e] == '\r' && buf[e + 1] == '\n') ? e + 2
+                                                                : e + 1;
+}
+
+// count non-empty lines in [begin, end); begin must sit at a line start
+int64_t count_rows(const char* buf, int64_t end, int64_t begin) {
+  int64_t rows = 0;
+  for (int64_t p = begin; p < end;) {
+    int64_t eol, next;
+    next_line(buf, end, p, &eol, &next);
+    if (eol > p) ++rows;
+    p = next;
+  }
+  return rows;
+}
+
+// Parse lines in [begin, end) into t's buffers starting at output row
+// base_row. begin must sit at a line start; end at a line boundary. On a bad
+// row, sets err (with the global row number) and returns false.
+bool encode_range(const char* buf, int64_t end, int64_t begin,
+                  const Spec& spec, Table* t, int64_t base_row,
+                  std::string* err) {
+  const int32_t n_feat = t->n_feat;
+  int64_t r = base_row;
+  char msg[256];
+  for (int64_t p = begin, eol = 0, next = 0; p < end; p = next) {
+    next_line(buf, end, p, &eol, &next);
+    if (eol == p) continue;
+
+    int32_t ord = 0;
+    const char* line_end = buf + eol;
+    const char* cursor = buf + p;
+    bool row_done = false;
+    while (!row_done) {
+      const char* field_end = cursor;
+      while (field_end < line_end && *field_end != spec.delim) ++field_end;
+      std::string_view tok = trim(cursor, field_end);
+
+      if (ord < spec.n_ord) {
+        const ColumnSpec& c = spec.cols[static_cast<size_t>(ord)];
+        switch (c.kind) {
+          case kIgnore:
+            break;
+          case kId:
+            t->id_spans[static_cast<size_t>(r * 2)] = tok.data() - buf;
+            t->id_spans[static_cast<size_t>(r * 2 + 1)] =
+                tok.data() - buf + static_cast<int64_t>(tok.size());
+            break;
+          case kClass: {
+            auto it = c.vocab.find(std::string(tok));
+            if (it == c.vocab.end()) {
+              std::snprintf(msg, sizeof(msg),
+                            "row %lld: unseen class value '%.*s'",
+                            static_cast<long long>(r),
+                            static_cast<int>(tok.size()), tok.data());
+              *err = msg;
+              return false;
+            }
+            t->labels[static_cast<size_t>(r)] = it->second;
+            break;
+          }
+          case kCategorical: {
+            auto it = c.vocab.find(std::string(tok));
+            int32_t idx;
+            if (it != c.vocab.end()) {
+              idx = it->second;
+            } else if (c.oov_index >= 0) {
+              idx = c.oov_index;
+            } else {
+              std::snprintf(msg, sizeof(msg),
+                            "row %lld ordinal %d: unseen categorical "
+                            "value '%.*s'",
+                            static_cast<long long>(r), ord,
+                            static_cast<int>(tok.size()), tok.data());
+              *err = msg;
+              return false;
+            }
+            const size_t o =
+                static_cast<size_t>(r * n_feat + c.feat_slot);
+            t->binned[o] = idx;
+            t->numeric[o] = static_cast<float>(idx);
+            break;
+          }
+          case kBucketed:
+          case kContinuous: {
+            double v;
+            if (!parse_double(tok, &v)) {
+              std::snprintf(msg, sizeof(msg),
+                            "row %lld ordinal %d: non-numeric value '%.*s'",
+                            static_cast<long long>(r), ord,
+                            static_cast<int>(tok.size()), tok.data());
+              *err = msg;
+              return false;
+            }
+            const size_t o =
+                static_cast<size_t>(r * n_feat + c.feat_slot);
+            t->numeric[o] = static_cast<float>(v);
+            if (c.kind == kBucketed)
+              t->binned[o] = static_cast<int32_t>(
+                  static_cast<int64_t>(std::floor(v / c.bucket_width)) -
+                  c.bin_offset);
+            break;
+          }
+        }
+      }
+      ++ord;
+      if (field_end >= line_end) {
+        row_done = true;
+        if (ord < spec.n_ord) {
+          // a needed column is missing in this row?
+          for (int32_t rest = ord; rest < spec.n_ord; ++rest) {
+            if (spec.cols[static_cast<size_t>(rest)].kind != kIgnore) {
+              std::snprintf(msg, sizeof(msg),
+                            "row %lld has %d fields, needs ordinal %d",
+                            static_cast<long long>(r), ord, rest);
+              *err = msg;
+              return false;
+            }
+          }
+        }
+      } else {
+        cursor = field_end + 1;
+      }
+    }
+    if (spec.id_ord < 0) {  // no id column: span empty, Python uses row index
+      t->id_spans[static_cast<size_t>(r * 2)] = 0;
+      t->id_spans[static_cast<size_t>(r * 2 + 1)] = 0;
+    }
+    ++r;
+  }
+  return true;
+}
+
+void alloc_table(Table* t, int64_t rows) {
+  t->binned.assign(static_cast<size_t>(rows * t->n_feat), 0);
+  t->numeric.assign(static_cast<size_t>(rows * t->n_feat), 0.0f);
+  if (t->has_labels) t->labels.assign(static_cast<size_t>(rows), 0);
+  t->id_spans.assign(static_cast<size_t>(rows * 2), 0);
+}
+
 }  // namespace
 
 extern "C" {
@@ -105,162 +301,102 @@ void* avt_encode(const char* buf, int64_t len, char delim,
                  const int32_t* vocab_counts, int32_t oov, int32_t n_feat) {
   auto* t = new Table();
   t->n_feat = n_feat;
+  Spec spec = build_spec(delim, n_ordinals, kinds, feat_slot, bucket_width,
+                         bin_offset, vocab_blob, vocab_counts, oov, n_feat);
+  t->has_labels = spec.class_ord >= 0;
+  const int64_t rows = count_rows(buf, len, 0);
+  alloc_table(t, rows);
+  if (!encode_range(buf, len, 0, spec, t, 0, &t->error)) return t;
+  t->rows = rows;
+  return t;
+}
 
-  std::vector<ColumnSpec> cols(static_cast<size_t>(n_ordinals));
-  const char* vp = vocab_blob;
-  int32_t class_ord = -1, id_ord = -1;
-  for (int32_t i = 0; i < n_ordinals; ++i) {
-    ColumnSpec& c = cols[static_cast<size_t>(i)];
-    c.kind = static_cast<Kind>(kinds[i]);
-    c.feat_slot = feat_slot[i];
-    c.bucket_width = bucket_width[i];
-    c.bin_offset = bin_offset[i];
-    for (int32_t v = 0; v < vocab_counts[i]; ++v) {
-      std::string tok(vp);
-      vp += tok.size() + 1;
-      c.vocab.emplace(std::move(tok), v);
-    }
-    if (c.kind == kCategorical && oov)
-      c.oov_index = vocab_counts[i];
-    if (c.kind == kClass) class_ord = i;
-    if (c.kind == kId) id_ord = i;
+// avt_encode with a thread-pool executor: the buffer splits into n_threads
+// byte ranges snapped forward to line starts; a parallel count pass fixes
+// each range's output row base; ranges then parse concurrently straight into
+// the shared output buffers (disjoint row slices — no merge copy). The
+// earliest bad row wins error reporting, exactly as the serial pass would
+// have reported it.
+void* avt_encode_parallel(const char* buf, int64_t len, char delim,
+                          int32_t n_ordinals, const int8_t* kinds,
+                          const int32_t* feat_slot,
+                          const double* bucket_width,
+                          const int64_t* bin_offset, const char* vocab_blob,
+                          const int32_t* vocab_counts, int32_t oov,
+                          int32_t n_feat, int32_t n_threads) {
+  if (n_threads <= 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    n_threads = hw ? static_cast<int32_t>(std::min(hw, 16u)) : 4;
+    // small inputs: thread spawn costs more than it saves (explicit
+    // n_threads > 1 is honored regardless, so tests can force the pool)
+    if (len < (1 << 20)) n_threads = 1;
   }
-  t->has_labels = class_ord >= 0;
+  if (n_threads == 1)
+    return avt_encode(buf, len, delim, n_ordinals, kinds, feat_slot,
+                      bucket_width, bin_offset, vocab_blob, vocab_counts,
+                      oov, n_feat);
 
-  // Line splitting replicates Python's universal-newline text mode ('\n',
-  // '\r\n', and lone '\r' all terminate a line) followed by read_csv_lines'
-  // `if line:` filter (utils/dataset.py) — whitespace-only lines are KEPT
-  // and then fail featurization identically on both paths.
-  auto next_line = [&](int64_t p, int64_t* eol, int64_t* next) {
-    int64_t e = p;
-    while (e < len && buf[e] != '\n' && buf[e] != '\r') ++e;
-    *eol = e;
-    *next = (e + 1 < len && buf[e] == '\r' && buf[e + 1] == '\n') ? e + 2
-                                                                  : e + 1;
-  };
+  auto* t = new Table();
+  t->n_feat = n_feat;
+  Spec spec = build_spec(delim, n_ordinals, kinds, feat_slot, bucket_width,
+                         bin_offset, vocab_blob, vocab_counts, oov, n_feat);
+  t->has_labels = spec.class_ord >= 0;
 
-  // count rows to size the output vectors once
-  int64_t rows = 0;
-  for (int64_t p = 0; p < len;) {
-    int64_t eol, next;
-    next_line(p, &eol, &next);
-    if (eol > p) ++rows;
-    p = next;
+  // range starts, snapped forward to the next line start
+  std::vector<int64_t> starts;
+  starts.reserve(static_cast<size_t>(n_threads) + 1);
+  starts.push_back(0);
+  for (int32_t i = 1; i < n_threads; ++i) {
+    int64_t p = len * i / n_threads;
+    if (p <= starts.back()) continue;
+    // advance past the line containing p; the line p sits in (even when p
+    // is exactly its first byte) stays wholly inside the previous range
+    int64_t q = p;
+    while (q < len && buf[q] != '\n' && buf[q] != '\r') ++q;
+    if (q < len)
+      q = (q + 1 < len && buf[q] == '\r' && buf[q + 1] == '\n') ? q + 2
+                                                                : q + 1;
+    if (q > starts.back() && q < len) starts.push_back(q);
   }
-  t->binned.assign(static_cast<size_t>(rows * n_feat), 0);
-  t->numeric.assign(static_cast<size_t>(rows * n_feat), 0.0f);
-  if (t->has_labels) t->labels.assign(static_cast<size_t>(rows), 0);
-  t->id_spans.assign(static_cast<size_t>(rows * 2), 0);
+  starts.push_back(len);
+  const size_t n_ranges = starts.size() - 1;
 
-  int64_t r = 0;
-  char msg[256];
-  for (int64_t p = 0, eol = 0, next = 0; p < len; p = next) {
-    next_line(p, &eol, &next);
-    if (eol == p) continue;
-
-    int32_t ord = 0;
-    const char* field_begin = buf + p;
-    const char* line_end = buf + eol;
-    const char* cursor = field_begin;
-    bool row_done = false;
-    while (!row_done) {
-      const char* field_end = cursor;
-      while (field_end < line_end && *field_end != delim) ++field_end;
-      std::string_view tok = trim(cursor, field_end);
-
-      if (ord < n_ordinals) {
-        const ColumnSpec& c = cols[static_cast<size_t>(ord)];
-        switch (c.kind) {
-          case kIgnore:
-            break;
-          case kId:
-            t->id_spans[static_cast<size_t>(r * 2)] = tok.data() - buf;
-            t->id_spans[static_cast<size_t>(r * 2 + 1)] =
-                tok.data() - buf + static_cast<int64_t>(tok.size());
-            break;
-          case kClass: {
-            auto it = c.vocab.find(std::string(tok));
-            if (it == c.vocab.end()) {
-              std::snprintf(msg, sizeof(msg),
-                            "row %lld: unseen class value '%.*s'",
-                            static_cast<long long>(r),
-                            static_cast<int>(tok.size()), tok.data());
-              t->error = msg;
-              return t;
-            }
-            t->labels[static_cast<size_t>(r)] = it->second;
-            break;
-          }
-          case kCategorical: {
-            auto it = c.vocab.find(std::string(tok));
-            int32_t idx;
-            if (it != c.vocab.end()) {
-              idx = it->second;
-            } else if (c.oov_index >= 0) {
-              idx = c.oov_index;
-            } else {
-              std::snprintf(msg, sizeof(msg),
-                            "row %lld ordinal %d: unseen categorical "
-                            "value '%.*s'",
-                            static_cast<long long>(r), ord,
-                            static_cast<int>(tok.size()), tok.data());
-              t->error = msg;
-              return t;
-            }
-            const size_t o =
-                static_cast<size_t>(r * n_feat + c.feat_slot);
-            t->binned[o] = idx;
-            t->numeric[o] = static_cast<float>(idx);
-            break;
-          }
-          case kBucketed:
-          case kContinuous: {
-            double v;
-            if (!parse_double(tok, &v)) {
-              std::snprintf(msg, sizeof(msg),
-                            "row %lld ordinal %d: non-numeric value '%.*s'",
-                            static_cast<long long>(r), ord,
-                            static_cast<int>(tok.size()), tok.data());
-              t->error = msg;
-              return t;
-            }
-            const size_t o =
-                static_cast<size_t>(r * n_feat + c.feat_slot);
-            t->numeric[o] = static_cast<float>(v);
-            if (c.kind == kBucketed)
-              t->binned[o] = static_cast<int32_t>(
-                  static_cast<int64_t>(std::floor(v / c.bucket_width)) -
-                  c.bin_offset);
-            break;
-          }
-        }
-      }
-      ++ord;
-      if (field_end >= line_end) {
-        row_done = true;
-        if (ord < n_ordinals) {
-          // a needed column is missing in this row?
-          for (int32_t rest = ord; rest < n_ordinals; ++rest) {
-            if (cols[static_cast<size_t>(rest)].kind != kIgnore) {
-              std::snprintf(msg, sizeof(msg),
-                            "row %lld has %d fields, needs ordinal %d",
-                            static_cast<long long>(r), ord, rest);
-              t->error = msg;
-              return t;
-            }
-          }
-        }
-      } else {
-        cursor = field_end + 1;
-      }
-    }
-    if (id_ord < 0) {  // no id column: span is empty, Python uses row index
-      t->id_spans[static_cast<size_t>(r * 2)] = 0;
-      t->id_spans[static_cast<size_t>(r * 2 + 1)] = 0;
-    }
-    ++r;
+  // pass 1: per-range row counts (parallel)
+  std::vector<int64_t> range_rows(n_ranges, 0);
+  {
+    std::vector<std::thread> pool;
+    pool.reserve(n_ranges);
+    for (size_t i = 0; i < n_ranges; ++i)
+      pool.emplace_back([&, i] {
+        range_rows[i] = count_rows(buf, starts[i + 1], starts[i]);
+      });
+    for (auto& th : pool) th.join();
   }
-  t->rows = r;
+  std::vector<int64_t> base(n_ranges + 1, 0);
+  for (size_t i = 0; i < n_ranges; ++i) base[i + 1] = base[i] + range_rows[i];
+  alloc_table(t, base[n_ranges]);
+
+  // pass 2: parse each range into its disjoint output slice (parallel)
+  std::vector<std::string> errors(n_ranges);
+  std::vector<char> failed(n_ranges, 0);
+  {
+    std::vector<std::thread> pool;
+    pool.reserve(n_ranges);
+    for (size_t i = 0; i < n_ranges; ++i)
+      pool.emplace_back([&, i] {
+        if (!encode_range(buf, starts[i + 1], starts[i], spec, t, base[i],
+                          &errors[i]))
+          failed[i] = 1;
+      });
+    for (auto& th : pool) th.join();
+  }
+  for (size_t i = 0; i < n_ranges; ++i) {
+    if (failed[i]) {        // earliest range's error = earliest bad row
+      t->error = errors[i];
+      return t;
+    }
+  }
+  t->rows = base[n_ranges];
   return t;
 }
 

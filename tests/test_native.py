@@ -90,6 +90,70 @@ class TestParity:
         assert encode_file(fz, path).n_rows == 20
 
 
+class TestParallel:
+    def test_parallel_parity(self, tmp_path):
+        """Thread-pool parse (forced 4 ranges) matches serial + Python."""
+        rows = churn_rows(2000, seed=6)
+        path = _write(tmp_path, rows)
+        fz = Featurizer(churn_schema()).fit(rows)
+        _assert_tables_equal(encode_file(fz, path, n_threads=4),
+                             transform_file(fz, path, force_python=True))
+
+    def test_parallel_more_threads_than_rows(self, tmp_path):
+        rows = churn_rows(3, seed=6)
+        path = _write(tmp_path, rows)
+        fz = Featurizer(churn_schema()).fit(rows)
+        _assert_tables_equal(encode_file(fz, path, n_threads=16),
+                             transform_file(fz, path, force_python=True))
+
+    def test_parallel_error_reports_global_row(self, tmp_path):
+        rows = churn_rows(1000, seed=6)
+        fz = Featurizer(churn_schema()).fit(rows)
+        bad = [list(r) for r in rows]
+        bad[700][1] = "NEVER_SEEN"
+        path = _write(tmp_path, bad)
+        with pytest.raises(ValueError, match="row 700"):
+            encode_file(fz, path, n_threads=4)
+
+    def test_parallel_crlf_blank_lines(self, tmp_path):
+        rows = churn_rows(600, seed=8)
+        path = str(tmp_path / "crlf.csv")
+        body = "\r\n".join(",".join(r) for r in rows[:300]) + \
+               "\r\n\r\n\r\n" + \
+               "\r\n".join(",".join(r) for r in rows[300:]) + "\r\n"
+        with open(path, "w", newline="") as fh:
+            fh.write(body)
+        fz = Featurizer(churn_schema()).fit(rows)
+        table = encode_file(fz, path, n_threads=8)
+        assert table.n_rows == 600
+        _assert_tables_equal(table,
+                             transform_file(fz, path, force_python=True))
+
+
+class TestPrefetch:
+    def test_prefetch_order_and_parity(self, tmp_path):
+        from avenir_tpu.native.prefetch import PrefetchLoader
+        all_rows = churn_rows(900, seed=11)
+        shards = [all_rows[i::3] for i in range(3)]
+        fz = Featurizer(churn_schema()).fit(all_rows)
+        paths = [_write(tmp_path, s, name=f"part-{i}.csv")
+                 for i, s in enumerate(shards)]
+        tables = list(PrefetchLoader(fz, paths, depth=2))
+        assert len(tables) == 3
+        for shard, table in zip(shards, tables):
+            _assert_tables_equal(table, fz.transform(shard))
+
+    def test_prefetch_requires_fit(self):
+        from avenir_tpu.native.prefetch import PrefetchLoader
+        with pytest.raises(RuntimeError, match="fit"):
+            PrefetchLoader(Featurizer(churn_schema()), ["x.csv"])
+
+    def test_prefetch_empty(self):
+        from avenir_tpu.native.prefetch import PrefetchLoader
+        fz = Featurizer(churn_schema()).fit(churn_rows(10))
+        assert list(PrefetchLoader(fz, [])) == []
+
+
 class TestErrors:
     def test_unseen_categorical_errors(self, tmp_path):
         rows = churn_rows(50, seed=2)
