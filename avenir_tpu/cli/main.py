@@ -643,6 +643,32 @@ def run_fisher_discriminant(conf: JobConfig, in_path: str,
             model, conf.get("field.delim.out", ","))) + "\n")
 
 
+def run_projection(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Grouping/ordering projection (chombo ``org.chombo.mr.Projection`` —
+    the stage the email-marketing Markov tutorial runs to order each
+    customer's transactions by time, tutorial_opt_email_marketing.txt:66-76).
+    Honors the buyhist.properties keys: ``projection.operation``
+    (groupingOrdering), ``key.field``, ``orderBy.field``,
+    ``projection.field`` (comma list), ``format.compact``."""
+    from avenir_tpu.utils.projection import grouping_ordering
+    op = conf.get("projection.operation", "groupingOrdering")
+    if op != "groupingOrdering":
+        raise ValueError(f"unsupported projection.operation: {op}")
+    rows = read_csv_lines(in_path, conf.get("field.delim.regex", ","))
+    out = grouping_ordering(
+        rows,
+        key_field=conf.get_int("key.field", 0),
+        order_by_field=conf.get_int("orderBy.field", 1),
+        projection_fields=conf.get_int_list("projection.field", [1]),
+        compact=conf.get_bool("format.compact", True),
+        numeric_order=(conf.get_bool("orderBy.numeric")
+                       if conf.get("orderBy.numeric") is not None else None))
+    delim = conf.get("field.delim.out", ",")
+    with open(out_path, "w") as fh:
+        for row in out:
+            fh.write(delim.join(row) + "\n")
+
+
 def run_word_counter(conf: JobConfig, in_path: str, out_path: str) -> None:
     """Lucene-style word count (reference text.WordCounter MR): honors
     ``text.field.ordinal`` (< 0 means the whole line) and
@@ -657,6 +683,7 @@ def run_word_counter(conf: JobConfig, in_path: str, out_path: str) -> None:
 
 
 VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
+    "Projection": run_projection,
     "WordCounter": run_word_counter,
     "BayesianDistribution": run_bayesian_distribution,
     "BayesianPredictor": run_bayesian_predictor,

@@ -191,3 +191,45 @@ def validate(pred: np.ndarray, truth: Sequence[str],
     cm.update(jnp.asarray([index[p] for p in pred]),
               jnp.asarray([index[t] for t in truth]))
     return cm
+
+
+# --------------------------------------------------------------------------
+# transaction-history states + next-state prediction
+# (the email-marketing tutorial's pre/post stages, resource/xaction_state.rb
+# and resource/mark_plan.rb)
+# --------------------------------------------------------------------------
+
+#: the tutorial's 9 two-letter states: (days-gap S/M/L) x (amount L/E/G)
+XACTION_STATES = ["SL", "SE", "SG", "ML", "ME", "MG", "LL", "LE", "LG"]
+
+
+def transaction_states(history: Sequence[Tuple[int, float]]) -> List[str]:
+    """Encode one customer's ordered (day, amount) purchase history as the
+    tutorial's two-letter state sequence (resource/xaction_state.rb:12-45):
+    first letter = days since previous purchase (<30 S, <60 M, else L),
+    second = previous amount vs current (prev < 0.9*amt L, < 1.1*amt E,
+    else G). ``day`` is any absolute day number (date ordinal)."""
+    seq: List[str] = []
+    for (pr_day, pr_amt), (day, amt) in zip(history, history[1:]):
+        days_diff = day - pr_day
+        dd = "S" if days_diff < 30 else ("M" if days_diff < 60 else "L")
+        if pr_amt < 0.9 * amt:
+            ad = "L"
+        elif pr_amt < 1.1 * amt:
+            ad = "E"
+        else:
+            ad = "G"
+        seq.append(dd + ad)
+    return seq
+
+
+def next_states(model: MarkovModel, last_states: Sequence[str]) -> List[str]:
+    """Most likely next state per customer given their latest state — the
+    argmax over the state's transition row (resource/mark_plan.rb:75-81,
+    which the tutorial maps to the optimum marketing contact time)."""
+    if model.trans is None:
+        raise ValueError("next-state prediction needs a global model")
+    index = {s: i for i, s in enumerate(model.states)}
+    rows = jnp.asarray([index[s] for s in last_states], jnp.int32)
+    best = np.asarray(jnp.argmax(jnp.asarray(model.trans)[rows], axis=1))
+    return [model.states[i] for i in best]

@@ -144,7 +144,8 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
     # whitespace-only lines stay and fail featurization identically on
     # every path (single-host Python, native C++, multi-host)
     with open(path, "r") as fh:
-        lines = [ln for ln in fh.read().splitlines() if ln]
+        lines = [ln.rstrip("\n") for ln in fh]
+    lines = [ln for ln in lines if ln]
     n_real = len(lines)
     g = padded_rows(n_real, mesh, axis)
     start, stop = process_slice(g)

@@ -86,3 +86,34 @@ class TestLeadGenSimulator:
         # after decay the learner should exploit the known-best arm
         picks = [loop.learner.next_actions()[0] for _ in range(25)]
         assert max(set(picks), key=picks.count) == sim.best_action
+
+
+class TestBuyXaction:
+    """buy_xaction.rb-style purchase stream: amounts oscillate with the
+    planted recency rule, so the derived two-letter states carry signal."""
+
+    def test_row_shape_and_day_order(self):
+        from avenir_tpu.datagen.generators import buy_xaction_rows
+        rows = buy_xaction_rows(200, 120, 0.1, seed=3)
+        assert all(len(r) == 4 for r in rows)
+        days = [int(r[2]) for r in rows]
+        assert days == sorted(days)
+        assert 0 <= min(days) and max(days) < 120
+
+    def test_planted_amount_oscillation(self):
+        from avenir_tpu.datagen.generators import buy_xaction_rows
+        from avenir_tpu.models import markov as M
+        from avenir_tpu.utils.projection import grouping_ordering
+        rows = buy_xaction_rows(300, 200, 0.15, seed=4)
+        compact = grouping_ordering(rows, key_field=0, order_by_field=2,
+                                    projection_fields=[2, 3],
+                                    numeric_order=True)
+        letters = []
+        for line in compact:
+            hist = [(int(line[i]), float(line[i + 1]))
+                    for i in range(1, len(line), 2)]
+            letters += [s[1] for s in M.transaction_states(hist)]
+        # the generator's amount rule alternates low/high, so equal-amount
+        # (E) transitions are rare vs larger (L) / smaller (G)
+        assert letters.count("E") < letters.count("L")
+        assert letters.count("E") < letters.count("G")

@@ -171,3 +171,51 @@ class TestHmm:
         assert model.emit[0, 0] > model.emit[0, 1]  # S emits o1 more
         assert model.emit[1, 1] > model.emit[1, 0]  # T emits o2 more
         assert model.trans[0, 1] > model.trans[1, 0]
+
+
+class TestTransactionStates:
+    """The email-marketing tutorial's pre/post stages (xaction_state.rb /
+    mark_plan.rb semantics)."""
+
+    def test_state_coding(self):
+        # gaps: 10 (S), 40 (M), 70 (L); amounts: 100->200 (prev<0.9*amt: L),
+        # 200->210 (within 10%: E), 210->100 (prev>1.1*amt: G)
+        hist = [(0, 100), (10, 200), (50, 210), (120, 100)]
+        assert M.transaction_states(hist) == ["SL", "ME", "LG"]
+
+    def test_boundary_days(self):
+        hist = [(0, 100), (29, 100), (59 + 29, 100), (59 + 29 + 60, 100)]
+        assert [s[0] for s in M.transaction_states(hist)] == ["S", "M", "L"]
+
+    def test_next_states_argmax(self):
+        trans = np.zeros((9, 9))
+        trans[M.XACTION_STATES.index("SL"), M.XACTION_STATES.index("LG")] = 7
+        trans[M.XACTION_STATES.index("ME"), M.XACTION_STATES.index("SE")] = 5
+        model = M.MarkovModel(states=M.XACTION_STATES, scale=1, trans=trans)
+        assert M.next_states(model, ["SL", "ME"]) == ["LG", "SE"]
+
+    def test_next_states_needs_global_model(self):
+        model = M.MarkovModel(states=M.XACTION_STATES, scale=1,
+                              class_trans={"a": np.zeros((9, 9))})
+        with pytest.raises(ValueError):
+            M.next_states(model, ["SL"])
+
+
+class TestProjection:
+
+    def test_grouping_ordering_compact(self):
+        from avenir_tpu.utils.projection import grouping_ordering
+        rows = [["c1", "x1", "5", "30"],
+                ["c2", "x2", "1", "99"],
+                ["c1", "x3", "2", "70"]]
+        out = grouping_ordering(rows, key_field=0, order_by_field=2,
+                                projection_fields=[2, 3], compact=True,
+                                numeric_order=True)
+        assert out == [["c1", "2", "70", "5", "30"], ["c2", "1", "99"]]
+
+    def test_non_compact_keeps_group_order(self):
+        from avenir_tpu.utils.projection import grouping_ordering
+        rows = [["g", "b"], ["g", "a"], ["h", "c"]]
+        out = grouping_ordering(rows, key_field=0, order_by_field=1,
+                                projection_fields=[1], compact=False)
+        assert out == [["g", "a"], ["g", "b"], ["h", "c"]]
