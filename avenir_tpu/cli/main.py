@@ -550,7 +550,11 @@ def run_correlation(conf: JobConfig, in_path: str, out_path: str,
         ords = [f.ordinal for f in table.feature_fields if f.is_categorical]
         pairs = [(a, b) for i, a in enumerate(ords) for b in ords[i + 1:]]
     algo = conf.get("correlation.algorithm", default_stat)
-    out = C.correlate_pairs(table, pairs, algo)
+    try:
+        class_ordinal = fz.schema.find_class_attr_field().ordinal
+    except ValueError:
+        class_ordinal = None
+    out = C.correlate_pairs(table, pairs, algo, class_ordinal=class_ordinal)
     delim = conf.get("field.delim.out", ",")
     with open(out_path, "w") as fh:
         for (a, b), value in out.items():

@@ -84,7 +84,7 @@ _ELEARN_FIELDS = [
 ]
 
 
-def elearn_schema() -> FeatureSchema:
+def elearn_schema_json() -> Dict:
     fields = [{"name": "studentID", "ordinal": 0, "id": True,
                "dataType": "string"}]
     for i, (name, lo, hi) in enumerate(_ELEARN_FIELDS):
@@ -93,11 +93,15 @@ def elearn_schema() -> FeatureSchema:
     fields.append({"name": "status", "ordinal": len(_ELEARN_FIELDS) + 1,
                    "dataType": "categorical", "classAttribute": True,
                    "cardinality": ["pass", "fail"]})
-    return FeatureSchema.from_json({
+    return {
         "distAlgorithm": "euclidean",
         "numericDiffThreshold": 0.2,
         "entity": {"name": "studentActivity", "fields": fields},
-    })
+    }
+
+
+def elearn_schema() -> FeatureSchema:
+    return FeatureSchema.from_json(elearn_schema_json())
 
 
 def elearn_rows(n: int, seed: int = 7, fail_rate: float = 0.25

@@ -26,13 +26,6 @@ from avenir_tpu.ops.histogram import pair_counts
 from avenir_tpu.utils.dataset import EncodedTable
 
 
-def contingency(table: EncodedTable, src_pos: int, dst_pos: int) -> np.ndarray:
-    """[Bsrc, Bdst] counts for two (binned) feature columns."""
-    return np.asarray(pair_counts(
-        table.binned[:, src_pos], table.binned[:, dst_pos],
-        table.bins_per_feature[src_pos], table.bins_per_feature[dst_pos]))
-
-
 def cramer_index(counts: np.ndarray) -> float:
     total = counts.sum()
     if total == 0:
@@ -84,13 +77,34 @@ STAT_ALGORITHMS = {
 
 def correlate_pairs(table: EncodedTable,
                     pairs: List[Tuple[int, int]],
-                    algorithm: str = "cramerIndex"
+                    algorithm: str = "cramerIndex",
+                    class_ordinal: int = None
                     ) -> Dict[Tuple[int, int], float]:
     """Correlation stat for each (srcOrdinal, dstOrdinal) attribute pair —
-    the whole CramerCorrelation / HeterogeneityReductionCorrelation job."""
+    the whole CramerCorrelation / HeterogeneityReductionCorrelation job.
+
+    Either side of a pair may name the class attribute (pass its ordinal as
+    ``class_ordinal``): to the reference the class column is just another
+    categorical attribute, and the churn tutorial correlates each feature
+    against it (tutorial_customer_churn_cramer_index.txt)."""
     stat = STAT_ALGORITHMS[algorithm]
     pos = {f.ordinal: i for i, f in enumerate(table.feature_fields)}
+
+    def column(ordinal: int) -> Tuple[jnp.ndarray, int]:
+        if ordinal in pos:
+            p = pos[ordinal]
+            return table.binned[:, p], table.bins_per_feature[p]
+        if class_ordinal is not None and ordinal == class_ordinal:
+            if table.labels is None:
+                raise ValueError("class column requested but the table has "
+                                 "no labels")
+            return table.labels, table.n_classes
+        raise KeyError(f"ordinal {ordinal} is neither a feature field nor "
+                       "the class attribute")
+
     out = {}
     for src, dst in pairs:
-        out[(src, dst)] = float(stat(contingency(table, pos[src], pos[dst])))
+        (sc, sb), (dc, db) = column(src), column(dst)
+        out[(src, dst)] = float(stat(np.asarray(
+            pair_counts(sc, dc, sb, db))))
     return out

