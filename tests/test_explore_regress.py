@@ -1,5 +1,6 @@
 """Explore (MI, correlation, sampling) + logistic + Fisher."""
 
+import math
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -217,3 +218,47 @@ class TestFisher:
         model = fisher.train(table)
         # prior favors pos (class0 here) -> boundary moves toward neg mean
         assert model.boundary[0] < 6
+
+
+class TestSampleComplexity:
+    """comp_learn.py analogues, hand-computed values."""
+
+    def test_pac_bound(self):
+        from avenir_tpu.explore import samplecomplexity as sc
+        # m = ln(973/0.05)/0.1 = 98.76 -> 98
+        assert sc.pac_sample_bound(973, 0.1, 0.05) == 98
+        assert sc.pac_sample_bound_ln(math.log(973), 0.1, 0.05) == 98
+
+    def test_pac_bound_validation(self):
+        from avenir_tpu.explore import samplecomplexity as sc
+        with pytest.raises(ValueError):
+            sc.pac_sample_bound(10, 0.0, 0.05)
+        with pytest.raises(ValueError):
+            sc.pac_sample_bound_ln(5.0, 0.1, 0.0)
+
+    def test_sample_table_sweep(self):
+        from avenir_tpu.explore import samplecomplexity as sc
+        table = sc.sample_table(100, [0.1, 0.2], [0.05])
+        assert len(table) == 2
+        assert table[0][2] > table[1][2]  # tighter error needs more samples
+
+    def test_conjunctive_space(self):
+        from avenir_tpu.explore import samplecomplexity as sc
+        # (3+1)(4+1) * 2 classes = 40
+        assert sc.conjunctive_hypothesis_space([3, 4], 2) == 40
+
+    def test_value_combinations(self):
+        from avenir_tpu.explore import samplecomplexity as sc
+        # pairs over [2,3,4]: 2*3 + 2*4 + 3*4 = 26
+        assert sc.num_value_combinations([2, 3, 4], 2) == 26
+        # all features: product
+        assert sc.num_value_combinations([2, 3, 4], 3) == 24
+        with pytest.raises(ValueError):
+            sc.num_value_combinations([2, 3], 5)
+
+    def test_dnf_and_cnf_spaces(self):
+        from avenir_tpu.explore import samplecomplexity as sc
+        # C(26, 2) * 2 = 650
+        assert sc.k_term_dnf_hypothesis_space([2, 3, 4], 2, 2, 2) == 650
+        ln_h = sc.k_cnf_hypothesis_space_ln([2, 3, 4], 2, 2)
+        assert abs(ln_h - 27 * math.log(2)) < 1e-9
