@@ -25,19 +25,23 @@ def pac_sample_bound(num_hypotheses: float, error: float,
                      prob_threshold: float) -> int:
     """m >= (ln|H| + ln(1/p)) / e — samples for a consistent learner to be
     within ``error`` with confidence 1-``prob_threshold``
-    (comp_learn.py:11-16 ``numSamples``)."""
+    (comp_learn.py:11-16 ``numSamples``). DEVIATION (documented): the
+    reference truncates (``long(m)``), returning one sample short of its own
+    bound; this build rounds up so the guarantee actually holds."""
     if error <= 0 or prob_threshold <= 0 or num_hypotheses < 1:
         raise ValueError("error > 0, prob_threshold > 0, |H| >= 1 required")
-    return int(math.log(num_hypotheses / prob_threshold) / error)
+    return math.ceil(math.log(num_hypotheses / prob_threshold) / error)
 
 
 def pac_sample_bound_ln(ln_num_hypotheses: float, error: float,
                         prob_threshold: float) -> int:
     """Same bound when |H| is only available in log space (k-CNF spaces
-    overflow |H| — comp_learn.py:18-24 ``numSamplesWithLn``)."""
+    overflow |H| — comp_learn.py:18-24 ``numSamplesWithLn``; same
+    round-up deviation as :func:`pac_sample_bound`)."""
     if error <= 0 or prob_threshold <= 0:
         raise ValueError("error > 0 and prob_threshold > 0 required")
-    return int((ln_num_hypotheses + math.log(1.0 / prob_threshold)) / error)
+    return math.ceil(
+        (ln_num_hypotheses + math.log(1.0 / prob_threshold)) / error)
 
 
 def sample_table(num_hypotheses: float, errors: Sequence[float],

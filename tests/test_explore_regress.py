@@ -225,9 +225,9 @@ class TestSampleComplexity:
 
     def test_pac_bound(self):
         from avenir_tpu.explore import samplecomplexity as sc
-        # m = ln(973/0.05)/0.1 = 98.76 -> 98
-        assert sc.pac_sample_bound(973, 0.1, 0.05) == 98
-        assert sc.pac_sample_bound_ln(math.log(973), 0.1, 0.05) == 98
+        # m = ln(973/0.05)/0.1 = 98.76 -> ceil -> 99
+        assert sc.pac_sample_bound(973, 0.1, 0.05) == 99  # ceil(98.76)
+        assert sc.pac_sample_bound_ln(math.log(973), 0.1, 0.05) == 99
 
     def test_pac_bound_validation(self):
         from avenir_tpu.explore import samplecomplexity as sc
