@@ -187,16 +187,23 @@ def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
                 row_mask: Optional[jnp.ndarray] = None
                 ) -> List[CandidateSplit]:
     """Gains for every candidate split of every attribute, reference
-    semantics, one batched pass per attribute (chunked over splits)."""
+    semantics, one batched pass per attribute (chunked over splits).
+
+    Dispatch and readback are separated: every attribute's chunks are
+    enqueued before the first result is fetched, so the device pipelines a
+    whole level's kernels and the host pays one transfer latency per level,
+    not one per attribute (the relay to the chip adds ~150ms per blocking
+    fetch)."""
     if parent_info is None:
         parent_info = root_info(table, algorithm)
     ord_to_pos = {f.ordinal: i for i, f in enumerate(table.feature_fields)}
     info_alg = algorithm in ("entropy", "giniIndex")
-    out: List[CandidateSplit] = []
 
+    pending = []             # (attr, keys, [device stat chunks], [intr chunks])
     for attr in attr_ordinals:
         pos = ord_to_pos[attr]
         f = table.feature_fields[pos]
+        stats_l, intr_l = [], []
         if f.is_categorical:
             card = f.cardinality or table.bin_labels[pos]
             groups_list = enumerate_categorical_splits(
@@ -211,14 +218,12 @@ def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
                         if v in vocab:
                             lookup[s, vocab[v]] = gi
             codes = table.binned[:, pos]
-            stats_l, intr_l = [], []
             for c0 in range(0, len(groups_list), _SPLIT_CHUNK):
                 st, ii = _categorical_split_counts(
                     codes, table.labels, jnp.asarray(lookup[c0:c0 + _SPLIT_CHUNK]),
                     n_seg, table.n_classes, algorithm, row_mask)
-                stats_l.append(np.asarray(st))
-                intr_l.append(np.asarray(ii))
-            stats, intrinsic = np.concatenate(stats_l), np.concatenate(intr_l)
+                stats_l.append(st)
+                intr_l.append(ii)
         else:
             splits = enumerate_numeric_splits(f)
             keys = [numeric_split_key(p) for p in splits]
@@ -227,15 +232,32 @@ def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
             for s, p in enumerate(splits):
                 pts[s, :len(p)] = p
             values = table.numeric[:, pos]
-            stats_l, intr_l = [], []
             for c0 in range(0, len(splits), _SPLIT_CHUNK):
                 st, ii = _numeric_split_counts(
                     values, table.labels, jnp.asarray(pts[c0:c0 + _SPLIT_CHUNK]),
                     max_pts + 1, table.n_classes, algorithm, row_mask)
-                stats_l.append(np.asarray(st))
-                intr_l.append(np.asarray(ii))
-            stats, intrinsic = np.concatenate(stats_l), np.concatenate(intr_l)
+                stats_l.append(st)
+                intr_l.append(ii)
+        pending.append((attr, keys, stats_l, intr_l))
 
+    if not pending:
+        return []
+    # one device-side concat + ONE host fetch for the whole level
+    all_stats = [c for (_, _, s, _) in pending for c in s]
+    all_intr = [c for (_, _, _, ii) in pending for c in ii]
+    fetched = np.asarray(jnp.concatenate(
+        [jnp.concatenate(all_stats).astype(jnp.float32),
+         jnp.concatenate(all_intr).astype(jnp.float32)]))
+    half = fetched.shape[0] // 2
+    stats_flat, intr_flat = fetched[:half], fetched[half:]
+
+    out: List[CandidateSplit] = []
+    cursor = 0
+    for attr, keys, stats_l, intr_l in pending:
+        n = len(keys)
+        stats = stats_flat[cursor:cursor + n]
+        intrinsic = intr_flat[cursor:cursor + n]
+        cursor += n
         for key, stat, intr in zip(keys, stats, intrinsic):
             if info_alg:
                 gain = parent_info - float(stat)

@@ -180,9 +180,46 @@ def bench_bandit_decisions() -> None:
          f"decisions/sec (softMax, {n_actions} arms, on-device loop)")
 
 
+def bench_grouped_bandit_decisions() -> None:
+    """Multi-context throughput (ReinforcementLearnerGroup / Storm bolt
+    parallelism): one decision per context per step, contexts vmapped —
+    the price-opt tutorial's 100 products become one stacked state."""
+    from avenir_tpu.models.bandits.learners import (
+        ALGORITHMS, LearnerConfig)
+    cfg = LearnerConfig(temp_constant=50.0)
+    algo = ALGORITHMS["softMax"]
+    n_actions, n_groups = 12, 4096
+    rng = np.random.default_rng(0)
+    arm_rewards = jnp.asarray(rng.uniform(10, 100, (n_groups, n_actions)),
+                              jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(0), n_groups)
+    states0 = jax.vmap(lambda k: algo.init(k, n_actions, cfg))(keys)
+    n_steps = 500
+
+    @jax.jit
+    def chain(states):
+        def body(st, _):
+            st, actions = jax.vmap(
+                lambda s: algo.next_action(s, cfg))(st)
+            rewards = jnp.take_along_axis(
+                arm_rewards, actions[:, None], axis=1)[:, 0]
+            st = jax.vmap(
+                lambda s, a, r: algo.set_reward(s, a, r, cfg=cfg)
+            )(st, actions, rewards)
+            return st, actions[0]
+        _, outs = jax.lax.scan(body, states, None, length=n_steps)
+        return outs
+
+    elapsed = timed(chain, states0)
+    emit("bandit_grouped_decisions_per_sec",
+         n_groups * n_steps / elapsed,
+         f"decisions/sec ({n_groups} contexts x {n_actions} arms, vmapped)")
+
+
 if __name__ == "__main__":
     bench_naive_bayes()
     bench_knn()
     bench_tree_split_gain()
     bench_markov_train()
     bench_bandit_decisions()
+    bench_grouped_bandit_decisions()
