@@ -49,6 +49,23 @@ def _gradient_kernel(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray
     return x.T @ (y - jax.nn.sigmoid(logits))
 
 
+_ITER_CHUNK = 16   # gradient steps per device dispatch
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _train_chunk(x: jnp.ndarray, y: jnp.ndarray, w0: jnp.ndarray,
+                 step_scale: jnp.ndarray, n_steps: int) -> jnp.ndarray:
+    """n_steps ascent iterations in one dispatch; returns the [n_steps, D]
+    coefficient trajectory so the host can append every iteration to the
+    history file and apply the per-iteration convergence tests — one
+    device round-trip per chunk instead of per iteration."""
+    def body(w, _):
+        w = w + step_scale * _gradient_kernel(x, y, w)
+        return w, w
+    _, traj = jax.lax.scan(body, w0, None, length=n_steps)
+    return traj
+
+
 def _coeff_diff_percent(new: np.ndarray, old: np.ndarray) -> np.ndarray:
     """|new − old|·100/|old| (LogisticRegressor.setCoefficientDiff :107-113)."""
     denom = np.where(np.abs(old) > 1e-12, np.abs(old), 1e-12)
@@ -105,19 +122,22 @@ def train(x: jnp.ndarray, y: jnp.ndarray, cfg: LogisticConfig,
     if coeff_file_path:
         w, start_iter = load_coefficients(coeff_file_path, d)
 
+    step_scale = jnp.asarray(cfg.learning_rate / n, jnp.float32)
     is_converged = False
     it = start_iter
-    while it < cfg.max_iterations:
-        grad = np.asarray(_gradient_kernel(xp, yp, jnp.asarray(w, jnp.float32)))
-        new_w = w + cfg.learning_rate * grad / n
-        it += 1
-        if coeff_file_path:
-            append_coefficients(coeff_file_path, new_w)
-        if it > 1 and converged(new_w, w, cfg):
+    while it < cfg.max_iterations and not is_converged:
+        k = min(_ITER_CHUNK, cfg.max_iterations - it)
+        traj = np.asarray(_train_chunk(
+            xp, yp, jnp.asarray(w, jnp.float32), step_scale, k))
+        for new_w in traj:
+            it += 1
+            if coeff_file_path:
+                append_coefficients(coeff_file_path, new_w)
+            if it > 1 and converged(new_w, w, cfg):
+                w = new_w
+                is_converged = True
+                break
             w = new_w
-            is_converged = True
-            break
-        w = new_w
     return w, it, is_converged
 
 

@@ -253,7 +253,7 @@ def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
 
     out: List[CandidateSplit] = []
     cursor = 0
-    for attr, keys, stats_l, intr_l in pending:
+    for attr, keys, _, _ in pending:
         n = len(keys)
         stats = stats_flat[cursor:cursor + n]
         intrinsic = intr_flat[cursor:cursor + n]
