@@ -654,23 +654,20 @@ def run_projection(conf: JobConfig, in_path: str, out_path: str) -> None:
     Honors the buyhist.properties keys: ``projection.operation``
     (groupingOrdering), ``key.field``, ``orderBy.field``,
     ``projection.field`` (comma list), ``format.compact``."""
-    from avenir_tpu.utils.projection import grouping_ordering
+    from avenir_tpu.utils.projection import project_file
     op = conf.get("projection.operation", "groupingOrdering")
     if op != "groupingOrdering":
         raise ValueError(f"unsupported projection.operation: {op}")
-    rows = read_csv_lines(in_path, conf.get("field.delim.regex", ","))
-    out = grouping_ordering(
-        rows,
+    project_file(
+        in_path, out_path,
         key_field=conf.get_int("key.field", 0),
         order_by_field=conf.get_int("orderBy.field", 1),
         projection_fields=conf.get_int_list("projection.field", [1]),
         compact=conf.get_bool("format.compact", True),
         numeric_order=(conf.get_bool("orderBy.numeric")
-                       if conf.get("orderBy.numeric") is not None else None))
-    delim = conf.get("field.delim.out", ",")
-    with open(out_path, "w") as fh:
-        for row in out:
-            fh.write(delim.join(row) + "\n")
+                       if conf.get("orderBy.numeric") is not None else None),
+        delim_regex=conf.get("field.delim.regex", ","),
+        delim_out=conf.get("field.delim.out", ","))
 
 
 def run_word_counter(conf: JobConfig, in_path: str, out_path: str) -> None:

@@ -105,6 +105,20 @@ def _open_and_register():
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
         lib.avt_free.restype = None
         lib.avt_free.argtypes = [ctypes.c_void_p]
+        lib.avt_project.restype = ctypes.c_void_p
+        lib.avt_project.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32]
+        lib.avt_project_size.restype = ctypes.c_int64
+        lib.avt_project_size.argtypes = [ctypes.c_void_p]
+        lib.avt_project_error.restype = ctypes.c_char_p
+        lib.avt_project_error.argtypes = [ctypes.c_void_p]
+        lib.avt_project_copy.restype = None
+        lib.avt_project_copy.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.avt_project_free.restype = None
+        lib.avt_project_free.argtypes = [ctypes.c_void_p]
         return lib
     except OSError as exc:
         _build_error = f"dlopen failed: {exc}"

@@ -16,6 +16,19 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 
+def _parse_number(tok: str) -> Optional[float]:
+    """Plain decimal floats only — the ONE number grammar both the Python
+    and native (strtod-based) paths accept identically. Python ``float``
+    extras (underscore digit separators) and strtod extras (hex floats) are
+    rejected so ordering never depends on which path ran."""
+    if not tok or len(tok) >= 64 or any(c in tok for c in "xX_"):
+        return None
+    try:
+        return float(tok)
+    except ValueError:
+        return None
+
+
 def grouping_ordering(rows: Sequence[Sequence[str]], key_field: int,
                       order_by_field: int,
                       projection_fields: Sequence[int],
@@ -45,17 +58,18 @@ def grouping_ordering(rows: Sequence[Sequence[str]], key_field: int,
         groups[key].append(row)
 
     if numeric_order is None:
-        def parses(v: str) -> bool:
-            try:
-                float(v)
-                return True
-            except ValueError:
-                return False
-        numeric_order = all(parses(r[order_by_field]) for r in rows)
+        numeric_order = all(
+            _parse_number(r[order_by_field]) is not None for r in rows)
 
     def sort_key(row: Sequence[str]):
         v = row[order_by_field]
-        return float(v) if numeric_order else v
+        if not numeric_order:
+            return v
+        num = _parse_number(v)
+        if num is None:
+            raise ValueError(f"numeric ordering requested but order-by "
+                             f"token {v!r} is not a plain decimal number")
+        return num
 
     out: List[List[str]] = []
     for key in order:
@@ -69,3 +83,55 @@ def grouping_ordering(rows: Sequence[Sequence[str]], key_field: int,
             for row in members:
                 out.append([key] + [row[f] for f in projection_fields])
     return out
+
+
+def project_file(in_path: str, out_path: str, key_field: int,
+                 order_by_field: int, projection_fields: Sequence[int],
+                 compact: bool = True, numeric_order: Optional[bool] = None,
+                 delim_regex: str = ",", delim_out: str = ",",
+                 force_python: bool = False) -> None:
+    """File-to-file projection: the native C++ pass (``avt_project``) when
+    the delimiters allow it, else ``grouping_ordering`` over
+    ``read_csv_lines`` with identical output.
+
+    When the in/out delimiters are the same single character, BOTH paths
+    join output fields with that character (so a ``\\t`` delimiter regex
+    produces real tabs whether or not a compiler is available)."""
+    from avenir_tpu.native.loader import _single_char_delim
+    delim = _single_char_delim(delim_regex) if delim_out == delim_regex \
+        else None
+    if delim is not None:
+        delim_out = delim
+    if not force_python and delim is not None:
+        from avenir_tpu import native
+        lib = native._load()
+        if lib is not None:
+            import ctypes
+            with open(in_path, "rb") as fh:
+                buf = fh.read()
+            proj = (ctypes.c_int32 * len(projection_fields))(
+                *projection_fields)
+            mode = -1 if numeric_order is None else int(numeric_order)
+            handle = lib.avt_project(buf, len(buf), delim.encode(),
+                                     key_field, order_by_field,
+                                     proj, len(projection_fields),
+                                     int(compact), mode)
+            try:
+                size = lib.avt_project_size(handle)
+                if size < 0:
+                    raise ValueError("native projection: " +
+                                     lib.avt_project_error(handle).decode())
+                out = ctypes.create_string_buffer(size)
+                lib.avt_project_copy(handle, out)
+                with open(out_path, "wb") as fh:
+                    fh.write(out.raw[:size])
+            finally:
+                lib.avt_project_free(handle)
+            return
+    from avenir_tpu.utils.dataset import read_csv_lines
+    rows = grouping_ordering(
+        read_csv_lines(in_path, delim_regex), key_field, order_by_field,
+        projection_fields, compact, numeric_order)
+    with open(out_path, "w") as fh:
+        for row in rows:
+            fh.write(delim_out.join(row) + "\n")

@@ -425,3 +425,159 @@ void avt_fill(void* handle, int32_t* binned, float* numeric,
 void avt_free(void* handle) { delete static_cast<Table*>(handle); }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// avt_project: grouping/ordering projection (chombo org.chombo.mr.Projection,
+// the transaction-sequencing stage of the email-marketing tutorial). Groups
+// rows by key_field preserving first-seen group order, stable-sorts each
+// group by order_field (lexicographic or numeric; numeric_mode -1 auto
+// detects: numeric iff every order token parses), and emits either one
+// compact line per key (key, proj fields of each member in order) or one
+// line per row. Mirrors avenir_tpu/utils/projection.py grouping_ordering
+// exactly (tokens trimmed, empty lines skipped); tests assert parity.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Projection {
+  std::string out;
+  std::string error;
+};
+
+// Plain decimal floats only — mirrors _parse_number in
+// avenir_tpu/utils/projection.py so numeric detection and ordering are
+// identical across the native and Python paths: no strtod hex floats, no
+// Python underscore separators, token length < 64.
+bool parse_number_strict(std::string_view tok, double* out) {
+  if (tok.empty() || tok.size() >= 64) return false;
+  for (char c : tok)
+    if (c == 'x' || c == 'X' || c == '_') return false;
+  return parse_double(tok, out);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* avt_project(const char* buf, int64_t len, char delim,
+                  int32_t key_field, int32_t order_field,
+                  const int32_t* proj_fields, int32_t n_proj,
+                  int32_t compact, int32_t numeric_mode) {
+  auto* p = new Projection();
+  int32_t max_field = std::max(key_field, order_field);
+  for (int32_t i = 0; i < n_proj; ++i)
+    max_field = std::max(max_field, proj_fields[i]);
+
+  struct Row {
+    std::string_view order_tok;
+    double order_num = 0.0;
+    std::vector<std::string_view> proj;
+  };
+  std::vector<std::string> group_order;
+  std::unordered_map<std::string, std::vector<Row>> groups;
+  bool all_numeric = true;
+
+  std::vector<std::string_view> fields;
+  int64_t line_no = 0;
+  for (int64_t pos = 0; pos < len;) {
+    int64_t eol, next;
+    next_line(buf, len, pos, &eol, &next);
+    int64_t begin = pos;
+    pos = next;
+    if (eol == begin) continue;          // empty line (read_csv_lines filter)
+    ++line_no;
+    fields.clear();
+    int64_t f0 = begin;
+    for (int64_t q = begin; q <= eol; ++q) {
+      if (q == eol || buf[q] == delim) {
+        fields.push_back(trim(buf + f0, buf + q));
+        f0 = q + 1;
+      }
+    }
+    if (static_cast<int64_t>(fields.size()) <= max_field) {
+      char msg[128];
+      std::snprintf(msg, sizeof(msg),
+                    "line %lld has %zu fields, need at least %d",
+                    static_cast<long long>(line_no), fields.size(),
+                    max_field + 1);
+      p->error = msg;
+      return p;
+    }
+    Row r;
+    r.order_tok = fields[static_cast<size_t>(order_field)];
+    if (all_numeric && !parse_number_strict(r.order_tok, &r.order_num))
+      all_numeric = false;
+    r.proj.reserve(static_cast<size_t>(n_proj));
+    for (int32_t i = 0; i < n_proj; ++i)
+      r.proj.push_back(fields[static_cast<size_t>(proj_fields[i])]);
+    std::string key(fields[static_cast<size_t>(key_field)]);
+    auto it = groups.find(key);
+    if (it == groups.end()) {
+      group_order.push_back(key);
+      it = groups.emplace(std::move(key), std::vector<Row>()).first;
+    }
+    it->second.push_back(std::move(r));
+  }
+
+  bool numeric = numeric_mode == 1 || (numeric_mode == -1 && all_numeric);
+  if (numeric && !all_numeric) {
+    p->error = "numeric ordering requested but an order-by token is not "
+               "numeric";
+    return p;
+  }
+  for (const std::string& key : group_order) {
+    std::vector<Row>& rows = groups[key];
+    if (numeric) {
+      // recompute: auto-detection may have stopped parsing mid-file
+      for (Row& r : rows) parse_number_strict(r.order_tok, &r.order_num);
+      std::stable_sort(rows.begin(), rows.end(),
+                       [](const Row& a, const Row& b) {
+                         return a.order_num < b.order_num;
+                       });
+    } else {
+      std::stable_sort(rows.begin(), rows.end(),
+                       [](const Row& a, const Row& b) {
+                         return a.order_tok < b.order_tok;
+                       });
+    }
+    if (compact) {
+      p->out.append(key);
+      for (const Row& r : rows)
+        for (const std::string_view& v : r.proj) {
+          p->out.push_back(delim);
+          p->out.append(v);
+        }
+      p->out.push_back('\n');
+    } else {
+      for (const Row& r : rows) {
+        p->out.append(key);
+        for (const std::string_view& v : r.proj) {
+          p->out.push_back(delim);
+          p->out.append(v);
+        }
+        p->out.push_back('\n');
+      }
+    }
+  }
+  return p;
+}
+
+int64_t avt_project_size(void* handle) {
+  auto* p = static_cast<Projection*>(handle);
+  return p->error.empty() ? static_cast<int64_t>(p->out.size()) : -1;
+}
+
+const char* avt_project_error(void* handle) {
+  return static_cast<Projection*>(handle)->error.c_str();
+}
+
+void avt_project_copy(void* handle, char* out) {
+  auto* p = static_cast<Projection*>(handle);
+  std::memcpy(out, p->out.data(), p->out.size());
+}
+
+void avt_project_free(void* handle) {
+  delete static_cast<Projection*>(handle);
+}
+
+}  // extern "C"

@@ -202,3 +202,49 @@ class TestErrors:
         # transform_file silently falls back
         table = transform_file(fz, path, delim_regex=",+")
         assert table.n_rows == 30
+
+
+class TestNativeProjection:
+    """avt_project parity with the Python grouping_ordering path."""
+
+    def _write(self, tmp_path, rows):
+        p = tmp_path / "in.csv"
+        p.write_text("\n".join(",".join(r) for r in rows) + "\n")
+        return str(p)
+
+    def test_parity_on_transactions(self, tmp_path):
+        from avenir_tpu.datagen.generators import buy_xaction_rows
+        from avenir_tpu.utils.projection import project_file
+        rows = buy_xaction_rows(150, 90, 0.2, seed=6)
+        src = self._write(tmp_path, rows)
+        out_native = str(tmp_path / "native.txt")
+        out_python = str(tmp_path / "python.txt")
+        project_file(src, out_native, 0, 2, [2, 3])
+        project_file(src, out_python, 0, 2, [2, 3], force_python=True)
+        assert open(out_native).read() == open(out_python).read()
+
+    def test_parity_lexicographic_and_noncompact(self, tmp_path):
+        from avenir_tpu.utils.projection import project_file
+        rows = [["g1", "x", "b", "9"], ["g2", "y", "a", "8"],
+                ["g1", "z", "a", "7"]]
+        src = self._write(tmp_path, rows)
+        for compact in (True, False):
+            a = str(tmp_path / f"n{compact}.txt")
+            b = str(tmp_path / f"p{compact}.txt")
+            project_file(src, a, 0, 2, [3], compact=compact)
+            project_file(src, b, 0, 2, [3], compact=compact,
+                         force_python=True)
+            assert open(a).read() == open(b).read()
+
+    def test_short_row_error(self, tmp_path):
+        from avenir_tpu.utils.projection import project_file
+        src = self._write(tmp_path, [["a", "1", "2"], ["b", "1"]])
+        with pytest.raises((ValueError, IndexError)):
+            project_file(src, str(tmp_path / "o.txt"), 0, 1, [2])
+
+    def test_forced_numeric_rejects_text(self, tmp_path):
+        from avenir_tpu.utils.projection import project_file
+        src = self._write(tmp_path, [["a", "x", "2"]])
+        with pytest.raises(ValueError):
+            project_file(src, str(tmp_path / "o.txt"), 0, 1, [2],
+                         numeric_order=True)
