@@ -31,7 +31,11 @@ class NativeUnavailable(RuntimeError):
 
 
 def _single_char_delim(delim_regex: str) -> Optional[str]:
-    if len(delim_regex) == 1 and delim_regex not in r".^$*+?{}[]\|()":
+    """The literal single-BYTE delimiter a regex denotes, or None. Multi-byte
+    (non-ASCII) characters return None: the native splitters compare one
+    byte, so those inputs must take the Python path."""
+    if (len(delim_regex) == 1 and delim_regex not in r".^$*+?{}[]\|()"
+            and len(delim_regex.encode()) == 1):
         return delim_regex
     if delim_regex == r"\t":
         return "\t"

@@ -16,12 +16,17 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 
+_NUMBER_CHARS = frozenset("0123456789+-.eE")
+
+
 def _parse_number(tok: str) -> Optional[float]:
     """Plain decimal floats only — the ONE number grammar both the Python
-    and native (strtod-based) paths accept identically. Python ``float``
-    extras (underscore digit separators) and strtod extras (hex floats) are
-    rejected so ordering never depends on which path ran."""
-    if not tok or len(tok) >= 64 or any(c in tok for c in "xX_"):
+    and native (strtod-based) paths accept identically: digits, sign,
+    point, exponent. Python ``float`` extras (underscore separators, nan,
+    inf) and strtod extras (hex floats, NAN(seq)) are all rejected so
+    ordering never depends on which path ran, and the sort comparator never
+    sees a NaN (which would break strict weak ordering)."""
+    if not tok or len(tok) >= 64 or not all(c in _NUMBER_CHARS for c in tok):
         return None
     try:
         return float(tok)
@@ -96,13 +101,21 @@ def project_file(in_path: str, out_path: str, key_field: int,
 
     When the in/out delimiters are the same single character, BOTH paths
     join output fields with that character (so a ``\\t`` delimiter regex
-    produces real tabs whether or not a compiler is available)."""
+    produces real tabs whether or not a compiler is available). Negative
+    field indices always take the Python path (Python-style indexing).
+
+    Known trim divergence (documented): the native path trims ASCII
+    whitespace from tokens; the Python path trims Unicode whitespace
+    (``str.strip``). Data whose tokens are padded with non-ASCII whitespace
+    (e.g. NBSP) groups differently per path."""
     from avenir_tpu.native.loader import _single_char_delim
     delim = _single_char_delim(delim_regex) if delim_out == delim_regex \
         else None
     if delim is not None:
         delim_out = delim
-    if not force_python and delim is not None:
+    has_negative = (key_field < 0 or order_by_field < 0
+                    or any(f < 0 for f in projection_fields))
+    if not force_python and delim is not None and not has_negative:
         from avenir_tpu import native
         lib = native._load()
         if lib is not None:

@@ -446,12 +446,17 @@ struct Projection {
 
 // Plain decimal floats only — mirrors _parse_number in
 // avenir_tpu/utils/projection.py so numeric detection and ordering are
-// identical across the native and Python paths: no strtod hex floats, no
-// Python underscore separators, token length < 64.
+// identical across the native and Python paths: digits, sign, point,
+// exponent; token length < 64. Excludes strtod's hex floats and NAN(seq),
+// Python's underscore separators, and nan/inf (a NaN in the sort
+// comparator would violate strict weak ordering — UB in stable_sort).
 bool parse_number_strict(std::string_view tok, double* out) {
   if (tok.empty() || tok.size() >= 64) return false;
-  for (char c : tok)
-    if (c == 'x' || c == 'X' || c == '_') return false;
+  for (char c : tok) {
+    bool ok = (c >= '0' && c <= '9') || c == '+' || c == '-' || c == '.' ||
+              c == 'e' || c == 'E';
+    if (!ok) return false;
+  }
   return parse_double(tok, out);
 }
 
@@ -464,9 +469,19 @@ void* avt_project(const char* buf, int64_t len, char delim,
                   const int32_t* proj_fields, int32_t n_proj,
                   int32_t compact, int32_t numeric_mode) {
   auto* p = new Projection();
+  int32_t min_field = std::min(key_field, order_field);
   int32_t max_field = std::max(key_field, order_field);
-  for (int32_t i = 0; i < n_proj; ++i)
+  for (int32_t i = 0; i < n_proj; ++i) {
+    min_field = std::min(min_field, proj_fields[i]);
     max_field = std::max(max_field, proj_fields[i]);
+  }
+  if (min_field < 0) {
+    // Python-style negative indexing is the wrapper's job (it routes such
+    // calls to the Python path); reaching here with one is a caller bug
+    p->error = "negative field indices are not supported by the native "
+               "projection";
+    return p;
+  }
 
   struct Row {
     std::string_view order_tok;

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# The reference's L4 pipeline driver (resource/knn.sh) on avenir-tpu: same
+# bash verbs chaining jobs through directories — except the TPU backend
+# fuses the three middle jobs (bayesianDistr / bayesianPredictor /
+# joinFeatureDistr) into the NearestNeighbor kernel, so they are no-op
+# aliases kept for script compatibility.
+#
+# Usage: PROJECT_HOME=/path/to/work ./knn.sh <verb>
+#   computeDistance : pairwise scaled-int distance matrix (SameTypeSimilarity)
+#   bayesianDistr   : no-op (fused into knnClassifier; kept for compatibility)
+#   bayesianPredictor: no-op (fused)
+#   joinFeatureDistr: no-op (fused)
+#   knnClassifier   : fused distance + top-K + kernel vote classification
+#
+# Expects under $PROJECT_HOME: test.csv, train.csv, knn.properties (with
+# feature.schema.file.path and train.data.path set).
+
+set -euo pipefail
+
+PROJECT_HOME=${PROJECT_HOME:-.}
+PROPS=$PROJECT_HOME/knn.properties
+AVENIR="python -m avenir_tpu"
+
+case "${1:-}" in
+computeDistance)
+    echo "computing pairwise distances"
+    $AVENIR SameTypeSimilarity "$PROJECT_HOME/train.csv" \
+        "$PROJECT_HOME/distance/part-00000" --conf "$PROPS"
+    ;;
+bayesianDistr|bayesianPredictor|joinFeatureDistr)
+    echo "$1: fused into knnClassifier on the TPU backend (no separate job);"
+    echo "enable class.condition.weighted=true in knn.properties instead"
+    ;;
+knnClassifier)
+    echo "running knn classifier"
+    $AVENIR NearestNeighbor "$PROJECT_HOME/test.csv" \
+        "$PROJECT_HOME/output/part-00000" --conf "$PROPS"
+    ;;
+*)
+    echo "usage: $0 {computeDistance|bayesianDistr|bayesianPredictor|joinFeatureDistr|knnClassifier}" >&2
+    exit 1
+    ;;
+esac

@@ -248,3 +248,37 @@ class TestNativeProjection:
         with pytest.raises(ValueError):
             project_file(src, str(tmp_path / "o.txt"), 0, 1, [2],
                          numeric_order=True)
+
+
+class TestProjectionGrammarParity:
+    """The strict number grammar and index handling match across paths."""
+
+    def _run_both(self, tmp_path, content, fields=(0, 1, [2])):
+        from avenir_tpu.utils.projection import project_file
+        src = tmp_path / "in.csv"
+        src.write_text(content)
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        k, o, p = fields
+        project_file(str(src), a, k, o, p)
+        project_file(str(src), b, k, o, p, force_python=True)
+        return open(a).read(), open(b).read()
+
+    def test_nan_and_strtod_extensions_sort_lexicographic(self, tmp_path):
+        for col in ("nan", "nan(123)", "inf", "0x1A", "1_0"):
+            a, b = self._run_both(tmp_path,
+                                  f"g,{col},p\ng,2,q\ng,1,r\n",
+                                  fields=(0, 1, [2]))
+            assert a == b, f"divergence for order token {col!r}"
+
+    def test_negative_projection_field_uses_python_semantics(self, tmp_path):
+        a, b = self._run_both(tmp_path, "g,1,x\ng,2,y\n", fields=(0, 1, [-1]))
+        assert a == b == "g,x,y\n"
+
+    def test_multibyte_delimiter_falls_back(self, tmp_path):
+        from avenir_tpu.utils.projection import project_file
+        src = tmp_path / "in.csv"
+        src.write_text("g¦1¦x\ng¦2¦y\n")
+        out = str(tmp_path / "o")
+        project_file(str(src), out, 0, 1, [2],
+                     delim_regex="¦", delim_out="¦")
+        assert open(out).read() == "g¦x¦y\n"

@@ -464,3 +464,37 @@ class TestLeadGenOnlineRlTutorial:
             out = [l.split(",") for l in fh.read().splitlines()]
         assert len(out) == 120
         assert all(o[1] in sim.actions for o in out)
+
+
+class TestKnnShellDriver:
+    """scripts/knn.sh keeps the reference's L4 bash-verb contract."""
+
+    def test_pipeline_verbs(self, tmp_path):
+        import subprocess
+        import sys
+        rows = G.elearn_rows(120, seed=12)
+        write_csv(tmp_path / "train.csv", rows[:100])
+        write_csv(tmp_path / "test.csv", rows[100:])
+        with open(tmp_path / "elearn.json", "w") as fh:
+            json.dump(G.elearn_schema_json(), fh)
+        write_props(tmp_path / "knn.properties",
+                    **{"feature.schema.file.path": "elearn.json",
+                       "train.data.path": "train.csv",
+                       "top.match.count": "3"})
+        (tmp_path / "distance").mkdir()
+        (tmp_path / "output").mkdir()
+        script = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "knn.sh")
+        env = dict(os.environ, PROJECT_HOME=str(tmp_path),
+                   PYTHONPATH=os.pathsep.join(sys.path))
+        for verb in ("computeDistance", "bayesianDistr", "knnClassifier"):
+            proc = subprocess.run(["bash", script, verb], env=env,
+                                  cwd=tmp_path, capture_output=True,
+                                  text=True, timeout=300)
+            assert proc.returncode == 0, proc.stderr
+        assert (tmp_path / "distance" / "part-00000").exists()
+        n_out = len(open(tmp_path / "output" / "part-00000").readlines())
+        assert n_out == 20
+        bad = subprocess.run(["bash", script, "nope"], env=env,
+                             capture_output=True, text=True)
+        assert bad.returncode == 1
