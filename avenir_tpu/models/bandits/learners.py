@@ -578,12 +578,85 @@ class Learner:
         self._reward = jax.jit(
             lambda s, a, r: self.algo.set_reward(s, a, r, cfg=cfg))
 
+        # masked scans: N sequential decisions (or reward folds) in ONE
+        # device dispatch — identical ops to N host calls, minus N-1
+        # round-trips. `active` pads each call up to a bucket length so a
+        # handful of compiled variants serve every batch size.
+        def _select_many(s, active):
+            def body(st, a):
+                def do(st):
+                    st2, action = self.algo.next_action(st, cfg)
+                    return st2, action.astype(jnp.int32)
+                def skip(st):
+                    return st, jnp.asarray(-1, jnp.int32)
+                return jax.lax.cond(a, do, skip, st)
+            return jax.lax.scan(body, s, active)
+        self._select_many = jax.jit(_select_many)
+
+        def _reward_many(s, idx, rew, active):
+            def body(st, xs):
+                i, r, a = xs
+                return jax.lax.cond(
+                    a, lambda st: self.algo.set_reward(st, i, r, cfg=cfg),
+                    lambda st: st, st), None
+            return jax.lax.scan(body, s, (idx, rew, active))[0]
+        self._reward_many = jax.jit(_reward_many)
+
+    _SCAN_BUCKET_MAX = 64
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, Learner._SCAN_BUCKET_MAX)
+
     def next_action(self) -> str:
         self.state, action = self._next(self.state)
         return self.actions[int(action)]
 
     def next_actions(self):
         return [self.next_action() for _ in range(self.cfg.batch_size)]
+
+    def next_action_batch(self, n: int):
+        """n sequential decisions, one device dispatch per <=64-step bucket
+        (same results as n ``next_action`` calls)."""
+        import numpy as np
+        out = []
+        while n > 0:
+            take = min(n, self._SCAN_BUCKET_MAX)
+            b = self._bucket(take)
+            active = np.zeros(b, bool)
+            active[:take] = True
+            self.state, actions = self._select_many(self.state,
+                                                    jnp.asarray(active))
+            out.extend(self.actions[int(a)]
+                       for a in np.asarray(actions)[:take])
+            n -= take
+        return out
+
+    def set_reward_batch(self, pairs) -> None:
+        """Fold (action_id, reward) pairs in order, bucketed dispatches.
+        All pairs are validated BEFORE any state mutates, so a bad
+        action_id raises with the learner state untouched (the same
+        all-or-nothing behavior per pair the scalar path has per call)."""
+        import numpy as np
+        resolved = [(self.actions.index(a), float(r)) for a, r in pairs]
+        pos = 0
+        while pos < len(resolved):
+            chunk = resolved[pos:pos + self._SCAN_BUCKET_MAX]
+            pos += len(chunk)
+            b = self._bucket(len(chunk))
+            idx = np.zeros(b, np.int32)
+            rew = np.zeros(b, np.float32)
+            active = np.zeros(b, bool)
+            for i, (action_idx, reward) in enumerate(chunk):
+                idx[i] = action_idx
+                rew[i] = reward
+                active[i] = True
+            self.state = self._reward_many(
+                self.state, jnp.asarray(idx), jnp.asarray(rew),
+                jnp.asarray(active))
 
     def set_reward(self, action_id: str, reward: float) -> None:
         idx = self.actions.index(action_id)

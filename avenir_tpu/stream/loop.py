@@ -165,11 +165,33 @@ class OnlineLearnerLoop:
                 self._skip_rewards = self.stats.rewards
                 self.resumed_events = self.stats.events
 
-    def _maybe_checkpoint(self) -> None:
-        if self._ckpt and self.stats.events % self._ckpt_interval == 0:
-            self._ckpt_mod.save_loop_state(
-                self._ckpt, self.stats.events, self.learner.state,
-                vars(self.stats))
+    def _drain_new_rewards(self) -> List[Tuple[str, float]]:
+        """Pending rewards minus the ones a restored checkpoint already
+        folded (append-only sources re-drain from the start on restart)."""
+        pairs = []
+        for action_id, reward in self.queues.drain_rewards():
+            if self._skip_rewards > 0:
+                self._skip_rewards -= 1
+                continue
+            pairs.append((action_id, reward))
+        return pairs
+
+    def _save_checkpoint(self) -> None:
+        self._ckpt_mod.save_loop_state(
+            self._ckpt, self.stats.events, self.learner.state,
+            vars(self.stats))
+
+    def _maybe_checkpoint(self, events_before: Optional[int] = None) -> None:
+        """Checkpoint on interval multiples; with ``events_before``, on any
+        batch that crossed a multiple."""
+        if not self._ckpt:
+            return
+        if events_before is None:
+            if self.stats.events % self._ckpt_interval == 0:
+                self._save_checkpoint()
+        elif (events_before // self._ckpt_interval
+              != self.stats.events // self._ckpt_interval):
+            self._save_checkpoint()
 
     def close(self) -> None:
         if self._ckpt:
@@ -185,10 +207,7 @@ class OnlineLearnerLoop:
     def step(self) -> bool:
         """Process one event (rewards drained first, like the bolt
         :96-99). Returns False when the event queue is empty."""
-        for action_id, reward in self.queues.drain_rewards():
-            if self._skip_rewards > 0:
-                self._skip_rewards -= 1
-                continue
+        for action_id, reward in self._drain_new_rewards():
             self.learner.set_reward(action_id, reward)
             self.stats.rewards += 1
         event_id = self.queues.pop_event()
@@ -202,11 +221,39 @@ class OnlineLearnerLoop:
         return True
 
     def run(self, max_events: Optional[int] = None) -> LoopStats:
+        """Drain the queues to completion with event micro-batching: all
+        pending rewards fold in one bucketed dispatch, then up to 64
+        pending events select in one masked-scan dispatch (the bolt's
+        drain-then-process pattern at batch granularity; results identical
+        to per-event ``step`` calls, minus the per-event round-trips)."""
         processed = 0
+        batch_size = self.learner.cfg.batch_size
+        event_cap = Learner._SCAN_BUCKET_MAX
         while max_events is None or processed < max_events:
-            if not self.step():
+            pairs = self._drain_new_rewards()
+            if pairs:
+                self.learner.set_reward_batch(pairs)
+                self.stats.rewards += len(pairs)
+            events: List[str] = []
+            while (len(events) < event_cap
+                   and (max_events is None
+                        or processed + len(events) < max_events)):
+                event_id = self.queues.pop_event()
+                if event_id is None:
+                    break
+                events.append(event_id)
+            if not events:
                 break
-            processed += 1
+            selections = self.learner.next_action_batch(
+                len(events) * batch_size)
+            events_before = self.stats.events
+            for i, event_id in enumerate(events):
+                sel = selections[i * batch_size:(i + 1) * batch_size]
+                self.queues.write_actions(event_id, sel)
+                self.stats.events += 1
+                self.stats.actions_written += len(sel)
+            processed += len(events)
+            self._maybe_checkpoint(events_before)
         return self.stats
 
 

@@ -19,7 +19,7 @@ set -euo pipefail
 
 PROJECT_HOME=${PROJECT_HOME:-.}
 PROPS=$PROJECT_HOME/knn.properties
-AVENIR="python -m avenir_tpu"
+AVENIR="${PYTHON:-python3} -m avenir_tpu"
 
 case "${1:-}" in
 computeDistance)

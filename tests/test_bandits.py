@@ -158,3 +158,36 @@ class TestOnlineLoop:
         assert loop.stats.events == 50
         assert loop.stats.rewards > 0
         assert not loop.step()  # empty queue -> False
+
+
+class TestBatchedLearnerEquivalence:
+    """next_action_batch / set_reward_batch are the SAME ops as sequential
+    calls (masked scan), so results must match bit-for-bit."""
+
+    @pytest.mark.parametrize("learner_type", [
+        "randomGreedy", "softMax", "upperConfidenceBoundOne",
+        "intervalEstimator", "exponentialWeight"])
+    def test_batch_equals_sequential(self, learner_type):
+        from avenir_tpu.models.bandits.learners import create
+        actions = ["a", "b", "c"]
+        config = {"random.selection.prob": "0.4"}
+        seq = create(learner_type, actions, config, seed=7)
+        bat = create(learner_type, actions, config, seed=7)
+        seq_out, i = [], 0
+        for rounds in (1, 3, 5, 70):       # 70 spans two scan buckets
+            got = bat.next_action_batch(rounds)
+            for _ in range(rounds):
+                seq_out.append(seq.next_action())
+            assert got == seq_out[-rounds:]
+            rewards = [(seq_out[(i + j) % len(seq_out)], 10.0 + j)
+                       for j in range(rounds)]
+            i += 1
+            for a, r in rewards:
+                seq.set_reward(a, r)
+            bat.set_reward_batch(rewards)
+        np.testing.assert_array_equal(
+            np.asarray(seq.state.trial_counts),
+            np.asarray(bat.state.trial_counts))
+        np.testing.assert_array_equal(
+            np.asarray(seq.state.reward_sum),
+            np.asarray(bat.state.reward_sum))

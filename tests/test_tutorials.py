@@ -486,6 +486,7 @@ class TestKnnShellDriver:
         script = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "scripts", "knn.sh")
         env = dict(os.environ, PROJECT_HOME=str(tmp_path),
+                   PYTHON=sys.executable,
                    PYTHONPATH=os.pathsep.join(sys.path))
         for verb in ("computeDistance", "bayesianDistr", "knnClassifier"):
             proc = subprocess.run(["bash", script, verb], env=env,
