@@ -165,6 +165,12 @@ class CandidateSplit:
     gain_ratio: float    # gain / intrinsic info
 
 
+def _info_fn(algorithm: str):
+    """Info-content function for a split.algorithm (single source for the
+    root/parent-info mapping used by root_info and grow_tree)."""
+    return it.entropy if algorithm == "entropy" else it.gini
+
+
 def root_info(table: EncodedTable, algorithm: str = "giniIndex",
               row_mask: Optional[jnp.ndarray] = None) -> float:
     """The at.root bootstrap: info content of the whole node
@@ -173,37 +179,40 @@ def root_info(table: EncodedTable, algorithm: str = "giniIndex",
     if row_mask is not None:
         oh = oh * row_mask[:, None]
     counts = jnp.sum(oh, axis=0)
-    fn = it.entropy if algorithm == "entropy" else it.gini
-    return float(fn(counts))
+    return float(_info_fn(algorithm)(counts))
 
 
 _SPLIT_CHUNK = 1024  # candidate splits per device dispatch
 
 
-def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
-                algorithm: str = "giniIndex",
-                parent_info: Optional[float] = None,
-                max_cat_attr_split_groups: int = 3,
-                row_mask: Optional[jnp.ndarray] = None
-                ) -> List[CandidateSplit]:
-    """Gains for every candidate split of every attribute, reference
-    semantics, one batched pass per attribute (chunked over splits).
+@partial(jax.jit, static_argnames=("n_segments", "n_classes", "algorithm"))
+def _numeric_split_counts_multi(values, labels, points, n_segments, n_classes,
+                                algorithm, mask_batch):
+    """vmap of _numeric_split_counts over a [K, N] node-mask batch —
+    every node of a tree level in one dispatch."""
+    return jax.vmap(lambda w: _numeric_split_counts(
+        values, labels, points, n_segments, n_classes, algorithm, w)
+    )(mask_batch)
 
-    Dispatch and readback are separated: every attribute's chunks are
-    enqueued before the first result is fetched, so the device pipelines a
-    whole level's kernels and the host pays one transfer latency per level,
-    not one per attribute (the relay to the chip adds ~150ms per blocking
-    fetch)."""
-    if parent_info is None:
-        parent_info = root_info(table, algorithm)
+
+@partial(jax.jit, static_argnames=("n_segments", "n_classes", "algorithm"))
+def _categorical_split_counts_multi(codes, labels, group_of_code, n_segments,
+                                    n_classes, algorithm, mask_batch):
+    return jax.vmap(lambda w: _categorical_split_counts(
+        codes, labels, group_of_code, n_segments, n_classes, algorithm, w)
+    )(mask_batch)
+
+
+def _attr_plans(table: EncodedTable, attr_ordinals: Sequence[int],
+                max_cat_attr_split_groups: int):
+    """Per-attribute candidate catalog + kernel operands: (attr, keys,
+    is_categorical, column, aux array, n_segments). Shared by the
+    single-node and level-batched gain passes."""
     ord_to_pos = {f.ordinal: i for i, f in enumerate(table.feature_fields)}
-    info_alg = algorithm in ("entropy", "giniIndex")
-
-    pending = []             # (attr, keys, [device stat chunks], [intr chunks])
+    plans = []
     for attr in attr_ordinals:
         pos = ord_to_pos[attr]
         f = table.feature_fields[pos]
-        stats_l, intr_l = [], []
         if f.is_categorical:
             card = f.cardinality or table.bin_labels[pos]
             groups_list = enumerate_categorical_splits(
@@ -217,13 +226,8 @@ def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
                     for v in group:
                         if v in vocab:
                             lookup[s, vocab[v]] = gi
-            codes = table.binned[:, pos]
-            for c0 in range(0, len(groups_list), _SPLIT_CHUNK):
-                st, ii = _categorical_split_counts(
-                    codes, table.labels, jnp.asarray(lookup[c0:c0 + _SPLIT_CHUNK]),
-                    n_seg, table.n_classes, algorithm, row_mask)
-                stats_l.append(st)
-                intr_l.append(ii)
+            plans.append((attr, keys, True, table.binned[:, pos], lookup,
+                          n_seg))
         else:
             splits = enumerate_numeric_splits(f)
             keys = [numeric_split_key(p) for p in splits]
@@ -231,29 +235,48 @@ def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
             pts = np.full((len(splits), max_pts), np.inf, np.float32)
             for s, p in enumerate(splits):
                 pts[s, :len(p)] = p
-            values = table.numeric[:, pos]
-            for c0 in range(0, len(splits), _SPLIT_CHUNK):
-                st, ii = _numeric_split_counts(
-                    values, table.labels, jnp.asarray(pts[c0:c0 + _SPLIT_CHUNK]),
-                    max_pts + 1, table.n_classes, algorithm, row_mask)
-                stats_l.append(st)
-                intr_l.append(ii)
-        pending.append((attr, keys, stats_l, intr_l))
+            plans.append((attr, keys, False, table.numeric[:, pos], pts,
+                          max_pts + 1))
+    return plans
 
-    if not pending:
-        return []
-    # one device-side concat + ONE host fetch for the whole level
-    all_stats = [c for (_, _, s, _) in pending for c in s]
-    all_intr = [c for (_, _, _, ii) in pending for c in ii]
+
+def _dispatch_and_fetch(table: EncodedTable, plans, algorithm,
+                        row_mask, multi: bool):
+    """Enqueue every plan's chunk kernels, then ONE fused readback.
+
+    Returns (stats, intrinsic) with a trailing candidate axis of total
+    length sum(len(keys)); with ``multi`` a leading node axis K. Dispatch
+    and readback are separated so the device pipelines a whole level's
+    kernels and the host pays one transfer latency total (the relay to the
+    chip adds ~150ms per blocking fetch)."""
+    num_fn = _numeric_split_counts_multi if multi else _numeric_split_counts
+    cat_fn = (_categorical_split_counts_multi if multi
+              else _categorical_split_counts)
+    stats_l, intr_l = [], []
+    for attr, keys, is_cat, column, aux, n_seg in plans:
+        fn = cat_fn if is_cat else num_fn
+        for c0 in range(0, len(keys), _SPLIT_CHUNK):
+            st, ii = fn(column, table.labels,
+                        jnp.asarray(aux[c0:c0 + _SPLIT_CHUNK]),
+                        n_seg, table.n_classes, algorithm, row_mask)
+            stats_l.append(st)
+            intr_l.append(ii)
+    axis = 1 if multi else 0
     fetched = np.asarray(jnp.concatenate(
-        [jnp.concatenate(all_stats).astype(jnp.float32),
-         jnp.concatenate(all_intr).astype(jnp.float32)]))
-    half = fetched.shape[0] // 2
-    stats_flat, intr_flat = fetched[:half], fetched[half:]
+        [jnp.concatenate(stats_l, axis=axis).astype(jnp.float32),
+         jnp.concatenate(intr_l, axis=axis).astype(jnp.float32)], axis=axis))
+    half = fetched.shape[axis] // 2
+    if multi:
+        return fetched[:, :half], fetched[:, half:]
+    return fetched[:half], fetched[half:]
 
+
+def _assemble_candidates(plans, stats_flat, intr_flat, algorithm,
+                         parent_info) -> List[CandidateSplit]:
+    info_alg = algorithm in ("entropy", "giniIndex")
     out: List[CandidateSplit] = []
     cursor = 0
-    for attr, keys, _, _ in pending:
+    for attr, keys, *_ in plans:
         n = len(keys)
         stats = stats_flat[cursor:cursor + n]
         intrinsic = intr_flat[cursor:cursor + n]
@@ -266,6 +289,63 @@ def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
                 # hellinger / classConfidenceRatio emit the raw stat
                 gain, ratio = float(stat), float(stat)
             out.append(CandidateSplit(attr, key, float(stat), gain, ratio))
+    return out
+
+
+def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
+                algorithm: str = "giniIndex",
+                parent_info: Optional[float] = None,
+                max_cat_attr_split_groups: int = 3,
+                row_mask: Optional[jnp.ndarray] = None
+                ) -> List[CandidateSplit]:
+    """Gains for every candidate split of every attribute, reference
+    semantics, one batched pass per attribute (chunked over splits) and one
+    fused readback for the whole call."""
+    if parent_info is None:
+        parent_info = root_info(table, algorithm)
+    plans = _attr_plans(table, attr_ordinals, max_cat_attr_split_groups)
+    if not plans:
+        return []
+    stats_flat, intr_flat = _dispatch_and_fetch(
+        table, plans, algorithm, row_mask, multi=False)
+    return _assemble_candidates(plans, stats_flat, intr_flat, algorithm,
+                                parent_info)
+
+
+#: max nodes per vmapped dispatch — bounds the K-times peak-memory blowup of
+#: the vmapped one_hot/einsum and, with power-of-two padding, the number of
+#: compiled kernel variants (K buckets 1,2,4,8 only)
+_NODE_BATCH = 8
+
+
+def split_gains_multi(table: EncodedTable, attr_ordinals: Sequence[int],
+                      algorithm: str,
+                      parent_infos: Sequence[float],
+                      max_cat_attr_split_groups: int,
+                      row_masks: np.ndarray
+                      ) -> List[List[CandidateSplit]]:
+    """Candidate-split gains for K nodes at once (``row_masks`` [K, N]) —
+    a tree level in vmapped dispatches + one readback per ``_NODE_BATCH``
+    slab. Slabs are padded with zero masks to power-of-two K so repeated
+    calls reuse at most four compiled variants per kernel."""
+    plans = _attr_plans(table, attr_ordinals, max_cat_attr_split_groups)
+    n_nodes = len(parent_infos)
+    if not plans:
+        return [[] for _ in range(n_nodes)]
+    out: List[List[CandidateSplit]] = []
+    for k0 in range(0, n_nodes, _NODE_BATCH):
+        take = min(_NODE_BATCH, n_nodes - k0)
+        padded = 1
+        while padded < take:
+            padded *= 2
+        masks = np.zeros((padded, row_masks.shape[1]), np.float32)
+        masks[:take] = row_masks[k0:k0 + take]
+        stats_b, intr_b = _dispatch_and_fetch(
+            table, plans, algorithm, jnp.asarray(masks), multi=True)
+        out.extend(
+            _assemble_candidates(plans, stats_b[k], intr_b[k], algorithm,
+                                 parent_infos[k0 + k])
+            for k in range(take))
     return out
 
 
@@ -381,43 +461,65 @@ class TreeConfig:
 
 def grow_tree(table: EncodedTable, config: TreeConfig,
               rng: Optional[np.random.Generator] = None) -> TreeNode:
-    """Host loop over nodes (the reference's SplitGenerator→DataPartitioner
-    rounds). Every node works on the FULL table with a 0/1 row mask, so all
-    device kernels keep static shapes and compile exactly once per attribute
-    — the mask plays the role of the reference's per-node HDFS partition."""
+    """Level-batched host loop (the reference's SplitGenerator→
+    DataPartitioner rounds). Every node works on the FULL table with a 0/1
+    row mask — the mask plays the role of the reference's per-node HDFS
+    partition — and all nodes of a level evaluate their candidate splits in
+    one vmapped device pass (``split_gains_multi``), so a level costs one
+    readback regardless of node count. Nodes are processed breadth-first;
+    with a ``rng`` (randomFromTop strategy) draws are consumed in BFS order."""
     attrs = list(config.split_attributes) or [
         f.ordinal for f in table.feature_fields
         if f.is_categorical or (f.is_numeric and f.bucket_width is not None)]
 
     oh_labels = np.asarray(jax.nn.one_hot(table.labels, table.n_classes))
+    info_fn = _info_fn(config.algorithm)
 
-    def build(mask: np.ndarray, depth: int) -> TreeNode:
-        counts = (oh_labels * mask[:, None]).sum(axis=0)
-        node = TreeNode(class_counts=counts, class_values=table.class_values)
-        n_rows = int(mask.sum())
-        if (depth >= config.max_depth or n_rows < config.min_node_size
-                or np.count_nonzero(counts) <= 1):
-            return node
-        mask_d = jnp.asarray(mask, jnp.float32)
-        parent = root_info(table, config.algorithm, mask_d)
-        cands = split_gains(table, attrs, config.algorithm, parent,
-                            config.max_cat_attr_split_groups, row_mask=mask_d)
-        if not cands:
-            return node
-        triples = [(c.attr_ordinal, c.key, c.gain_ratio) for c in cands]
-        _, (attr, key, stat) = select_split(
-            triples, config.split_selection_strategy,
-            config.num_top_splits, rng)
-        if stat <= config.min_gain:
-            return node
-        node.attr_ordinal, node.split_key = attr, key
-        segs = segment_of_rows(table, attr, key)
-        for seg in np.unique(segs[mask > 0]):
-            node.children[int(seg)] = build(
-                mask * (segs == seg).astype(np.float32), depth + 1)
-        return node
-
-    return build(np.ones(table.n_rows, np.float32), 0)
+    root: Optional[TreeNode] = None
+    # (mask, parent node, child segment id, depth)
+    frontier = [(np.ones(table.n_rows, np.float32), None, None, 0)]
+    while frontier:
+        splittable = []
+        for mask, parent, seg, depth in frontier:
+            counts = (oh_labels * mask[:, None]).sum(axis=0)
+            node = TreeNode(class_counts=counts,
+                            class_values=table.class_values)
+            if parent is None:
+                root = node
+            else:
+                parent.children[seg] = node
+            n_node = int(mask.sum())
+            if not (depth >= config.max_depth
+                    or n_node < config.min_node_size
+                    or np.count_nonzero(counts) <= 1):
+                splittable.append((mask, node, depth, counts))
+        frontier = []
+        if not splittable:
+            break
+        # per-node parent info in one dispatch (same float32 device math as
+        # root_info), then every node's gains in one vmapped pass
+        parents = np.asarray(info_fn(
+            jnp.asarray(np.stack([c for *_, c in splittable]))))
+        masks_b = np.stack([m for m, *_ in splittable]).astype(np.float32)
+        cands_b = split_gains_multi(
+            table, attrs, config.algorithm, [float(p) for p in parents],
+            config.max_cat_attr_split_groups, masks_b)
+        for (mask, node, depth, _), cands in zip(splittable, cands_b):
+            if not cands:
+                continue
+            triples = [(c.attr_ordinal, c.key, c.gain_ratio) for c in cands]
+            _, (attr, key, stat) = select_split(
+                triples, config.split_selection_strategy,
+                config.num_top_splits, rng)
+            if stat <= config.min_gain:
+                continue
+            node.attr_ordinal, node.split_key = attr, key
+            segs = segment_of_rows(table, attr, key)
+            for seg_val in np.unique(segs[mask > 0]):
+                frontier.append(
+                    (mask * (segs == seg_val).astype(np.float32), node,
+                     int(seg_val), depth + 1))
+    return root
 
 
 def predict(tree: TreeNode, table: EncodedTable) -> np.ndarray:

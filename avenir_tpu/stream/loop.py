@@ -224,8 +224,11 @@ class OnlineLearnerLoop:
         """Drain the queues to completion with event micro-batching: all
         pending rewards fold in one bucketed dispatch, then up to 64
         pending events select in one masked-scan dispatch (the bolt's
-        drain-then-process pattern at batch granularity; results identical
-        to per-event ``step`` calls, minus the per-event round-trips)."""
+        drain-then-process pattern at batch granularity). With statically
+        pre-filled queues the results are identical to per-event ``step``
+        calls minus the round-trips; with a LIVE reward producer (Redis),
+        rewards arriving mid-batch fold only at the next batch boundary —
+        use ``step`` when strict per-event interleaving matters."""
         processed = 0
         batch_size = self.learner.cfg.batch_size
         event_cap = Learner._SCAN_BUCKET_MAX
