@@ -49,6 +49,11 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 BIG = 3.0e38          # float sentinel (fits float32)
 INT_BIG = 2 ** 30
+# Tile budget (empirical, v5e): tile_m*tile_n beyond ~4M slab elements blows
+# the 16MB scoped-VMEM limit once the train sweep gets long (observed at
+# (1024, 8192) with 1M train rows). The defaults sit exactly at 4M; callers
+# passing larger explicit tiles own the risk (tile sweeps rely on oversize
+# configs genuinely failing rather than being silently shrunk).
 
 
 def _topk_kernel(x_ref, y_ref, y2_ref, out_d_ref, out_i_ref,
@@ -203,7 +208,7 @@ def pairwise_topk_pallas(x_num: Optional[jnp.ndarray],
                          y_cat: Optional[jnp.ndarray] = None,
                          *, k: int, n_cat_bins: int = 0,
                          distance_scale: int = 1000,
-                         tile_m: int = 1024, tile_n: int = 8192,
+                         tile_m: int = 1024, tile_n: int = 4096,
                          n_acc: int = 4, mode: str = "fast",
                          interpret: bool = False
                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
