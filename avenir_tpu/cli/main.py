@@ -15,6 +15,7 @@ property files drive the TPU backend unchanged.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 from typing import Callable, Dict, List
 
@@ -734,7 +735,25 @@ def main(argv: List[str] = None) -> int:
     for override in args.D:
         key, _, value = override.partition("=")
         conf.set(key, value)
-    VERBS[args.verb](conf, args.input, args.output)
+
+    # observability (SURVEY.md §5): the reference's ``debug.on`` log switch
+    # plus the TPU-native additions — an XLA trace when
+    # ``profile.trace.dir`` is set, and per-job wall time under debug.on
+    from avenir_tpu.utils import profiling
+    debug_on = conf.get_bool("debug.on", False)
+    # pass the explicit value: each invocation's config decides the level
+    # (the None-means-leave-alone contract is for default-arg library calls)
+    logger = profiling.get_logger("cli", debug_on)
+    logger.debug("verb=%s input=%s output=%s conf=%s",
+                 args.verb, args.input, args.output, args.conf)
+    trace_dir = conf.get("profile.trace.dir")
+    timer = profiling.StepTimer(args.verb)
+    ctx = (profiling.trace(trace_dir) if trace_dir
+           else contextlib.nullcontext())
+    with ctx, timer.step():
+        VERBS[args.verb](conf, args.input, args.output)
+    if debug_on:
+        logger.debug("timing %s", timer.summary())
     return 0
 
 

@@ -48,3 +48,55 @@ class TestTrace:
                 jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
         found = glob.glob(os.path.join(log_dir, "**", "*"), recursive=True)
         assert any(os.path.isfile(f) for f in found)
+
+
+class TestCliObservability:
+    """debug.on + profile.trace.dir wired through the CLI driver."""
+
+    def test_debug_on_logs_and_times(self, tmp_path, caplog):
+        import json
+        import logging
+        from avenir_tpu.cli.main import main as cli
+        from avenir_tpu.datagen import generators as G
+        rows = G.churn_rows(200, seed=3)
+        (tmp_path / "data.csv").write_text(
+            "\n".join(",".join(r) for r in rows))
+        with open(tmp_path / "churn.json", "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        (tmp_path / "p.properties").write_text(
+            f"feature.schema.file.path={tmp_path}/churn.json\n"
+            "debug.on=true\n")
+        # configure the logger BEFORE enabling propagation: get_logger sets
+        # propagate=False on first configuration, which would otherwise undo
+        # the setting when the CLI configures it mid-run
+        get_logger("cli")
+        cli_logger = logging.getLogger("avenir_tpu.cli")
+        with caplog.at_level(logging.DEBUG, logger="avenir_tpu.cli"):
+            cli_logger.propagate = True
+            try:
+                cli(["BayesianDistribution", str(tmp_path / "data.csv"),
+                     str(tmp_path / "model.txt"),
+                     "--conf", str(tmp_path / "p.properties")])
+            finally:
+                cli_logger.propagate = False
+                cli_logger.setLevel(logging.WARNING)
+        messages = [r.getMessage() for r in caplog.records]
+        assert any("verb=BayesianDistribution" in m for m in messages)
+        assert any("timing" in m and "mean_ms" in m for m in messages)
+
+    def test_trace_dir_produces_profile(self, tmp_path):
+        import json
+        from avenir_tpu.cli.main import main as cli
+        from avenir_tpu.datagen import generators as G
+        rows = G.churn_rows(100, seed=4)
+        (tmp_path / "data.csv").write_text(
+            "\n".join(",".join(r) for r in rows))
+        with open(tmp_path / "churn.json", "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        (tmp_path / "p.properties").write_text(
+            f"feature.schema.file.path={tmp_path}/churn.json\n"
+            f"profile.trace.dir={tmp_path}/trace\n")
+        cli(["BayesianDistribution", str(tmp_path / "data.csv"),
+             str(tmp_path / "model.txt"),
+             "--conf", str(tmp_path / "p.properties")])
+        assert list((tmp_path / "trace").rglob("*"))
