@@ -1,1 +1,15 @@
+"""Model families (one module per reference package — SURVEY.md §2):
 
+- ``naive_bayes`` — BayesianDistribution/BayesianPredictor (train, predict,
+  save_model/load_model in the reference wire format)
+- ``knn``         — NearestNeighbor/Neighborhood (classify, regress, fused
+  distance + top-k + kernel vote)
+- ``tree``        — ClassPartitionGenerator/DataPartitioner machinery
+  (split_gains, select_split, segment_of_rows) plus grow_tree/predict
+- ``markov``      — MarkovStateTransitionModel/MarkovModelClassifier +
+  transaction_states/next_states (the email-marketing stages)
+- ``hmm``         — HiddenMarkovModelBuilder/ViterbiStatePredictor
+- ``logistic``    — LogisticRegressionJob (resumable coefficient history)
+- ``fisher``      — FisherDiscriminant
+- ``bandits``     — 4 batch MR selectors + 10 streaming learners
+"""

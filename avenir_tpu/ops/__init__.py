@@ -1,1 +1,14 @@
+"""Device kernels shared by the model families:
 
+- ``histogram``        — one-hot/segment count reductions (the MR
+  combiner+shuffle+reduce replacement): class/feature/bin counts, pair
+  counts, per-class moments, transition counts
+- ``distance``         — blocked pairwise distance + top-k (XLA path;
+  ``pairwise_full`` emits the SameTypeSimilarity scaled-int matrix)
+- ``pallas_distance``  — the hand-scheduled fused TPU kernel for the same
+  computation (north-star benchmark path)
+- ``infotheory``       — entropy/gini/Hellinger/class-confidence split
+  stats, mutual information, gain-ratio pieces
+- ``scanops``          — Viterbi as lax.scan + max-plus associative form
+  (the long-sequence/sequence-parallel decode)
+"""
