@@ -282,3 +282,53 @@ class TestProjectionGrammarParity:
         project_file(str(src), out, 0, 1, [2],
                      delim_regex="¦", delim_out="¦")
         assert open(out).read() == "g¦x¦y\n"
+
+
+class TestFeaturizerFuzzParity:
+    """Seeded fuzz: random ASCII CSVs through both featurizer paths must be
+    bit-identical (the dual-path contract the projection hardening enforces
+    for ordering; this covers encoding)."""
+
+    def test_random_tables_match(self, tmp_path):
+        import random
+        from avenir_tpu.native.loader import transform_file
+        from avenir_tpu.utils.schema import FeatureSchema
+        rnd = random.Random(1234)
+        for trial in range(5):
+            card = [f"v{i}" for i in range(rnd.randint(2, 6))]
+            schema = FeatureSchema.from_json({"fields": [
+                {"name": "id", "ordinal": 0, "id": True,
+                 "dataType": "string"},
+                {"name": "cat", "ordinal": 1, "dataType": "categorical",
+                 "cardinality": card, "feature": True},
+                {"name": "bucketed", "ordinal": 2, "dataType": "int",
+                 "min": 0, "max": 100, "bucketWidth": rnd.choice([5, 10]),
+                 "feature": True},
+                {"name": "cont", "ordinal": 3, "dataType": "double",
+                 "feature": True},
+                {"name": "label", "ordinal": 4, "dataType": "categorical",
+                 "classAttribute": True, "cardinality": ["a", "b"]},
+            ]})
+            lines = []
+            for i in range(rnd.randint(20, 80)):
+                pad = " " * rnd.randint(0, 2)
+                lines.append(",".join([
+                    f"{pad}R{i}{pad}",
+                    pad + rnd.choice(card) + pad,
+                    str(rnd.randint(0, 100)),
+                    f"{rnd.uniform(-5, 5):.4f}",
+                    rnd.choice(["a", "b"]),
+                ]))
+            src = tmp_path / f"fuzz{trial}.csv"
+            src.write_text("\n".join(lines) + "\n")
+            fz = Featurizer(schema)
+            fz.fit([l.split(",") for l in lines])
+            nat = transform_file(fz, str(src))
+            py = transform_file(fz, str(src), force_python=True)
+            np.testing.assert_array_equal(np.asarray(nat.binned),
+                                          np.asarray(py.binned))
+            np.testing.assert_array_equal(np.asarray(nat.numeric),
+                                          np.asarray(py.numeric))
+            np.testing.assert_array_equal(np.asarray(nat.labels),
+                                          np.asarray(py.labels))
+            assert nat.ids == py.ids
