@@ -291,7 +291,6 @@ class TestFeaturizerFuzzParity:
 
     def test_random_tables_match(self, tmp_path):
         import random
-        from avenir_tpu.native.loader import transform_file
         from avenir_tpu.utils.schema import FeatureSchema
         rnd = random.Random(1234)
         for trial in range(5):
@@ -323,12 +322,11 @@ class TestFeaturizerFuzzParity:
             src.write_text("\n".join(lines) + "\n")
             fz = Featurizer(schema)
             fz.fit([l.split(",") for l in lines])
-            nat = transform_file(fz, str(src))
+            # encode_file raises rather than silently falling back to the
+            # Python path, so the comparison can never be Python-vs-Python
+            nat = encode_file(fz, str(src))
             py = transform_file(fz, str(src), force_python=True)
-            np.testing.assert_array_equal(np.asarray(nat.binned),
-                                          np.asarray(py.binned))
+            _assert_tables_equal(nat, py)
+            # the helper allows float tolerance; parity here is bit-exact
             np.testing.assert_array_equal(np.asarray(nat.numeric),
                                           np.asarray(py.numeric))
-            np.testing.assert_array_equal(np.asarray(nat.labels),
-                                          np.asarray(py.labels))
-            assert nat.ids == py.ids
