@@ -750,8 +750,27 @@ def main(argv: List[str] = None) -> int:
     timer = profiling.StepTimer(args.verb)
     ctx = (profiling.trace(trace_dir) if trace_dir
            else contextlib.nullcontext())
+    # the reference's task-retry budget (mapreduce.map.maxattempts=2,
+    # resource/knn.properties:5-6) applied at the job level: transient
+    # runtime/IO failures (e.g. a dropped accelerator connection) re-run the
+    # verb — safe because every job is idempotent (outputs fully overwrite).
+    # Config errors (ValueError/KeyError) fail fast.
+    attempts = max(1,   # floor: zero/negative budgets must not skip the job
+                   conf.get_int("mapreduce.map.maxattempts", 1),
+                   conf.get_int("mapreduce.reduce.maxattempts", 1),
+                   conf.get_int("max.attempts", 1))
     with ctx, timer.step():
-        VERBS[args.verb](conf, args.input, args.output)
+        for attempt in range(1, attempts + 1):
+            try:
+                VERBS[args.verb](conf, args.input, args.output)
+                break
+            except (ValueError, KeyError, FileNotFoundError):
+                raise
+            except Exception:
+                if attempt == attempts:
+                    raise
+                logger.warning("attempt %d/%d of %s failed; retrying",
+                               attempt, attempts, args.verb, exc_info=True)
     if debug_on:
         logger.debug("timing %s", timer.summary())
     return 0

@@ -246,3 +246,56 @@ class TestReferenceArtifactCompatibility:
         card_field = campaign.find_field_by_name("campaignType")
         assert card_field.max_split == 2
         assert len(card_field.cardinality) == 9
+
+
+class TestCliRetryBudget:
+    """The reference's task-retry budget (mapreduce.*.maxattempts) applied
+    at the job level for transient failures."""
+
+    def _props(self, tmp_path, extra=""):
+        p = tmp_path / "r.properties"
+        p.write_text("mapreduce.map.maxattempts=2\n" + extra)
+        return str(p)
+
+    def test_transient_failure_retries(self, tmp_path, monkeypatch):
+        from avenir_tpu.cli import main as M
+        calls = []
+
+        def flaky(conf, i, o):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("transient accelerator failure")
+
+        monkeypatch.setitem(M.VERBS, "WordCounter", flaky)
+        (tmp_path / "in.txt").write_text("a b\n")
+        M.main(["WordCounter", str(tmp_path / "in.txt"),
+                str(tmp_path / "out.txt"),
+                "--conf", self._props(tmp_path)])
+        assert len(calls) == 2
+
+    def test_budget_exhaustion_raises(self, tmp_path, monkeypatch):
+        from avenir_tpu.cli import main as M
+        monkeypatch.setitem(
+            M.VERBS, "WordCounter",
+            lambda c, i, o: (_ for _ in ()).throw(RuntimeError("down")))
+        (tmp_path / "in.txt").write_text("a\n")
+        with pytest.raises(RuntimeError):
+            M.main(["WordCounter", str(tmp_path / "in.txt"),
+                    str(tmp_path / "out.txt"),
+                    "--conf", self._props(tmp_path)])
+
+    def test_config_errors_fail_fast(self, tmp_path, monkeypatch):
+        from avenir_tpu.cli import main as M
+        calls = []
+
+        def bad_config(conf, i, o):
+            calls.append(1)
+            raise ValueError("missing required key")
+
+        monkeypatch.setitem(M.VERBS, "WordCounter", bad_config)
+        (tmp_path / "in.txt").write_text("a\n")
+        with pytest.raises(ValueError):
+            M.main(["WordCounter", str(tmp_path / "in.txt"),
+                    str(tmp_path / "out.txt"),
+                    "--conf", self._props(tmp_path)])
+        assert len(calls) == 1
