@@ -502,6 +502,13 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
           f'"actions": {stats.actions_written}}}')
 
 
+# a retried attempt would resume from checkpoint.dir and emit only the
+# un-replayed tail of the action file — NOT a full overwrite; the online
+# loop owns its durability (checkpoint + event replay), so the job-level
+# retry budget must not re-run it
+run_reinforcement_learner.retry_safe = False
+
+
 def run_mutual_information(conf: JobConfig, in_path: str,
                            out_path: str) -> None:
     """All seven MI distribution families + feature-selection scores
@@ -759,6 +766,10 @@ def main(argv: List[str] = None) -> int:
                    conf.get_int("mapreduce.map.maxattempts", 1),
                    conf.get_int("mapreduce.reduce.maxattempts", 1),
                    conf.get_int("max.attempts", 1))
+    if not getattr(VERBS[args.verb], "retry_safe", True):
+        # verbs that manage their own durability (checkpoint + replay)
+        # would emit partial output on a re-run, not a full overwrite
+        attempts = 1
     with ctx, timer.step():
         for attempt in range(1, attempts + 1):
             try:

@@ -76,14 +76,20 @@ class RedisQueues:
                  event_queue: str = "eventQueue",
                  action_queue: str = "actionQueue",
                  reward_queue: str = "rewardQueue",
-                 field_delim: str = ","):
-        try:
-            import redis  # type: ignore
-        except ImportError as exc:  # pragma: no cover - env without redis
-            raise RuntimeError(
-                "RedisQueues needs the 'redis' package; use InProcQueues "
-                "or install redis") from exc
-        self._r = redis.StrictRedis(host=host, port=port)
+                 field_delim: str = ",",
+                 client=None):
+        """``client`` overrides the Redis connection — anything speaking
+        rpop/lpush/lindex (tests use an in-memory fake; production omits it
+        and connects via the ``redis`` package)."""
+        if client is None:
+            try:
+                import redis  # type: ignore
+            except ImportError as exc:  # pragma: no cover - env w/o redis
+                raise RuntimeError(
+                    "RedisQueues needs the 'redis' package; use InProcQueues "
+                    "or install redis") from exc
+            client = redis.StrictRedis(host=host, port=port)
+        self._r = client
         self.event_queue = event_queue
         self.action_queue = action_queue
         self.reward_queue = reward_queue

@@ -191,3 +191,70 @@ class TestBatchedLearnerEquivalence:
         np.testing.assert_array_equal(
             np.asarray(seq.state.reward_sum),
             np.asarray(bat.state.reward_sum))
+
+
+class FakeRedis:
+    """In-memory rpop/lpush/lindex with Redis list semantics (lpush at head,
+    rpop at tail, negative lindex from the tail)."""
+
+    def __init__(self):
+        self.lists = {}
+
+    def lpush(self, key, value):
+        self.lists.setdefault(key, []).insert(
+            0, value.encode() if isinstance(value, str) else value)
+
+    def rpop(self, key):
+        lst = self.lists.get(key)
+        return lst.pop() if lst else None
+
+    def lindex(self, key, index):
+        lst = self.lists.get(key, [])
+        try:
+            return lst[index]
+        except IndexError:
+            return None
+
+
+class TestRedisWireProtocol:
+    """RedisQueues speaks the reference's list wire format (RedisSpout rpop,
+    RedisActionWriter lpush, RedisRewardReader tail-first lindex cursor)."""
+
+    def _queues(self):
+        from avenir_tpu.stream.loop import RedisQueues
+        fake = FakeRedis()
+        return RedisQueues(client=fake), fake
+
+    def test_event_fifo_and_action_format(self):
+        q, fake = self._queues()
+        fake.lpush("eventQueue", "e1")
+        fake.lpush("eventQueue", "e2")
+        assert q.pop_event() == "e1"          # rpop = oldest first
+        q.write_actions("e1", ["page3", "page1"])
+        assert fake.lists["actionQueue"][0] == b"e1,page3,page1"
+
+    def test_reward_cursor_never_rereads(self):
+        q, fake = self._queues()
+        fake.lpush("rewardQueue", "a,10")
+        fake.lpush("rewardQueue", "b,20")
+        assert q.drain_rewards() == [("a", 10.0), ("b", 20.0)]
+        assert q.drain_rewards() == []        # cursor advanced
+        fake.lpush("rewardQueue", "c,30")     # lpush keeps neg indices stable
+        assert q.drain_rewards() == [("c", 30.0)]
+
+    def test_loop_end_to_end_over_fake_redis(self):
+        from avenir_tpu.stream.loop import OnlineLearnerLoop
+        q, fake = self._queues()
+        for i in range(40):
+            fake.lpush("eventQueue", f"session{i:04d}")
+        fake.lpush("rewardQueue", "page2,60")
+        fake.lpush("rewardQueue", "page3,90")
+        with OnlineLearnerLoop("randomGreedy", ["page1", "page2", "page3"],
+                               {"random.selection.prob": "0.3"}, q,
+                               seed=5) as loop:
+            stats = loop.run()
+        assert stats.events == 40 and stats.rewards == 2
+        actions = [v.decode() for v in fake.lists["actionQueue"]]
+        assert len(actions) == 40
+        assert all(a.split(",")[1] in ("page1", "page2", "page3")
+                   for a in actions)

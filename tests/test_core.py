@@ -275,14 +275,36 @@ class TestCliRetryBudget:
 
     def test_budget_exhaustion_raises(self, tmp_path, monkeypatch):
         from avenir_tpu.cli import main as M
-        monkeypatch.setitem(
-            M.VERBS, "WordCounter",
-            lambda c, i, o: (_ for _ in ()).throw(RuntimeError("down")))
+        calls = []
+
+        def always_down(conf, i, o):
+            calls.append(1)
+            raise RuntimeError("down")
+
+        monkeypatch.setitem(M.VERBS, "WordCounter", always_down)
         (tmp_path / "in.txt").write_text("a\n")
         with pytest.raises(RuntimeError):
             M.main(["WordCounter", str(tmp_path / "in.txt"),
                     str(tmp_path / "out.txt"),
                     "--conf", self._props(tmp_path)])
+        assert len(calls) == 2  # budget really was consumed
+
+    def test_checkpointed_verb_not_retried(self, tmp_path, monkeypatch):
+        from avenir_tpu.cli import main as M
+        calls = []
+
+        def down_once(conf, i, o):
+            calls.append(1)
+            raise RuntimeError("transient")
+
+        down_once.retry_safe = False
+        monkeypatch.setitem(M.VERBS, "WordCounter", down_once)
+        (tmp_path / "in.txt").write_text("a\n")
+        with pytest.raises(RuntimeError):
+            M.main(["WordCounter", str(tmp_path / "in.txt"),
+                    str(tmp_path / "out.txt"),
+                    "--conf", self._props(tmp_path)])
+        assert len(calls) == 1  # durability-owning verbs run exactly once
 
     def test_config_errors_fail_fast(self, tmp_path, monkeypatch):
         from avenir_tpu.cli import main as M
