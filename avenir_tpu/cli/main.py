@@ -271,10 +271,15 @@ def run_class_partition_generator(conf: JobConfig, in_path: str,
         attrs = [f.ordinal for f in table.feature_fields
                  if f.is_categorical or f.bucket_width is not None]
     parent = conf.get_float("parent.info")
-    splits = T.split_gains(
-        table, attrs, algorithm, parent,
-        conf.get_int("max.cat.attr.split.groups", 3))
-    T.write_candidate_splits(splits, out_path, delim)
+    max_groups = conf.get_int("max.cat.attr.split.groups", 3)
+    class_probs = None
+    if conf.get_bool("output.split.prob", False):
+        splits, class_probs = T.split_gains_with_class_probs(
+            table, attrs, algorithm, parent, max_groups)
+    else:
+        splits = T.split_gains(table, attrs, algorithm, parent, max_groups)
+    T.write_candidate_splits(splits, out_path, delim,
+                             class_probs=class_probs)
 
 
 def run_data_partitioner(conf: JobConfig, in_path: str, out_path: str) -> None:

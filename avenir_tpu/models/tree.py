@@ -121,12 +121,9 @@ def parse_categorical_split_key(key: str) -> Tuple[Tuple[str, ...], ...]:
 # gains: one batched device pass per attribute
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("n_segments", "n_classes", "algorithm"))
-def _numeric_split_counts(values: jnp.ndarray, labels: jnp.ndarray,
-                          points: jnp.ndarray, n_segments: int,
-                          n_classes: int, algorithm: str,
-                          weights: Optional[jnp.ndarray] = None):
-    """values [N], points [S, P] (+inf padded) -> (stat [S], intrinsic [S]).
+def _numeric_seg_class_counts(values, labels, points, n_segments, n_classes,
+                              weights):
+    """values [N], points [S, P] (+inf padded) -> [S, G, C] counts.
 
     Segment of a value = #points strictly below it (IntegerSplit
     .getSegmentIndex: advance while value > point, AttributeSplitHandler
@@ -137,7 +134,28 @@ def _numeric_split_counts(values: jnp.ndarray, labels: jnp.ndarray,
     oh_lab = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)      # [N, C]
     if weights is not None:
         oh_lab = oh_lab * weights[:, None]
-    counts = jnp.einsum("sng,nc->sgc", oh_seg, oh_lab)                 # [S,G,C]
+    return jnp.einsum("sng,nc->sgc", oh_seg, oh_lab)                   # [S,G,C]
+
+
+def _categorical_seg_class_counts(codes, labels, group_of_code, n_segments,
+                                  n_classes, weights):
+    """codes [N] vocab ids, group_of_code [S, V] -> [S, G, C] counts."""
+    seg = group_of_code[:, codes]                                      # [S, N]
+    oh_seg = jax.nn.one_hot(seg, n_segments, dtype=jnp.float32)
+    oh_lab = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
+    if weights is not None:
+        oh_lab = oh_lab * weights[:, None]
+    return jnp.einsum("sng,nc->sgc", oh_seg, oh_lab)
+
+
+@partial(jax.jit, static_argnames=("n_segments", "n_classes", "algorithm"))
+def _numeric_split_counts(values: jnp.ndarray, labels: jnp.ndarray,
+                          points: jnp.ndarray, n_segments: int,
+                          n_classes: int, algorithm: str,
+                          weights: Optional[jnp.ndarray] = None):
+    """-> (stat [S], intrinsic [S])."""
+    counts = _numeric_seg_class_counts(values, labels, points, n_segments,
+                                       n_classes, weights)
     return it.split_stat(counts, algorithm), it.intrinsic_info_content(counts)
 
 
@@ -146,14 +164,30 @@ def _categorical_split_counts(codes: jnp.ndarray, labels: jnp.ndarray,
                               group_of_code: jnp.ndarray, n_segments: int,
                               n_classes: int, algorithm: str,
                               weights: Optional[jnp.ndarray] = None):
-    """codes [N] vocab ids, group_of_code [S, V] -> (stat [S], intrinsic [S])."""
-    seg = group_of_code[:, codes]                                      # [S, N]
-    oh_seg = jax.nn.one_hot(seg, n_segments, dtype=jnp.float32)
-    oh_lab = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
-    if weights is not None:
-        oh_lab = oh_lab * weights[:, None]
-    counts = jnp.einsum("sng,nc->sgc", oh_seg, oh_lab)
+    """-> (stat [S], intrinsic [S])."""
+    counts = _categorical_seg_class_counts(codes, labels, group_of_code,
+                                           n_segments, n_classes, weights)
     return it.split_stat(counts, algorithm), it.intrinsic_info_content(counts)
+
+
+@partial(jax.jit, static_argnames=("n_segments", "n_classes", "algorithm"))
+def _numeric_split_full(values, labels, points, n_segments, n_classes,
+                        algorithm):
+    """-> (stat [S], intrinsic [S], counts [S, G, C]) — one dispatch
+    computes both the gains and the output.split.prob payload."""
+    counts = _numeric_seg_class_counts(values, labels, points, n_segments,
+                                       n_classes, None)
+    return (it.split_stat(counts, algorithm),
+            it.intrinsic_info_content(counts), counts)
+
+
+@partial(jax.jit, static_argnames=("n_segments", "n_classes", "algorithm"))
+def _categorical_split_full(codes, labels, group_of_code, n_segments,
+                            n_classes, algorithm):
+    counts = _categorical_seg_class_counts(codes, labels, group_of_code,
+                                           n_segments, n_classes, None)
+    return (it.split_stat(counts, algorithm),
+            it.intrinsic_info_content(counts), counts)
 
 
 @dataclass
@@ -241,34 +275,62 @@ def _attr_plans(table: EncodedTable, attr_ordinals: Sequence[int],
 
 
 def _dispatch_and_fetch(table: EncodedTable, plans, algorithm,
-                        row_mask, multi: bool):
+                        row_mask, multi: bool, with_counts: bool = False):
     """Enqueue every plan's chunk kernels, then ONE fused readback.
 
     Returns (stats, intrinsic) with a trailing candidate axis of total
-    length sum(len(keys)); with ``multi`` a leading node axis K. Dispatch
-    and readback are separated so the device pipelines a whole level's
-    kernels and the host pays one transfer latency total (the relay to the
-    chip adds ~150ms per blocking fetch)."""
+    length sum(len(keys)); with ``multi`` a leading node axis K; with
+    ``with_counts`` (single-node only) additionally a per-attribute list of
+    [S, G, C] segment-class counts riding the same dispatches and the same
+    single fetch. Dispatch and readback are separated so the device
+    pipelines a whole level's kernels and the host pays one transfer
+    latency total (the relay to the chip adds ~150ms per blocking fetch)."""
+    assert not (multi and with_counts)
     num_fn = _numeric_split_counts_multi if multi else _numeric_split_counts
     cat_fn = (_categorical_split_counts_multi if multi
               else _categorical_split_counts)
-    stats_l, intr_l = [], []
+    stats_l, intr_l, counts_l, count_shapes = [], [], [], []
     for attr, keys, is_cat, column, aux, n_seg in plans:
-        fn = cat_fn if is_cat else num_fn
         for c0 in range(0, len(keys), _SPLIT_CHUNK):
-            st, ii = fn(column, table.labels,
-                        jnp.asarray(aux[c0:c0 + _SPLIT_CHUNK]),
-                        n_seg, table.n_classes, algorithm, row_mask)
+            aux_c = jnp.asarray(aux[c0:c0 + _SPLIT_CHUNK])
+            if with_counts:
+                fn = _categorical_split_full if is_cat else _numeric_split_full
+                st, ii, cnt = fn(column, table.labels, aux_c, n_seg,
+                                 table.n_classes, algorithm)
+                counts_l.append(cnt.astype(jnp.float32).reshape(-1))
+                count_shapes.append(cnt.shape)
+            else:
+                fn = cat_fn if is_cat else num_fn
+                st, ii = fn(column, table.labels, aux_c, n_seg,
+                            table.n_classes, algorithm, row_mask)
             stats_l.append(st)
             intr_l.append(ii)
     axis = 1 if multi else 0
     fetched = np.asarray(jnp.concatenate(
         [jnp.concatenate(stats_l, axis=axis).astype(jnp.float32),
-         jnp.concatenate(intr_l, axis=axis).astype(jnp.float32)], axis=axis))
-    half = fetched.shape[axis] // 2
+         jnp.concatenate(intr_l, axis=axis).astype(jnp.float32)]
+        + counts_l, axis=axis))
     if multi:
+        half = fetched.shape[1] // 2
         return fetched[:, :half], fetched[:, half:]
-    return fetched[:half], fetched[half:]
+    n_total = sum(len(keys) for _, keys, *_ in plans)
+    stats_flat, intr_flat = fetched[:n_total], fetched[n_total:2 * n_total]
+    if not with_counts:
+        return stats_flat, intr_flat
+    counts_per_attr = []
+    pos = 2 * n_total
+    shape_i = 0
+    for _, keys, *_ in plans:
+        covered, chunks = 0, []
+        while covered < len(keys):
+            shp = count_shapes[shape_i]
+            size = int(np.prod(shp))
+            chunks.append(fetched[pos:pos + size].reshape(shp))
+            pos += size
+            covered += shp[0]
+            shape_i += 1
+        counts_per_attr.append(np.concatenate(chunks))
+    return stats_flat, intr_flat, counts_per_attr
 
 
 def _assemble_candidates(plans, stats_flat, intr_flat, algorithm,
@@ -310,6 +372,42 @@ def split_gains(table: EncodedTable, attr_ordinals: Sequence[int],
         table, plans, algorithm, row_mask, multi=False)
     return _assemble_candidates(plans, stats_flat, intr_flat, algorithm,
                                 parent_info)
+
+
+def split_gains_with_class_probs(
+        table: EncodedTable, attr_ordinals: Sequence[int],
+        algorithm: str = "giniIndex",
+        parent_info: Optional[float] = None,
+        max_cat_attr_split_groups: int = 3,
+) -> Tuple[List[CandidateSplit],
+           Dict[Tuple[int, str], List[Tuple[int, str, float]]]]:
+    """``split_gains`` plus P(class | segment) per candidate split — the
+    ``output.split.prob=true`` payload (ClassPartitionGenerator.java:539-560,
+    serialized as repeating ``segment;classVal;prob`` triples). Stats and
+    counts come out of the SAME kernel dispatches (no second counting pass)
+    with one fused readback for everything."""
+    if parent_info is None:
+        parent_info = root_info(table, algorithm)
+    plans = _attr_plans(table, attr_ordinals, max_cat_attr_split_groups)
+    if not plans:
+        return [], {}
+    stats_flat, intr_flat, counts_per_attr = _dispatch_and_fetch(
+        table, plans, algorithm, None, multi=False, with_counts=True)
+    cands = _assemble_candidates(plans, stats_flat, intr_flat, algorithm,
+                                 parent_info)
+    probs_out: Dict[Tuple[int, str], List[Tuple[int, str, float]]] = {}
+    for (attr, keys, *_), counts in zip(plans, counts_per_attr):
+        seg_tot = counts.sum(axis=2, keepdims=True)     # counts: [S, G, C]
+        probs = counts / np.maximum(seg_tot, 1.0)
+        for s, key in enumerate(keys):
+            triples = []
+            for g in range(counts.shape[1]):
+                if seg_tot[s, g, 0] <= 0:
+                    continue          # segment absent from this split
+                for c, cls in enumerate(table.class_values):
+                    triples.append((g, cls, float(probs[s, g, c])))
+            probs_out[(attr, key)] = triples
+    return cands, probs_out
 
 
 #: max nodes per vmapped dispatch — bounds the K-times peak-memory blowup of
@@ -354,13 +452,22 @@ def split_gains_multi(table: EncodedTable, attr_ordinals: Sequence[int],
 # --------------------------------------------------------------------------
 
 def write_candidate_splits(splits: List[CandidateSplit], path: str,
-                           delim: str = ";") -> None:
+                           delim: str = ";",
+                           class_probs: Optional[Dict] = None) -> None:
     """Lines ``attr;splitKey;stat`` — what DataPartitioner.findBestSplitKey
-    parses and sorts descending on field 2 (DataPartitioner.java:219-226)."""
+    parses and sorts descending on field 2 (DataPartitioner.java:219-226).
+    With ``class_probs`` (from :func:`split_gains_with_class_probs`) each line carries
+    the reference's ``output.split.prob`` suffix of repeating
+    ``segment;classVal;prob`` triples (:539-560); the read path ignores the
+    extra fields, as the reference's does."""
     with open(path, "w") as fh:
         for s in splits:
-            fh.write(delim.join([str(s.attr_ordinal), s.key,
-                                 repr(s.gain_ratio)]) + "\n")
+            parts = [str(s.attr_ordinal), s.key, repr(s.gain_ratio)]
+            if class_probs is not None:
+                for seg, cls, pr in class_probs.get(
+                        (s.attr_ordinal, s.key), []):
+                    parts += [str(seg), cls, repr(pr)]
+            fh.write(delim.join(parts) + "\n")
 
 
 def read_candidate_splits(path: str, delim: str = ";"

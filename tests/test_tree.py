@@ -10,6 +10,14 @@ from avenir_tpu.utils.dataset import Featurizer
 from avenir_tpu.utils.schema import FeatureField
 
 
+@pytest.fixture(scope="module")
+def table_retarget():
+    fz = Featurizer(retarget_schema())
+    rows = retarget_rows(400, seed=9)
+    fz.fit(rows)
+    return fz.transform(rows)
+
+
 class TestEnumeration:
     def test_numeric_splits(self):
         f = FeatureField(name="x", ordinal=1, data_type="int",
@@ -126,3 +134,38 @@ class TestGrowTree:
         tree = T.grow_tree(table, T.TreeConfig(max_depth=2))
         d = tree.to_dict()
         assert "children" in d and "classCounts" in d
+
+
+class TestSplitClassProbs:
+    """output.split.prob payload: P(class|segment) per candidate split
+    (ClassPartitionGenerator.java:539-560)."""
+
+    def test_probs_sum_to_one_and_recover_rule(self, table_retarget):
+        cands, probs = T.split_gains_with_class_probs(
+            table_retarget, [1], "giniIndex", 0.5, 3)
+        assert probs and len(probs) == len(cands)
+        # stats identical to the plain gains pass (same kernels, same math)
+        plain = T.split_gains(table_retarget, [1], "giniIndex", 0.5, 3)
+        assert [(c.attr_ordinal, c.key, c.stat) for c in cands] == \
+               [(c.attr_ordinal, c.key, c.stat) for c in plain]
+        for (attr, key), triples in probs.items():
+            assert attr == 1
+            by_seg = {}
+            for seg, cls, pr in triples:
+                by_seg.setdefault(seg, 0.0)
+                by_seg[seg] += pr
+            for seg, total in by_seg.items():
+                assert abs(total - 1.0) < 1e-5, (key, seg, total)
+
+    def test_wire_suffix_round_trip(self, table_retarget, tmp_path):
+        cands, probs = T.split_gains_with_class_probs(
+            table_retarget, [1], "giniIndex", 0.5, 3)
+        path = str(tmp_path / "splits.txt")
+        T.write_candidate_splits(cands, path, ";", class_probs=probs)
+        with open(path) as fh:
+            lines = [l.split(";") for l in fh.read().splitlines()]
+        # suffix present: 3 base fields + 3-field triples
+        assert all(len(l) > 3 and (len(l) - 3) % 3 == 0 for l in lines)
+        # the read path ignores the suffix
+        parsed = T.read_candidate_splits(path, ";")
+        assert len(parsed) == len(cands)
