@@ -525,22 +525,33 @@ def run_mutual_information(conf: JobConfig, in_path: str,
     table = fz.transform(rows)
     scores = mi.compute_scores(mi.compute_distributions(table))
     delim = conf.get("field.delim.out", ",")
-    algos = conf.get_list("mi.score.algorithms",
-                          ["mutualInfoMaximizer"])
-    rf = conf.get_float("mi.redundancy.factor", 1.0)
+    # the reference's key/value names (MutualInformation.java:452-455,
+    # resource/hosp.properties) with this build's camelCase names as aliases
+    # explicit None checks: an explicitly-empty value suppresses rankings,
+    # only a truly absent key falls back
+    algos = conf.get_list("mutual.info.score.algorithms")
+    if algos is None:
+        algos = conf.get_list("mi.score.algorithms")
+    if algos is None:
+        algos = ["mutual.info.maximization"]
+    rf = conf.get_float("mutual.info.redundancy.factor",
+                        conf.get_float("mi.redundancy.factor", 1.0))
+    output_mi = conf.get_bool("output.mutual.info", True)
     with open(out_path, "w") as fh:
-        for ordinal, value in sorted(scores.feature_class_mi.items()):
-            fh.write(delim.join(["featureClass", str(ordinal),
-                                 repr(value)]) + "\n")
-        for (a, b), value in sorted(scores.feature_pair_mi.items()):
-            fh.write(delim.join(["featurePair", str(a), str(b),
-                                 repr(value)]) + "\n")
-        for (a, b), value in sorted(scores.feature_pair_class_mi.items()):
-            fh.write(delim.join(["featurePairClass", str(a), str(b),
-                                 repr(value)]) + "\n")
-        for (a, b), value in sorted(scores.class_cond_pair_mi.items()):
-            fh.write(delim.join(["classCondPair", str(a), str(b),
-                                 repr(value)]) + "\n")
+        if output_mi:
+            for ordinal, value in sorted(scores.feature_class_mi.items()):
+                fh.write(delim.join(["featureClass", str(ordinal),
+                                     repr(value)]) + "\n")
+            for (a, b), value in sorted(scores.feature_pair_mi.items()):
+                fh.write(delim.join(["featurePair", str(a), str(b),
+                                     repr(value)]) + "\n")
+            for (a, b), value in sorted(
+                    scores.feature_pair_class_mi.items()):
+                fh.write(delim.join(["featurePairClass", str(a), str(b),
+                                     repr(value)]) + "\n")
+            for (a, b), value in sorted(scores.class_cond_pair_mi.items()):
+                fh.write(delim.join(["classCondPair", str(a), str(b),
+                                     repr(value)]) + "\n")
         for algo in algos:
             ranked = mi.SCORE_ALGORITHMS[algo](scores, redundancy_factor=rf)
             for rank, (ordinal, value) in enumerate(ranked):
@@ -770,6 +781,9 @@ def main(argv: List[str] = None) -> int:
     attempts = max(1,   # floor: zero/negative budgets must not skip the job
                    conf.get_int("mapreduce.map.maxattempts", 1),
                    conf.get_int("mapreduce.reduce.maxattempts", 1),
+                   # the old-style Hadoop spellings (resource/hosp.properties)
+                   conf.get_int("mapred.map.max.attempts", 1),
+                   conf.get_int("mapred.reduce.max.attempts", 1),
                    conf.get_int("max.attempts", 1))
     if not getattr(VERBS[args.verb], "retry_safe", True):
         # verbs that manage their own durability (checkpoint + replay)

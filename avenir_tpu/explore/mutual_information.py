@@ -220,3 +220,15 @@ SCORE_ALGORITHMS = {
     "doubleInputSymmetricalRelevance": lambda s, **kw: disr(s),
     "minRedundancyMaxRelevance": lambda s, **kw: mrmr(s),
 }
+
+# the reference's own dotted algorithm names (MutualInformation.java:797-821,
+# as configured in resource/hosp.properties) alias the registry entries
+SCORE_ALGORITHMS.update({
+    "mutual.info.maximization": SCORE_ALGORITHMS["mutualInfoMaximizer"],
+    "mutual.info.selection": SCORE_ALGORITHMS["mutualInfoFeatureSelection"],
+    "joint.mutual.info": SCORE_ALGORITHMS["jointMutualInfo"],
+    "double.input.symmetric.relevance":
+        SCORE_ALGORITHMS["doubleInputSymmetricalRelevance"],
+    "min.redundancy.max.relevance":
+        SCORE_ALGORITHMS["minRedundancyMaxRelevance"],
+})
