@@ -282,6 +282,44 @@ def run_class_partition_generator(conf: JobConfig, in_path: str,
                              class_probs=class_probs)
 
 
+def _read_raw_lines(path: str) -> List[str]:
+    """Raw non-empty lines of a file or MR part-file dir — EXACTLY the rows
+    ``read_csv_lines`` parses (same sidecar filter, same empty-line rule),
+    so verbatim-emit paths stay index-aligned with the parsed table."""
+    import os
+    if os.path.isdir(path):
+        lines: List[str] = []
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            if name.startswith(("_", ".")) or not os.path.isfile(full):
+                continue
+            lines.extend(_read_raw_lines(full))
+        return lines
+    with open(path) as fh:
+        return [l.rstrip("\n") for l in fh if l.rstrip("\n")]
+
+
+def run_split_generator(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """ClassPartitionGenerator with the tree.SplitGenerator path convention
+    (SplitGenerator.java:39-54): when ``project.base.path`` is set, the
+    positional paths are OVERRIDDEN (as the reference does) by
+    ``<base>/split=root/data[/<split.path>]`` → sibling ``splits/`` dir
+    (written as ``splits/part-r-00000``, the artifact DataPartitioner's
+    default reader expects). Directory inputs (MR part-file dirs) are
+    handled by ``read_csv_lines`` for every verb."""
+    import os
+    base = conf.get("project.base.path")
+    if base:
+        split_path = conf.get("split.path")
+        in_path = os.path.join(base, "split=root", "data")
+        if split_path:
+            in_path = os.path.join(in_path, split_path)
+        out_dir = os.path.join(os.path.dirname(in_path), "splits")
+        os.makedirs(out_dir, exist_ok=True)
+        out_path = os.path.join(out_dir, "part-r-00000")
+    run_class_partition_generator(conf, in_path, out_path)
+
+
 def run_data_partitioner(conf: JobConfig, in_path: str, out_path: str) -> None:
     """Partition node data by the best candidate split (reference
     tree.DataPartitioner): reads the sibling ``splits`` artifact, sorts by
@@ -295,8 +333,11 @@ def run_data_partitioner(conf: JobConfig, in_path: str, out_path: str) -> None:
     fz, rows = _load_table(conf, in_path)
     table = fz.transform(rows)
     delim = conf.get("field.delim.out", ";")
+    # sibling `splits/` of the node's data: for a part-file dir input the
+    # data component IS in_path; for a file input it is the parent dir
+    data_dir = in_path if os.path.isdir(in_path) else os.path.dirname(in_path)
     splits_path = conf.get("candidate.splits.path") or os.path.join(
-        os.path.dirname(os.path.dirname(in_path)), "splits", "part-r-00000")
+        os.path.dirname(data_dir), "splits", "part-r-00000")
     candidates = T.read_candidate_splits(splits_path, delim)
     split_index, (attr, key, _stat) = T.select_split(
         candidates, conf.get("split.selection.strategy", "best"),
@@ -304,9 +345,9 @@ def run_data_partitioner(conf: JobConfig, in_path: str, out_path: str) -> None:
     segs = T.segment_of_rows(table, attr, key)
     # emit the ORIGINAL input lines unchanged (the reference mapper writes
     # `value` verbatim) — rejoining parsed tokens would corrupt data whose
-    # delimiter regex is not its literal delimiter
-    with open(in_path) as fh:
-        raw_lines = [l.rstrip("\n") for l in fh if l.strip()]
+    # delimiter regex is not its literal delimiter. Same file/dir handling
+    # and line filter as read_csv_lines so indices stay row-aligned.
+    raw_lines = _read_raw_lines(in_path)
     for seg in sorted(set(int(s) for s in np.asarray(segs))):
         seg_dir = os.path.join(out_path, f"split={split_index}",
                                f"segment={seg}", "data")
@@ -715,7 +756,7 @@ VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "SameTypeSimilarity": run_same_type_similarity,
     "NearestNeighbor": run_nearest_neighbor,
     "ClassPartitionGenerator": run_class_partition_generator,
-    "SplitGenerator": run_class_partition_generator,
+    "SplitGenerator": run_split_generator,
     "DataPartitioner": run_data_partitioner,
     "MarkovStateTransitionModel": run_markov_state_transition_model,
     "MarkovModelClassifier": run_markov_model_classifier,

@@ -20,6 +20,7 @@ mask) so it can be sharded over the ``data`` mesh axis and consumed inside
 
 from __future__ import annotations
 
+import os
 import re
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -32,9 +33,21 @@ from avenir_tpu.utils.schema import FeatureField, FeatureSchema
 
 def read_csv_lines(path: str, delim_regex: str = ",") -> List[List[str]]:
     """Read CSV rows, splitting on a regex like the reference's
-    ``field.delim.regex`` (every mapper does ``value.split(fieldDelimRegex)``)."""
+    ``field.delim.regex`` (every mapper does ``value.split(fieldDelimRegex)``).
+
+    A directory reads every non-hidden regular file in sorted order — an MR
+    input dir of part files, with Hadoop's hiddenFileFilter semantics
+    (names starting with ``_`` or ``.`` are sidecars, not data)."""
+    if os.path.isdir(path):
+        rows: List[List[str]] = []
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            if name.startswith(("_", ".")) or not os.path.isfile(full):
+                continue
+            rows.extend(read_csv_lines(full, delim_regex))
+        return rows
     splitter = re.compile(delim_regex)
-    rows: List[List[str]] = []
+    rows = []
     with open(path, "r") as fh:
         for line in fh:
             line = line.rstrip("\n")

@@ -113,9 +113,13 @@ def project_file(in_path: str, out_path: str, key_field: int,
         else None
     if delim is not None:
         delim_out = delim
+    import os
     has_negative = (key_field < 0 or order_by_field < 0
                     or any(f < 0 for f in projection_fields))
-    if not force_python and delim is not None and not has_negative:
+    # the native pass reads one file's raw bytes; directory inputs (MR
+    # part-file dirs) take the Python path via read_csv_lines
+    if (not force_python and delim is not None and not has_negative
+            and os.path.isfile(in_path)):
         from avenir_tpu import native
         lib = native._load()
         if lib is not None:

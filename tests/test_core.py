@@ -321,3 +321,21 @@ class TestCliRetryBudget:
                     str(tmp_path / "out.txt"),
                     "--conf", self._props(tmp_path)])
         assert len(calls) == 1
+
+
+class TestDirectoryInput:
+    """MR-dir inputs: part files merge in sorted order, sidecars skipped,
+    missing trailing newlines cannot fuse rows (read_csv_lines reads each
+    file separately)."""
+
+    def test_part_files_and_sidecars(self, tmp_path):
+        from avenir_tpu.utils.dataset import read_csv_lines
+        d = tmp_path / "input"
+        d.mkdir()
+        # part-00000 deliberately lacks a trailing newline
+        (d / "part-00000").write_text("a,1\nb,2")
+        (d / "part-00001").write_text("c,3\n")
+        (d / "_SUCCESS").write_text("")
+        (d / ".part-00000.crc").write_bytes(b"\x00\x01binary")
+        rows = read_csv_lines(str(d))
+        assert rows == [["a", "1"], ["b", "2"], ["c", "3"]]

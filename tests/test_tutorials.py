@@ -499,3 +499,41 @@ class TestKnnShellDriver:
         bad = subprocess.run(["bash", script, "nope"], env=env,
                              capture_output=True, text=True)
         assert bad.returncode == 1
+
+
+class TestSplitGeneratorPathConvention:
+    """tree.SplitGenerator derives in/out from project.base.path + split.path
+    (SplitGenerator.java:39-54); positional args are overridden."""
+
+    def test_base_path_layout(self, tmp_path, capsys):
+        rows = G.retarget_rows(600, seed=35)
+        base = tmp_path / "campaign"
+        (base / "split=root" / "data").mkdir(parents=True)
+        write_csv(base / "split=root" / "data" / "part-00000", rows[:300])
+        write_csv(base / "split=root" / "data" / "part-00001", rows[300:])
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._RETARGET_SCHEMA_JSON, fh)
+        props = tmp_path / "retarget.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "field.delim.out": ";",
+                       "split.algorithm": "giniIndex",
+                       "split.attributes": "1",
+                       "parent.info": "0.47",
+                       "project.base.path": base})
+        # positional paths deliberately bogus: the convention overrides them
+        cli(["SplitGenerator", "IGNORED_IN", "IGNORED_OUT",
+             "--conf", str(props)])
+        out = base / "split=root" / "splits" / "part-r-00000"
+        assert out.exists()
+        lines = [l.split(";") for l in out.read_text().splitlines()]
+        assert lines and all(l[0] == "1" for l in lines)
+        # the next pipeline step consumes the SAME dir + sibling splits
+        cli(["DataPartitioner", str(base / "split=root" / "data"),
+             str(base / "split=root"), "--conf", str(props)])
+        capsys.readouterr()
+        parts = list((base / "split=root").glob(
+            "split=*/segment=*/data/partition.txt"))
+        assert parts, "DataPartitioner wrote no partitions from dir input"
+        n_rows = sum(len(p.read_text().splitlines()) for p in parts)
+        assert n_rows == 600
