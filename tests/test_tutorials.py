@@ -592,3 +592,48 @@ class TestKnnRegressionCli:
         # the planted linear target must beat half of that
         baseline = float(np.abs(truth - truth.mean()).mean())
         assert mae < 0.5 * baseline, (method, mae, baseline)
+
+
+class TestBayesArbitrationCli:
+    """BayesianPredictor's arbitration knobs through the CLI:
+    bp.predict.class.cost (cost arbitration), class.prob.diff.threshold
+    (ambiguity column) — BayesianPredictor.java:125-165 key plumbing."""
+
+    def _fixture(self, tmp_path):
+        rows = G.churn_rows(1200, seed=111)
+        write_csv(tmp_path / "train.csv", rows[:900])
+        write_csv(tmp_path / "test.csv", rows[900:])
+        with open(tmp_path / "churn.json", "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        props = tmp_path / "churn.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "churn.json",
+                       "bayesian.model.file.path": tmp_path / "model.txt",
+                       "laplace.smoothing": "1.0"})
+        cli(["BayesianDistribution", str(tmp_path / "train.csv"),
+             str(tmp_path / "model.txt"), "--conf", str(props)])
+        return props
+
+    def test_cost_arbitration_skews_positive(self, tmp_path, capsys):
+        props = self._fixture(tmp_path)
+        def n_closed(extra):
+            cli(["BayesianPredictor", str(tmp_path / "test.csv"),
+                 str(tmp_path / "pred.txt"), "--conf", str(props)] + extra)
+            capsys.readouterr()
+            return sum(l.split(",")[-2] == "closed"
+                       for l in open(tmp_path / "pred.txt"))
+        plain = n_closed([])
+        # heavy false-negative cost: predicting the positive class more often
+        costly = n_closed(["-D", "bp.predict.class=open,closed",
+                           "-D", "bp.predict.class.cost=8,1"])
+        assert costly > plain
+
+    def test_ambiguity_column(self, tmp_path, capsys):
+        props = self._fixture(tmp_path)
+        cli(["BayesianPredictor", str(tmp_path / "test.csv"),
+             str(tmp_path / "pred.txt"), "--conf", str(props),
+             "-D", "class.prob.diff.threshold=20"])
+        capsys.readouterr()
+        tags = {l.rsplit(",", 1)[-1]
+                for l in open(tmp_path / "pred.txt").read().splitlines()}
+        assert tags == {"ambiguous", "classified"}  # both outcomes present
