@@ -36,7 +36,7 @@ M_TEST = int(os.environ.get("BENCH_M_TEST", 8192))
 N_FEATURES = 9
 K = 5
 ITERS = int(os.environ.get("BENCH_ITERS", 100))
-REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 5))
 # "auto": hand-scheduled pallas kernel on TPU, XLA path elsewhere
 IMPL = os.environ.get("BENCH_IMPL", "auto")
 
