@@ -95,4 +95,20 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    # the relay to the chip can throw transient compile/transfer errors
+    # (HTTP 500s observed); the driver records this run's single JSON line,
+    # so a flake must not lose the round's measurement. Deterministic
+    # failures (bad config/JSON, shape errors) surface immediately.
+    for attempt in range(3):
+        try:
+            main()
+            break
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError):
+            raise
+        except Exception as exc:
+            print(f"bench attempt {attempt + 1} failed: {exc!r}",
+                  file=sys.stderr)
+            if attempt == 2:
+                raise
+            time.sleep(5)
