@@ -19,6 +19,8 @@ import contextlib
 import sys
 from typing import Callable, Dict, List
 
+import numpy as np
+
 from avenir_tpu.utils.config import JobConfig
 from avenir_tpu.utils.dataset import Featurizer, read_csv_lines
 from avenir_tpu.utils.schema import FeatureSchema
@@ -217,6 +219,19 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
             regr_input = (
                 jnp.asarray([float(r[x_ord]) for r in train_rows]),
                 jnp.asarray([float(r[x_ord]) for r in test_rows]))
+        elif cfg.regression_method == "multiLinearRegression":
+            # all numeric input variables (regr.input.field.ordinals, default
+            # every numeric feature) — the fit Neighborhood.java:246-249
+            # left TODO
+            ords = conf.get_int_list("regr.input.field.ordinals")
+            if ords is None:
+                ords = [f.ordinal for f in fz.schema.get_feature_fields()
+                        if not f.is_categorical]
+            regr_input = (
+                jnp.asarray([[float(r[o]) for o in ords]
+                             for r in train_rows]),
+                jnp.asarray([[float(r[o]) for o in ords]
+                             for r in test_rows]))
         pred = knn.regress(train, test, cfg, targets, regr_input=regr_input)
         with open(out_path, "w") as fh:
             for i in range(test.n_rows):
@@ -250,6 +265,39 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
         print(cm.report().to_json())
 
 
+def _select_split_attributes(conf: JobConfig, table) -> List[int]:
+    """``split.attribute.selection.strategy`` (ClassPartitionGenerator.java
+    :141, :160-196): userSpecified / all / random. ``random`` draws
+    ``random.split.set.size`` distinct feature ordinals (the random-forest
+    per-round subset, :176-189). Like the reference's bare Math.random()
+    it draws fresh entropy per invocation — so successive forest rounds get
+    different subsets — unless ``random.seed`` is set, which pins the draw
+    for reproducible runs. ``notUsedYet`` is an unimplemented TODO in the
+    reference itself (:171-175) and is rejected here too."""
+    splittable = [f.ordinal for f in table.feature_fields
+                  if f.is_categorical or f.bucket_width is not None]
+    strategy = conf.get("split.attribute.selection.strategy", "userSpecified")
+    if strategy == "userSpecified":
+        attrs = conf.get_int_list("split.attributes")
+        # reference requires split.attributes here; degrade to all splittable
+        # so round-1 configs without the key keep working
+        return attrs if attrs is not None else splittable
+    if strategy == "all":
+        return splittable
+    if strategy == "random":
+        size = min(conf.get_int("random.split.set.size", 3), len(splittable))
+        rng = np.random.default_rng(conf.get_int("random.seed"))
+        return sorted(int(o) for o in
+                      rng.choice(splittable, size=size, replace=False))
+    if strategy == "notUsedYet":
+        raise ValueError(
+            "split.attribute.selection.strategy=notUsedYet is a TODO in the "
+            "reference (ClassPartitionGenerator.java:171-175) and is not "
+            "implemented here either")
+    raise ValueError(
+        f"invalid splitting attribute selection strategy {strategy!r}")
+
+
 def run_class_partition_generator(conf: JobConfig, in_path: str,
                                   out_path: str) -> None:
     """Candidate-split gains (reference ClassPartitionGenerator /
@@ -266,14 +314,14 @@ def run_class_partition_generator(conf: JobConfig, in_path: str,
         with open(out_path, "w") as fh:
             fh.write(repr(T.root_info(table, algorithm)) + "\n")
         return
-    attrs = conf.get_int_list("split.attributes")
-    if attrs is None:
-        attrs = [f.ordinal for f in table.feature_fields
-                 if f.is_categorical or f.bucket_width is not None]
+    attrs = _select_split_attributes(conf, table)
     parent = conf.get_float("parent.info")
     max_groups = conf.get_int("max.cat.attr.split.groups", 3)
     class_probs = None
-    if conf.get_bool("output.split.prob", False):
+    # the reference emits the class-prob suffix only for entropy/giniIndex
+    # (ClassPartitionGenerator.java:531-545); other algorithms ignore the flag
+    if (conf.get_bool("output.split.prob", False)
+            and algorithm in ("entropy", "giniIndex")):
         splits, class_probs = T.split_gains_with_class_probs(
             table, attrs, algorithm, parent, max_groups)
     else:
@@ -835,7 +883,9 @@ def main(argv: List[str] = None) -> int:
             try:
                 VERBS[args.verb](conf, args.input, args.output)
                 break
-            except (ValueError, KeyError, FileNotFoundError):
+            except (ValueError, KeyError, FileNotFoundError, TypeError,
+                    IndexError):
+                # deterministic input/config defects: a re-run cannot succeed
                 raise
             except Exception:
                 if attempt == attempts:

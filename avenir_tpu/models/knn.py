@@ -199,11 +199,14 @@ def regress(train: EncodedTable, test: EncodedTable, config: KnnConfig,
             regr_input: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
             ) -> KnnPrediction:
     """KNN regression: average / median / per-neighborhood linear fit
-    (Neighborhood.doRegression :223-250; multi-linear is TODO in the
-    reference and omitted here too).
+    (Neighborhood.doRegression :223-250), plus ``multiLinearRegression`` —
+    a closed-form ridge-regularized least squares over all neighbor
+    features, completing the TODO the reference left at
+    Neighborhood.java:246-249.
 
-    ``regr_input`` = (train_x [N], test_x [M]) for the linear mode, matching
-    the reference's regrInputVar.
+    ``regr_input`` = (train_x [N], test_x [M]) for the linear mode (the
+    reference's regrInputVar), or ([N, F], [M, F]) feature matrices for the
+    multi-linear mode.
     """
     dist, idx = neighbors(train, test, config)
     nbr_y = train_targets[idx].astype(jnp.float32)              # [M, k]
@@ -231,6 +234,28 @@ def regress(train: EncodedTable, test: EncodedTable, config: KnnConfig,
         slope = sxy / jnp.where(sxx > 0, sxx, 1.0)
         intercept = my[:, 0] - slope * mx[:, 0]
         pred = jnp.asarray(intercept + slope * test_x, jnp.int32)
+    elif config.regression_method == "multiLinearRegression":
+        if regr_input is None:
+            raise ValueError("multiLinearRegression needs regr_input")
+        train_x, test_x = regr_input                # [N, F], [M, F]
+        if train_x.ndim != 2 or test_x.ndim != 2:
+            raise ValueError("multiLinearRegression needs [N, F]/[M, F] "
+                             "feature matrices as regr_input")
+        nbr_x = train_x[idx].astype(jnp.float32)    # [M, k, F]
+        ones = jnp.ones(nbr_x.shape[:2] + (1,), jnp.float32)
+        a = jnp.concatenate([nbr_x, ones], axis=2)  # [M, k, F+1]
+        ata = jnp.einsum("mkf,mkg->mfg", a, a)      # [M, F+1, F+1]
+        aty = jnp.einsum("mkf,mk->mf", a, nbr_y)
+        # scale-aware ridge keeps k < F+1 neighborhoods (and collinear
+        # neighbor features) solvable — the minimum-norm fit, batched
+        f1 = a.shape[2]
+        lam = 1e-5 * jnp.einsum("mff->m", ata)[:, None, None] / f1 + 1e-6
+        w = jnp.linalg.solve(ata + lam * jnp.eye(f1, dtype=jnp.float32),
+                             aty[..., None])[..., 0]    # [M, F+1]
+        test_aug = jnp.concatenate(
+            [test_x.astype(jnp.float32),
+             jnp.ones((test_x.shape[0], 1), jnp.float32)], axis=1)
+        pred = jnp.asarray(jnp.sum(test_aug * w, axis=1), jnp.int32)
     else:
         raise ValueError(
             f"unknown regression method {config.regression_method!r}")

@@ -285,11 +285,12 @@ def _dispatch_and_fetch(table: EncodedTable, plans, algorithm,
     single fetch. Dispatch and readback are separated so the device
     pipelines a whole level's kernels and the host pays one transfer
     latency total (the relay to the chip adds ~150ms per blocking fetch)."""
-    assert not (multi and with_counts)
+    if multi and with_counts:
+        raise ValueError("with_counts is single-node only")
     # the *_split_full kernels take no row weights; a masked counts request
     # would silently return whole-table numbers
-    assert not (with_counts and row_mask is not None), \
-        "with_counts does not support row_mask"
+    if with_counts and row_mask is not None:
+        raise ValueError("with_counts does not support row_mask")
     num_fn = _numeric_split_counts_multi if multi else _numeric_split_counts
     cat_fn = (_categorical_split_counts_multi if multi
               else _categorical_split_counts)

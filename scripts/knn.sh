@@ -24,6 +24,7 @@ AVENIR="${PYTHON:-python3} -m avenir_tpu"
 case "${1:-}" in
 computeDistance)
     echo "computing pairwise distances"
+    mkdir -p "$PROJECT_HOME/distance"   # Hadoop would create the output dir
     $AVENIR SameTypeSimilarity "$PROJECT_HOME/train.csv" \
         "$PROJECT_HOME/distance/part-00000" --conf "$PROPS"
     ;;
@@ -33,6 +34,7 @@ bayesianDistr|bayesianPredictor|joinFeatureDistr)
     ;;
 knnClassifier)
     echo "running knn classifier"
+    mkdir -p "$PROJECT_HOME/output"     # Hadoop would create the output dir
     $AVENIR NearestNeighbor "$PROJECT_HOME/test.csv" \
         "$PROJECT_HOME/output/part-00000" --conf "$PROPS"
     ;;

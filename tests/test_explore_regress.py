@@ -170,6 +170,33 @@ class TestLogistic:
             jnp.asarray(x), jnp.asarray(y), cfg10, None)
         np.testing.assert_allclose(w10, w10_direct, rtol=1e-5)
 
+    def test_f64_fallback_tight_threshold(self, tmp_path):
+        """Thresholds below float32 resolution run the float64 host loop
+        (reference computes in Java doubles) with identical history
+        semantics: same file contract, and iterates keep resolving changes
+        a float32 fixed point would freeze."""
+        x, y = self._data(500)
+        path = str(tmp_path / "coeffs.txt")
+        cfg = logistic.LogisticConfig(learning_rate=0.5, max_iterations=8000,
+                                      convergence_threshold=1e-7)
+        w, iters, conv = logistic.train(jnp.asarray(x), jnp.asarray(y), cfg,
+                                        path)
+        hist = [np.asarray([float(v) for v in l.split(",")])
+                for l in open(path).read().splitlines()]
+        assert len(hist) == iters
+        # the 1e-7-percent test passed with a GENUINE sub-f32 step: the last
+        # delta is nonzero (not a fixed point) yet below the f32 ulp of |w|
+        # (~6e-8 relative) — unreachable resolution for float32 iterates
+        assert conv and iters < cfg.max_iterations
+        late_delta = np.abs(hist[-1] - hist[-2]).max()
+        assert 0 < late_delta < np.abs(hist[-1]).max() * 6e-8
+        # agrees with the float32 path to float32 accuracy
+        w32, _, _ = logistic.train(
+            jnp.asarray(x), jnp.asarray(y),
+            logistic.LogisticConfig(learning_rate=0.5, max_iterations=300,
+                                    convergence_threshold=1e-3))
+        np.testing.assert_allclose(w, w32, atol=5e-3)
+
     def test_convergence_stops_early(self):
         x, y = self._data(500)
         cfg = logistic.LogisticConfig(learning_rate=0.01, max_iterations=500,

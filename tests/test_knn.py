@@ -10,6 +10,7 @@ from avenir_tpu.models import knn
 from avenir_tpu.models import naive_bayes as nb
 from avenir_tpu.ops import distance as D
 from avenir_tpu.utils.dataset import Featurizer
+from avenir_tpu.utils.schema import FeatureSchema
 
 
 class TestDistanceOp:
@@ -184,3 +185,54 @@ class TestRegression:
                            regr_input=(train_x, test_x))
         mae = np.abs(pred.predicted - truth).mean()
         assert mae < 25, mae
+
+    def test_multi_linear_recovers_planted_plane(self):
+        """multiLinearRegression (the fit Neighborhood.java:246-249 left
+        TODO): closed-form least squares over all neighbor features must
+        essentially recover a planted linear target, far beyond what
+        neighborhood averaging can do."""
+        rng = np.random.default_rng(7)
+        x = rng.uniform(0, 1, size=(600, 3)).astype(np.float32)
+        y = 200 * x[:, 0] + 100 * x[:, 1] - 50 * x[:, 2] + \
+            rng.normal(0, 2, 600)
+        rows = [[f"R{i:05d}", str(int(x[i, 0] * 100)),
+                 str(int(x[i, 1] * 100)), str(int(x[i, 2] * 100)),
+                 f"{y[i]:.2f}"] for i in range(600)]
+        fields = [{"name": "id", "ordinal": 0, "id": True,
+                   "dataType": "string"}]
+        for i, name in enumerate(("a", "b", "c")):
+            fields.append({"name": name, "ordinal": i + 1, "dataType": "int",
+                           "min": 0, "max": 100, "feature": True})
+        fields.append({"name": "y", "ordinal": 4, "dataType": "double",
+                       "classAttribute": True})
+        fz = Featurizer(FeatureSchema.from_json({"fields": fields}))
+        train = fz.fit_transform(rows[:500], with_labels=False)
+        test = fz.transform(rows[500:], with_labels=False)
+        targets = jnp.asarray(y[:500])
+        tr_x = jnp.asarray(x[:500] * 100)
+        te_x = jnp.asarray(x[500:] * 100)
+        cfg = knn.KnnConfig(top_match_count=10,
+                            prediction_mode="regression",
+                            regression_method="multiLinearRegression")
+        pred = knn.regress(train, test, cfg, targets,
+                           regr_input=(tr_x, te_x))
+        mae = np.abs(pred.predicted - y[500:]).mean()
+        avg_cfg = knn.KnnConfig(top_match_count=10,
+                                prediction_mode="regression",
+                                regression_method="average")
+        avg_mae = np.abs(
+            knn.regress(train, test, avg_cfg, targets).predicted
+            - y[500:]).mean()
+        assert mae < 6, mae              # ~noise + int truncation
+        assert mae < 0.5 * avg_mae, (mae, avg_mae)
+
+    def test_multi_linear_requires_matrices(self):
+        train, test, targets, _ = self._tables()
+        cfg = knn.KnnConfig(top_match_count=5,
+                            prediction_mode="regression",
+                            regression_method="multiLinearRegression")
+        with pytest.raises(ValueError, match="multiLinearRegression"):
+            knn.regress(train, test, cfg, targets)
+        with pytest.raises(ValueError, match="feature matrices"):
+            knn.regress(train, test, cfg, targets,
+                        regr_input=(jnp.zeros(400), jnp.zeros(100)))

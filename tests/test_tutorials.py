@@ -144,6 +144,90 @@ class TestDiseaseTreeTutorial:
         assert 1 in attrs and 3 in attrs  # cartValue and loyalty enumerated
 
 
+class TestSplitAttributeSelection:
+    """split.attribute.selection.strategy dispatch
+    (ClassPartitionGenerator.java:141, :160-196)."""
+
+    def _props(self, tmp_path, **extra):
+        rows = G.retarget_rows(600, seed=41)
+        write_csv(tmp_path / "data.csv", rows)
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._RETARGET_SCHEMA_JSON, fh)
+        props = tmp_path / "p.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "field.delim.out": ";",
+                       "split.algorithm": "giniIndex",
+                       "parent.info": "0.5", **extra})
+        return props
+
+    def _attrs(self, tmp_path, props):
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "splits.txt"), "--conf", str(props)])
+        with open(tmp_path / "splits.txt") as fh:
+            return {int(l.split(";")[0]) for l in fh.read().splitlines()}
+
+    def test_random_draws_distinct_subset(self, tmp_path):
+        props = self._props(
+            tmp_path,
+            **{"split.attribute.selection.strategy": "random",
+               "random.split.set.size": "2"})
+        attrs = self._attrs(tmp_path, props)
+        assert len(attrs) == 2 and attrs <= {1, 2, 3}
+
+    def test_random_size_capped_at_splittable(self, tmp_path):
+        props = self._props(
+            tmp_path,
+            **{"split.attribute.selection.strategy": "random",
+               "random.split.set.size": "99"})
+        assert self._attrs(tmp_path, props) == {1, 2, 3}
+
+    def test_all_strategy(self, tmp_path):
+        props = self._props(
+            tmp_path, **{"split.attribute.selection.strategy": "all",
+                         "split.attributes": "1"})  # ignored under "all"
+        assert self._attrs(tmp_path, props) == {1, 2, 3}
+
+    def test_user_specified_honors_list(self, tmp_path):
+        props = self._props(tmp_path, **{"split.attributes": "1,3"})
+        assert self._attrs(tmp_path, props) == {1, 3}
+
+    def test_not_used_yet_rejected(self, tmp_path):
+        """notUsedYet is a TODO in the reference itself
+        (ClassPartitionGenerator.java:171-175): rejected, not guessed at."""
+        props = self._props(
+            tmp_path,
+            **{"split.attribute.selection.strategy": "notUsedYet"})
+        with pytest.raises(ValueError, match="notUsedYet"):
+            self._attrs(tmp_path, props)
+
+    def test_unknown_strategy_rejected(self, tmp_path):
+        props = self._props(
+            tmp_path, **{"split.attribute.selection.strategy": "bogus"})
+        with pytest.raises(ValueError, match="invalid splitting attribute"):
+            self._attrs(tmp_path, props)
+
+    def test_split_prob_suffix_gated_on_algorithm(self, tmp_path):
+        """output.split.prob emits the class-prob suffix only for
+        entropy/giniIndex (ClassPartitionGenerator.java:531-545); with
+        hellingerDistance the artifact keeps the plain 3-field format."""
+        props = self._props(tmp_path,
+                            **{"split.algorithm": "hellingerDistance",
+                               "output.split.prob": "true"})
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "splits.txt"), "--conf", str(props)])
+        with open(tmp_path / "splits.txt") as fh:
+            lines = [l.split(";") for l in fh.read().splitlines()]
+        assert lines and all(len(l) == 3 for l in lines)
+        props2 = self._props(tmp_path, **{"split.algorithm": "giniIndex",
+                                          "output.split.prob": "true"})
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "splits.txt"), "--conf", str(props2)])
+        with open(tmp_path / "splits.txt") as fh:
+            lines = [l.split(";") for l in fh.read().splitlines()]
+        assert lines and all(len(l) > 3 for l in lines)
+
+
 class TestRetargetTreeTutorial:
     """abandoned_shopping_cart_retarget_tutorial.txt:42-45 — the two-pass
     root bootstrap then SplitGenerator -> DataPartitioner per level, state in
@@ -481,8 +565,8 @@ class TestKnnShellDriver:
                     **{"feature.schema.file.path": "elearn.json",
                        "train.data.path": "train.csv",
                        "top.match.count": "3"})
-        (tmp_path / "distance").mkdir()
-        (tmp_path / "output").mkdir()
+        # no pre-mkdir: the script must create distance/ and output/ the way
+        # Hadoop creates job output paths for the reference driver
         script = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "scripts", "knn.sh")
         env = dict(os.environ, PROJECT_HOME=str(tmp_path),
@@ -568,6 +652,8 @@ class TestKnnRegressionCli:
         ("average", {}),
         ("median", {}),
         ("linearRegression", {"regr.input.field.ordinal": "1"}),
+        ("multiLinearRegression", {}),
+        ("multiLinearRegression", {"regr.input.field.ordinals": "1,2,3"}),
     ])
     def test_regression_methods(self, tmp_path, capsys, method, extra):
         rows = self._rows(500, seed=91)
