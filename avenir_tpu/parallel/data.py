@@ -35,22 +35,26 @@ def process_slice(n_global: int, n_processes: Optional[int] = None,
                   process_id: Optional[int] = None) -> Tuple[int, int]:
     """Contiguous [start, stop) row range owned by this host process.
 
-    ``n_global`` must divide evenly by the process count (callers pad first
-    with :func:`padded_rows`)."""
+    Every process gets the same ceil(n_global / n_processes) rows — real
+    CSVs are not aligned to process counts, so the slices tile the padded
+    total ``per * n_processes`` and indices ≥ ``n_global`` are tail padding
+    the caller materializes (e.g. as copies of the last real row) and masks
+    out of reductions, exactly as :func:`load_sharded_table` does. The
+    reference's analogue is HDFS handing mappers arbitrary, unaligned
+    splits."""
     n_processes = jax.process_count() if n_processes is None else n_processes
     process_id = jax.process_index() if process_id is None else process_id
-    if n_global % n_processes:
-        raise ValueError(f"{n_global} rows not divisible by "
-                         f"{n_processes} processes; pad first")
-    per = n_global // n_processes
+    per = -(-n_global // n_processes)          # ceil: tail process pads
     return process_id * per, (process_id + 1) * per
 
 
 def padded_rows(n_rows: int, mesh: Mesh, axis: str = DATA_AXIS) -> int:
-    """Global row count padded so every device (and so every process) gets
-    an equal, whole shard."""
-    d = mesh.shape[axis]
-    return ((n_rows + d - 1) // d) * d
+    """Global row count padded so every device AND every process gets an
+    equal, whole shard (lcm alignment covers meshes whose data axis is not
+    a multiple of the process count)."""
+    import math
+    q = math.lcm(mesh.shape[axis], jax.process_count())
+    return ((n_rows + q - 1) // q) * q
 
 
 @dataclass(frozen=True)

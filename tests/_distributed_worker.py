@@ -1,0 +1,69 @@
+"""Worker for the 2-process jax.distributed loader test (spawned by
+tests/test_parallel_data.py). Each process owns 4 virtual CPU devices,
+joins the distributed runtime over localhost (the DCN analogue), loads its
+slice of a shared CSV via load_sharded_table, and prints the globally
+reduced class counts — which must match the single-process reference."""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    proc_id, n_proc = int(sys.argv[1]), int(sys.argv[2])
+    port, csv_path = sys.argv[3], sys.argv[4]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+
+    import jax
+
+    # the session sitecustomize pre-imports jax and may already have
+    # initialized a backend (same workaround as __graft_entry__): clear it
+    # so distributed init happens first against the CPU platform
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from avenir_tpu.datagen.generators import churn_schema
+    from avenir_tpu.parallel.data import load_sharded_table
+    from avenir_tpu.parallel.mesh import initialize_distributed, make_mesh
+    from avenir_tpu.utils.dataset import Featurizer, read_csv_lines
+
+    initialize_distributed(f"localhost:{port}", num_processes=n_proc,
+                           process_id=proc_id)
+    assert jax.process_count() == n_proc, jax.process_count()
+    assert len(jax.devices()) == 4 * n_proc, len(jax.devices())
+
+    fz = Featurizer(churn_schema()).fit(read_csv_lines(csv_path, ","))
+    mesh = make_mesh()
+    st = load_sharded_table(fz, csv_path, mesh)
+    n_classes = len(st.table.class_values)
+
+    @jax.jit
+    def masked_counts(labels, mask):
+        return jnp.sum(jax.nn.one_hot(labels, n_classes) * mask[:, None],
+                       axis=0)
+
+    counts = masked_counts(st.table.labels, st.mask)
+    jax.block_until_ready(counts)
+    local_shards = len(st.table.labels.addressable_shards)
+    print("RESULT " + json.dumps({
+        "proc": proc_id,
+        "counts": [float(v) for v in np.asarray(counts)],
+        "n_global": st.n_global,
+        "n_rows": st.table.n_rows,
+        "mask_sum": float(jnp.sum(st.mask)),
+        "local_shards": local_shards,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,8 +28,17 @@ def churn_fixture(tmp_path):
 def test_process_slice_single_process():
     assert process_slice(80, 1, 0) == (0, 80)
     assert process_slice(80, 4, 2) == (40, 60)
-    with pytest.raises(ValueError, match="not divisible"):
-        process_slice(81, 4, 1)
+
+
+def test_process_slice_pads_tail():
+    """Non-divisible row counts give every process an equal ceil-sized
+    slice; the tail slice extends past n_global with padding indices the
+    loader materializes and masks (real CSVs are never process-aligned)."""
+    slices = [process_slice(81, 4, p) for p in range(4)]
+    assert slices == [(0, 21), (21, 42), (42, 63), (63, 84)]
+    # slices tile the padded total and cover every real row exactly once
+    assert slices[-1][1] >= 81
+    assert all(b[0] == a[1] for a, b in zip(slices, slices[1:]))
 
 
 def test_load_sharded_matches_local(mesh, churn_fixture):
@@ -65,6 +74,55 @@ def test_shard_table_roundtrip(mesh, churn_fixture):
     np.testing.assert_array_equal(
         np.asarray(st.table.binned)[:333], np.asarray(local.binned))
     assert float(jnp.sum(st.mask)) == 333
+
+
+def test_two_process_distributed_load(tmp_path):
+    """End-to-end 2-process jax.distributed run (subprocesses, localhost
+    coordinator — the DCN bring-up path): initialize_distributed +
+    load_sharded_table on a non-aligned 333-row CSV must reduce to the same
+    class counts as the in-memory single-process path, with each process
+    holding only its own device shards."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    rows = churn_rows(333, seed=4)
+    path = str(tmp_path / "churn.csv")
+    with open(path, "w") as fh:
+        fh.write("\n".join(",".join(r) for r in rows) + "\n")
+
+    with socket.socket() as s:        # free coordinator port
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_distributed_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)        # worker sets its own 4-device flag
+    env["PYTHONPATH"] = os.pathsep.join(sys.path)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), "2", str(port), path],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for i in range(2)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-2000:]
+    results = []
+    for out, _ in outs:
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        results.append(json.loads(line[len("RESULT "):]))
+
+    fz = Featurizer(churn_schema()).fit(rows)
+    local = fz.transform(rows)
+    plain = np.asarray(class_counts(
+        local.labels, len(local.class_values))).tolist()
+    for r in results:
+        assert r["counts"] == plain
+        assert r["n_global"] == 333 and r["mask_sum"] == 333
+        assert r["n_rows"] % 8 == 0       # padded over 8 global devices
+        assert r["local_shards"] == 4     # only this process's devices
 
 
 def test_data_dependent_schema_rejected(mesh, tmp_path):
