@@ -1,0 +1,259 @@
+"""Minimal Redis-protocol (RESP) list broker + client.
+
+The reference's serving topology decouples producers and consumers through
+Redis lists (RedisSpout.java rpop, RedisActionWriter.java lpush,
+RedisRewardReader.java lindex cursor). This module provides the smallest
+self-contained broker speaking that exact wire contract — LPUSH / RPOP /
+LINDEX / LLEN / DEL / FLUSHALL / PING over RESP — so multi-process serving
+(the ``num.workers`` scale-out, ReinforcementLearnerTopology.java:64-82)
+runs and is testable with zero external infrastructure. A real Redis server
+is a drop-in replacement: ``MiniRedisClient`` mirrors the redis-py subset
+``stream.loop.RedisQueues`` consumes (bytes in, bytes out).
+
+Single-process uses need none of this — ``InProcQueues`` stays the default.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+# --------------------------------------------------------------------------
+# RESP encoding/decoding (the subset the list commands need)
+# --------------------------------------------------------------------------
+
+def _encode_bulk(val: Optional[bytes]) -> bytes:
+    if val is None:
+        return b"$-1\r\n"
+    return b"$%d\r\n%s\r\n" % (len(val), val)
+
+
+def _read_line(rfile) -> bytes:
+    line = rfile.readline()
+    if not line or not line.endswith(b"\r\n"):
+        raise ConnectionError("client closed")
+    return line[:-2]
+
+
+def _read_command(rfile) -> Optional[List[bytes]]:
+    """One client command (RESP array of bulk strings); None on EOF."""
+    first = rfile.readline()
+    if not first:
+        return None
+    if not first.endswith(b"\r\n") or first[:1] != b"*":
+        raise ConnectionError(f"malformed RESP header {first!r}")
+    n = int(first[1:-2])
+    parts = []
+    for _ in range(n):
+        header = _read_line(rfile)
+        if header[:1] != b"$":
+            raise ConnectionError(f"expected bulk string, got {header!r}")
+        size = int(header[1:])
+        body = rfile.read(size + 2)
+        if len(body) != size + 2:
+            raise ConnectionError("short read")
+        parts.append(body[:-2])
+    return parts
+
+
+# --------------------------------------------------------------------------
+# server
+# --------------------------------------------------------------------------
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        srv: "MiniRedisServer" = self.server.owner  # type: ignore[attr-defined]
+        while True:
+            try:
+                cmd = _read_command(self.rfile)
+            except ConnectionError:
+                return
+            if cmd is None:
+                return
+            self.wfile.write(srv.execute(cmd))
+            self.wfile.flush()
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class MiniRedisServer:
+    """Threaded in-memory list store speaking the RESP list subset."""
+
+    def __init__(self, host: str = "localhost", port: int = 0):
+        self._lists: Dict[bytes, deque] = {}
+        self._lock = threading.Lock()
+        self._tcp = _TCPServer((host, port), _Handler)
+        self._tcp.owner = self  # type: ignore[attr-defined]
+        self.host, self.port = self._tcp.server_address[:2]
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "MiniRedisServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    def __enter__(self) -> "MiniRedisServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- command dispatch --------------------------------------------------
+
+    def execute(self, cmd: List[bytes]) -> bytes:
+        name = cmd[0].upper()
+        args = cmd[1:]
+        with self._lock:
+            if name == b"PING":
+                return b"+PONG\r\n"
+            if name == b"LPUSH":
+                q = self._lists.setdefault(args[0], deque())
+                for val in args[1:]:
+                    q.appendleft(val)
+                return b":%d\r\n" % len(q)
+            if name == b"RPOP":
+                q = self._lists.get(args[0])
+                return _encode_bulk(q.pop() if q else None)
+            if name == b"LINDEX":
+                q = self._lists.get(args[0])
+                idx = int(args[1])
+                if q is None:
+                    return _encode_bulk(None)
+                pos = idx if idx >= 0 else len(q) + idx
+                if 0 <= pos < len(q):
+                    return _encode_bulk(q[pos])
+                return _encode_bulk(None)
+            if name == b"LLEN":
+                q = self._lists.get(args[0])
+                return b":%d\r\n" % (len(q) if q else 0)
+            if name == b"DEL":
+                n = 0
+                for key in args:
+                    n += 1 if self._lists.pop(key, None) is not None else 0
+                return b":%d\r\n" % n
+            if name == b"FLUSHALL":
+                self._lists.clear()
+                return b"+OK\r\n"
+            return b"-ERR unknown command '%s'\r\n" % name
+
+
+# --------------------------------------------------------------------------
+# client (the redis-py subset RedisQueues consumes)
+# --------------------------------------------------------------------------
+
+class MiniRedisClient:
+    """Tiny blocking client; method-compatible with redis.StrictRedis for
+    the list commands (returns bytes, like redis-py without decoding)."""
+
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        self._rfile.close()
+        self._sock.close()
+
+    def _call(self, *parts: bytes):
+        msg = b"*%d\r\n" % len(parts) + b"".join(
+            b"$%d\r\n%s\r\n" % (len(p), p) for p in parts)
+        with self._lock:
+            self._sock.sendall(msg)
+            return self._reply()
+
+    def _reply(self):
+        line = _read_line(self._rfile)
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            size = int(rest)
+            if size < 0:
+                return None
+            body = self._rfile.read(size + 2)
+            if len(body) != size + 2:    # EOF mid-reply must not truncate
+                raise ConnectionError("short bulk reply")
+            return body[:-2]
+        if kind == b"-":
+            raise RuntimeError(rest.decode())
+        raise ConnectionError(f"unexpected reply {line!r}")
+
+    @staticmethod
+    def _b(v) -> bytes:
+        return v if isinstance(v, bytes) else str(v).encode()
+
+    def ping(self):
+        return self._call(b"PING")
+
+    def lpush(self, key, *values) -> int:
+        return self._call(b"LPUSH", self._b(key),
+                          *[self._b(v) for v in values])
+
+    def rpop(self, key) -> Optional[bytes]:
+        return self._call(b"RPOP", self._b(key))
+
+    def lindex(self, key, index) -> Optional[bytes]:
+        return self._call(b"LINDEX", self._b(key), self._b(index))
+
+    def llen(self, key) -> int:
+        return self._call(b"LLEN", self._b(key))
+
+    def delete(self, *keys) -> int:
+        return self._call(b"DEL", *[self._b(k) for k in keys])
+
+    def flushall(self):
+        return self._call(b"FLUSHALL")
+
+
+def connect_with_retry(host: str, port: int,
+                       timeout: float = 10.0) -> MiniRedisClient:
+    """Client to a broker that may still be starting (subprocess spawn)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            client = MiniRedisClient(host, port)
+            client.ping()
+            return client
+        except (ConnectionError, OSError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+def main(argv=None) -> int:
+    """Standalone broker process (``python -m avenir_tpu.stream.miniredis
+    --port N``): keeps the broker's connection threads out of any client's
+    GIL — the deployment run_scaleout uses."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    srv = MiniRedisServer(args.host, args.port)
+    print(f"miniredis listening {srv.host}:{srv.port}", flush=True)
+    srv._thread.start()
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,343 @@
+"""Multi-process serving scale-out — the ``num.workers`` contract, reborn.
+
+The reference's Storm topology scales serving by running multiple bolt
+instances across worker processes (ReinforcementLearnerTopology.java:64-82,
+knobs num.workers / bolt.threads, shuffleGrouping over Netty). Here the
+same deployment shape is N OS processes, each running ``OnlineLearnerLoop``
+instances for the learner groups it OWNS (group i belongs to worker
+i mod N — the fieldsGrouping analogue; ownership means each group's state
+lives in exactly one process, so no cross-process state races exist by
+construction), all sharing one Redis-protocol broker:
+
+    eventQueue:<group>   events for one group       (driver lpush, owner rpop)
+    rewardQueue:<group>  rewards for one group      (driver lpush, owner
+                                                     lindex-cursor drain)
+    actionQueue          all selections, shared     (owners lpush, driver rpop)
+
+``run_scaleout`` is the measured demo: a producer with per-group planted
+best actions (the lead_gen.py fixture pattern) drives N workers through two
+phases — drain-everything throughput (decisions/sec) and a paced phase for
+p50/p90 event->action latency — and verifies every event was answered
+exactly once and learners converged onto the planted arms.
+
+Workers are plain subprocesses (``python -m avenir_tpu.stream.scaleout
+--worker ...``) against any RESP broker: ``miniredis`` in-process by
+default, a real Redis server by pointing host/port at it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from avenir_tpu.stream.loop import OnlineLearnerLoop, RedisQueues
+from avenir_tpu.stream.miniredis import (
+    MiniRedisClient, MiniRedisServer, connect_with_retry)
+
+STOP_SENTINEL = "__STOP__"
+
+
+def owned_groups(groups: Sequence[str], worker_id: int,
+                 n_workers: int) -> List[str]:
+    """Group i -> worker i mod N (fieldsGrouping: stable ownership)."""
+    return [g for i, g in enumerate(groups) if i % n_workers == worker_id]
+
+
+class _StoppableQueues(RedisQueues):
+    """Per-group queue view that retires on the driver's stop sentinel."""
+
+    def __init__(self, client, group: str):
+        super().__init__(event_queue=f"eventQueue:{group}",
+                         action_queue="actionQueue",
+                         reward_queue=f"rewardQueue:{group}",
+                         client=client)
+        self.stopped = False
+
+    def pop_event(self) -> Optional[str]:
+        if self.stopped:
+            return None
+        event = super().pop_event()
+        if event == STOP_SENTINEL:
+            self.stopped = True
+            return None
+        return event
+
+
+def worker_main(host: str, port: int, worker_id: int, n_workers: int,
+                groups: Sequence[str], learner_type: str,
+                actions: Sequence[str], config: Dict, seed: int) -> Dict:
+    """One serving process: loops for the owned groups until every group's
+    stop sentinel arrives. Returns per-worker stats."""
+    client = MiniRedisClient(host, port)
+    loops = {}
+    for g in owned_groups(groups, worker_id, n_workers):
+        # per-group seed component: each group's learner must explore
+        # independently (a shared seed correlates every group's RNG)
+        loops[g] = OnlineLearnerLoop(
+            learner_type, actions, dict(config),
+            _StoppableQueues(client, g),
+            seed=seed + 1000 * worker_id + list(groups).index(g))
+    active = set(loops)
+    idle_sleep = 0.001
+    while active:
+        progressed = False
+        for g in list(active):
+            loop = loops[g]
+            if loop.queues.stopped:
+                active.discard(g)
+                continue
+            # one event per visit keeps groups fair; rewards drain inside
+            progressed = loop.step() or progressed
+        if progressed:
+            idle_sleep = 0.001
+        elif active:
+            # adaptive backoff: an idle worker must not convoy the broker
+            # with poll round-trips (each visit costs 2 RTTs per group)
+            time.sleep(idle_sleep)
+            idle_sleep = min(idle_sleep * 2, 0.016)
+    client.close()
+    return {
+        "worker": worker_id,
+        "events": sum(l.stats.events for l in loops.values()),
+        "rewards": sum(l.stats.rewards for l in loops.values()),
+        "groups": sorted(loops),
+    }
+
+
+@dataclass
+class ScaleoutResult:
+    n_workers: int
+    throughput_events: int
+    decisions_per_sec: float
+    paced_events: int
+    p50_latency_ms: float
+    p90_latency_ms: float
+    best_action_fraction: float   # last-30% convergence onto planted arms
+    worker_stats: List[Dict] = field(default_factory=list)
+
+
+def _spawn_workers(host: str, port: int, n_workers: int,
+                   groups: Sequence[str], learner_type: str,
+                   actions: Sequence[str], config: Dict,
+                   seed: int) -> List[subprocess.Popen]:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    for w in range(n_workers):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
+             "--host", host, "--port", str(port), "--worker-id", str(w),
+             "--n-workers", str(n_workers), "--groups", ",".join(groups),
+             "--learner-type", learner_type, "--actions", ",".join(actions),
+             "--config", json.dumps(config), "--seed", str(seed)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    return procs
+
+
+def _consume_one(client: MiniRedisClient, ctr, rng, t_push,
+                 latencies: List[float],
+                 picks: List[Tuple[str, str]]) -> bool:
+    """Pop one action line, record latency/pick, issue the planted-CTR
+    reward. False when the action queue is empty."""
+    raw = client.rpop("actionQueue")
+    if raw is None:
+        return False
+    event_id, _, action = raw.decode().partition(",")
+    action = action.split(",")[0]
+    g = event_id.partition(":")[0]
+    latencies.append(time.perf_counter() - t_push[event_id])
+    picks.append((g, action))
+    reward = 1.0 if rng.random() < ctr[g][action] else 0.0
+    client.lpush(f"rewardQueue:{g}", f"{action},{reward}")
+    return True
+
+
+def _drive(client: MiniRedisClient, groups: Sequence[str],
+           ctr: Dict[str, Dict[str, float]], n_events: int,
+           rate: Optional[float], rng, t_push: Dict[str, float],
+           latencies: List[float], picks: List[Tuple[str, str]]) -> None:
+    """Throughput mode (``rate=None``): BURST all events up-front so every
+    group carries backlog and worker parallelism — not this driver's serial
+    reward loop — sets the drain time. Paced mode: inject at ``rate``/s and
+    consume as answers arrive, measuring per-event serving latency."""
+    if rate is None:
+        for sent in range(n_events):
+            g = groups[sent % len(groups)]
+            event_id = f"{g}:{sent}"
+            t_push[event_id] = time.perf_counter()
+            client.lpush(f"eventQueue:{g}", event_id)
+        answered = 0
+        while answered < n_events:
+            if _consume_one(client, ctr, rng, t_push, latencies, picks):
+                answered += 1
+            else:
+                time.sleep(0.0005)
+        return
+    sent = answered = 0
+    next_at = time.perf_counter()
+    while answered < n_events:
+        if sent < n_events and time.perf_counter() >= next_at:
+            g = groups[sent % len(groups)]
+            event_id = f"{g}:{sent}"
+            t_push[event_id] = time.perf_counter()
+            next_at = time.perf_counter() + 1.0 / rate
+            client.lpush(f"eventQueue:{g}", event_id)
+            sent += 1
+        if not _consume_one(client, ctr, rng, t_push, latencies, picks):
+            time.sleep(0.0005)
+        else:
+            answered += 1
+
+
+def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
+                 throughput_events: int = 1000, paced_events: int = 200,
+                 paced_rate: float = 100.0, learner_type: str = "softMax",
+                 seed: int = 7, host: str = "localhost",
+                 server: Optional[MiniRedisServer] = None) -> ScaleoutResult:
+    """Measure N serving workers against one broker (started here unless
+    passed in). Every event must come back answered exactly once."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    # planted: one clearly-best arm per group (the lead_gen.py shape)
+    ctr = {}
+    for g in groups:
+        best = int(rng.integers(n_actions))
+        ctr[g] = {a: (0.8 if i == best else 0.15)
+                  for i, a in enumerate(actions)}
+    # batch.size=8: each event asks for 8 ranked selections (the
+    # nextActions() batch contract, ReinforcementLearner.java:86-91) —
+    # and makes the per-event learner work heavy enough that worker
+    # parallelism, not the driver's serial reward loop, sets throughput
+    config = {"current.decision.round": 1, "batch.size": 8}
+
+    # broker in its OWN process: its connection threads must not share the
+    # driver's GIL (an in-process ThreadingTCPServer makes every added
+    # worker steal driver cycles)
+    broker_proc = None
+    if server is None:
+        import socket as _socket
+        with _socket.socket() as s:
+            s.bind((host, 0))
+            broker_port = s.getsockname()[1]
+        broker_proc = subprocess.Popen(
+            [sys.executable, "-m", "avenir_tpu.stream.miniredis",
+             "--host", host, "--port", str(broker_port)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        broker_host = host
+    else:
+        broker_host, broker_port = server.host, server.port
+    try:
+        client = connect_with_retry(broker_host, broker_port)
+        client.flushall()
+        procs = _spawn_workers(broker_host, broker_port, n_workers, groups,
+                               learner_type, actions, config, seed)
+        try:
+            t_push: Dict[str, float] = {}
+            latencies: List[float] = []
+            picks: List[Tuple[str, str]] = []
+            # warmup: first dispatch per worker pays jit compile; excluded
+            _drive(client, groups, ctr, 4 * n_groups, None, rng,
+                   t_push, [], [])
+            t_push.clear()
+
+            t0 = time.perf_counter()
+            _drive(client, groups, ctr, throughput_events, None, rng,
+                   t_push, [], picks)
+            throughput_s = time.perf_counter() - t0
+
+            t_push.clear()
+            _drive(client, groups, ctr, paced_events, paced_rate, rng,
+                   t_push, latencies, picks)
+
+            for g in groups:
+                client.lpush(f"eventQueue:{g}", STOP_SENTINEL)
+            worker_stats = []
+            for p in procs:
+                out, err = p.communicate(timeout=120)
+                if p.returncode != 0:
+                    raise RuntimeError(f"worker failed: {err[-1500:]}")
+                worker_stats.append(json.loads(out.splitlines()[-1]))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        total = sum(w["events"] for w in worker_stats)
+        expected = 4 * n_groups + throughput_events + paced_events
+        if total != expected:      # exactly-once delivery is the contract
+            raise RuntimeError(
+                f"workers answered {total} events, expected {expected}")
+
+        tail = picks[-int(0.3 * len(picks)):]
+        best_frac = sum(ctr[g][a] > 0.5 for g, a in tail) / max(len(tail), 1)
+        lat = sorted(latencies)
+        return ScaleoutResult(
+            n_workers=n_workers,
+            throughput_events=throughput_events,
+            decisions_per_sec=throughput_events / throughput_s,
+            paced_events=paced_events,
+            p50_latency_ms=1e3 * lat[len(lat) // 2] if lat else 0.0,
+            p90_latency_ms=1e3 * lat[int(0.9 * len(lat))] if lat else 0.0,
+            best_action_fraction=best_frac,
+            worker_stats=worker_stats)
+    finally:
+        if broker_proc is not None:
+            broker_proc.terminate()
+            broker_proc.wait(timeout=10)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int)
+    ap.add_argument("--worker-id", type=int)
+    ap.add_argument("--n-workers", type=int, default=2)
+    ap.add_argument("--groups", default="")
+    ap.add_argument("--learner-type", default="softMax")
+    ap.add_argument("--actions", default="")
+    ap.add_argument("--config", default="{}")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--sweep", default="1,2,4",
+                    help="driver mode: worker counts to measure")
+    ap.add_argument("--events", type=int, default=1000)
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        # serving is host-latency-bound (one tiny learner step per event):
+        # force the CPU backend even when a sitecustomize pins the session
+        # at a remote TPU — a relay round-trip per decision would dominate.
+        # Batched multi-context serving on the chip is GroupedLearner's job.
+        import jax
+        from jax.extend.backend import clear_backends
+        clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+        stats = worker_main(args.host, args.port, args.worker_id,
+                            args.n_workers, args.groups.split(","),
+                            args.learner_type, args.actions.split(","),
+                            json.loads(args.config), args.seed)
+        print(json.dumps(stats), flush=True)
+        return 0
+
+    for n in [int(v) for v in args.sweep.split(",")]:
+        r = run_scaleout(n, throughput_events=args.events,
+                         learner_type=args.learner_type)
+        print(json.dumps({
+            "n_workers": r.n_workers,
+            "decisions_per_sec": round(r.decisions_per_sec, 1),
+            "p50_latency_ms": round(r.p50_latency_ms, 2),
+            "p90_latency_ms": round(r.p90_latency_ms, 2),
+            "best_action_fraction": round(r.best_action_fraction, 3),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
