@@ -24,6 +24,38 @@ slab and runs ``lax.approx_min_k`` over it; here the slab never leaves VMEM:
   set; with the default 512 buckets and small k the expected recall is
   ~1 − (k−1)/1024 ≈ 99.6% for k=5 (grow ``n_acc`` for large k).
 
+ROOFLINE (round 2; measured on the live v5e chip — scripts/roofline_knn.py,
+scripts/sweep4_diag_results.txt, scripts/sweep8-10; the relay adds ±25%
+run-to-run noise, so every claim below comes from same-run interleaved
+timing, anchored on the XLA ``approx_min_k`` path):
+
+- the binding unit is the VPU min-fold plus a ~5µs fixed per-grid-step
+  cost, NOT the D=9-padded-to-128 MXU contraction: an f32-dot variant
+  (≥3 MXU passes vs 1 for bf16) is only ~29% slower end-to-end; per-step
+  time scales with tile_m·tile_n fold work on top of the fixed cost; and
+  halving the accumulator blocks (n_acc=2) makes it *slower* — the
+  read-modify-write chains on the accumulators bind before raw VPU ops;
+- at the production tile the kernel reaches ~25-31% of the padded-K=128
+  MXU slab ceiling (197 TFLOP/s datasheet → 7.7e11 pairs/s) and ~12-15%
+  of HBM — neither saturates *because* the fold holds them; the kernel
+  runs ~1.1-1.4× the XLA ``approx_min_k`` streaming path on the same
+  shapes;
+- four redesigns were built against this analysis, measured interleaved,
+  and REJECTED (kept in scripts/ as the negative results): (1) packed-key
+  fold — metric bitcast to int32 with the train-chunk id in the low
+  mantissa bits, single integer min, half the scratch — ran 0.85× the XLA
+  anchor vs 1.1-1.4× for this kernel (the mask/or stream costs what the
+  second select saved); (2) a step-level register-tree reduce (one
+  accumulator RMW per grid step) measured the same 0.85×; (3) the packed
+  fold as pure XLA ran 5× slower (XLA materializes the [M, B] slabs in
+  HBM); (4) a transposed sublane-contraction dot (D pads to 16 not 128,
+  8× less MXU work) was slower — Mosaic inserts relayouts that eat the
+  win. Also rejected: exact-distance recomputation from the found indices
+  (a [M, k] row gather costs ~22% end-to-end); larger tile_n via grouped
+  sub-dots (the n_acc=8 / tile_n≥8192 configs fail Mosaic compilation at
+  tile_m=1024); and pl.ds dynamic-slice loads where static slices serve
+  (measured 60% slower — they defeat Mosaic's load fusion).
+
 Categorical attributes ride the same MXU contraction: a one-hot encoding
 scaled by 1/√2 makes squared euclidean equal the mismatch count
 (``ops.distance.categorical_mismatch`` computes the identical quantity as an

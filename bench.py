@@ -47,6 +47,39 @@ def _timed(chain, test, train) -> float:
     return time.perf_counter() - t0
 
 
+# fast-mode recall bound: expected ~1-(k-1)/1024 = 99.6% at k=5
+# (ops/pallas_distance.py docstring); gate leaves slack for sampling noise
+MIN_RECALL = 0.985
+# scaled-int distance agreement on jointly-found neighbors: the bf16
+# cross-term and the exact path's f32 |x|²+|y|²-2xy cancellation each
+# perturb ~1e-2 of the unit distance at scale 1000
+MAX_DIST_ERR = 25
+
+
+def _parity_gate(test, train) -> None:
+    """On-hardware pallas-vs-XLA-exact agreement BEFORE timing: a Mosaic
+    regression (wrong indices, broken fold, recall collapse) must fail the
+    bench loudly rather than publish a fast wrong number (VERDICT round-1
+    item 9). Runs on a 512-row slice — one compile each path, negligible
+    next to the timed sweep."""
+    from avenir_tpu.ops.distance import pairwise_topk as xla_topk
+    d_ex, i_ex = xla_topk(test[:512], train, k=K, mode="exact")
+    d_pl, i_pl = pairwise_topk_pallas(test[:512], train, k=K)
+    d_ex, i_ex, d_pl, i_pl = map(np.asarray, (d_ex, i_ex, d_pl, i_pl))
+    recall = np.mean([len(set(i_ex[r]) & set(i_pl[r])) / K
+                      for r in range(i_ex.shape[0])])
+    if recall < MIN_RECALL:
+        raise AssertionError(
+            f"pallas recall {recall:.4f} below bound {MIN_RECALL}")
+    matched = i_pl == i_ex
+    if matched.any():
+        err = int(np.abs(d_pl - d_ex)[matched].max())
+        if err > MAX_DIST_ERR:
+            raise AssertionError(
+                f"pallas scaled-distance error {err} exceeds "
+                f"{MAX_DIST_ERR} on matched neighbors")
+
+
 def main() -> None:
     rng = np.random.default_rng(0)
     train = jnp.asarray(rng.random((N_TRAIN, N_FEATURES), dtype=np.float32))
@@ -54,6 +87,8 @@ def main() -> None:
 
     use_pallas = (IMPL == "pallas" or
                   (IMPL == "auto" and jax.devices()[0].platform == "tpu"))
+    if use_pallas:
+        _parity_gate(test, train)
 
     def topk(t, train):
         if use_pallas:
@@ -104,7 +139,10 @@ if __name__ == "__main__":
         try:
             main()
             break
-        except (ValueError, TypeError, KeyError, json.JSONDecodeError):
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError,
+                AssertionError):
+            # config/shape errors and parity-gate failures are
+            # deterministic: retrying cannot help
             raise
         except Exception as exc:
             print(f"bench attempt {attempt + 1} failed: {exc!r}",

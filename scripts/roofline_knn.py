@@ -1,0 +1,326 @@
+"""Roofline decomposition of the pallas KNN kernel on the live chip.
+
+Times isolated variants of ``ops.pallas_distance`` (the north-star kernel)
+with the relay-aware chained-scan method (see bench.py docstring) to find the
+binding unit — the D=9-padded-to-128 MXU contraction, the VPU min-fold, or
+HBM streaming of the train set — and reports each as a fraction of the
+v5e ("TPU v5 lite") datasheet ceilings.
+
+Variants:
+  full      current production kernel (bf16 cross + indexed min-fold)
+  dotmin    same dot, single un-indexed min fold  -> isolates index cost
+  nodot     no matmul, full indexed fold on broadcast y2 -> isolates VPU cost
+  tpose     transposed operands [D, M]x[D, N], contraction on the sublane
+            axis: D=9 pads to 16 sublanes instead of 128 lanes, cutting the
+            padded-K MXU work 8x if Mosaic lowers it natively
+  xla       streaming XLA path (pairwise_topk mode=fast) for reference
+
+Run:  JAX_PLATFORMS=tpu python scripts/roofline_knn.py
+Results are committed to scripts/roofline_knn_results.txt; the conclusions
+live in the kernel docstring (ops/pallas_distance.py).
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.ops.pallas_distance import (
+    LANES, BIG, INT_BIG, _pad_rows, pairwise_topk_pallas)
+# NOTE: the decomposition below targets the ROUND-1 compare/select kernel —
+# its conclusions (VPU-fold-bound, ~5us fixed step cost, RMW-chain
+# sensitivity) motivated the round-2 packed-key redesign in
+# ops/pallas_distance.py. "full" now times whatever the production kernel
+# is; dotmin/nodot/tpose remain the round-1 isolation variants.
+
+N_TRAIN = 65536
+M_TEST = 8192
+D = 9
+K = 5
+ITERS = 50
+REPEATS = 5
+TILE_M, TILE_N, N_ACC = 1024, 4096, 4
+
+# --- v5e datasheet ceilings (TPU v5 lite; public spec) ---------------------
+BF16_FLOPS = 197e12          # peak bf16 MXU FLOP/s
+HBM_BPS = 819e9              # HBM GB/s
+# derived: padded-K=128 MXU slab ceiling. Each [M,N] output element costs
+# 2*128 FLOP of (mostly padding) MXU work at D=9 -> elements/sec ceiling:
+MXU_PAIRS_CEIL_K128 = BF16_FLOPS / (2 * 128)
+MXU_PAIRS_CEIL_K16 = BF16_FLOPS / (2 * 16)   # if sublane-contraction works
+
+
+def _dotmin_kernel(x_ref, y_ref, y2_ref, out_d_ref, acc_d, *, tn: int):
+    """Dot + cheapest possible slab consumption (1 min-op per element)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_d[:] = jnp.full(acc_d.shape, BIG, jnp.float32)
+
+    x = x_ref[:].astype(jnp.bfloat16)
+    y = y_ref[:].astype(jnp.bfloat16)
+    cross = lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    metric = y2_ref[:] - 2.0 * cross
+    n_chunks = tn // LANES
+    for c in range(n_chunks):
+        chunk = metric[:, c * LANES:(c + 1) * LANES]
+        acc_d[:] = jnp.minimum(acc_d[:], chunk)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        out_d_ref[:] = acc_d[:]
+
+
+def _nodot_kernel(x_ref, y_ref, y2_ref, out_d_ref, out_i_ref,
+                  acc_d, acc_i, *, k: int, tn: int, n_acc: int):
+    """Full indexed fold + extraction, matmul replaced by a broadcast."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_d[:] = jnp.full(acc_d.shape, BIG, jnp.float32)
+        acc_i[:] = jnp.full(acc_i.shape, -1, jnp.int32)
+
+    tm = x_ref.shape[0]
+    # consume x so the spec stays comparable; broadcast stands in for cross
+    metric = y2_ref[:] + jnp.sum(x_ref[:], axis=1, keepdims=True)
+    metric = jnp.broadcast_to(metric, (tm, tn))
+    n_chunks = tn // LANES
+    lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+    for c in range(n_chunks):
+        s = c % n_acc
+        chunk = metric[:, c * LANES:(c + 1) * LANES]
+        cur_d = acc_d[:, s * LANES:(s + 1) * LANES]
+        better = chunk < cur_d
+        idx = j * tn + c * LANES + lane
+        acc_d[:, s * LANES:(s + 1) * LANES] = jnp.where(better, chunk, cur_d)
+        cur_i = acc_i[:, s * LANES:(s + 1) * LANES]
+        acc_i[:, s * LANES:(s + 1) * LANES] = jnp.where(better, idx, cur_i)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        val = acc_d[:]
+        idx = acc_i[:]
+        new_d = jnp.full((tm, LANES), BIG, jnp.float32)
+        new_i = jnp.full((tm, LANES), -1, jnp.int32)
+        slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+        for slot in range(k):
+            min_d = jnp.min(val, axis=1, keepdims=True)
+            min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
+                            axis=1, keepdims=True)
+            new_d = jnp.where(slot_lane == slot, min_d, new_d)
+            new_i = jnp.where(slot_lane == slot, min_i, new_i)
+            val = jnp.where((val == min_d) & (idx == min_i), BIG, val)
+        out_d_ref[:] = new_d
+        out_i_ref[:] = new_i
+
+
+def _tpose_kernel(xt_ref, yt_ref, y2_ref, out_d_ref, out_i_ref,
+                  acc_d, acc_i, *, k: int, tn: int, n_acc: int):
+    """Transposed operands: contraction rides the sublane axis (D pads to
+    16 for bf16 instead of 128 lanes)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_d[:] = jnp.full(acc_d.shape, BIG, jnp.float32)
+        acc_i[:] = jnp.full(acc_i.shape, -1, jnp.int32)
+
+    xt = xt_ref[:].astype(jnp.bfloat16)          # [D, TM]
+    yt = yt_ref[:].astype(jnp.bfloat16)          # [D, TN]
+    cross = lax.dot_general(xt, yt, (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [TM, TN]
+    metric = y2_ref[:] - 2.0 * cross
+    tm = metric.shape[0]
+    n_chunks = tn // LANES
+    lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+    for c in range(n_chunks):
+        s = c % n_acc
+        chunk = metric[:, c * LANES:(c + 1) * LANES]
+        cur_d = acc_d[:, s * LANES:(s + 1) * LANES]
+        better = chunk < cur_d
+        idx = j * tn + c * LANES + lane
+        acc_d[:, s * LANES:(s + 1) * LANES] = jnp.where(better, chunk, cur_d)
+        cur_i = acc_i[:, s * LANES:(s + 1) * LANES]
+        acc_i[:, s * LANES:(s + 1) * LANES] = jnp.where(better, idx, cur_i)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        val = acc_d[:]
+        idx = acc_i[:]
+        new_d = jnp.full((tm, LANES), BIG, jnp.float32)
+        new_i = jnp.full((tm, LANES), -1, jnp.int32)
+        slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+        for slot in range(k):
+            min_d = jnp.min(val, axis=1, keepdims=True)
+            min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
+                            axis=1, keepdims=True)
+            new_d = jnp.where(slot_lane == slot, min_d, new_d)
+            new_i = jnp.where(slot_lane == slot, min_i, new_i)
+            val = jnp.where((val == min_d) & (idx == min_i), BIG, val)
+        out_d_ref[:] = new_d
+        out_i_ref[:] = new_i
+
+
+def _launch(variant: str, x, y):
+    m = x.shape[0]
+    xp = _pad_rows(x, TILE_M)
+    yp = _pad_rows(y, TILE_N)
+    n = y.shape[0]
+    y2 = jnp.sum(y * y, axis=1)
+    y2p = jnp.pad(y2, (0, yp.shape[0] - n), constant_values=BIG)[None, :]
+    grid = (xp.shape[0] // TILE_M, yp.shape[0] // TILE_N)
+    d = x.shape[1]
+
+    if variant == "full":
+        return pairwise_topk_pallas(x, y, k=K, tile_m=TILE_M,
+                                    tile_n=TILE_N, n_acc=N_ACC)
+    elif variant == "nodot":
+        kernel = partial(_nodot_kernel, k=K, tn=TILE_N, n_acc=N_ACC)
+    elif variant == "dotmin":
+        out = pl.pallas_call(
+            partial(_dotmin_kernel, tn=TILE_N),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((TILE_M, d), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((TILE_N, d), lambda i, j: (j, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, TILE_N), lambda i, j: (0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((TILE_M, LANES), lambda i, j: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((TILE_M, LANES), jnp.float32)],
+        )(xp, yp, y2p)
+        return out[:m], None
+    elif variant == "tpose":
+        xt = xp.T                                  # [D, M_pad]
+        yt = yp.T                                  # [D, N_pad]
+        out_d, out_i = pl.pallas_call(
+            partial(_tpose_kernel, k=K, tn=TILE_N, n_acc=N_ACC),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((d, TILE_M), lambda i, j: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((d, TILE_N), lambda i, j: (0, j),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, TILE_N), lambda i, j: (0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((TILE_M, LANES), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((TILE_M, LANES), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
+                jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((TILE_M, N_ACC * LANES), jnp.float32),
+                pltpu.VMEM((TILE_M, N_ACC * LANES), jnp.int32),
+            ],
+        )(xt, yt, y2p)
+        return out_d[:m], out_i[:m]
+    else:
+        raise ValueError(variant)
+
+    out_d, out_i = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_M, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_N, d), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE_N), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((TILE_M, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_M, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TILE_M, N_ACC * LANES), jnp.float32),
+            pltpu.VMEM((TILE_M, N_ACC * LANES), jnp.int32),
+        ],
+    )(xp, yp, y2p)
+    return out_d[:m], out_i[:m]
+
+
+def _time_variant(variant: str, test, train) -> float:
+    if variant == "xla":
+        def run(t):
+            return pairwise_topk(t, train, k=K, mode="fast")[0]
+    else:
+        def run(t):
+            return _launch(variant, t, train)[0]
+
+    @jax.jit
+    def chain(t):
+        def body(t, _):
+            d = run(t)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, d[0, 0]
+        _, outs = lax.scan(body, t, None, length=ITERS)
+        return outs
+
+    np.asarray(chain(test))          # compile + warm
+    best = min(_time(chain, test) for _ in range(REPEATS))
+    return best
+
+
+def _time(chain, test) -> float:
+    t0 = time.perf_counter()
+    np.asarray(chain(test))
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    train = jnp.asarray(rng.random((N_TRAIN, D), dtype=np.float32))
+    test = jnp.asarray(rng.random((M_TEST, D), dtype=np.float32))
+    pairs_per_iter = M_TEST * N_TRAIN
+
+    print(f"# shape: {M_TEST} test x {N_TRAIN} train, D={D}, k={K}, "
+          f"tiles ({TILE_M},{TILE_N}) n_acc={N_ACC}, iters={ITERS}, "
+          f"best of {REPEATS}")
+    print(f"# ceilings: MXU@K128 {MXU_PAIRS_CEIL_K128:.3e} pairs/s, "
+          f"MXU@K16 {MXU_PAIRS_CEIL_K16:.3e} pairs/s")
+    for variant in ("full", "dotmin", "nodot", "tpose", "xla"):
+        try:
+            elapsed = _time_variant(variant, test, train)
+        except Exception as exc:        # mosaic may reject a formulation
+            print(f"{variant:8s} FAILED: {type(exc).__name__}: "
+                  f"{str(exc).splitlines()[0][:140]}")
+            continue
+        pairs = pairs_per_iter * ITERS / elapsed
+        rows = M_TEST * ITERS / elapsed
+        # HBM: per test tile the padded train sweep streams N*128 lanes f32
+        hbm = (M_TEST / TILE_M) * N_TRAIN * 128 * 4 * ITERS / elapsed
+        print(f"{variant:8s} {elapsed*1e3:8.1f} ms  {rows/1e6:7.3f} M rows/s"
+              f"  {pairs:.3e} pairs/s"
+              f"  {100*pairs/MXU_PAIRS_CEIL_K128:5.1f}% MXU@K128"
+              f"  {100*hbm/HBM_BPS:5.1f}% HBM(f32-padded)")
+
+
+if __name__ == "__main__":
+    main()

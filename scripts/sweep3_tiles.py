@@ -1,0 +1,86 @@
+"""Interleaved tile-config sweep of the production pallas KNN kernel.
+
+Round-robins timing draws across configs (one chain call each per round,
+best-of over rounds) so the relay's time-varying load hits every config
+equally — the sequential sweeps in sweep_pallas.py / sweep2_pallas.py let a
+slow relay window bias whole configs (scripts/roofline_knn_results.txt shows
+a *simpler* kernel variant timing 23% slower purely from draw ordering).
+
+Run:  PYTHONPATH=/root/repo:/root/.axon_site python scripts/sweep3_tiles.py
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas
+
+N_TRAIN = 65536
+M_TEST = 8192
+D = 9
+K = 5
+ITERS = 50
+ROUNDS = 6
+
+# (tile_m, tile_n, n_acc)
+CONFIGS = [
+    (1024, 4096, 4),     # production default (round 1)
+    (512, 8192, 4),
+    (1024, 8192, 4),
+    (1024, 16384, 4),
+    (2048, 8192, 4),
+    (512, 16384, 4),
+    (256, 16384, 4),
+]
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    train = jnp.asarray(rng.random((N_TRAIN, D), dtype=np.float32))
+    test = jnp.asarray(rng.random((M_TEST, D), dtype=np.float32))
+
+    chains = {}
+    for cfg in CONFIGS:
+        tm, tn, na = cfg
+
+        def make(tm=tm, tn=tn, na=na):
+            @jax.jit
+            def chain(t):
+                def body(t, _):
+                    d, i = pairwise_topk_pallas(
+                        t, train, k=K, tile_m=tm, tile_n=tn, n_acc=na)
+                    eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+                    return t + eps, d[0, 0]
+                _, outs = lax.scan(body, t, None, length=ITERS)
+                return outs
+            return chain
+
+        chains[cfg] = make()
+
+    # compile + warm everything first so rounds only measure steady state
+    for cfg, chain in list(chains.items()):
+        try:
+            np.asarray(chain(test))
+        except Exception as exc:
+            print(f"{cfg} FAILED compile: {str(exc).splitlines()[0][:120]}")
+            del chains[cfg]
+
+    best = {cfg: float("inf") for cfg in chains}
+    for r in range(ROUNDS):
+        for cfg, chain in chains.items():
+            t0 = time.perf_counter()
+            np.asarray(chain(test))
+            best[cfg] = min(best[cfg], time.perf_counter() - t0)
+
+    for cfg in chains:
+        rows = M_TEST * ITERS / best[cfg]
+        print(f"tile=({cfg[0]:5d},{cfg[1]:6d}) n_acc={cfg[2]}  "
+              f"{best[cfg]*1e3:7.1f} ms  {rows/1e6:7.3f} M rows/s")
+
+
+if __name__ == "__main__":
+    main()
