@@ -28,6 +28,10 @@ import jax.numpy as jnp
 ITERS = 50
 REPEATS = 3
 
+# v5e datasheet ceilings for the roofline columns (TPU v5 lite):
+HBM_BPS = 819e9            # HBM bytes/sec
+BF16_FLOPS = 197e12        # peak bf16 MXU FLOP/s
+
 
 def timed(fn, *args) -> float:
     np.asarray(fn(*args))                       # compile + warm
@@ -39,9 +43,20 @@ def timed(fn, *args) -> float:
     return best
 
 
-def emit(metric: str, value: float, unit: str) -> None:
-    print(json.dumps({"metric": metric, "value": round(value, 1),
-                      "unit": unit}))
+def emit(metric: str, value: float, unit: str,
+         bound: float = None, bound_model: str = None) -> None:
+    """One JSON line per metric. ``bound`` is the roofline rate for the
+    SAME unit under the stated ``bound_model`` (v5e datasheet numbers), so
+    round-over-round perf claims carry their utilization: a number can only
+    be called good/bad relative to what the binding unit admits."""
+    rec = {"metric": metric, "value": round(value, 1), "unit": unit}
+    if bound is not None:
+        rec["roofline_bound"] = float(f"{bound:.3g}")
+        rec["roofline_util"] = round(value / bound, 4)
+        rec["bound_model"] = bound_model
+    elif bound_model is not None:
+        rec["bound_model"] = bound_model
+    print(json.dumps(rec))
 
 
 def bench_naive_bayes() -> None:
@@ -63,8 +78,15 @@ def bench_naive_bayes() -> None:
         return outs
 
     elapsed = timed(chain, binned, labels, jnp.ones(n, jnp.float32))
+    # algorithmic HBM floor: per sample the train kernel streams the binned
+    # row (F*4B) + label + weight and materializes/reads the [F, B] one-hot
+    # (2 * F*B*4B) — the segment-sum-by-one-hot design's own traffic
+    bytes_per_sample = f * 4 + 8 + 2 * f * bins * 4
     emit("naive_bayes_train_samples_per_sec", n * ITERS / elapsed,
-         f"samples/sec ({n} rows x {f} churn-shaped features)")
+         f"samples/sec ({n} rows x {f} churn-shaped features)",
+         bound=HBM_BPS / bytes_per_sample,
+         bound_model=f"HBM stream, {bytes_per_sample}B/sample "
+                     "(row + one-hot write+read)")
 
 
 def bench_knn() -> None:
@@ -90,32 +112,41 @@ def bench_knn() -> None:
         return outs
 
     elapsed = timed(chain, test, train)
+    # MXU model: every (test, train) pair costs 2*128 FLOP of (mostly
+    # padding) MXU work at D=9 padded to the 128-lane contraction; the
+    # measured binding unit is actually the VPU fold on top of this
+    # (ops/pallas_distance.py roofline docstring)
     emit("knn_pairwise_topk_rows_per_sec_per_chip", m_test * ITERS / elapsed,
-         f"test rows/sec vs {n_train} train rows (D={d}, k={k})")
+         f"test rows/sec vs {n_train} train rows (D={d}, k={k})",
+         bound=BF16_FLOPS / (2 * 128) / n_train,
+         bound_model="MXU padded-K128 slab, 256 FLOP/pair")
 
 
-def bench_tree_split_gain() -> None:
-    """retarget.properties shape: one full level of candidate-split gains
-    (numeric cartValue/visits + categorical loyalty) over 1M rows."""
+def _retarget_big_table(reps: int = 256):
+    """The shared 1M-row tree workload: retarget.properties shape tiled on
+    device (gains are label/feature histograms, so row content distribution
+    — not uniqueness — is what matters for throughput)."""
+    import dataclasses
     from avenir_tpu.datagen import retarget_schema
-    from avenir_tpu.models.tree import split_gains
-    from avenir_tpu.utils.dataset import Featurizer
     from avenir_tpu.datagen.generators import retarget_rows
-    schema = retarget_schema()
-    fz = Featurizer(schema)
+    from avenir_tpu.utils.dataset import Featurizer
+    fz = Featurizer(retarget_schema())
     base = retarget_rows(4096, seed=1)
     fz.fit(base)
     table = fz.transform(base)
-    # tile rows to 1M on device: gains are label/feature histograms, so row
-    # content distribution (not uniqueness) is what matters for throughput
-    reps = 256
-    import dataclasses
-    big = dataclasses.replace(
+    return dataclasses.replace(
         table,
         binned=jnp.tile(table.binned, (reps, 1)),
         numeric=jnp.tile(table.numeric, (reps, 1)),
         labels=jnp.tile(table.labels, reps),
         ids=[], n_rows=table.n_rows * reps)
+
+
+def bench_tree_split_gain() -> None:
+    """One full level of candidate-split gains (numeric cartValue/visits +
+    categorical loyalty) over the shared 1M-row workload."""
+    from avenir_tpu.models.tree import split_gains
+    big = _retarget_big_table()
     attrs = [f.ordinal for f in big.feature_fields]
 
     split_gains(big, attrs, "giniIndex", parent_info=1.0)   # compile + warm
@@ -124,9 +155,43 @@ def bench_tree_split_gain() -> None:
     for _ in range(n_levels):
         splits = split_gains(big, attrs, "giniIndex", parent_info=1.0)
     elapsed = (time.perf_counter() - t0) / n_levels
+    # device-compute floor per level: the counts matmuls are ~T*S*N*C MACs
+    # + one stream of the table; the measured number is RELAY-bound (one
+    # host round-trip per level, ~150ms) — the utilization column makes
+    # that audit-visible, and grow_tree_device exists to delete it
+    t_cands, s_max, n_cls = len(splits), 4, 2
+    floor_s = (2 * t_cands * s_max * big.n_rows * n_cls / BF16_FLOPS
+               + big.n_rows * 20 / HBM_BPS)
     emit("tree_split_gain_levels_per_sec", 1.0 / elapsed,
          f"levels/sec ({big.n_rows} rows, {len(splits)} candidate splits, "
-         "host-driven incl. relay latency)")
+         "host-driven incl. relay latency)",
+         bound=1.0 / floor_s,
+         bound_model="device compute floor (counts MACs + table stream); "
+                     "gap = per-level relay RTT")
+
+
+def bench_tree_device_growth() -> None:
+    """Full tree GROWTH (stats + split selection + row routing, all nodes
+    of every level) as one device dispatch per tree — grow_tree_device,
+    the path that deletes the reference's two-MR-jobs-per-level boundary
+    (DataPartitioner.java:59-106) AND round-1's one-fetch-per-level loop."""
+    from avenir_tpu.models.tree import TreeConfig, grow_tree_device
+    big = _retarget_big_table()
+    depth = 4
+    cfg = TreeConfig(max_depth=depth, algorithm="giniIndex")
+    grow_tree_device(big, cfg)                  # compile + warm
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        grow_tree_device(big, cfg)
+        best = min(best, time.perf_counter() - t0)
+    # floor: one relay round-trip per TREE (~150ms — irreducible for a
+    # host-resident caller) + the level compute
+    emit("tree_device_growth_levels_per_sec", depth / best,
+         f"levels/sec ({big.n_rows} rows, depth {depth}, full growth: "
+         "stats+selection+routing, one dispatch + one readback per tree)",
+         bound=depth / 0.15,
+         bound_model="one relay RTT (~150ms) per tree; gap = level compute")
 
 
 def bench_markov_train() -> None:
@@ -149,8 +214,14 @@ def bench_markov_train() -> None:
         return outs
 
     elapsed = timed(chain, seqs, lengths)
+    # algorithmic HBM floor: stream the [B, T] sequence block + the
+    # bigram one-hot pair writes/reads (2 * T * S * 4B per sequence)
+    bytes_per_seq = t * 4 + 2 * t * s * 4
     emit("markov_train_sequences_per_sec", b * ITERS / elapsed,
-         f"sequences/sec ({b} seqs x T={t}, {s} states)")
+         f"sequences/sec ({b} seqs x T={t}, {s} states)",
+         bound=HBM_BPS / bytes_per_seq,
+         bound_model=f"HBM stream, {bytes_per_seq}B/seq "
+                     "(tokens + one-hot write+read)")
 
 
 def bench_bandit_decisions() -> None:
@@ -177,7 +248,11 @@ def bench_bandit_decisions() -> None:
 
     elapsed = timed(chain, state0)
     emit("bandit_online_decisions_per_sec", n_decisions / elapsed,
-         f"decisions/sec (softMax, {n_actions} arms, on-device loop)")
+         f"decisions/sec (softMax, {n_actions} arms, on-device loop)",
+         bound_model="serial-dependency-bound: each decision's state "
+                     "update feeds the next, so the rate is the scan-step "
+                     "pipeline latency, not a bandwidth/FLOP ceiling — "
+                     "scale via grouped contexts instead")
 
 
 def bench_grouped_bandit_decisions() -> None:
@@ -211,15 +286,22 @@ def bench_grouped_bandit_decisions() -> None:
         return outs
 
     elapsed = timed(chain, states0)
+    # HBM floor: per decision the vmapped step reads+writes the context's
+    # [A]-sized state leaves (~6 arrays) once
+    bytes_per_decision = 2 * 6 * n_actions * 4
     emit("bandit_grouped_decisions_per_sec",
          n_groups * n_steps / elapsed,
-         f"decisions/sec ({n_groups} contexts x {n_actions} arms, vmapped)")
+         f"decisions/sec ({n_groups} contexts x {n_actions} arms, vmapped)",
+         bound=HBM_BPS / bytes_per_decision,
+         bound_model=f"HBM stream, {bytes_per_decision}B/decision "
+                     "(state leaves read+write)")
 
 
 if __name__ == "__main__":
     bench_naive_bayes()
     bench_knn()
     bench_tree_split_gain()
+    bench_tree_device_growth()
     bench_markov_train()
     bench_bandit_decisions()
     bench_grouped_bandit_decisions()
