@@ -265,6 +265,87 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
         print(cm.report().to_json())
 
 
+def run_tree_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Grow a COMPLETE decision tree in one job — the assembly the
+    reference's per-level SplitGenerator/DataPartitioner rounds never had
+    (SURVEY.md §2.3). Reference key names where they exist
+    (split.algorithm, split.attributes, max.cat.attr.split.groups,
+    split.selection.strategy, num.top.splits); new keys max.depth /
+    min.node.size / min.gain. The model artifact is JSON:
+    {"classValues": [...], "root": {classCounts, attr, splitKey,
+    children}} — loadable by TreePredictor.
+
+    ``best`` selection runs the device-resident growth (one dispatch + one
+    readback per tree, models/tree.grow_tree_device); randomFromTop uses
+    the host loop (it consumes host randomness)."""
+    import json
+    from avenir_tpu.models import tree as T
+    fz, rows = _load_table(conf, in_path)
+    table = fz.transform(rows)
+    strategy = conf.get("split.selection.strategy", "best")
+    cfg = T.TreeConfig(
+        split_attributes=tuple(conf.get_int_list("split.attributes") or ()),
+        algorithm=conf.get("split.algorithm", "giniIndex"),
+        max_depth=conf.get_int("max.depth", 3),
+        min_node_size=conf.get_int("min.node.size", 10),
+        max_cat_attr_split_groups=conf.get_int(
+            "max.cat.attr.split.groups", 3),
+        split_selection_strategy=strategy,
+        num_top_splits=conf.get_int("num.top.splits", 5),
+        min_gain=conf.get_float("min.gain", 1e-6))
+    if strategy == "best":
+        try:
+            tree = T.grow_tree_device(table, cfg)
+        except ValueError as exc:
+            # fall back ONLY for the depth guard (its message names the
+            # alternative); anything else is a real defect to surface
+            if "use grow_tree" not in str(exc):
+                raise
+            print(f"TreeBuilder: device growth unavailable ({exc}); "
+                  "using the per-level host loop", file=sys.stderr)
+            tree = T.grow_tree(table, cfg)
+    else:
+        rng = np.random.default_rng(conf.get_int("random.seed", 0))
+        tree = T.grow_tree(table, cfg, rng=rng)
+    with open(out_path, "w") as fh:
+        json.dump({"classValues": table.class_values,
+                   "root": tree.to_dict()}, fh)
+    def depth_of(n) -> int:
+        return 0 if not n.children else 1 + max(
+            depth_of(c) for c in n.children.values())
+
+    print(json.dumps({"Tree.Depth": depth_of(tree),
+                      "Tree.Rows": table.n_rows}))
+
+
+def run_tree_predictor(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Classify rows down a TreeBuilder model (``tree.model.file.path``) —
+    the inference leg the reference never shipped. ``validation.mode=true``
+    prints the confusion-matrix report like the other predictors."""
+    import json
+    from avenir_tpu.models import tree as T
+    from avenir_tpu.utils.metrics import ConfusionMatrix
+    import jax.numpy as jnp
+    validation = conf.get_bool("validation.mode", False)
+    fz, rows = _load_table(conf, in_path, for_predict=True)
+    table = fz.transform(rows, with_labels=validation)
+    with open(conf.get_required("tree.model.file.path")) as fh:
+        model = json.load(fh)
+    tree = T.TreeNode.from_dict(model["root"], model["classValues"])
+    pred = T.predict(tree, table)
+    delim = conf.get("field.delim.out", ",")
+    with open(out_path, "w") as fh:
+        for i in range(table.n_rows):
+            fh.write(delim.join(
+                [table.ids[i] if table.ids else str(i),
+                 model["classValues"][int(pred[i])]]) + "\n")
+    if validation and table.labels is not None:
+        cm = ConfusionMatrix(model["classValues"],
+                             positive_class=conf.get("positive.class.value"))
+        cm.update(jnp.asarray(pred), table.labels)
+        print(cm.report().to_json())
+
+
 def _select_split_attributes(conf: JobConfig, table) -> List[int]:
     """``split.attribute.selection.strategy`` (ClassPartitionGenerator.java
     :141, :160-196): userSpecified / all / random. ``random`` draws
@@ -675,7 +756,16 @@ def run_correlation(conf: JobConfig, in_path: str, out_path: str,
 
 
 def run_under_sampling(conf: JobConfig, in_path: str, out_path: str) -> None:
-    """Majority-class undersampling (reference UnderSamplingBalancer)."""
+    """Majority-class undersampling (reference UnderSamplingBalancer).
+
+    CONTRACT DEVIATION (deliberate, documented): the verb accepts the
+    reference's key names but uses EXACT global class counts, where the
+    reference estimates counts from a streaming bootstrap over the first
+    ``distr.batch.size`` rows (UnderSamplingBalancer.java:92-131). For the
+    same seed different rows may survive; the kept-class BALANCE is
+    equivalent or better (exact instead of estimate), and
+    ``distr.batch.size`` is accepted but unused.
+    """
     import re
     import jax
     import jax.numpy as jnp
@@ -806,6 +896,8 @@ VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "ClassPartitionGenerator": run_class_partition_generator,
     "SplitGenerator": run_split_generator,
     "DataPartitioner": run_data_partitioner,
+    "TreeBuilder": run_tree_builder,
+    "TreePredictor": run_tree_predictor,
     "MarkovStateTransitionModel": run_markov_state_transition_model,
     "MarkovModelClassifier": run_markov_model_classifier,
     "HiddenMarkovModelBuilder": run_hmm_builder,

@@ -558,6 +558,16 @@ class TreeNode:
             "children": {str(k): v.to_dict() for k, v in self.children.items()},
         }
 
+    @classmethod
+    def from_dict(cls, d: dict, class_values: List[str]) -> "TreeNode":
+        node = cls(class_counts=np.asarray(d["classCounts"], np.float64),
+                   class_values=list(class_values),
+                   attr_ordinal=d.get("attr"),
+                   split_key=d.get("splitKey"))
+        for k, child in d.get("children", {}).items():
+            node.children[int(k)] = cls.from_dict(child, class_values)
+        return node
+
 
 @dataclass(frozen=True)
 class TreeConfig:

@@ -68,17 +68,30 @@ def intrinsic_info_content(counts: jnp.ndarray) -> jnp.ndarray:
 
 
 def hellinger_distance(counts: jnp.ndarray) -> jnp.ndarray:
-    """Hellinger distance between the two per-class segment distributions.
+    """Hellinger distance between per-class segment distributions.
 
-    ``counts``: [..., S, 2] (binary class only, as the reference enforces at
-    AttributeSplitStat.java:244-247). sqrt over segments of
-    (sqrt(n_s0/n0) - sqrt(n_s1/n1))^2.
+    ``counts``: [..., S, C]. For C=2 this is exactly the reference's
+    formula — sqrt over segments of (sqrt(n_s0/n0) - sqrt(n_s1/n1))^2 —
+    which the reference RESTRICTS to binary classes
+    (AttributeSplitStat.java:244-247). For C>2 this build generalizes where
+    the reference gave up: the mean pairwise Hellinger distance over all
+    class pairs, which reduces to the reference's value at C=2 and keeps
+    the same "how differently do classes distribute over segments" reading.
     """
-    class_tot = jnp.sum(counts, axis=-2, keepdims=True)  # [..., 1, 2]
+    class_tot = jnp.sum(counts, axis=-2, keepdims=True)  # [..., 1, C]
     frac = counts / jnp.where(class_tot > 0, class_tot, 1.0)
-    root = jnp.sqrt(frac)
-    diff = root[..., 0] - root[..., 1]                   # [..., S]
-    return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    root = jnp.sqrt(frac)                                # [..., S, C]
+    diff = root[..., :, None] - root[..., None, :]       # [..., S, C, C]
+    pair_d = jnp.sqrt(jnp.sum(diff * diff, axis=-3))     # [..., C, C]
+    c = counts.shape[-1]
+    triu = jnp.triu(jnp.ones((c, c), counts.dtype), k=1)
+    # pairs with an ABSENT class would read as phantom distance-1 pairs
+    # (the absent side's distribution is all-zero) and inflate every
+    # candidate's stat by a constant: average over PRESENT pairs only
+    present = (class_tot[..., 0, :] > 0).astype(counts.dtype)  # [..., C]
+    pairs = triu * present[..., :, None] * present[..., None, :]
+    n_pairs = jnp.maximum(jnp.sum(pairs, axis=(-2, -1)), 1.0)
+    return jnp.sum(pair_d * pairs, axis=(-2, -1)) / n_pairs
 
 
 def class_confidence_ratio(counts: jnp.ndarray) -> jnp.ndarray:

@@ -76,6 +76,37 @@ class TestInfoTheory:
         counts = jnp.asarray([[3.0, 3.0], [3.0, 3.0]])
         assert float(it.hellinger_distance(counts)) == pytest.approx(0.0)
 
+    def test_hellinger_multiclass_generalization(self):
+        """C>2 (beyond the reference's binary restriction,
+        AttributeSplitStat.java:244-247): mean pairwise Hellinger."""
+        # three classes perfectly separated into three segments: every pair
+        # is a perfectly-separating binary split -> mean = sqrt(2)
+        counts = jnp.asarray([[4.0, 0.0, 0.0],
+                              [0.0, 5.0, 0.0],
+                              [0.0, 0.0, 6.0]])
+        assert float(it.hellinger_distance(counts)) == pytest.approx(
+            np.sqrt(2.0))
+        # identical three-class distributions -> 0
+        counts = jnp.asarray([[2.0, 4.0, 6.0], [2.0, 4.0, 6.0]])
+        assert float(it.hellinger_distance(counts)) == pytest.approx(0.0)
+        # hand value: classes 0/1 separated, class 2 uniform across segs.
+        # d(0,1)=sqrt(2); d(0,2)=d(1,2)=sqrt(2-sqrt(2)); mean of 3 pairs
+        counts = jnp.asarray([[4.0, 0.0, 3.0], [0.0, 4.0, 3.0]])
+        expect = (np.sqrt(2.0) + 2 * np.sqrt(2.0 - np.sqrt(2.0))) / 3
+        assert float(it.hellinger_distance(counts)) == pytest.approx(
+            expect, rel=1e-5)
+
+    def test_hellinger_absent_class_not_phantom_pair(self):
+        """A class absent from the node must not contribute phantom
+        distance-1 pairs: with only classes 0/1 present and identically
+        distributed, the stat is 0 (no signal), not 2/3."""
+        counts = jnp.asarray([[3.0, 3.0, 0.0], [3.0, 3.0, 0.0]])
+        assert float(it.hellinger_distance(counts)) == pytest.approx(0.0)
+        # and the present-pair distance is unaffected by the absent class
+        counts = jnp.asarray([[4.0, 0.0, 0.0], [0.0, 4.0, 0.0]])
+        assert float(it.hellinger_distance(counts)) == pytest.approx(
+            np.sqrt(2.0))
+
     def test_class_confidence_ratio_pure_split(self):
         counts = jnp.asarray([[6.0, 0.0], [0.0, 3.0]])
         assert float(it.class_confidence_ratio(counts)) == pytest.approx(0.0)

@@ -623,6 +623,60 @@ class TestSplitGeneratorPathConvention:
         assert n_rows == 600
 
 
+class TestTreeBuilderCli:
+    """TreeBuilder/TreePredictor: the complete grow-then-classify pipeline
+    (the tree assembly + inference the reference never shipped) as two CLI
+    jobs with a JSON model artifact between them."""
+
+    def test_build_predict_roundtrip(self, tmp_path, capsys):
+        rows = G.retarget_rows(1800, seed=44)
+        write_csv(tmp_path / "train.csv", rows[:1500])
+        write_csv(tmp_path / "test.csv", rows[1500:])
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._RETARGET_SCHEMA_JSON, fh)
+        props = tmp_path / "tree.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "split.algorithm": "giniIndex",
+                       "max.depth": "3",
+                       "tree.model.file.path": tmp_path / "tree.json"})
+        cli(["TreeBuilder", str(tmp_path / "train.csv"),
+             str(tmp_path / "tree.json"), "--conf", str(props)])
+        stats = last_json(capsys)
+        assert 1 <= stats["Tree.Depth"] <= 3
+        assert stats["Tree.Rows"] == 1500
+        model = json.load(open(tmp_path / "tree.json"))
+        assert set(model["classValues"]) == {"yes", "no"}
+        assert model["root"]["splitKey"] is not None
+
+        cli(["TreePredictor", str(tmp_path / "test.csv"),
+             str(tmp_path / "pred.txt"), "--conf", str(props),
+             "-D", "validation.mode=true",
+             "-D", "positive.class.value=yes"])
+        report = last_json(capsys)
+        # planted rule (cartValue>250, loyalty=gold) is depth-2 learnable
+        assert report["Validation.Accuracy"] > 0.7
+        preds = [l.split(",") for l in
+                 open(tmp_path / "pred.txt").read().splitlines()]
+        assert len(preds) == 300
+        assert all(p[1] in ("yes", "no") for p in preds)
+
+    def test_random_from_top_strategy(self, tmp_path, capsys):
+        rows = G.retarget_rows(600, seed=45)
+        write_csv(tmp_path / "train.csv", rows)
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._RETARGET_SCHEMA_JSON, fh)
+        props = tmp_path / "t.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "split.selection.strategy": "randomFromTop",
+                       "num.top.splits": "3",
+                       "max.depth": "2"})
+        cli(["TreeBuilder", str(tmp_path / "train.csv"),
+             str(tmp_path / "tree.json"), "--conf", str(props)])
+        assert last_json(capsys)["Tree.Depth"] >= 1
+
+
 class TestKnnRegressionCli:
     """NearestNeighbor with prediction.mode=regression (the reference's
     regression branch, NearestNeighbor.java:122-123): the class-attribute
