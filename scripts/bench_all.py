@@ -69,24 +69,28 @@ def bench_naive_bayes() -> None:
     labels = jnp.asarray(rng.integers(0, classes, n), jnp.int32)
 
     @jax.jit
-    def chain(binned, labels, weights):
-        def body(w, _):
-            model = _train_kernel(binned, cont, labels, w, classes, bins)
-            eps = (jnp.sum(model.class_counts) % 7) * 1e-20
-            return w + eps, model.class_counts[0]
-        _, outs = jax.lax.scan(body, weights, None, length=ITERS)
+    def chain(binned, labels):
+        def body(lbl, _):
+            # weights=None: the production CLI path (and the fast
+            # combined-index bf16 reduction, ops/histogram.py)
+            model = _train_kernel(binned, cont, lbl, None, classes, bins)
+            # data dependency XLA cannot fold: counts are non-negative so
+            # min(total, 0) is always 0, but the compiler can't prove it
+            tot = jnp.sum(model.post_counts).astype(jnp.int32)
+            return lbl + jnp.minimum(tot, 0), model.class_counts[0]
+        _, outs = jax.lax.scan(body, labels, None, length=ITERS)
         return outs
 
-    elapsed = timed(chain, binned, labels, jnp.ones(n, jnp.float32))
-    # algorithmic HBM floor: per sample the train kernel streams the binned
-    # row (F*4B) + label + weight and materializes/reads the [F, B] one-hot
-    # (2 * F*B*4B) — the segment-sum-by-one-hot design's own traffic
-    bytes_per_sample = f * 4 + 8 + 2 * f * bins * 4
+    elapsed = timed(chain, binned, labels)
+    # algorithmic HBM floor for the unweighted kernel actually benched:
+    # binned row (F*4B) + label (4B) + the combined-index bf16 one-hot
+    # [F, C*B] written + read (2 * F*C*B*2B)
+    bytes_per_sample = f * 4 + 4 + 2 * f * classes * bins * 2
     emit("naive_bayes_train_samples_per_sec", n * ITERS / elapsed,
          f"samples/sec ({n} rows x {f} churn-shaped features)",
          bound=HBM_BPS / bytes_per_sample,
          bound_model=f"HBM stream, {bytes_per_sample}B/sample "
-                     "(row + one-hot write+read)")
+                     "(row + combined bf16 one-hot write+read)")
 
 
 def bench_knn() -> None:
