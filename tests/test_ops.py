@@ -13,6 +13,19 @@ class TestHistogram:
         out = H.class_counts(jnp.asarray([0, 1, 1, 2]), 3)
         np.testing.assert_allclose(np.asarray(out), [1, 2, 1])
 
+    def test_out_of_range_bins_dropped_not_aliased(self):
+        """A bin id outside [0, n_bins) (schema min/max narrower than the
+        data) must contribute NOTHING — never a phantom count in another
+        class's slot of the combined index."""
+        bins = jnp.asarray([[2], [-1], [0]], jnp.int32)   # 2 and -1 invalid
+        labels = jnp.asarray([0, 1, 1], jnp.int32)
+        out = np.asarray(H.class_feature_bin_counts(bins, labels, 2, 2))
+        np.testing.assert_array_equal(out, [[[0, 0]], [[1, 0]]])
+        # weighted path: identical drop semantics
+        w = jnp.ones(3, jnp.float32)
+        outw = np.asarray(H.class_feature_bin_counts(bins, labels, 2, 2, w))
+        np.testing.assert_array_equal(outw, out)
+
     def test_class_feature_bin_counts(self):
         bins = jnp.asarray([[0, 1], [1, 1], [0, 0]])
         labels = jnp.asarray([0, 1, 0])
