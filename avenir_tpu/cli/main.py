@@ -548,17 +548,40 @@ def run_markov_model_classifier(conf: JobConfig, in_path: str,
 
 
 def run_hmm_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
-    """Build an HMM from tagged data (reference HiddenMarkovModelBuilder)."""
+    """Build an HMM from tagged data (reference HiddenMarkovModelBuilder) —
+    or, with ``training.mode=untagged``, from raw observation sequences via
+    Baum-Welch EM (``num.states`` hidden states, ``num.iterations`` EM
+    steps), the unsupervised leg the reference never had: its builder
+    requires tagged tokens (HiddenMarkovModelBuilder.java:136-260)."""
     from avenir_tpu.models import hmm as H
     delim = conf.get("field.delim.regex", ",")
-    states = conf.get_list("model.states")
-    observations = conf.get_list("model.observations")
-    if states is None or observations is None:
-        raise ValueError("model.states and model.observations are required")
     rows = read_csv_lines(in_path, delim)
     # the reference builder scales with trans.prob.scale, default 1000
     # (HiddenMarkovModelBuilder.java:293)
     scale = conf.get_int("trans.prob.scale", 1000)
+    if conf.get("training.mode", "tagged") == "untagged":
+        # trailing delimiters leave empty tokens in CSV rows; they are not
+        # observations
+        rows = [[t for t in r if t] for r in rows]
+        observations = conf.get_list("model.observations")
+        if observations is None:
+            observations = sorted({t for r in rows for t in r})
+        n_states = conf.get_int("num.states")
+        if n_states is None:
+            raise ValueError("training.mode=untagged needs num.states")
+        model, ll = H.train_baum_welch(
+            rows, observations, n_states,
+            n_iters=conf.get_int("num.iterations", 50),
+            seed=conf.get_int("random.seed", 0), scale=scale,
+            state_names=conf.get_list("model.states"))
+        H.save_model(model, out_path, delim=conf.get("field.delim.out", ","))
+        print(f'{{"BaumWelch.LogLikelihood": {float(ll[-1])}, '
+              f'"BaumWelch.Iterations": {len(ll)}}}')
+        return
+    states = conf.get_list("model.states")
+    observations = conf.get_list("model.observations")
+    if states is None or observations is None:
+        raise ValueError("model.states and model.observations are required")
     if conf.get_bool("partially.tagged", False):
         wf = conf.get_int_list("window.function", [1])
         model = H.train_partially_tagged(rows, states, observations, wf,

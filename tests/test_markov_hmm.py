@@ -173,6 +173,69 @@ class TestHmm:
         assert model.trans[0, 1] > model.trans[1, 0]
 
 
+class TestBaumWelch:
+    """Unsupervised HMM training (the leg the reference's tagged-only
+    builder never had): EM must monotonically improve likelihood and
+    recover a planted model up to state permutation."""
+
+    def _planted(self, n_seqs=300, seed=3):
+        rng = np.random.default_rng(seed)
+        A = np.array([[0.9, 0.1], [0.2, 0.8]])
+        B = np.array([[0.45, 0.45, 0.05, 0.05],
+                      [0.05, 0.05, 0.45, 0.45]])
+        pi = np.array([0.6, 0.4])
+        names = ["a", "b", "c", "d"]
+        rows, paths = [], []
+        for _ in range(n_seqs):
+            t_len = int(rng.integers(15, 30))
+            s = rng.choice(2, p=pi)
+            seq, st = [], []
+            for _ in range(t_len):
+                seq.append(names[rng.choice(4, p=B[s])])
+                st.append(s)
+                s = rng.choice(2, p=A[s])
+            rows.append(seq)
+            paths.append(st)
+        return rows, paths, A, B, names
+
+    def test_recovers_planted_model(self):
+        rows, paths, A, B, names = self._planted()
+        model, ll = H.train_baum_welch(rows, names, 2, n_iters=40, seed=1)
+        # EM guarantee: total log-likelihood never decreases (tiny f32 slack)
+        assert np.all(np.diff(ll) >= -1e-2), ll
+        assert ll[-1] > ll[0] + 100
+        # emissions recovered up to the state permutation
+        perm = ([0, 1] if model.emit[0, 0] > model.emit[1, 0] else [1, 0])
+        assert np.abs(model.emit[perm] - B).max() < 0.05
+        assert np.abs(model.trans[perm][:, perm] - A).max() < 0.1
+        # decoded states match the hidden truth
+        pred = H.predict_states(model, rows[:50], reversed_output=False)
+        sidx = {s: i for i, s in enumerate(model.states)}
+        acc = np.mean([perm[sidx[p]] == t
+                       for rp, rt in zip(pred, paths[:50])
+                       for p, t in zip(rp, rt)])
+        assert acc > 0.85, acc
+
+    def test_model_round_trips_wire_format(self, tmp_path):
+        rows, *_ , names = self._planted(n_seqs=40)
+        model, _ = H.train_baum_welch(rows, names, 2, n_iters=5,
+                                        scale=1000)
+        path = str(tmp_path / "hmm.txt")
+        H.save_model(model, path)
+        loaded = H.load_model(path, scale=1000)
+        np.testing.assert_allclose(loaded.trans, model.trans)
+        np.testing.assert_allclose(loaded.emit, model.emit)
+        assert loaded.states == model.states
+
+    def test_ragged_lengths_and_single_state(self):
+        rows = [["a"], ["a", "b"], ["b", "a", "b", "a", "a"]]
+        model, ll = H.train_baum_welch(rows, ["a", "b"], 1, n_iters=3)
+        assert model.trans.shape == (1, 1)
+        assert np.isfinite(ll).all()
+        # single state: emissions are just the observation frequencies
+        np.testing.assert_allclose(model.emit[0], [5 / 8, 3 / 8], atol=0.01)
+
+
 class TestTransactionStates:
     """The email-marketing tutorial's pre/post stages (xaction_state.rb /
     mark_plan.rb semantics)."""

@@ -623,6 +623,46 @@ class TestSplitGeneratorPathConvention:
         assert n_rows == 600
 
 
+class TestHmmUntaggedCli:
+    """HiddenMarkovModelBuilder with training.mode=untagged: Baum-Welch
+    over raw observation sequences (the unsupervised leg the reference's
+    tagged-only builder lacks), emitting the same model wire format."""
+
+    def test_untagged_training_emits_model(self, tmp_path, capsys):
+        rng = np.random.default_rng(8)
+        A = np.array([[0.9, 0.1], [0.2, 0.8]])
+        B = np.array([[0.8, 0.1, 0.1], [0.1, 0.1, 0.8]])
+        names = ["x", "y", "z"]
+        lines = []
+        for _ in range(150):
+            s = int(rng.integers(2))
+            seq = []
+            for _ in range(20):
+                seq.append(names[rng.choice(3, p=B[s])])
+                s = rng.choice(2, p=A[s])
+            lines.append(seq)
+        write_csv(tmp_path / "obs.csv", lines)
+        props = tmp_path / "hmm.properties"
+        write_props(props, **{"training.mode": "untagged",
+                              "num.states": "2",
+                              "num.iterations": "25",
+                              "trans.prob.scale": "1000"})
+        cli(["HiddenMarkovModelBuilder", str(tmp_path / "obs.csv"),
+             str(tmp_path / "model.txt"), "--conf", str(props)])
+        stats = last_json(capsys)
+        assert stats["BaumWelch.Iterations"] == 25
+        model_lines = open(tmp_path / "model.txt").read().splitlines()
+        # wire format: states / observations / 2 trans / 2 emit / initial
+        assert model_lines[0] == "s0,s1"
+        assert model_lines[1] == "x,y,z"
+        assert len(model_lines) == 2 + 2 + 2 + 1
+        # the planted split (x-heavy vs z-heavy emissions) is recovered
+        emit = np.asarray([[float(v) for v in model_lines[4 + i].split(",")]
+                           for i in range(2)])
+        hi = emit.argmax(axis=1)
+        assert set(hi) == {0, 2}, emit
+
+
 class TestTreeBuilderCli:
     """TreeBuilder/TreePredictor: the complete grow-then-classify pipeline
     (the tree assembly + inference the reference never shipped) as two CLI
