@@ -1,4 +1,14 @@
-"""Online serving: the Storm/Redis topology replacement."""
+"""Online serving: the Storm/Redis topology replacement.
+
+- ``loop``      — OnlineLearnerLoop (the bolt), GroupedLearner (the
+                  multi-context ReinforcementLearnerGroup), in-proc +
+                  Redis-wire queue adapters
+- ``miniredis`` — self-contained RESP list broker + client (the Redis
+                  wire contract without external infrastructure)
+- ``scaleout``  — N-worker-process serving over one broker with per-group
+                  ownership (the num.workers contract,
+                  ReinforcementLearnerTopology.java:64-82)
+"""
 
 from avenir_tpu.stream.loop import (
     GroupedLearner, InProcQueues, LoopStats, OnlineLearnerLoop, RedisQueues,
