@@ -909,7 +909,10 @@ def grow_tree_device(table: EncodedTable, config: TreeConfig) -> TreeNode:
         return node
 
     root = build(0, 0)
-    assert root is not None
+    if root is None:
+        # zero-row table: a leaf root with empty counts, like grow_tree
+        root = TreeNode(class_counts=np.zeros(table.n_classes),
+                        class_values=table.class_values)
     return root
 
 
