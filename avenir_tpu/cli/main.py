@@ -318,14 +318,31 @@ def run_tree_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
                       "Tree.Rows": table.n_rows}))
 
 
+def _write_predictions(conf: JobConfig, out_path: str, table, pred,
+                       class_values: List[str]) -> None:
+    """Shared predictor tail: id,class lines + the validation-mode
+    confusion-matrix report (tree/forest predictors)."""
+    import jax.numpy as jnp
+    from avenir_tpu.utils.metrics import ConfusionMatrix
+    delim = conf.get("field.delim.out", ",")
+    with open(out_path, "w") as fh:
+        for i in range(table.n_rows):
+            fh.write(delim.join(
+                [table.ids[i] if table.ids else str(i),
+                 class_values[int(pred[i])]]) + "\n")
+    if conf.get_bool("validation.mode", False) and table.labels is not None:
+        cm = ConfusionMatrix(class_values,
+                             positive_class=conf.get("positive.class.value"))
+        cm.update(jnp.asarray(pred), table.labels)
+        print(cm.report().to_json())
+
+
 def run_tree_predictor(conf: JobConfig, in_path: str, out_path: str) -> None:
     """Classify rows down a TreeBuilder model (``tree.model.file.path``) —
     the inference leg the reference never shipped. ``validation.mode=true``
     prints the confusion-matrix report like the other predictors."""
     import json
     from avenir_tpu.models import tree as T
-    from avenir_tpu.utils.metrics import ConfusionMatrix
-    import jax.numpy as jnp
     validation = conf.get_bool("validation.mode", False)
     fz, rows = _load_table(conf, in_path, for_predict=True)
     table = fz.transform(rows, with_labels=validation)
@@ -333,17 +350,49 @@ def run_tree_predictor(conf: JobConfig, in_path: str, out_path: str) -> None:
         model = json.load(fh)
     tree = T.TreeNode.from_dict(model["root"], model["classValues"])
     pred = T.predict(tree, table)
-    delim = conf.get("field.delim.out", ",")
-    with open(out_path, "w") as fh:
-        for i in range(table.n_rows):
-            fh.write(delim.join(
-                [table.ids[i] if table.ids else str(i),
-                 model["classValues"][int(pred[i])]]) + "\n")
-    if validation and table.labels is not None:
-        cm = ConfusionMatrix(model["classValues"],
-                             positive_class=conf.get("positive.class.value"))
-        cm.update(jnp.asarray(pred), table.labels)
-        print(cm.report().to_json())
+    _write_predictions(conf, out_path, table, pred, model["classValues"])
+
+
+def run_forest_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Grow a random forest (composes the reference's `random`
+    attribute-selection strategy + BaggingSampler bootstrap into the
+    ensemble it never shipped). Keys: ``num.trees``,
+    ``random.split.set.size``, ``bagging`` plus the TreeBuilder keys; the
+    artifact stacks TreeBuilder's JSON tree format."""
+    import json
+    from avenir_tpu.models import forest as F
+    from avenir_tpu.models.tree import TreeConfig
+    fz, rows = _load_table(conf, in_path)
+    table = fz.transform(rows)
+    cfg = F.ForestConfig(
+        n_trees=conf.get_int("num.trees", 10),
+        attrs_per_tree=conf.get_int("random.split.set.size", 3),
+        bagging=conf.get_bool("bagging", True),
+        seed=conf.get_int("random.seed", 0),
+        tree=TreeConfig(
+            algorithm=conf.get("split.algorithm", "giniIndex"),
+            max_depth=conf.get_int("max.depth", 3),
+            min_node_size=conf.get_int("min.node.size", 10),
+            max_cat_attr_split_groups=conf.get_int(
+                "max.cat.attr.split.groups", 3),
+            min_gain=conf.get_float("min.gain", 1e-6)))
+    trees = F.grow_forest(table, cfg)
+    F.save_forest(trees, out_path)
+    print(json.dumps({"Forest.Trees": len(trees),
+                      "Forest.Rows": table.n_rows}))
+
+
+def run_forest_predictor(conf: JobConfig, in_path: str,
+                         out_path: str) -> None:
+    """Majority-vote classification down a RandomForestBuilder model
+    (``forest.model.file.path``)."""
+    from avenir_tpu.models import forest as F
+    validation = conf.get_bool("validation.mode", False)
+    fz, rows = _load_table(conf, in_path, for_predict=True)
+    table = fz.transform(rows, with_labels=validation)
+    trees = F.load_forest(conf.get_required("forest.model.file.path"))
+    pred = F.predict_forest(trees, table)
+    _write_predictions(conf, out_path, table, pred, trees[0].class_values)
 
 
 def _select_split_attributes(conf: JobConfig, table) -> List[int]:
@@ -355,8 +404,8 @@ def _select_split_attributes(conf: JobConfig, table) -> List[int]:
     different subsets — unless ``random.seed`` is set, which pins the draw
     for reproducible runs. ``notUsedYet`` is an unimplemented TODO in the
     reference itself (:171-175) and is rejected here too."""
-    splittable = [f.ordinal for f in table.feature_fields
-                  if f.is_categorical or f.bucket_width is not None]
+    from avenir_tpu.models.tree import splittable_ordinals
+    splittable = splittable_ordinals(table)
     strategy = conf.get("split.attribute.selection.strategy", "userSpecified")
     if strategy == "userSpecified":
         attrs = conf.get_int_list("split.attributes")
@@ -921,6 +970,8 @@ VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "DataPartitioner": run_data_partitioner,
     "TreeBuilder": run_tree_builder,
     "TreePredictor": run_tree_predictor,
+    "RandomForestBuilder": run_forest_builder,
+    "RandomForestPredictor": run_forest_predictor,
     "MarkovStateTransitionModel": run_markov_state_transition_model,
     "MarkovModelClassifier": run_markov_model_classifier,
     "HiddenMarkovModelBuilder": run_hmm_builder,

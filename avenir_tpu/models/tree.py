@@ -581,25 +581,37 @@ class TreeConfig:
     min_gain: float = 1e-6
 
 
+def splittable_ordinals(table: EncodedTable) -> List[int]:
+    """The attributes candidate splits can be enumerated for: categorical,
+    or numeric with a bucket grid — the ONE source of the splittability
+    rule (grow_tree / grow_tree_device / forests / CLI all share it)."""
+    return [f.ordinal for f in table.feature_fields
+            if f.is_categorical or
+            (f.is_numeric and f.bucket_width is not None)]
+
+
 def grow_tree(table: EncodedTable, config: TreeConfig,
-              rng: Optional[np.random.Generator] = None) -> TreeNode:
+              rng: Optional[np.random.Generator] = None,
+              row_weights: Optional[np.ndarray] = None) -> TreeNode:
     """Level-batched host loop (the reference's SplitGenerator→
-    DataPartitioner rounds). Every node works on the FULL table with a 0/1
-    row mask — the mask plays the role of the reference's per-node HDFS
-    partition — and all nodes of a level evaluate their candidate splits in
-    one vmapped device pass (``split_gains_multi``), so a level costs one
-    readback regardless of node count. Nodes are processed breadth-first;
-    with a ``rng`` (randomFromTop strategy) draws are consumed in BFS order."""
-    attrs = list(config.split_attributes) or [
-        f.ordinal for f in table.feature_fields
-        if f.is_categorical or (f.is_numeric and f.bucket_width is not None)]
+    DataPartitioner rounds). Every node works on the FULL table with a
+    row-weight mask — the mask plays the role of the reference's per-node
+    HDFS partition — and all nodes of a level evaluate their candidate
+    splits in one vmapped device pass (``split_gains_multi``), so a level
+    costs one readback regardless of node count. Nodes are processed
+    breadth-first; with a ``rng`` (randomFromTop strategy) draws are
+    consumed in BFS order. ``row_weights`` seeds the root mask (bootstrap
+    multiplicities for bagging, same semantics as grow_tree_device)."""
+    attrs = list(config.split_attributes) or splittable_ordinals(table)
 
     oh_labels = np.asarray(jax.nn.one_hot(table.labels, table.n_classes))
     info_fn = _info_fn(config.algorithm)
 
     root: Optional[TreeNode] = None
     # (mask, parent node, child segment id, depth)
-    frontier = [(np.ones(table.n_rows, np.float32), None, None, 0)]
+    root_mask = (np.ones(table.n_rows, np.float32) if row_weights is None
+                 else np.asarray(row_weights, np.float32))
+    frontier = [(root_mask, None, None, 0)]
     while frontier:
         splittable = []
         for mask, parent, seg, depth in frontier:
@@ -818,17 +830,21 @@ def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
 def _grow_levels(labels: jnp.ndarray, columns_num: jnp.ndarray,
                  columns_cat: jnp.ndarray, points: jnp.ndarray,
                  lookup: jnp.ndarray, is_cat_t: jnp.ndarray,
-                 col_of_t: jnp.ndarray, *, plan_slices, depth: int,
+                 col_of_t: jnp.ndarray, row_w0: jnp.ndarray, *,
+                 plan_slices, depth: int,
                  s_max: int, n_classes: int, algorithm: str,
                  min_node_size: int, min_gain: float):
     """The WHOLE depth-D growth as one dispatch: levels are python-unrolled
     inside the jit (the node axis grows s_max× per level, so shapes differ
     and lax.scan cannot carry them), so the host pays one launch + one
     fetch per tree instead of one per level — per-launch relay latency was
-    the dominant cost of a per-level dispatch loop."""
+    the dominant cost of a per-level dispatch loop. ``row_w0`` seeds the
+    row weights (all-ones for plain growth; bootstrap multiplicities for
+    bagged forests — a row counted c times is exactly a table with that
+    row repeated c times)."""
     n = labels.shape[0]
     node_id = jnp.zeros(n, jnp.int32)
-    row_w = jnp.ones(n, jnp.float32)
+    row_w = row_w0
     records = []
     k_nodes = 1
     for _ in range(depth):
@@ -849,25 +865,30 @@ def _grow_levels(labels: jnp.ndarray, columns_num: jnp.ndarray,
     return records, final_counts
 
 
-def grow_tree_device(table: EncodedTable, config: TreeConfig) -> TreeNode:
+def grow_tree_device(table: EncodedTable, config: TreeConfig,
+                     row_weights: Optional[jnp.ndarray] = None) -> TreeNode:
     """``grow_tree`` with the per-level host round-trip deleted: the whole
     depth-D growth runs as D pipelined device dispatches (node membership as
     an int32 row→node id, split selection and segment routing on device) and
     ONE readback of the level records at the end — vs the reference's two MR
     jobs per level (SplitGenerator → DataPartitioner, DataPartitioner.java
     :59-106) and grow_tree's one fetch per level. ``best`` selection only
-    (randomFromTop consumes host randomness; use grow_tree)."""
+    (randomFromTop consumes host randomness; use grow_tree).
+
+    ``row_weights`` (e.g. bootstrap multiplicities for bagged forests)
+    weight every count; a row with weight c grows the identical tree to a
+    table with that row repeated c times."""
     if config.split_selection_strategy != "best":
         raise ValueError("grow_tree_device supports the 'best' strategy; "
                          "use grow_tree for randomFromTop")
-    attrs = list(config.split_attributes) or [
-        f.ordinal for f in table.feature_fields
-        if f.is_categorical or (f.is_numeric and f.bucket_width is not None)]
+    attrs = list(config.split_attributes) or splittable_ordinals(table)
     plans = _attr_plans(table, attrs, config.max_cat_attr_split_groups)
     if not plans:
         # no splittable attribute: a single-leaf root, like grow_tree
-        counts = np.asarray(jnp.sum(
-            jax.nn.one_hot(table.labels, table.n_classes), axis=0))
+        oh = jax.nn.one_hot(table.labels, table.n_classes)
+        if row_weights is not None:
+            oh = oh * jnp.asarray(row_weights, jnp.float32)[:, None]
+        counts = np.asarray(jnp.sum(oh, axis=0))
         return TreeNode(class_counts=counts,
                         class_values=table.class_values)
     cand = _device_candidates(table, plans)
@@ -881,9 +902,11 @@ def grow_tree_device(table: EncodedTable, config: TreeConfig) -> TreeNode:
             f"needs a [{table.n_rows}, {kc_final}] node one-hot (> 4GB); "
             "use grow_tree (masked, per-level) for deep trees")
 
+    row_w0 = (jnp.ones(table.n_rows, jnp.float32) if row_weights is None
+              else jnp.asarray(row_weights, jnp.float32))
     records, final_counts = _grow_levels(
         table.labels, cand.columns_num, cand.columns_cat, cand.points,
-        cand.lookup, cand.is_cat, cand.col_of_t,
+        cand.lookup, cand.is_cat, cand.col_of_t, row_w0,
         plan_slices=tuple(cand.plan_slices), depth=config.max_depth,
         s_max=s_max, n_classes=table.n_classes,
         algorithm=config.algorithm, min_node_size=config.min_node_size,
@@ -916,10 +939,15 @@ def grow_tree_device(table: EncodedTable, config: TreeConfig) -> TreeNode:
     return root
 
 
-def predict(tree: TreeNode, table: EncodedTable) -> np.ndarray:
-    """Class index per row by routing down the (completed) tree."""
+def predict(tree: TreeNode, table: EncodedTable,
+            seg_cache: Optional[Dict[Tuple[int, str], np.ndarray]] = None
+            ) -> np.ndarray:
+    """Class index per row by routing down the (completed) tree.
+    ``seg_cache`` may be shared across trees (forests) so each (attr, key)
+    segmentation of the table is computed once."""
     out = np.zeros(table.n_rows, np.int64)
-    seg_cache: Dict[Tuple[int, str], np.ndarray] = {}
+    if seg_cache is None:
+        seg_cache = {}
 
     def segments(attr: int, key: str) -> np.ndarray:
         if (attr, key) not in seg_cache:

@@ -1,0 +1,119 @@
+"""Random forest: bootstrap-weight equivalence, ensemble accuracy,
+artifact round-trip (the ensemble the reference's `random` strategy +
+BaggingSampler gesture at but never compose)."""
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from avenir_tpu.datagen.generators import retarget_rows, retarget_schema
+from avenir_tpu.models import forest as F
+from avenir_tpu.models import tree as T
+from avenir_tpu.utils.dataset import Featurizer
+
+
+@pytest.fixture(scope="module")
+def split():
+    rows = retarget_rows(2400, seed=21)
+    fz = Featurizer(retarget_schema())
+    return fz.fit_transform(rows[:2000]), fz.transform(rows[2000:])
+
+
+class TestBootstrapWeights:
+    def test_weighted_growth_equals_materialized_resample(self):
+        """A row weighted c must grow the IDENTICAL tree to a table with
+        that row physically repeated c times — the property that lets
+        bagging skip materializing resampled tables."""
+        rows = retarget_rows(400, seed=3)
+        fz = Featurizer(retarget_schema())
+        table = fz.fit_transform(rows)
+        rng = np.random.default_rng(5)
+        counts = rng.multinomial(table.n_rows,
+                                 np.full(table.n_rows, 1 / table.n_rows))
+        cfg = T.TreeConfig(max_depth=3)
+        weighted = T.grow_tree_device(
+            table, cfg, row_weights=jnp.asarray(counts, jnp.float32))
+
+        idx = np.repeat(np.arange(table.n_rows), counts)
+        resampled = dataclasses.replace(
+            table,
+            binned=jnp.asarray(np.asarray(table.binned)[idx]),
+            numeric=jnp.asarray(np.asarray(table.numeric)[idx]),
+            labels=jnp.asarray(np.asarray(table.labels)[idx]),
+            ids=[], n_rows=len(idx))
+        materialized = T.grow_tree_device(resampled, cfg)
+
+        def canon(n):
+            return (None if n is None else
+                    (n.attr_ordinal, n.split_key,
+                     tuple(int(c) for c in n.class_counts),
+                     tuple(sorted((k, canon(v))
+                                  for k, v in n.children.items()))))
+        assert canon(weighted) == canon(materialized)
+
+
+class TestHostWeightedGrowth:
+    def test_host_loop_accepts_weights_and_matches_device(self):
+        """The depth-guard fallback path: grow_tree with bootstrap weights
+        must produce the same tree as grow_tree_device with them."""
+        rows = retarget_rows(400, seed=3)
+        table = Featurizer(retarget_schema()).fit_transform(rows)
+        rng = np.random.default_rng(5)
+        counts = rng.multinomial(table.n_rows,
+                                 np.full(table.n_rows, 1 / table.n_rows))
+        cfg = T.TreeConfig(max_depth=2)
+        host = T.grow_tree(table, cfg,
+                           row_weights=counts.astype(np.float32))
+        dev = T.grow_tree_device(
+            table, cfg, row_weights=jnp.asarray(counts, jnp.float32))
+
+        def canon(n):
+            return (None if n is None else
+                    (n.attr_ordinal, n.split_key,
+                     tuple(int(c) for c in n.class_counts),
+                     tuple(sorted((k, canon(v))
+                                  for k, v in n.children.items()))))
+        assert canon(host) == canon(dev)
+
+
+class TestForest:
+    def test_recovers_planted_rule(self, split):
+        train, test = split
+        trees = F.grow_forest(train, F.ForestConfig(
+            n_trees=9, attrs_per_tree=2, seed=4,
+            tree=T.TreeConfig(max_depth=3)))
+        assert len(trees) == 9
+        # attribute subsets actually vary across trees
+        roots = {t.attr_ordinal for t in trees if t.attr_ordinal is not None}
+        assert len(roots) >= 2, roots
+        pred = F.predict_forest(trees, test)
+        truth = np.asarray(test.labels)
+        acc = (pred == truth).mean()
+        assert acc > 0.7, acc
+
+    def test_round_trip(self, split, tmp_path):
+        train, test = split
+        trees = F.grow_forest(train, F.ForestConfig(
+            n_trees=3, seed=1, tree=T.TreeConfig(max_depth=2)))
+        path = str(tmp_path / "forest.json")
+        F.save_forest(trees, path)
+        loaded = F.load_forest(path)
+        assert len(loaded) == 3
+        np.testing.assert_array_equal(F.predict_forest(loaded, test),
+                                      F.predict_forest(trees, test))
+
+    def test_no_bagging_same_attrs_gives_identical_trees(self, split):
+        """Without bagging and with the full attribute set, every tree is
+        the deterministic best tree — the degenerate sanity case."""
+        train, _ = split
+        trees = F.grow_forest(train, F.ForestConfig(
+            n_trees=2, attrs_per_tree=3, bagging=False,
+            tree=T.TreeConfig(max_depth=2)))
+        assert trees[0].to_dict() == trees[1].to_dict()
+
+    def test_rejects_empty(self, split):
+        train, _ = split
+        with pytest.raises(ValueError, match="n_trees"):
+            F.grow_forest(train, F.ForestConfig(n_trees=0))

@@ -717,6 +717,34 @@ class TestTreeBuilderCli:
         assert last_json(capsys)["Tree.Depth"] >= 1
 
 
+class TestRandomForestCli:
+    """RandomForestBuilder/Predictor: the ensemble the reference's random
+    strategy + BaggingSampler gesture at, as two CLI jobs."""
+
+    def test_build_predict(self, tmp_path, capsys):
+        rows = G.retarget_rows(1500, seed=52)
+        write_csv(tmp_path / "train.csv", rows[:1200])
+        write_csv(tmp_path / "test.csv", rows[1200:])
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._RETARGET_SCHEMA_JSON, fh)
+        props = tmp_path / "f.properties"
+        write_props(props,
+                    **{"feature.schema.file.path": tmp_path / "schema.json",
+                       "num.trees": "7",
+                       "random.split.set.size": "2",
+                       "max.depth": "3",
+                       "forest.model.file.path": tmp_path / "forest.json"})
+        cli(["RandomForestBuilder", str(tmp_path / "train.csv"),
+             str(tmp_path / "forest.json"), "--conf", str(props)])
+        assert last_json(capsys)["Forest.Trees"] == 7
+        cli(["RandomForestPredictor", str(tmp_path / "test.csv"),
+             str(tmp_path / "pred.txt"), "--conf", str(props),
+             "-D", "validation.mode=true",
+             "-D", "positive.class.value=yes"])
+        assert last_json(capsys)["Validation.Accuracy"] > 0.65
+        assert len(open(tmp_path / "pred.txt").readlines()) == 300
+
+
 class TestKnnRegressionCli:
     """NearestNeighbor with prediction.mode=regression (the reference's
     regression branch, NearestNeighbor.java:122-123): the class-attribute
