@@ -375,6 +375,9 @@ def run_forest_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
             min_node_size=conf.get_int("min.node.size", 10),
             max_cat_attr_split_groups=conf.get_int(
                 "max.cat.attr.split.groups", 3),
+            split_selection_strategy=conf.get(
+                "split.selection.strategy", "best"),
+            num_top_splits=conf.get_int("num.top.splits", 5),
             min_gain=conf.get_float("min.gain", 1e-6)))
     trees = F.grow_forest(table, cfg)
     F.save_forest(trees, out_path)
@@ -610,22 +613,37 @@ def run_hmm_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
     scale = conf.get_int("trans.prob.scale", 1000)
     if conf.get("training.mode", "tagged") == "untagged":
         # trailing delimiters leave empty tokens in CSV rows; they are not
-        # observations
-        rows = [[t for t in r if t] for r in rows]
+        # observations, and a row emptied by the filter (e.g. ",,") is not
+        # a trainable sequence
+        rows = [row for row in ([t for t in r if t] for r in rows) if row]
+        if not rows:
+            raise ValueError(f"no non-empty observation rows in {in_path}")
         observations = conf.get_list("model.observations")
         if observations is None:
             observations = sorted({t for r in rows for t in r})
         n_states = conf.get_int("num.states")
         if n_states is None:
             raise ValueError("training.mode=untagged needs num.states")
+        # convergence contract mirrors the logistic job's driver loop
+        # (LogisticRegressionJob.java:279-289): iterate until the budget or
+        # the improvement threshold; here the threshold is on relative LL
+        # gain, checked once per on-device chunk
+        tol = conf.get_float("convergence.threshold", 1e-6)
         model, ll = H.train_baum_welch(
             rows, observations, n_states,
             n_iters=conf.get_int("num.iterations", 50),
             seed=conf.get_int("random.seed", 0), scale=scale,
-            state_names=conf.get_list("model.states"))
+            state_names=conf.get_list("model.states"),
+            smoothing=conf.get_float("prob.smoothing", 1e-4),
+            ll_rel_tol=tol,
+            chunk_size=conf.get_int("iteration.chunk.size", 10))
         H.save_model(model, out_path, delim=conf.get("field.delim.out", ","))
+        # converged = the tolerance test itself passed (deriving it from
+        # iterations-vs-budget misreads a crossing on the final iteration)
+        converged = H.ll_converged(ll.tolist(), tol)
         print(f'{{"BaumWelch.LogLikelihood": {float(ll[-1])}, '
-              f'"BaumWelch.Iterations": {len(ll)}}}')
+              f'"BaumWelch.Iterations": {len(ll)}, '
+              f'"BaumWelch.Converged": {str(converged).lower()}}}')
         return
     states = conf.get_list("model.states")
     observations = conf.get_list("model.observations")

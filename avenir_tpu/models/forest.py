@@ -24,7 +24,7 @@ TreePredictor's single-tree format, stacked.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -64,30 +64,35 @@ def grow_forest(table: EncodedTable, config: ForestConfig
         attrs = tuple(sorted(
             int(a) for a in rng.choice(splittable, size=size,
                                        replace=False)))
-        weights = None
+        host_weights = None
         if config.bagging:
-            # bootstrap as multiplicities: multinomial over rows
-            weights = jnp.asarray(
-                rng.multinomial(table.n_rows,
-                                np.full(table.n_rows, 1.0 / table.n_rows)),
-                jnp.float32)
-        cfg = TreeConfig(
-            split_attributes=attrs,
-            algorithm=config.tree.algorithm,
-            max_depth=config.tree.max_depth,
-            min_node_size=config.tree.min_node_size,
-            max_cat_attr_split_groups=config.tree.max_cat_attr_split_groups,
-            min_gain=config.tree.min_gain)
+            # bootstrap as multiplicities: multinomial over rows (kept on
+            # host; converted per path so no transfer runs unless needed)
+            host_weights = rng.multinomial(
+                table.n_rows,
+                np.full(table.n_rows, 1.0 / table.n_rows)).astype(np.float32)
+        # replace() carries EVERY TreeConfig field through — a configured
+        # split_selection_strategy/num_top_splits must not silently revert
+        # to the defaults (round-2 verdict item)
+        cfg = replace(config.tree, split_attributes=attrs)
+        if cfg.split_selection_strategy != "best":
+            # randomFromTop consumes host randomness per node
+            # (DataPartitioner.java:182-185): the masked per-level host
+            # loop is the path that implements it
+            trees.append(grow_tree(table, cfg, rng=rng,
+                                   row_weights=host_weights))
+            continue
         try:
-            trees.append(grow_tree_device(table, cfg, row_weights=weights))
+            trees.append(grow_tree_device(
+                table, cfg,
+                row_weights=None if host_weights is None
+                else jnp.asarray(host_weights)))
         except ValueError as exc:
             if "use grow_tree" not in str(exc):
                 raise
             # depth outside the device path's one-hot budget: the masked
             # per-level host loop takes the same bootstrap weights
-            trees.append(grow_tree(table, cfg,
-                                   row_weights=None if weights is None
-                                   else np.asarray(weights)))
+            trees.append(grow_tree(table, cfg, row_weights=host_weights))
     return trees
 
 

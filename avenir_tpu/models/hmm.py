@@ -154,10 +154,14 @@ def _encode_padded_batch(obs_rows: Sequence[Sequence[str]],
 @partial(jax.jit, static_argnames=("n_states", "n_obs", "n_iters"))
 def _baum_welch_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
                        li0: jnp.ndarray, lt0: jnp.ndarray, le0: jnp.ndarray,
+                       eps: jnp.ndarray,
                        *, n_states: int, n_obs: int, n_iters: int):
-    """All EM iterations in ONE dispatch (log-space forward-backward,
+    """A CHUNK of EM iterations in one dispatch (log-space forward-backward,
     vmapped over the padded [B, T] batch with length masks). Returns
     (log initial, log trans, log emit, per-iteration total log-likelihood).
+    ``eps`` is the traced M-step count smoothing, so changing it never
+    recompiles; the host loop chains chunks and checks convergence between
+    them — one readback per chunk, like logistic's _train_chunk.
     """
     bsz, t_max = obs.shape
     t_iota = jnp.arange(t_max)
@@ -211,7 +215,6 @@ def _baum_welch_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
         li, lt, le = params
         a_c, b_c, i_c, lls = jax.vmap(
             lambda o, n: e_step_one(li, lt, le, o, n))(obs, lengths)
-        eps = 1e-4                                          # smoothing
         a_sum = jnp.sum(a_c, axis=0) + eps
         b_sum = jnp.sum(b_c, axis=0) + eps
         i_sum = jnp.sum(i_c, axis=0) + eps
@@ -225,27 +228,60 @@ def _baum_welch_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
     return li, lt, le, ll_hist
 
 
+def ll_converged(hist: Sequence[float], ll_rel_tol: float) -> bool:
+    """The ONE tolerance test: per-iteration LL gain at/below
+    ``ll_rel_tol * max(1, |LL|)`` — used by the training loop's early stop
+    and by callers reporting convergence, so the two cannot drift apart."""
+    return len(hist) >= 2 and abs(hist[-1] - hist[-2]) <= (
+        ll_rel_tol * max(1.0, abs(hist[-1])))
+
+
 def train_baum_welch(obs_rows: Sequence[Sequence[str]],
                      observations: List[str], n_states: int, *,
                      n_iters: int = 50, seed: int = 0, scale: int = 1,
-                     state_names: Optional[List[str]] = None
+                     state_names: Optional[List[str]] = None,
+                     smoothing: float = 1e-4,
+                     ll_rel_tol: Optional[float] = None,
+                     chunk_size: int = 10
                      ) -> Tuple[HmmModel, np.ndarray]:
     """Unsupervised HMM training — the leg the reference's
     HiddenMarkovModelBuilder never had (it requires fully or partially
     TAGGED data, HiddenMarkovModelBuilder.java:136-260; untagged corpora
     are out of its reach). Classic Baum-Welch EM, run entirely on device:
-    one dispatch executes every iteration (log-space forward-backward
-    vmapped over sequences, masked for ragged lengths) and returns the
-    model plus the per-iteration total log-likelihood — which EM guarantees
-    non-decreasing, asserted in tests.
+    iterations execute in chunks of ``chunk_size`` dispatches-worth each
+    (log-space forward-backward vmapped over sequences, masked for ragged
+    lengths) with ONE host readback per chunk — the same
+    convergence-without-per-iteration-readback contract as logistic's
+    _train_chunk. Returns the model plus the per-iteration total
+    log-likelihood — which EM guarantees non-decreasing, asserted in tests.
+
+    ``smoothing`` is the M-step additive count smoothing (traced, so tuning
+    it never recompiles). ``ll_rel_tol``, when set, stops early once the
+    per-iteration LL gain falls to ``ll_rel_tol * max(1, |LL|)`` — checked
+    at chunk boundaries, so up to ``chunk_size - 1`` extra (harmless,
+    LL-non-decreasing) iterations may run past the crossing. ``n_iters``
+    is the iteration budget, rounded up to whole chunks (a remainder-sized
+    tail dispatch would recompile the kernel for a handful of iterations).
 
     Returns (HmmModel in the reference wire format, log-likelihood history
-    [n_iters]). States are synthetic names ``s0..s{K-1}`` unless given."""
+    [iterations actually run]). States are synthetic names ``s0..s{K-1}``
+    unless given."""
     if n_states < 1:
         raise ValueError("n_states must be >= 1")
     if state_names is not None and len(state_names) != n_states:
         raise ValueError(
             f"{len(state_names)} state names for {n_states} states")
+    if not smoothing > 0:
+        # eps=0 turns an unreached state's M-step into log(0/0) = NaN,
+        # which poisons every later iteration and the LL history
+        raise ValueError(f"smoothing must be > 0, got {smoothing}")
+    empties = [b for b, r in enumerate(obs_rows) if len(r) == 0]
+    if empties:
+        # an n=0 sequence's forward pass never touches the -1e30 carry, so
+        # its "log-likelihood" would contaminate the EM history with ~-1e30
+        raise ValueError(
+            f"zero-length observation rows (e.g. row {empties[0]}) cannot "
+            f"be trained on; drop them before calling train_baum_welch")
     batch, lengths = _encode_padded_batch(obs_rows, observations)
 
     rng = np.random.default_rng(seed)
@@ -259,10 +295,24 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
     lt0 = rand_log_stochastic((n_states, n_states))
     le0 = rand_log_stochastic((n_states, len(observations)))
 
-    li, lt, le, ll_hist = _baum_welch_kernel(
-        jnp.asarray(batch), jnp.asarray(lengths), li0, lt0, le0,
-        n_states=n_states, n_obs=len(observations), n_iters=n_iters)
-    li, lt, le, ll_hist = jax.device_get((li, lt, le, ll_hist))
+    obs_j, len_j = jnp.asarray(batch), jnp.asarray(lengths)
+    eps_j = jnp.asarray(smoothing, jnp.float32)
+    # always dispatch FULL chunks — a remainder-sized tail chunk would
+    # recompile the whole kernel for a handful of iterations; the budget is
+    # therefore rounded up to whole chunks (up to chunk-1 extra harmless,
+    # LL-non-decreasing iterations), mirroring the tolerance-check slack
+    chunk = max(1, min(chunk_size, n_iters))
+    li, lt, le = li0, lt0, le0
+    hist: list = []
+    while len(hist) < n_iters:
+        li, lt, le, ll_c = _baum_welch_kernel(
+            obs_j, len_j, li, lt, le, eps_j, n_states=n_states,
+            n_obs=len(observations), n_iters=chunk)
+        hist.extend(np.asarray(jax.device_get(ll_c), np.float64).tolist())
+        if ll_rel_tol is not None and ll_converged(hist, ll_rel_tol):
+            break
+    ll_hist = np.asarray(hist)
+    li, lt, le = jax.device_get((li, lt, le))
 
     states = state_names or [f"s{i}" for i in range(n_states)]
     if scale > 1:

@@ -495,6 +495,12 @@ def select_split(candidates: List[Tuple[int, str, float]],
     the chosen split, split) — the reference names the output directory by
     the candidate's line index in the splits file (DataPartitioner.Split
     keeps its construction index, :172-177, used for ``split=<i>``)."""
+    if strategy not in ("best", "randomFromTop"):
+        # a typo'd strategy must not silently degrade to "best" — the same
+        # silent-misconfiguration class as the dropped-config forest bug
+        raise ValueError(
+            f"unknown split selection strategy {strategy!r} "
+            f"(expected 'best' or 'randomFromTop')")
     order = sorted(range(len(candidates)),
                    key=lambda i: -candidates[i][2])
     pick = 0

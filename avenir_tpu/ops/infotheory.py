@@ -77,6 +77,14 @@ def hellinger_distance(counts: jnp.ndarray) -> jnp.ndarray:
     the reference gave up: the mean pairwise Hellinger distance over all
     class pairs, which reduces to the reference's value at C=2 and keeps
     the same "how differently do classes distribute over segments" reading.
+
+    Documented deviation (absent classes): pairs involving a class with ZERO
+    rows are excluded from the average, at every C *including C=2*. The
+    reference's C=2 formula would read the absent side's distribution as
+    all-zero and emit a constant sqrt(sum(n_s/n)) = 1.0 for every candidate;
+    this build emits the equally candidate-independent constant 0.0 instead.
+    Rankings are unaffected either way (both are constants across
+    candidates); only the CLI-emitted stat value differs in that edge case.
     """
     class_tot = jnp.sum(counts, axis=-2, keepdims=True)  # [..., 1, C]
     frac = counts / jnp.where(class_tot > 0, class_tot, 1.0)

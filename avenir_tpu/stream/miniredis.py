@@ -101,7 +101,10 @@ class MiniRedisServer:
         return self
 
     def close(self) -> None:
-        self._tcp.shutdown()
+        # shutdown() blocks on an event only serve_forever() sets — calling
+        # it on a constructed-but-never-started server would hang forever
+        if self._thread.is_alive():
+            self._tcp.shutdown()
         self._tcp.server_close()
 
     def __enter__(self) -> "MiniRedisServer":
@@ -225,11 +228,14 @@ def connect_with_retry(host: str, port: int,
     """Client to a broker that may still be starting (subprocess spawn)."""
     deadline = time.monotonic() + timeout
     while True:
+        client = None
         try:
             client = MiniRedisClient(host, port)
             client.ping()
             return client
         except (ConnectionError, OSError):
+            if client is not None:     # connected but ping failed: no leak
+                client.close()
             if time.monotonic() > deadline:
                 raise
             time.sleep(0.05)

@@ -73,8 +73,20 @@ def _parity_gate(test, train) -> None:
     if recall < MIN_RECALL:
         raise AssertionError(
             f"pallas recall {recall:.4f} below bound {MIN_RECALL}")
-    matched = i_pl == i_ex
-    err = int(np.abs(d_pl - d_ex)[matched].max()) if matched.any() else 0
+    # distance agreement on the per-row SET INTERSECTION, aligned by
+    # neighbor index (not column position): an ordering-only disagreement
+    # must not empty the comparison and vacuously pass
+    err, n_matched = 0, 0
+    for r in range(i_ex.shape[0]):
+        ex = {int(i): float(d) for i, d in zip(i_ex[r], d_ex[r])}
+        for i, d in zip(i_pl[r], d_pl[r]):
+            if int(i) in ex:
+                err = max(err, abs(int(round(float(d) - ex[int(i)]))))
+                n_matched += 1
+    if n_matched == 0:
+        raise AssertionError(
+            "parity gate found zero jointly-reported neighbors despite "
+            f"recall {recall:.4f} — index comparison is broken")
     if err > MAX_DIST_ERR:
         raise AssertionError(
             f"pallas scaled-distance error {err} exceeds "
@@ -83,8 +95,8 @@ def _parity_gate(test, train) -> None:
     # (stderr: the driver records only the stdout JSON line)
     import sys
     print(f"parity gate: recall={recall:.4f} (bound {MIN_RECALL}), "
-          f"matched-neighbor scaled-dist max err={err} "
-          f"(bound {MAX_DIST_ERR})", file=sys.stderr)
+          f"matched-neighbor scaled-dist max err={err} over {n_matched} "
+          f"index-aligned pairs (bound {MAX_DIST_ERR})", file=sys.stderr)
 
 
 def main() -> None:

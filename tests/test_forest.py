@@ -117,3 +117,21 @@ class TestForest:
         train, _ = split
         with pytest.raises(ValueError, match="n_trees"):
             F.grow_forest(train, F.ForestConfig(n_trees=0))
+
+    def test_split_selection_strategy_propagates(self, split):
+        """A randomFromTop forest must actually grow randomFromTop trees —
+        round 2 silently dropped the strategy and grew `best` trees. With
+        bagging off and the full attribute set, `best` trees are all
+        identical; randomFromTop draws must differentiate them."""
+        train, _ = split
+        base = T.TreeConfig(max_depth=2, split_selection_strategy=(
+            "randomFromTop"), num_top_splits=4)
+        trees = F.grow_forest(train, F.ForestConfig(
+            n_trees=6, attrs_per_tree=3, bagging=False, seed=9, tree=base))
+        assert len({repr(t.to_dict()) for t in trees}) > 1, (
+            "randomFromTop strategy was dropped: all trees identical")
+        # and the degenerate check still holds for best
+        best = F.grow_forest(train, F.ForestConfig(
+            n_trees=2, attrs_per_tree=3, bagging=False, seed=9,
+            tree=T.TreeConfig(max_depth=2)))
+        assert best[0].to_dict() == best[1].to_dict()

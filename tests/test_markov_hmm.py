@@ -235,6 +235,29 @@ class TestBaumWelch:
         # single state: emissions are just the observation frequencies
         np.testing.assert_allclose(model.emit[0], [5 / 8, 3 / 8], atol=0.01)
 
+    def test_rejects_zero_length_rows(self):
+        with pytest.raises(ValueError, match="zero-length"):
+            H.train_baum_welch([["a", "b"], []], ["a", "b"], 2, n_iters=2)
+
+    def test_ll_rel_tol_stops_early(self):
+        rows, *_ , names = self._planted(n_seqs=80)
+        model, ll = H.train_baum_welch(rows, names, 2, n_iters=200, seed=1,
+                                       ll_rel_tol=1e-4, chunk_size=5)
+        # converged well inside the budget, monotone to the end, and the
+        # final per-iteration relative gain is at/below the threshold
+        assert len(ll) < 200, len(ll)
+        assert np.all(np.diff(ll) >= -1e-2)
+        assert abs(ll[-1] - ll[-2]) <= 1e-4 * max(1.0, abs(ll[-1]))
+
+    def test_smoothing_is_configurable(self):
+        rows, *_ , names = self._planted(n_seqs=40)
+        _, ll_soft = H.train_baum_welch(rows, names, 2, n_iters=5, seed=1,
+                                        smoothing=1.0)
+        _, ll_sharp = H.train_baum_welch(rows, names, 2, n_iters=5, seed=1,
+                                         smoothing=1e-4)
+        # heavy smoothing pulls the model toward uniform: lower likelihood
+        assert ll_sharp[-1] > ll_soft[-1]
+
 
 class TestTransactionStates:
     """The email-marketing tutorial's pre/post stages (xaction_state.rb /

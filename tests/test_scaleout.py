@@ -32,6 +32,22 @@ class TestMiniRedis:
             assert c.llen("q") == 0
             c.close()
 
+    def test_close_before_start_does_not_hang(self):
+        """shutdown() waits on an event only serve_forever() sets; close()
+        on a constructed-but-never-started server must return, not
+        deadlock. Run in a daemon thread so a regression fails the test
+        instead of hanging the suite."""
+        srv = MiniRedisServer()
+        done = threading.Event()
+
+        def do_close():
+            srv.close()
+            done.set()
+
+        t = threading.Thread(target=do_close, daemon=True)
+        t.start()
+        assert done.wait(timeout=5.0), "close() before start() deadlocked"
+
     def test_redis_queues_over_wire(self):
         """stream.loop.RedisQueues against the real socket broker (round 1
         only exercised it against an in-memory fake)."""
