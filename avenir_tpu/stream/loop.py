@@ -53,6 +53,9 @@ class InProcQueues:
     def pop_event(self) -> Optional[str]:
         return self.events.pop() if self.events else None
 
+    def ack_event(self, event_id: str) -> None:
+        """In one process a popped event cannot be orphaned: no ledger."""
+
     def push_reward(self, action_id: str, reward: float) -> None:
         self.rewards.appendleft((action_id, reward))
 
@@ -77,10 +80,21 @@ class RedisQueues:
                  action_queue: str = "actionQueue",
                  reward_queue: str = "rewardQueue",
                  field_delim: str = ",",
-                 client=None):
+                 client=None,
+                 pending_queue: Optional[str] = None):
         """``client`` overrides the Redis connection — anything speaking
         rpop/lpush/lindex (tests use an in-memory fake; production omits it
-        and connects via the ``redis`` package)."""
+        and connects via the ``redis`` package).
+
+        ``pending_queue`` arms the ack/replay ledger (the chombo
+        GenericSpout/GenericBolt ack bookkeeping the reference's topology
+        rides, ReinforcementLearnerBolt.java:41 + the
+        ``replay.failed.message`` knob): ``pop_event`` becomes an atomic
+        RPOPLPUSH into the ledger, ``ack_event`` removes the entry once the
+        answer is written, and :func:`reclaim_pending` replays whatever a
+        dead consumer left behind. Ack-after-answer makes delivery
+        at-least-once (Storm's guarantee); consumers deduplicate by event
+        id to complete the exactly-once effect."""
         if client is None:
             try:
                 import redis  # type: ignore
@@ -93,14 +107,24 @@ class RedisQueues:
         self.event_queue = event_queue
         self.action_queue = action_queue
         self.reward_queue = reward_queue
+        self.pending_queue = pending_queue
         self.delim = field_delim
         # the reference's RedisRewardReader walks the list from the tail
         # (oldest under lpush producers) with a negative decrementing cursor
         self._reward_cursor = -1
 
     def pop_event(self) -> Optional[str]:
-        raw = self._r.rpop(self.event_queue)
+        if self.pending_queue is not None:
+            raw = self._r.rpoplpush(self.event_queue, self.pending_queue)
+        else:
+            raw = self._r.rpop(self.event_queue)
         return raw.decode() if raw is not None else None
+
+    def ack_event(self, event_id: str) -> None:
+        """Retire one ledger entry — called AFTER the answer is written, so
+        a consumer death between pop and ack leaves the event replayable."""
+        if self.pending_queue is not None:
+            self._r.lrem(self.pending_queue, 1, event_id)
 
     def drain_rewards(self) -> List[Tuple[str, float]]:
         """lindex-cursor scan like RedisRewardReader: read tail-first
@@ -119,6 +143,18 @@ class RedisQueues:
     def write_actions(self, event_id: str, actions: Sequence[str]) -> None:
         self._r.lpush(self.action_queue,
                       self.delim.join([event_id] + list(actions)))
+
+
+def reclaim_pending(client, pending_queue: str, event_queue: str) -> int:
+    """Replay a dead consumer's un-acked events back onto their event queue
+    (``replay.failed.message=true`` semantics). Entries a crashed worker
+    answered but had not yet acked will be served twice — at-least-once, so
+    the consumer of the action queue deduplicates by event id. Returns the
+    number of events replayed."""
+    n = 0
+    while client.rpoplpush(pending_queue, event_queue) is not None:
+        n += 1
+    return n
 
 
 # --------------------------------------------------------------------------
@@ -221,6 +257,9 @@ class OnlineLearnerLoop:
             return False
         selections = self.learner.next_actions()
         self.queues.write_actions(event_id, selections)
+        # ack AFTER the answer is on the wire: a death in between replays
+        # the event (at-least-once) rather than losing it
+        self.queues.ack_event(event_id)
         self.stats.events += 1
         self.stats.actions_written += len(selections)
         self._maybe_checkpoint()
@@ -259,6 +298,7 @@ class OnlineLearnerLoop:
             for i, event_id in enumerate(events):
                 sel = selections[i * batch_size:(i + 1) * batch_size]
                 self.queues.write_actions(event_id, sel)
+                self.queues.ack_event(event_id)
                 self.stats.events += 1
                 self.stats.actions_written += len(sel)
             processed += len(events)

@@ -129,6 +129,45 @@ class MiniRedisServer:
             if name == b"RPOP":
                 q = self._lists.get(args[0])
                 return _encode_bulk(q.pop() if q else None)
+            if name == b"RPOPLPUSH":
+                # atomic move (the reliable-queue primitive the ack/replay
+                # ledger rides): nothing is ever in neither list
+                q = self._lists.get(args[0])
+                if not q:
+                    return _encode_bulk(None)
+                val = q.pop()
+                self._lists.setdefault(args[1], deque()).appendleft(val)
+                return _encode_bulk(val)
+            if name == b"LREM":
+                q = self._lists.get(args[0])
+                count, val = int(args[1]), args[2]
+                if not q:
+                    return b":0\r\n"
+                # count>0: head-first; count<0: tail-first; 0: all
+                removed, items = 0, list(q)   # index 0 = head (LPUSH side)
+                if count < 0:
+                    items.reverse()
+                limit = abs(count) if count != 0 else len(items)
+                kept = []
+                for item in items:
+                    if item == val and removed < limit:
+                        removed += 1
+                    else:
+                        kept.append(item)
+                if count < 0:
+                    kept.reverse()
+                self._lists[args[0]] = deque(kept)
+                return b":%d\r\n" % removed
+            if name == b"LRANGE":
+                q = self._lists.get(args[0])
+                lo, hi = int(args[1]), int(args[2])
+                items = list(q) if q else []
+                n = len(items)
+                lo = lo + n if lo < 0 else lo
+                hi = hi + n if hi < 0 else hi
+                sel = items[max(lo, 0):min(hi, n - 1) + 1]
+                return b"*%d\r\n" % len(sel) + b"".join(
+                    _encode_bulk(v) for v in sel)
             if name == b"LINDEX":
                 q = self._lists.get(args[0])
                 idx = int(args[1])
@@ -192,6 +231,8 @@ class MiniRedisClient:
             if len(body) != size + 2:    # EOF mid-reply must not truncate
                 raise ConnectionError("short bulk reply")
             return body[:-2]
+        if kind == b"*":
+            return [self._reply() for _ in range(int(rest))]
         if kind == b"-":
             raise RuntimeError(rest.decode())
         raise ConnectionError(f"unexpected reply {line!r}")
@@ -209,6 +250,17 @@ class MiniRedisClient:
 
     def rpop(self, key) -> Optional[bytes]:
         return self._call(b"RPOP", self._b(key))
+
+    def rpoplpush(self, src, dst) -> Optional[bytes]:
+        return self._call(b"RPOPLPUSH", self._b(src), self._b(dst))
+
+    def lrem(self, key, count, value) -> int:
+        return self._call(b"LREM", self._b(key), self._b(count),
+                          self._b(value))
+
+    def lrange(self, key, start, stop) -> List[bytes]:
+        return self._call(b"LRANGE", self._b(key), self._b(start),
+                          self._b(stop))
 
     def lindex(self, key, index) -> Optional[bytes]:
         return self._call(b"LINDEX", self._b(key), self._b(index))

@@ -9,10 +9,26 @@ i mod N — the fieldsGrouping analogue; ownership means each group's state
 lives in exactly one process, so no cross-process state races exist by
 construction), all sharing one Redis-protocol broker:
 
-    eventQueue:<group>   events for one group       (driver lpush, owner rpop)
+    eventQueue:<group>   events for one group       (driver lpush, owner pops
+                                                     via atomic RPOPLPUSH)
+    pendingQueue:<group> ack/replay ledger          (entry retired by LREM
+                                                     after the answer is
+                                                     written; reclaimed by a
+                                                     replacement worker on
+                                                     crash — the chombo
+                                                     GenericSpout/GenericBolt
+                                                     ack bookkeeping +
+                                                     replay.failed.message,
+                                                     ReinforcementLearnerBolt
+                                                     .java:41)
     rewardQueue:<group>  rewards for one group      (driver lpush, owner
                                                      lindex-cursor drain)
     actionQueue          all selections, shared     (owners lpush, driver rpop)
+
+Delivery is at-least-once across crashes (ack-after-answer; Storm's own
+guarantee); the action-queue consumer deduplicates by event id, completing
+the exactly-once effect — ``run_chaos`` SIGKILLs a worker mid-stream and
+asserts it.
 
 ``run_scaleout`` is the measured demo: a producer with per-group planted
 best actions (the lead_gen.py fixture pattern) drives N workers through two
@@ -28,6 +44,7 @@ default, a real Redis server by pointing host/port at it.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import subprocess
@@ -36,7 +53,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from avenir_tpu.stream.loop import OnlineLearnerLoop, RedisQueues
+from avenir_tpu.stream.loop import (
+    OnlineLearnerLoop, RedisQueues, reclaim_pending)
 from avenir_tpu.stream.miniredis import (
     MiniRedisClient, MiniRedisServer, connect_with_retry)
 
@@ -50,12 +68,18 @@ def owned_groups(groups: Sequence[str], worker_id: int,
 
 
 class _StoppableQueues(RedisQueues):
-    """Per-group queue view that retires on the driver's stop sentinel."""
+    """Per-group queue view that retires on the driver's stop sentinel.
+    Always runs with the ack/replay ledger armed: every pop is an atomic
+    move into ``pendingQueue:<group>``, acked only after the answer is
+    written — so a worker death between pop and answer leaves the event
+    replayable instead of lost (the GenericSpout/GenericBolt ack
+    bookkeeping, ReinforcementLearnerBolt.java:41)."""
 
     def __init__(self, client, group: str):
         super().__init__(event_queue=f"eventQueue:{group}",
                          action_queue="actionQueue",
                          reward_queue=f"rewardQueue:{group}",
+                         pending_queue=f"pendingQueue:{group}",
                          client=client)
         self.stopped = False
 
@@ -64,6 +88,7 @@ class _StoppableQueues(RedisQueues):
             return None
         event = super().pop_event()
         if event == STOP_SENTINEL:
+            self.ack_event(event)     # the sentinel needs no replay
             self.stopped = True
             return None
         return event
@@ -71,10 +96,19 @@ class _StoppableQueues(RedisQueues):
 
 def worker_main(host: str, port: int, worker_id: int, n_workers: int,
                 groups: Sequence[str], learner_type: str,
-                actions: Sequence[str], config: Dict, seed: int) -> Dict:
+                actions: Sequence[str], config: Dict, seed: int,
+                replay: bool = False) -> Dict:
     """One serving process: loops for the owned groups until every group's
-    stop sentinel arrives. Returns per-worker stats."""
+    stop sentinel arrives. Returns per-worker stats. ``replay`` implements
+    ``replay.failed.message=true``: on startup, un-acked events a dead
+    predecessor left in this worker's groups' pending ledgers are pushed
+    back onto their event queues and served again."""
     client = MiniRedisClient(host, port)
+    replayed = 0
+    if replay:
+        for g in owned_groups(groups, worker_id, n_workers):
+            replayed += reclaim_pending(
+                client, f"pendingQueue:{g}", f"eventQueue:{g}")
     loops = {}
     for g in owned_groups(groups, worker_id, n_workers):
         # per-group seed component: each group's learner must explore
@@ -106,6 +140,7 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
         "worker": worker_id,
         "events": sum(l.stats.events for l in loops.values()),
         "rewards": sum(l.stats.rewards for l in loops.values()),
+        "replayed": replayed,
         "groups": sorted(loops),
     }
 
@@ -122,22 +157,59 @@ class ScaleoutResult:
     worker_stats: List[Dict] = field(default_factory=list)
 
 
+@contextlib.contextmanager
+def _broker(host: str, server: Optional[MiniRedisServer] = None):
+    """Yield a flushed client to a RESP broker: the given in-process
+    ``server`` (e.g. a real/external one for tests), else a fresh broker
+    SUBPROCESS — its connection threads must not share the driver's GIL
+    (an in-process ThreadingTCPServer makes every added worker steal
+    driver cycles). Yields (client, host, port)."""
+    broker_proc = None
+    if server is None:
+        import socket as _socket
+        with _socket.socket() as s:
+            s.bind((host, 0))
+            port = s.getsockname()[1]
+        broker_proc = subprocess.Popen(
+            [sys.executable, "-m", "avenir_tpu.stream.miniredis",
+             "--host", host, "--port", str(port)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    else:
+        host, port = server.host, server.port
+    try:
+        client = connect_with_retry(host, port)
+        client.flushall()
+        yield client, host, port
+    finally:
+        if broker_proc is not None:
+            broker_proc.terminate()
+            broker_proc.wait(timeout=10)
+
+
+def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
+                  groups: Sequence[str], learner_type: str,
+                  actions: Sequence[str], config: Dict, seed: int,
+                  replay: bool = False) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
+           "--host", host, "--port", str(port),
+           "--worker-id", str(worker_id),
+           "--n-workers", str(n_workers), "--groups", ",".join(groups),
+           "--learner-type", learner_type, "--actions", ",".join(actions),
+           "--config", json.dumps(config), "--seed", str(seed)]
+    if replay:
+        cmd.append("--replay")
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
 def _spawn_workers(host: str, port: int, n_workers: int,
                    groups: Sequence[str], learner_type: str,
                    actions: Sequence[str], config: Dict,
                    seed: int) -> List[subprocess.Popen]:
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    procs = []
-    for w in range(n_workers):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
-             "--host", host, "--port", str(port), "--worker-id", str(w),
-             "--n-workers", str(n_workers), "--groups", ",".join(groups),
-             "--learner-type", learner_type, "--actions", ",".join(actions),
-             "--config", json.dumps(config), "--seed", str(seed)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True))
-    return procs
+    return [_spawn_worker(host, port, w, n_workers, groups, learner_type,
+                          actions, config, seed)
+            for w in range(n_workers)]
 
 
 def _consume_one(client: MiniRedisClient, ctr, rng, t_push,
@@ -218,25 +290,7 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
     # parallelism, not the driver's serial reward loop, sets throughput
     config = {"current.decision.round": 1, "batch.size": 8}
 
-    # broker in its OWN process: its connection threads must not share the
-    # driver's GIL (an in-process ThreadingTCPServer makes every added
-    # worker steal driver cycles)
-    broker_proc = None
-    if server is None:
-        import socket as _socket
-        with _socket.socket() as s:
-            s.bind((host, 0))
-            broker_port = s.getsockname()[1]
-        broker_proc = subprocess.Popen(
-            [sys.executable, "-m", "avenir_tpu.stream.miniredis",
-             "--host", host, "--port", str(broker_port)],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        broker_host = host
-    else:
-        broker_host, broker_port = server.host, server.port
-    try:
-        client = connect_with_retry(broker_host, broker_port)
-        client.flushall()
+    with _broker(host, server) as (client, broker_host, broker_port):
         procs = _spawn_workers(broker_host, broker_port, n_workers, groups,
                                learner_type, actions, config, seed)
         try:
@@ -274,6 +328,10 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
         if total != expected:      # exactly-once delivery is the contract
             raise RuntimeError(
                 f"workers answered {total} events, expected {expected}")
+        # the ack ledger must retire every entry on the happy path
+        left = sum(client.llen(f"pendingQueue:{g}") for g in groups)
+        if left:
+            raise RuntimeError(f"{left} un-acked ledger entries left behind")
 
         tail = picks[-int(0.3 * len(picks)):]
         best_frac = sum(ctr[g][a] > 0.5 for g, a in tail) / max(len(tail), 1)
@@ -287,10 +345,106 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
             p90_latency_ms=1e3 * lat[int(0.9 * len(lat))] if lat else 0.0,
             best_action_fraction=best_frac,
             worker_stats=worker_stats)
+
+
+@dataclass
+class ChaosResult:
+    n_events: int
+    unique_answered: int          # after driver-side dedup by event id
+    duplicates: int               # answers replay served a second time
+    replayed: int                 # ledger entries the replacement reclaimed
+    pending_left: int             # un-acked ledger entries at the end
+    killed_at: int                # unique answers when SIGKILL was sent
+    worker_stats: List[Dict] = field(default_factory=list)
+
+
+def run_chaos(n_workers: int = 2, *, n_groups: int = 4, n_actions: int = 4,
+              n_events: int = 400, kill_after: int = 100,
+              learner_type: str = "softMax", seed: int = 13,
+              host: str = "localhost", timeout_s: float = 120.0,
+              server: Optional[MiniRedisServer] = None) -> ChaosResult:
+    """Failure-injection run: SIGKILL one worker mid-stream, respawn it
+    with ``replay.failed.message=true`` semantics, and verify NO event is
+    lost. The kill window can leave answered-but-unacked events, which the
+    replacement serves again — at-least-once delivery, exactly Storm's
+    ack/replay guarantee — so the driver deduplicates answers by event id;
+    after dedup every one of ``n_events`` events is answered exactly once
+    (asserted by the chaos test)."""
+    import numpy as np
+    import signal as _signal
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    ctr = {g: {a: (0.8 if i == int(rng.integers(n_actions)) else 0.15)
+               for i, a in enumerate(actions)} for g in groups}
+    config = {"current.decision.round": 1, "batch.size": 4}
+
+    procs: List[subprocess.Popen] = []
+    try:
+        with _broker(host, server) as (client, host, broker_port):
+            procs = _spawn_workers(host, broker_port, n_workers, groups,
+                                   learner_type, actions, config, seed)
+            for sent in range(n_events):
+                g = groups[sent % len(groups)]
+                client.lpush(f"eventQueue:{g}", f"{g}:{sent}")
+
+            answered: set = set()
+            duplicates = 0
+            killed_at = -1
+            deadline = time.monotonic() + timeout_s
+            while len(answered) < n_events:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"chaos run stalled: {len(answered)}/{n_events} "
+                        f"answered, {duplicates} duplicates")
+                raw = client.rpop("actionQueue")
+                if raw is None:
+                    # the kill itself can race the last pops; nudge the loop
+                    time.sleep(0.001)
+                else:
+                    event_id, _, action = raw.decode().partition(",")
+                    action = action.split(",")[0]
+                    g = event_id.partition(":")[0]
+                    if event_id in answered:
+                        duplicates += 1  # replayed answer: dedup, no reward
+                    else:
+                        answered.add(event_id)
+                        reward = (1.0 if rng.random() < ctr[g][action]
+                                  else 0.0)
+                        client.lpush(f"rewardQueue:{g}", f"{action},{reward}")
+                if killed_at < 0 and len(answered) >= kill_after:
+                    # SIGKILL (not terminate): the worker must get NO chance
+                    # to ack or clean up — the crash the ledger exists for
+                    killed_at = len(answered)
+                    procs[0].send_signal(_signal.SIGKILL)
+                    procs[0].wait(timeout=30)
+                    procs[0].stdout.close()
+                    procs[0].stderr.close()
+                    procs[0] = _spawn_worker(
+                        host, broker_port, 0, n_workers, groups,
+                        learner_type, actions, config, seed + 999,
+                        replay=True)
+
+            for g in groups:
+                client.lpush(f"eventQueue:{g}", STOP_SENTINEL)
+            worker_stats = []
+            for p in procs:
+                out, err = p.communicate(timeout=60)
+                if p.returncode != 0:
+                    raise RuntimeError(f"worker failed: {err[-1500:]}")
+                worker_stats.append(json.loads(out.splitlines()[-1]))
+            pending_left = sum(client.llen(f"pendingQueue:{g}")
+                               for g in groups)
+            return ChaosResult(
+                n_events=n_events, unique_answered=len(answered),
+                duplicates=duplicates,
+                replayed=sum(w.get("replayed", 0) for w in worker_stats),
+                pending_left=pending_left, killed_at=killed_at,
+                worker_stats=worker_stats)
     finally:
-        if broker_proc is not None:
-            broker_proc.terminate()
-            broker_proc.wait(timeout=10)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -305,6 +459,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--actions", default="")
     ap.add_argument("--config", default="{}")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--replay", action="store_true",
+                    help="worker mode: reclaim un-acked pending events on "
+                         "startup (replay.failed.message=true)")
     ap.add_argument("--sweep", default="1,2,4",
                     help="driver mode: worker counts to measure")
     ap.add_argument("--events", type=int, default=1000)
@@ -322,7 +479,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         stats = worker_main(args.host, args.port, args.worker_id,
                             args.n_workers, args.groups.split(","),
                             args.learner_type, args.actions.split(","),
-                            json.loads(args.config), args.seed)
+                            json.loads(args.config), args.seed,
+                            replay=args.replay)
         print(json.dumps(stats), flush=True)
         return 0
 
