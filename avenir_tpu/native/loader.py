@@ -170,3 +170,19 @@ def transform_file(fz: Featurizer, path: str, delim_regex: str = ",",
     from avenir_tpu.utils.dataset import read_csv_lines
     return fz.transform(read_csv_lines(path, delim_regex),
                         with_labels=with_labels)
+
+
+def transform_file_streamed(fz: Featurizer, path: str,
+                            delim_regex: str = ",",
+                            with_labels: bool = True,
+                            chunk_rows: int = 65536) -> EncodedTable:
+    """Bounded-memory featurize for files larger than RAM: stream lines
+    one at a time (``iter_csv_rows``) and featurize in ``chunk_rows``
+    chunks — peak memory is the OUTPUT arrays plus one chunk, never the
+    file bytes or its token lists. Same output as :func:`transform_file`
+    (asserted in tests); slower than the native C++ pass, so it is the
+    explicit out-of-core leg, not the default."""
+    from avenir_tpu.utils.dataset import iter_csv_rows
+    return fz.transform_chunked(iter_csv_rows(path, delim_regex),
+                                with_labels=with_labels,
+                                chunk_rows=chunk_rows)

@@ -1,23 +1,35 @@
-"""Multi-host sharded input pipeline.
+"""Multi-host sharded input pipeline — streaming, HDFS-split style.
 
-The reference's input substrate is HDFS: the JobTracker splits files and
-each mapper JVM reads only its split (SURVEY.md §1 L0). The TPU-native
-equivalent: every host process scans the raw CSV bytes (line splitting
-only — there is no line index, so the scan is unavoidable) but tokenizes
-and featurizes ONLY its contiguous row slice, and the slices are assembled
-into ONE globally-sharded array with
-``jax.make_array_from_process_local_data`` — rows sharded over the ``data``
-mesh axis, with DCN touched only by this input path (and checkpoints),
-never by the compute collectives.
+The reference's input substrate is HDFS: the JobTracker splits files by
+BYTE RANGES and each mapper JVM reads only its split, resolving line
+boundaries at the cuts (SURVEY.md §1 L0). This module is that contract
+TPU-native, with bounded memory end to end:
 
-Single-process meshes (tests, one host) degrade to "read everything, shard
-over local devices" (via the native C++ featurizer when applicable) with no
-special casing.
+1. the file is cut into one byte window per host process;
+2. each process STREAMS its own window once to count rows
+   (``iter_csv_rows`` — one buffered line at a time, split-boundary rule
+   at the cuts);
+3. the per-window counts are exchanged (``process_allgather`` over DCN —
+   the only cross-host traffic in the input path), fixing every process's
+   global row slice;
+4. each process streams again from the window containing its slice's
+   first row, featurizing chunk-by-chunk (``Featurizer.transform_chunked``)
+   — only its own slice's ARRAYS are ever resident, never the file, its
+   lines, or its token lists;
+5. the slices assemble into ONE globally row-sharded array with
+   ``jax.make_array_from_process_local_data`` over the ``data`` mesh axis.
+
+DCN carries only steps 3 and 5 (and checkpoints); compute collectives stay
+on ICI. Single-process meshes (tests, one host) default to "read
+everything, shard over local devices" via the native C++ featurizer (the
+fast path when the file fits); pass ``stream=True`` (or call
+``native.loader.transform_file_streamed`` directly) for the chunked
+bounded-memory path when it does not.
 """
 
 from __future__ import annotations
 
-import re
+import os
 from dataclasses import dataclass, replace
 from typing import Optional, Tuple
 
@@ -28,7 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from avenir_tpu.parallel.mesh import DATA_AXIS
-from avenir_tpu.utils.dataset import EncodedTable, Featurizer
+from avenir_tpu.utils.dataset import EncodedTable, Featurizer, iter_csv_rows
 
 
 def process_slice(n_global: int, n_processes: Optional[int] = None,
@@ -116,21 +128,48 @@ def shard_table(table: EncodedTable, mesh: Mesh,
                         n_global=table.n_rows)
 
 
+def _byte_windows(size: int, n_processes: int):
+    """One contiguous byte window per process, tiling [0, size)."""
+    per = -(-size // n_processes) if size else 0
+    return [(p * per, min((p + 1) * per, size)) for p in range(n_processes)]
+
+
+def _stream_global_rows(path: str, delim_regex: str, lo: int, hi: int,
+                        prefix: np.ndarray, windows) -> "object":
+    """Yield the file's non-empty rows with global ordinals in [lo, hi),
+    starting the scan at the byte window containing row ``lo`` (``prefix``
+    = cumulative per-window row counts) rather than byte 0 — each process
+    reads ~its own window's bytes, not the file."""
+    q = max(0, int(np.searchsorted(prefix, lo, side="right")) - 1)
+    ordinal = int(prefix[q])
+    size = windows[-1][1]
+    for row in iter_csv_rows(path, delim_regex,
+                             byte_window=(windows[q][0], size)):
+        if ordinal >= hi:
+            return
+        if ordinal >= lo:
+            yield row
+        ordinal += 1
+
+
 def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
                        axis: str = DATA_AXIS, delim_regex: str = ",",
-                       with_labels: bool = True) -> ShardedTable:
-    """Each process reads + featurizes only its row slice of ``path`` (a
-    shared filesystem, the HDFS analogue), then the slices assemble into one
-    globally row-sharded table.
+                       with_labels: bool = True,
+                       chunk_rows: int = 65536,
+                       stream: bool = False) -> ShardedTable:
+    """Each process streams + featurizes only its row slice of ``path`` (a
+    shared filesystem, the HDFS analogue) with bounded memory — see the
+    module docstring for the two-pass byte-window protocol — then the
+    slices assemble into one globally row-sharded table.
 
     The featurizer must already be fit from the schema alone (cardinality
     lists + min/max present): a data-dependent fit on a local slice would
     give each process a different vocabulary.
 
-    Each process scans the raw bytes once to find line boundaries (CSV has
-    no row index) but regex-tokenizes and featurizes only its own slice;
-    single-process meshes take the native C++ featurizer fast path when
-    it applies."""
+    Row-slice padding (the ceil-sized tail slices of ``process_slice``)
+    materializes as copies of the file's LAST real row, masked out of every
+    reduction — identical semantics on every path (single-host, native,
+    multi-host)."""
     if not fz.fitted:
         raise ValueError("featurizer must be fit before distributed loading")
     if fz.schema_data_dependent:
@@ -139,34 +178,67 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
             "cardinality or bucketed numeric without min/max) — per-process "
             "slice fitting would diverge; complete the schema instead")
     if jax.process_count() == 1:
-        from avenir_tpu.native.loader import transform_file
-        return shard_table(
-            transform_file(fz, path, delim_regex, with_labels=with_labels),
-            mesh, axis)
-    splitter = re.compile(delim_regex)
-    # same line acceptance as read_csv_lines: drop empty lines only —
-    # whitespace-only lines stay and fail featurization identically on
-    # every path (single-host Python, native C++, multi-host)
-    with open(path, "r") as fh:
-        lines = [ln.rstrip("\n") for ln in fh]
-    lines = [ln for ln in lines if ln]
-    n_real = len(lines)
+        # multi-process runs always stream; one process defaults to the
+        # native whole-file fast path and takes the chunked bounded-memory
+        # reader only on request (stream=True honors chunk_rows here too)
+        from avenir_tpu.native.loader import (transform_file,
+                                              transform_file_streamed)
+        local = (transform_file_streamed(fz, path, delim_regex,
+                                         with_labels=with_labels,
+                                         chunk_rows=chunk_rows)
+                 if stream else
+                 transform_file(fz, path, delim_regex,
+                                with_labels=with_labels))
+        return shard_table(local, mesh, axis)
+    from jax.experimental import multihost_utils
+
+    # pass 1: count rows in THIS process's byte window (streaming)
+    size = os.path.getsize(path)
+    windows = _byte_windows(size, jax.process_count())
+    my_window = windows[jax.process_index()]
+    my_count = sum(1 for _ in iter_csv_rows(path, delim_regex,
+                                            byte_window=my_window))
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray(my_count, np.int64)))
+    prefix = np.concatenate([[0], np.cumsum(counts)])
+    n_real = int(prefix[-1])
+    if n_real == 0:
+        raise ValueError(f"no non-empty rows in {path}")
+
+    # pass 2: stream-featurize this process's global row slice
     g = padded_rows(n_real, mesh, axis)
     start, stop = process_slice(g)
-    # this process's slice, with global padding rows materialized as copies
-    # of the last real row (masked out of every reduction); only the slice
-    # is tokenized
-    local_rows = [[t.strip() for t in splitter.split(lines[min(i, n_real - 1)])]
-                  for i in range(start, stop)]
-    local = fz.transform(local_rows, with_labels=with_labels)
-    mask = np.asarray([1.0 if i < n_real else 0.0
-                       for i in range(start, stop)], np.float32)
+    lo, hi = min(start, n_real), min(stop, n_real)
+    if lo == hi:
+        # slice is ALL padding: featurize the global last real row once
+        # as the padding prototype (every path pads with that row)
+        lo, hi = n_real - 1, n_real
+    local = fz.transform_chunked(
+        _stream_global_rows(path, delim_regex, lo, hi, prefix, windows),
+        with_labels=with_labels, chunk_rows=chunk_rows)
+
+    n_need = stop - start
+    n_have = hi - lo
+
+    def prep(a):
+        a = np.asarray(a)
+        if start >= n_real:            # all-padding: replicate the prototype
+            return np.repeat(a[-1:], n_need, axis=0)
+        if n_need > n_have:            # tail padding: copies of the last row
+            width = ((0, n_need - n_have),) + ((0, 0),) * (a.ndim - 1)
+            return np.pad(a, width, mode="edge")
+        return a
+
+    mask = ((start + np.arange(n_need)) < n_real).astype(np.float32)
+    ids = (list(local.ids) + [local.ids[-1]] * (n_need - len(local.ids))
+           if start < n_real else [local.ids[-1]] * n_need)
     new = replace(
         local,
-        binned=_to_global(np.asarray(local.binned), mesh, axis),
-        numeric=_to_global(np.asarray(local.numeric), mesh, axis),
+        binned=_to_global(prep(local.binned), mesh, axis),
+        numeric=_to_global(prep(local.numeric), mesh, axis),
         labels=(None if local.labels is None else
-                _to_global(np.asarray(local.labels), mesh, axis)),
+                _to_global(prep(local.labels), mesh, axis)),
+        ids=ids,
         n_rows=g)
     return ShardedTable(table=new, mask=_to_global(mask, mesh, axis),
                         n_global=n_real)

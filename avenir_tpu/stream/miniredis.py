@@ -163,9 +163,11 @@ class MiniRedisServer:
                 lo, hi = int(args[1]), int(args[2])
                 items = list(q) if q else []
                 n = len(items)
-                lo = lo + n if lo < 0 else lo
+                lo = max(lo + n if lo < 0 else lo, 0)
                 hi = hi + n if hi < 0 else hi
-                sel = items[max(lo, 0):min(hi, n - 1) + 1]
+                # a stop still negative after conversion is out of range:
+                # real Redis replies with an empty array, not a slice
+                sel = items[lo:hi + 1] if 0 <= hi and lo <= hi else []
                 return b"*%d\r\n" % len(sel) + b"".join(
                     _encode_bulk(v) for v in sel)
             if name == b"LINDEX":

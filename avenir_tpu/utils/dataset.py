@@ -56,6 +56,39 @@ def read_csv_lines(path: str, delim_regex: str = ",") -> List[List[str]]:
     return rows
 
 
+def iter_csv_rows(path: str, delim_regex: str = ",",
+                  byte_window: Optional[Tuple[int, int]] = None):
+    """Stream tokenized non-empty rows of ONE file without ever holding it
+    in memory (a buffered binary reader: one line at a time).
+
+    ``byte_window=(w0, w1)`` restricts the stream to lines whose FIRST byte
+    lies in ``[w0, w1)`` — the HDFS-split boundary rule (SURVEY.md §1 L0):
+    the line straddling ``w0`` belongs to the previous window (resolved by
+    peeking one byte back and reading through its newline), and the line
+    straddling ``w1`` is read to completion by the window that owns its
+    start. Windows therefore partition the file's lines exactly, whatever
+    the byte cuts hit. Handles LF and CRLF endings; a lone-CR (classic Mac)
+    file needs the in-memory text-mode reader."""
+    splitter = re.compile(delim_regex)
+    size = os.path.getsize(path)
+    w0, w1 = (0, size) if byte_window is None else byte_window
+    w1 = min(w1, size)
+    if w0 >= w1:
+        return
+    with open(path, "rb") as fh:
+        if w0 > 0:
+            fh.seek(w0 - 1)
+            if fh.read(1) != b"\n":
+                fh.readline()        # partial line: the previous window's
+        while fh.tell() < w1:
+            raw = fh.readline()
+            if not raw:
+                break
+            line = raw.rstrip(b"\r\n").decode()
+            if line:
+                yield [t.strip() for t in splitter.split(line)]
+
+
 @dataclass
 class FieldEncoder:
     """Per-column encoder derived from a :class:`FeatureField` (+ data)."""
@@ -222,8 +255,14 @@ class Featurizer:
         return self
 
     # -- encoding ------------------------------------------------------------
-    def transform(self, rows: Sequence[Sequence[str]],
-                  with_labels: bool = True) -> EncodedTable:
+    def transform_arrays(self, rows: Sequence[Sequence[str]],
+                         with_labels: bool = True,
+                         row_offset: int = 0):
+        """Numpy featurization core: (binned [N,F] i32, numeric [N,F] f32,
+        labels [N] i32 or None, ids). ``row_offset`` numbers synthetic ids
+        when the schema has no id field (chunked callers keep ids global).
+        Host-side by design — chunked/streaming loaders concatenate these
+        without bouncing every chunk through the device."""
         if not self._fitted:
             raise RuntimeError("call fit() (or fit_transform) first")
         n = len(rows)
@@ -243,7 +282,8 @@ class Featurizer:
         class_index = {v: i for i, v in enumerate(self.class_values)}
 
         for r, row in enumerate(rows):
-            ids.append(row[id_field.ordinal] if id_field is not None else str(r))
+            ids.append(row[id_field.ordinal] if id_field is not None
+                       else str(row_offset + r))
             for c, enc in enumerate(self.encoders):
                 b, v = enc.encode(row[enc.field.ordinal])
                 binned[r, c] = b
@@ -258,7 +298,14 @@ class Featurizer:
                 if token not in class_index:
                     raise KeyError(f"unseen class value {token!r}")
                 labels[r] = class_index[token]
+        return binned, numeric, labels, ids
 
+    def table_from_arrays(self, binned, numeric, labels,
+                          ids: List[str]) -> EncodedTable:
+        """Wrap featurized arrays with this featurizer's schema metadata —
+        the single place the EncodedTable metadata is assembled (transform,
+        the chunked/streaming loaders, and the native C++ path all end
+        here)."""
         return EncodedTable(
             binned=jnp.asarray(binned),
             numeric=jnp.asarray(numeric),
@@ -272,6 +319,44 @@ class Featurizer:
             norm_min=tuple(e.norm_min for e in self.encoders),
             norm_max=tuple(e.norm_max for e in self.encoders),
         )
+
+    def transform(self, rows: Sequence[Sequence[str]],
+                  with_labels: bool = True) -> EncodedTable:
+        binned, numeric, labels, ids = self.transform_arrays(
+            rows, with_labels=with_labels)
+        return self.table_from_arrays(binned, numeric, labels, ids)
+
+    def transform_chunked(self, rows_iter, with_labels: bool = True,
+                          chunk_rows: int = 65536) -> EncodedTable:
+        """Featurize a row ITERATOR chunk-by-chunk: peak memory is the
+        output arrays plus ONE chunk of token lists — the whole-file token
+        list (~10x the raw bytes as Python strings) is never materialized.
+        This is the out-of-core leg of the input path (SURVEY.md §1 L0:
+        the reference's mappers stream HDFS splits)."""
+        bs, vs, ls, ids = [], [], [], []
+        buf: List[Sequence[str]] = []
+        total = 0
+
+        def flush():
+            nonlocal total
+            b, v, l, i = self.transform_arrays(
+                buf, with_labels=with_labels, row_offset=total)
+            bs.append(b)
+            vs.append(v)
+            if l is not None:
+                ls.append(l)
+            ids.extend(i)
+            total += len(buf)
+            buf.clear()
+
+        for row in rows_iter:
+            buf.append(row)
+            if len(buf) >= max(chunk_rows, 1):
+                flush()
+        flush()                       # tail (and the empty-input shape)
+        labels = np.concatenate(ls) if ls else None
+        return self.table_from_arrays(
+            np.concatenate(bs), np.concatenate(vs), labels, ids)
 
     @staticmethod
     def _bin_labels(enc: FieldEncoder) -> List[str]:
