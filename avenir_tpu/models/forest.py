@@ -90,8 +90,11 @@ def grow_forest(table: EncodedTable, config: ForestConfig
         except ValueError as exc:
             if "use grow_tree" not in str(exc):
                 raise
-            # depth outside the device path's one-hot budget: the masked
-            # per-level host loop takes the same bootstrap weights
+            # the live frontier overflowed cfg.device_node_budget — a
+            # POST-RUN detection, so this tree already paid its failed
+            # device growth; the masked per-level host loop re-grows it
+            # with the same bootstrap weights (raise the budget if this
+            # path is hit often)
             trees.append(grow_tree(table, cfg, row_weights=host_weights))
     return trees
 

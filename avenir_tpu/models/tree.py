@@ -585,6 +585,9 @@ class TreeConfig:
     split_selection_strategy: str = "best"    # split.selection.strategy
     num_top_splits: int = 5                   # num.top.splits
     min_gain: float = 1e-6
+    # grow_tree_device: static cap on LIVE nodes per level (the sparse
+    # frontier); overflow is detected and reported, not silently truncated
+    device_node_budget: int = 2048
 
 
 def splittable_ordinals(table: EncodedTable) -> List[int]:
@@ -747,6 +750,10 @@ def _device_candidates(table: EncodedTable, plans) -> _DeviceCandidates:
 # chunk of candidates whose [chunk*s_max, N] one-hot slab is materialized at
 # once for the counts matmul (~128MB bf16 at 1M rows, s_max 4, chunk 16)
 _LEVEL_CHUNK_T = 16
+# max columns of the [N, K*C] node one-hot slab per matmul: deep levels'
+# node axes are processed in column chunks, so the slab stays ~256MB bf16
+# at 1M rows however many live nodes the frontier carries
+_NODE_COLS_CHUNK = 128
 
 
 def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
@@ -754,17 +761,23 @@ def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
                 columns_cat: jnp.ndarray, points: jnp.ndarray,
                 lookup: jnp.ndarray, is_cat_t: jnp.ndarray,
                 col_of_t: jnp.ndarray, *, plan_slices, k_nodes: int,
-                s_max: int, n_classes: int, algorithm: str,
+                k_next: int, s_max: int, n_classes: int, algorithm: str,
                 min_node_size: int, min_gain: float):
     """One growth level fully on device: per-node candidate stats → best
-    split selection → row routing. Returns the next (node_id, row_w) plus
-    the level record (chosen candidate, node counts, split mask).
-    Traced inside :func:`_grow_levels` — never dispatched alone."""
+    split selection → SPARSE FRONTIER COMPACTION → row routing. The node
+    axis holds only live (still-splittable) nodes: each level's record
+    carries every child's class counts, the children that can split again
+    are assigned compact slots (cumsum over the liveness mask), and rows
+    routed to leaf children get weight 0 — so the node axis grows with the
+    LIVE frontier, not s_max^depth (the round-2 dense axis hit a 4GB wall
+    at depth ~6 on 1M rows). ``k_next`` caps next level's slots; overflow
+    is detected host-side from the recorded ``n_live``. Returns the next
+    (node_id, row_w) plus the level record. Traced inside
+    :func:`_grow_levels` — never dispatched alone."""
     n = node_id.shape[0]
     kc = k_nodes * n_classes
-    oh_nc = (jax.nn.one_hot(node_id * n_classes + labels, kc,
-                            dtype=jnp.bfloat16)
-             * row_w[:, None].astype(jnp.bfloat16))        # [N, K*C]
+    nc_id = node_id * n_classes + labels                   # [N]
+    w_col = row_w[:, None].astype(jnp.bfloat16)
 
     t_total = points.shape[0]
     counts_l = []
@@ -784,11 +797,19 @@ def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
                               ).astype(jnp.int32)
             oh_seg = (seg[:, :, None] ==
                       jnp.arange(s_max)[None, None, :]).astype(jnp.bfloat16)
-            # [tc*S, N] @ [N, K*C] on the MXU — the level's class histograms
-            chunk = jax.lax.dot_general(
-                oh_seg.transpose(0, 2, 1).reshape(tc * s_max, n), oh_nc,
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            lhs = oh_seg.transpose(0, 2, 1).reshape(tc * s_max, n)
+            # [tc*S, N] @ [N, <=COLS] per node-column chunk on the MXU —
+            # the level's class histograms, K-chunked for bounded memory
+            cols = []
+            for c0 in range(0, kc, _NODE_COLS_CHUNK):
+                c1 = min(c0 + _NODE_COLS_CHUNK, kc)
+                oh_nc = (jax.nn.one_hot(nc_id - c0, c1 - c0,
+                                        dtype=jnp.bfloat16) * w_col)
+                cols.append(jax.lax.dot_general(
+                    lhs, oh_nc, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+            chunk = jnp.concatenate(cols, axis=1) if len(cols) > 1 else (
+                cols[0])
             counts_l.append(chunk.reshape(tc, s_max, k_nodes, n_classes))
     counts = jnp.concatenate(counts_l)                     # [T, S, K, C]
 
@@ -813,6 +834,21 @@ def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
                & (jnp.sum(node_counts > 0, axis=1) > 1)
                & (best_ratio > min_gain))                  # [K]
 
+    # every child's class counts through its node's chosen candidate —
+    # recorded so leaf children never need a next-level slot
+    child_counts = jnp.take_along_axis(
+        counts.transpose(2, 0, 1, 3),                      # [K, T, S, C]
+        best_t[:, None, None, None], axis=1)[:, 0]         # [K, S, C]
+    child_n = jnp.sum(child_counts, axis=-1)               # [K, S]
+    # live = could split again: same pre-gain tests its own level would
+    # apply (size, class diversity); the gain test runs at that level
+    live = (split_k[:, None] & (child_n >= min_node_size)
+            & (jnp.sum(child_counts > 0, axis=-1) > 1))    # [K, S]
+    ls = live.reshape(-1)                                  # [K*S]
+    slot = jnp.cumsum(ls.astype(jnp.int32)) - 1            # dense→compact
+    child_slot = jnp.where(ls, slot, -1)                   # [K*S]
+    n_live = jnp.sum(ls.astype(jnp.int32))
+
     # routing: evaluate ONLY each row's chosen candidate
     t_row = best_t[node_id]                                # [N]
     col_row = col_of_t[t_row]
@@ -823,63 +859,79 @@ def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
     cat_seg_row = lookup.reshape(-1)[t_row * lookup.shape[1] + code_row]
     seg_row = jnp.where(is_cat_t[t_row], cat_seg_row, num_seg_row)
 
-    new_node_id = node_id * s_max + seg_row
-    new_row_w = row_w * split_k[node_id].astype(row_w.dtype)
+    cs_row = child_slot[node_id * s_max + seg_row]         # [N]
+    in_budget = (cs_row >= 0) & (cs_row < k_next)
+    new_node_id = jnp.clip(cs_row, 0, k_next - 1)
+    new_row_w = row_w * in_budget.astype(row_w.dtype)
     return (new_node_id, new_row_w,
-            {"best_t": best_t, "node_counts": node_counts,
-             "split": split_k, "ratio": best_ratio})
+            {"best_t": best_t, "split": split_k,
+             "child_counts": child_counts,
+             "child_slot": child_slot.reshape(k_nodes, s_max),
+             "n_live": n_live})
+
+
+def _level_widths(depth: int, s_max: int, budget: int):
+    """Static per-level slot counts: the live frontier grows at most
+    s_max× per level, capped by the node budget."""
+    widths, k = [], 1
+    for _ in range(depth):
+        widths.append(k)
+        k = min(k * s_max, budget)
+    return widths
 
 
 @partial(jax.jit, static_argnames=("plan_slices", "depth", "s_max",
                                    "n_classes", "algorithm",
-                                   "min_node_size", "min_gain"))
+                                   "min_node_size", "min_gain",
+                                   "node_budget"))
 def _grow_levels(labels: jnp.ndarray, columns_num: jnp.ndarray,
                  columns_cat: jnp.ndarray, points: jnp.ndarray,
                  lookup: jnp.ndarray, is_cat_t: jnp.ndarray,
                  col_of_t: jnp.ndarray, row_w0: jnp.ndarray, *,
                  plan_slices, depth: int,
                  s_max: int, n_classes: int, algorithm: str,
-                 min_node_size: int, min_gain: float):
+                 min_node_size: int, min_gain: float, node_budget: int):
     """The WHOLE depth-D growth as one dispatch: levels are python-unrolled
-    inside the jit (the node axis grows s_max× per level, so shapes differ
-    and lax.scan cannot carry them), so the host pays one launch + one
-    fetch per tree instead of one per level — per-launch relay latency was
-    the dominant cost of a per-level dispatch loop. ``row_w0`` seeds the
-    row weights (all-ones for plain growth; bootstrap multiplicities for
-    bagged forests — a row counted c times is exactly a table with that
-    row repeated c times)."""
+    inside the jit (the compacted node axis differs per level, so shapes
+    differ and lax.scan cannot carry them), so the host pays one launch +
+    one fetch per tree instead of one per level — per-launch relay latency
+    was the dominant cost of a per-level dispatch loop. ``row_w0`` seeds
+    the row weights (all-ones for plain growth; bootstrap multiplicities
+    for bagged forests — a row counted c times is exactly a table with
+    that row repeated c times). Each level's record carries every child's
+    class counts, so no trailing leaf pass is needed."""
     n = labels.shape[0]
     node_id = jnp.zeros(n, jnp.int32)
     row_w = row_w0
     records = []
-    k_nodes = 1
-    for _ in range(depth):
+    widths = _level_widths(depth, s_max, node_budget)
+    for d in range(depth):
+        # == widths[d + 1] for d+1 < depth: one formula, one source of truth
+        k_next = min(widths[d] * s_max, node_budget)
         node_id, row_w, rec = _level_body(
             node_id, row_w, labels, columns_num, columns_cat, points,
             lookup, is_cat_t, col_of_t, plan_slices=plan_slices,
-            k_nodes=k_nodes, s_max=s_max, n_classes=n_classes,
-            algorithm=algorithm, min_node_size=min_node_size,
-            min_gain=min_gain)
+            k_nodes=widths[d], k_next=k_next, s_max=s_max,
+            n_classes=n_classes, algorithm=algorithm,
+            min_node_size=min_node_size, min_gain=min_gain)
         records.append(rec)
-        k_nodes *= s_max
-    # trailing level: leaf class counts via a one-hot column sum (exact in
-    # f32 for counts < 2^24; a scatter-add here lowers poorly on TPU)
-    oh_final = (jax.nn.one_hot(node_id * n_classes + labels,
-                               k_nodes * n_classes, dtype=jnp.float32)
-                * row_w[:, None])
-    final_counts = jnp.sum(oh_final, axis=0).reshape(k_nodes, n_classes)
-    return records, final_counts
+    return records
 
 
 def grow_tree_device(table: EncodedTable, config: TreeConfig,
                      row_weights: Optional[jnp.ndarray] = None) -> TreeNode:
     """``grow_tree`` with the per-level host round-trip deleted: the whole
     depth-D growth runs as D pipelined device dispatches (node membership as
-    an int32 row→node id, split selection and segment routing on device) and
-    ONE readback of the level records at the end — vs the reference's two MR
-    jobs per level (SplitGenerator → DataPartitioner, DataPartitioner.java
-    :59-106) and grow_tree's one fetch per level. ``best`` selection only
-    (randomFromTop consumes host randomness; use grow_tree).
+    an int32 row→node id, split selection, SPARSE frontier compaction and
+    segment routing on device) and ONE readback of the level records at the
+    end — vs the reference's two MR jobs per level (SplitGenerator →
+    DataPartitioner, DataPartitioner.java:59-106) and grow_tree's one fetch
+    per level. The node axis carries only the live frontier (round 2's
+    dense s_max^depth axis hit a 4GB wall around depth 6 at 1M rows), so
+    depth 8-10 stays device-resident; a frontier wider than
+    ``config.device_node_budget`` raises with a grow_tree pointer rather
+    than truncating. ``best`` selection only (randomFromTop consumes host
+    randomness; use grow_tree).
 
     ``row_weights`` (e.g. bootstrap multiplicities for bagged forests)
     weight every count; a row with weight c grows the identical tree to a
@@ -889,55 +941,68 @@ def grow_tree_device(table: EncodedTable, config: TreeConfig,
                          "use grow_tree for randomFromTop")
     attrs = list(config.split_attributes) or splittable_ordinals(table)
     plans = _attr_plans(table, attrs, config.max_cat_attr_split_groups)
-    if not plans:
-        # no splittable attribute: a single-leaf root, like grow_tree
+
+    def leaf_root() -> TreeNode:
         oh = jax.nn.one_hot(table.labels, table.n_classes)
         if row_weights is not None:
             oh = oh * jnp.asarray(row_weights, jnp.float32)[:, None]
         counts = np.asarray(jnp.sum(oh, axis=0))
         return TreeNode(class_counts=counts,
                         class_values=table.class_values)
+
+    if not plans or config.max_depth < 1:
+        # no splittable attribute / zero depth: a single leaf, like grow_tree
+        return leaf_root()
     cand = _device_candidates(table, plans)
     s_max = cand.s_max
-    # the dense node axis grows s_max^depth: the one-hot slabs are
-    # [N, s_max^depth * C] — guard the exponential before the device OOMs
-    kc_final = (s_max ** config.max_depth) * table.n_classes
-    if table.n_rows * kc_final * 4 > 2 ** 32:
-        raise ValueError(
-            f"max_depth={config.max_depth} with {s_max} segments/split "
-            f"needs a [{table.n_rows}, {kc_final}] node one-hot (> 4GB); "
-            "use grow_tree (masked, per-level) for deep trees")
 
     row_w0 = (jnp.ones(table.n_rows, jnp.float32) if row_weights is None
               else jnp.asarray(row_weights, jnp.float32))
-    records, final_counts = _grow_levels(
+    records = _grow_levels(
         table.labels, cand.columns_num, cand.columns_cat, cand.points,
         cand.lookup, cand.is_cat, cand.col_of_t, row_w0,
         plan_slices=tuple(cand.plan_slices), depth=config.max_depth,
         s_max=s_max, n_classes=table.n_classes,
         algorithm=config.algorithm, min_node_size=config.min_node_size,
-        min_gain=config.min_gain)
+        min_gain=config.min_gain, node_budget=config.device_node_budget)
     # ONE readback for the whole tree
-    records, final_counts = jax.device_get((records, final_counts))
+    records = jax.device_get(records)
 
-    def build(level: int, k: int) -> Optional[TreeNode]:
-        counts = (np.asarray(records[level]["node_counts"][k])
-                  if level < len(records) else np.asarray(final_counts[k]))
+    widths = _level_widths(config.max_depth, s_max,
+                           config.device_node_budget)
+    # overflow check: only levels whose live children feed a NEXT level
+    # can truncate (the last level's children are all leaves, fully
+    # reconstructed from child_counts regardless of n_live)
+    for d, rec in enumerate(records[:-1]):
+        if int(rec["n_live"]) > widths[d + 1]:
+            raise ValueError(
+                f"live frontier {int(rec['n_live'])} at depth {d + 1} "
+                f"exceeds device_node_budget={config.device_node_budget}; "
+                "raise the budget or use grow_tree (masked, per-level)")
+
+    def build(level: int, slot: int, counts: np.ndarray
+              ) -> Optional[TreeNode]:
         if counts.sum() <= 0:
             return None
         node = TreeNode(class_counts=counts,
                         class_values=table.class_values)
-        if level < len(records) and bool(records[level]["split"][k]):
-            t = int(records[level]["best_t"][k])
-            attr, key, n_seg = cand.keys[t]
-            node.attr_ordinal, node.split_key = attr, key
-            for s in range(n_seg):
-                child = build(level + 1, k * s_max + s)
-                if child is not None:
-                    node.children[s] = child
+        if slot < 0 or level >= len(records):
+            return node                       # leaf: counts came from the
+        rec = records[level]                  # parent's child_counts row
+        if not bool(rec["split"][slot]):
+            return node
+        t = int(rec["best_t"][slot])
+        attr, key, n_seg = cand.keys[t]
+        node.attr_ordinal, node.split_key = attr, key
+        for s in range(n_seg):
+            child = build(level + 1, int(rec["child_slot"][slot, s]),
+                          np.asarray(rec["child_counts"][slot, s]))
+            if child is not None:
+                node.children[s] = child
         return node
 
-    root = build(0, 0)
+    root_counts = np.asarray(records[0]["child_counts"][0]).sum(axis=0)
+    root = build(0, 0, root_counts)
     if root is None:
         # zero-row table: a leaf root with empty counts, like grow_tree
         root = TreeNode(class_counts=np.zeros(table.n_classes),

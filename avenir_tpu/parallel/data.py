@@ -213,7 +213,9 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
         # slice is ALL padding: featurize the global last real row once
         # as the padding prototype (every path pads with that row)
         lo, hi = n_real - 1, n_real
-    local = fz.transform_chunked(
+    # numpy all the way to _to_global: the slice must not bounce through
+    # the device before padding and global assembly
+    binned, numeric, labels, local_ids = fz.transform_chunked_arrays(
         _stream_global_rows(path, delim_regex, lo, hi, prefix, windows),
         with_labels=with_labels, chunk_rows=chunk_rows)
 
@@ -221,7 +223,6 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
     n_have = hi - lo
 
     def prep(a):
-        a = np.asarray(a)
         if start >= n_real:            # all-padding: replicate the prototype
             return np.repeat(a[-1:], n_need, axis=0)
         if n_need > n_have:            # tail padding: copies of the last row
@@ -230,14 +231,18 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
         return a
 
     mask = ((start + np.arange(n_need)) < n_real).astype(np.float32)
-    ids = (list(local.ids) + [local.ids[-1]] * (n_need - len(local.ids))
-           if start < n_real else [local.ids[-1]] * n_need)
+    ids = (local_ids + [local_ids[-1]] * (n_need - len(local_ids))
+           if start < n_real else [local_ids[-1]] * n_need)
+    # schema metadata via a zero-row table (nothing shipped to the device)
+    meta = fz.table_from_arrays(
+        binned[:0], numeric[:0],
+        None if labels is None else labels[:0], [])
     new = replace(
-        local,
-        binned=_to_global(prep(local.binned), mesh, axis),
-        numeric=_to_global(prep(local.numeric), mesh, axis),
-        labels=(None if local.labels is None else
-                _to_global(prep(local.labels), mesh, axis)),
+        meta,
+        binned=_to_global(prep(binned), mesh, axis),
+        numeric=_to_global(prep(numeric), mesh, axis),
+        labels=(None if labels is None else
+                _to_global(prep(labels), mesh, axis)),
         ids=ids,
         n_rows=g)
     return ShardedTable(table=new, mask=_to_global(mask, mesh, axis),

@@ -326,13 +326,12 @@ class Featurizer:
             rows, with_labels=with_labels)
         return self.table_from_arrays(binned, numeric, labels, ids)
 
-    def transform_chunked(self, rows_iter, with_labels: bool = True,
-                          chunk_rows: int = 65536) -> EncodedTable:
-        """Featurize a row ITERATOR chunk-by-chunk: peak memory is the
-        output arrays plus ONE chunk of token lists — the whole-file token
-        list (~10x the raw bytes as Python strings) is never materialized.
-        This is the out-of-core leg of the input path (SURVEY.md §1 L0:
-        the reference's mappers stream HDFS splits)."""
+    def transform_chunked_arrays(self, rows_iter, with_labels: bool = True,
+                                 chunk_rows: int = 65536):
+        """Numpy core of :meth:`transform_chunked` — featurize a row
+        ITERATOR chunk-by-chunk, returning host arrays (binned, numeric,
+        labels-or-None, ids) so callers that pad/reshard (the multi-host
+        loader) never bounce the slice through the device first."""
         bs, vs, ls, ids = [], [], [], []
         buf: List[Sequence[str]] = []
         total = 0
@@ -355,8 +354,17 @@ class Featurizer:
                 flush()
         flush()                       # tail (and the empty-input shape)
         labels = np.concatenate(ls) if ls else None
-        return self.table_from_arrays(
-            np.concatenate(bs), np.concatenate(vs), labels, ids)
+        return np.concatenate(bs), np.concatenate(vs), labels, ids
+
+    def transform_chunked(self, rows_iter, with_labels: bool = True,
+                          chunk_rows: int = 65536) -> EncodedTable:
+        """Featurize a row ITERATOR chunk-by-chunk: peak memory is the
+        output arrays plus ONE chunk of token lists — the whole-file token
+        list (~10x the raw bytes as Python strings) is never materialized.
+        This is the out-of-core leg of the input path (SURVEY.md §1 L0:
+        the reference's mappers stream HDFS splits)."""
+        return self.table_from_arrays(*self.transform_chunked_arrays(
+            rows_iter, with_labels=with_labels, chunk_rows=chunk_rows))
 
     @staticmethod
     def _bin_labels(enc: FieldEncoder) -> List[str]:

@@ -1,0 +1,70 @@
+"""Deep device-resident tree growth at 1M rows (round-3 sparse frontier).
+
+Round 2's dense s_max^depth node axis hit its 4GB guard around depth 6 on
+1.05M rows and fell back to the 24x-slower host loop. The sparse live
+frontier (tree.py _level_body: per-level compaction via a liveness cumsum,
+child counts recorded so leaves need no slots, K-chunked one-hot matmuls)
+keeps depth 8-12 in ONE dispatch chain + ONE readback. This script records
+levels/sec at depths 4/8/10/12 and asserts the depth-4 tree is identical
+to the round-2 measurement workload's.
+
+Run: PYTHONPATH=. python scripts/tree_device_deep.py
+"""
+
+import time
+
+import numpy as np
+
+from avenir_tpu.datagen.generators import retarget_rows, retarget_schema
+from avenir_tpu.models import tree as T
+from avenir_tpu.utils.dataset import Featurizer
+
+
+def canon(n):
+    if n is None:
+        return None
+    return (n.attr_ordinal, n.split_key,
+            tuple(int(c) for c in n.class_counts),
+            tuple(sorted((k, canon(v)) for k, v in n.children.items())))
+
+
+def tree_depth(n):
+    return 0 if not n.children else 1 + max(
+        tree_depth(c) for c in n.children.values())
+
+
+def n_nodes(n):
+    return 1 + sum(n_nodes(c) for c in n.children.values())
+
+
+def main() -> None:
+    n_rows = 1_050_000
+    reps = 1024
+    base = retarget_rows(n_rows // reps + 1, seed=31)
+    rows = (base * reps)[:n_rows]
+    table = Featurizer(retarget_schema()).fit_transform(rows)
+    print(f"table: {table.n_rows} rows, {table.n_features} features")
+
+    for depth in (4, 8, 10, 12):
+        cfg = T.TreeConfig(max_depth=depth, min_node_size=5)
+        t0 = time.perf_counter()
+        tree = T.grow_tree_device(table, cfg)      # compile + run
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        tree = T.grow_tree_device(table, cfg)      # warm
+        warm = time.perf_counter() - t0
+        print(f"depth {depth:2d}: warm {warm:.2f}s = "
+              f"{depth / warm:.1f} levels/sec (cold {cold:.1f}s); "
+              f"tree depth {tree_depth(tree)}, {n_nodes(tree)} nodes")
+
+    # bit-identity spot check vs the host loop at a host-feasible depth
+    cfg = T.TreeConfig(max_depth=4, min_node_size=5)
+    host = T.grow_tree(table, cfg)
+    dev = T.grow_tree_device(table, cfg)
+    same = canon(host) == canon(dev)
+    print(f"depth-4 bit-identity vs grow_tree at 1.05M rows: {same}")
+    assert same
+
+
+if __name__ == "__main__":
+    main()

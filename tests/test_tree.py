@@ -181,12 +181,31 @@ class TestGrowTreeDevice:
         with pytest.raises(ValueError, match="best"):
             T.grow_tree_device(table, cfg)
 
-    def test_depth_guard_rejects_exponential_node_axis(self):
-        rows = retarget_rows(200, seed=2)
+    def test_deep_growth_stays_device_resident(self):
+        """Round 2's dense s_max^depth axis made depth 12 impossible (4GB
+        guard); the sparse live frontier grows it in one dispatch chain and
+        still matches the host loop bit-identically."""
+        rows = retarget_rows(1200, seed=2)
         table = Featurizer(retarget_schema()).fit_transform(rows)
-        # force an over-budget [N, s_max^depth * C] one-hot request
-        cfg = T.TreeConfig(max_depth=12)
-        with pytest.raises(ValueError, match="grow_tree"):
+        cfg = T.TreeConfig(max_depth=12, min_node_size=5)
+        host = T.grow_tree(table, cfg)
+        dev = T.grow_tree_device(table, cfg)
+        assert _canon(host) == _canon(dev)
+
+        def depth(n):
+            return 0 if not n.children else 1 + max(
+                depth(c) for c in n.children.values())
+        assert depth(dev) >= 5, depth(dev)   # actually grew deep
+
+    def test_budget_overflow_detected_not_truncated(self):
+        """A frontier wider than device_node_budget must raise (with the
+        grow_tree fallback pointer the forest path keys on), never
+        silently drop nodes."""
+        rows = retarget_rows(1200, seed=2)
+        table = Featurizer(retarget_schema()).fit_transform(rows)
+        cfg = T.TreeConfig(max_depth=4, min_node_size=5,
+                           device_node_budget=2)
+        with pytest.raises(ValueError, match="use grow_tree"):
             T.grow_tree_device(table, cfg)
 
     def test_no_splittable_attrs_gives_leaf_root(self):
