@@ -292,7 +292,8 @@ def run_tree_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
             "max.cat.attr.split.groups", 3),
         split_selection_strategy=strategy,
         num_top_splits=conf.get_int("num.top.splits", 5),
-        min_gain=conf.get_float("min.gain", 1e-6))
+        min_gain=conf.get_float("min.gain", 1e-6),
+        device_node_budget=conf.get_int("device.node.budget", 2048))
     if strategy == "best":
         try:
             tree = T.grow_tree_device(table, cfg)
@@ -378,7 +379,8 @@ def run_forest_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
             split_selection_strategy=conf.get(
                 "split.selection.strategy", "best"),
             num_top_splits=conf.get_int("num.top.splits", 5),
-            min_gain=conf.get_float("min.gain", 1e-6)))
+            min_gain=conf.get_float("min.gain", 1e-6),
+            device_node_budget=conf.get_int("device.node.budget", 2048)))
     trees = F.grow_forest(table, cfg)
     F.save_forest(trees, out_path)
     print(json.dumps({"Forest.Trees": len(trees),

@@ -590,6 +590,19 @@ class TreeConfig:
     device_node_budget: int = 2048
 
 
+def canonical_tree(n: Optional["TreeNode"]):
+    """Order-insensitive structural fingerprint of a tree — (attr, key,
+    int class counts, sorted children) per node. THE one definition of
+    'identical tree' every bit-identity assertion (tests, on-chip deep
+    growth checks) compares by; extend here when TreeNode grows fields."""
+    if n is None:
+        return None
+    return (n.attr_ordinal, n.split_key,
+            tuple(int(c) for c in n.class_counts),
+            tuple(sorted((k, canonical_tree(v))
+                         for k, v in n.children.items())))
+
+
 def splittable_ordinals(table: EncodedTable) -> List[int]:
     """The attributes candidate splits can be enumerated for: categorical,
     or numeric with a bucket grid — the ONE source of the splittability

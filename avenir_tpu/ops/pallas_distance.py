@@ -36,10 +36,16 @@ timing, anchored on the XLA ``approx_min_k`` path):
   halving the accumulator blocks (n_acc=2) makes it *slower* — the
   read-modify-write chains on the accumulators bind before raw VPU ops;
 - at the production tile the kernel reaches ~25-31% of the padded-K=128
-  MXU slab ceiling (197 TFLOP/s datasheet → 7.7e11 pairs/s) and ~12-15%
-  of HBM — neither saturates *because* the fold holds them; the kernel
-  runs ~1.1-1.4× the XLA ``approx_min_k`` streaming path on the same
-  shapes;
+  MXU slab ceiling (197 TFLOP/s datasheet → 7.7e11 pairs/s), ~12-15%
+  of HBM, and ~21% of the 6-op VPU-fold ceiling (round-3 accounting,
+  scripts/roofline_knn_results.txt) — none saturates *because* the fold's
+  serialized RMW structure holds them. ROUND-3 UPDATE (jax 0.9): this
+  kernel and the XLA ``approx_min_k`` path TRADE PLACES run-to-run
+  (0.96×–1.22× same day, interleaved — scripts/sweep11-13_results.txt);
+  bench.py gates both against exact and auto-selects per run. Raising
+  pallas's default 16MB scoped-VMEM limit (CompilerParams) compiles
+  tiles to (2048,16384), none faster — the fixed per-step cost is NOT
+  the binder (scripts/PERF_NOTES.md round-3 section);
 - four redesigns were built against this analysis, measured interleaved,
   and REJECTED (kept in scripts/ as the negative results): (1) packed-key
   fold — metric bitcast to int32 with the train-chunk id in the low

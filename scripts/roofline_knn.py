@@ -56,6 +56,21 @@ HBM_BPS = 819e9              # HBM GB/s
 MXU_PAIRS_CEIL_K128 = BF16_FLOPS / (2 * 128)
 MXU_PAIRS_CEIL_K16 = BF16_FLOPS / (2 * 16)   # if sublane-contraction works
 
+# --- VPU ceiling for the compare/select fold (round-3 accounting) ----------
+# clock self-consistent with the MXU datasheet number: 197e12 bf16 FLOP/s
+# over 4 MXUs x 128x128 x 2 FLOP/MAC -> 1.503 GHz. The VPU executes 4 ALU
+# ops per cycle on (8,128)-shaped f32 vregs = 4*1024 lanes/cycle.
+TPU_CLOCK = BF16_FLOPS / (2 * 128 * 128 * 4)            # ~1.503e9 Hz
+VPU_OPS = 4 * 8 * 128 * TPU_CLOCK                        # ~6.16e12 f32 op/s
+# production fold, ops per candidate pair on the [TM, TN] slab:
+#   metric = y2 - 2*cross          2  (mul + sub)
+#   better = chunk < cur_d         1  (compare)
+#   acc_d  = where(better, ...)    1  (select)
+#   idx    = j*tn + c*128 + lane   1  (the broadcast add; iota is hoisted)
+#   acc_i  = where(better, ...)    1  (select)
+FOLD_OPS_PER_PAIR = 6
+VPU_PAIRS_CEIL = VPU_OPS / FOLD_OPS_PER_PAIR             # ~1.03e12 pairs/s
+
 
 def _dotmin_kernel(x_ref, y_ref, y2_ref, out_d_ref, acc_d, *, tn: int):
     """Dot + cheapest possible slab consumption (1 min-op per element)."""
@@ -304,7 +319,8 @@ def main() -> None:
           f"tiles ({TILE_M},{TILE_N}) n_acc={N_ACC}, iters={ITERS}, "
           f"best of {REPEATS}")
     print(f"# ceilings: MXU@K128 {MXU_PAIRS_CEIL_K128:.3e} pairs/s, "
-          f"MXU@K16 {MXU_PAIRS_CEIL_K16:.3e} pairs/s")
+          f"MXU@K16 {MXU_PAIRS_CEIL_K16:.3e} pairs/s, "
+          f"VPU-fold@{FOLD_OPS_PER_PAIR}ops {VPU_PAIRS_CEIL:.3e} pairs/s")
     for variant in ("full", "dotmin", "nodot", "tpose", "xla"):
         try:
             elapsed = _time_variant(variant, test, train)
@@ -319,7 +335,8 @@ def main() -> None:
         print(f"{variant:8s} {elapsed*1e3:8.1f} ms  {rows/1e6:7.3f} M rows/s"
               f"  {pairs:.3e} pairs/s"
               f"  {100*pairs/MXU_PAIRS_CEIL_K128:5.1f}% MXU@K128"
-              f"  {100*hbm/HBM_BPS:5.1f}% HBM(f32-padded)")
+              f"  {100*hbm/HBM_BPS:5.1f}% HBM(f32-padded)"
+              f"  {100*pairs/VPU_PAIRS_CEIL:5.1f}% VPU-fold")
 
 
 if __name__ == "__main__":

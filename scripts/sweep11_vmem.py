@@ -1,0 +1,155 @@
+"""Sweep 11 (round 3): bigger tiles via an explicit VMEM budget.
+
+Round-2 sweeps found every config with a metric slab > 4M elements failed
+Mosaic compilation and concluded the binding fixed per-step cost (~5us x
+128 grid steps ~= 21% of iteration time) could not be amortized further.
+Those failures were hit under pallas's DEFAULT 16MB scoped-VMEM limit —
+`pltpu.CompilerParams(vmem_limit_bytes=...)` raises it toward the chip's
+128MB. Bigger slabs halve/quarter the grid-step count at constant total
+fold work, attacking the fixed cost directly.
+
+Method: same-run interleaved (round-robin, best-of), anchored on the XLA
+approx_min_k path and the production pallas config. Correctness-gated
+against the exact path per config before timing.
+
+Run: PYTHONPATH=. python scripts/sweep11_vmem.py
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.ops.pallas_distance import (
+    BIG, LANES, _pad_rows, _topk_kernel, pairwise_topk_pallas)
+
+N_TRAIN = 65536
+M_TEST = 8192
+D = 9
+K = 5
+ITERS = 50
+ROUNDS = 5
+VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def launch(x, y, *, tile_m, tile_n, n_acc, vmem_limit=None):
+    m = x.shape[0]
+    xp = _pad_rows(x, tile_m)
+    yp = _pad_rows(y, tile_n)
+    n = y.shape[0]
+    y2 = jnp.sum(y * y, axis=1)
+    y2p = jnp.pad(y2, (0, yp.shape[0] - n), constant_values=BIG)[None, :]
+    grid = (xp.shape[0] // tile_m, yp.shape[0] // tile_n)
+    kernel = partial(_topk_kernel, k=K, tn=tile_n, n_acc=n_acc,
+                     use_bf16=True)
+    kwargs = {}
+    if vmem_limit is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_limit)
+    out_d, out_i = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_m, D), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, D), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_m, n_acc * LANES), jnp.float32),
+            pltpu.VMEM((tile_m, n_acc * LANES), jnp.int32),
+        ],
+        **kwargs,
+    )(xp, yp, y2p)
+    return out_d[:m], out_i[:m]
+
+
+def recall_of(i_got, i_ref):
+    return np.mean([len(set(a[:K]) & set(b[:K])) / K
+                    for a, b in zip(np.asarray(i_got), np.asarray(i_ref))])
+
+
+def chain_for(fn, test):
+    @jax.jit
+    def chain(t):
+        def body(t, _):
+            d = fn(t)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, d[0, 0]
+        _, outs = lax.scan(body, t, None, length=ITERS)
+        return outs
+    np.asarray(chain(test))      # compile + warm
+    return chain
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    train = jnp.asarray(rng.random((N_TRAIN, D), dtype=np.float32))
+    test = jnp.asarray(rng.random((M_TEST, D), dtype=np.float32))
+    _, i_exact = pairwise_topk(test[:512], train, k=K, mode="exact")
+
+    configs = {
+        "xla":       lambda t: pairwise_topk(t, train, k=K, mode="fast")[0],
+        "prod_1024x4096": lambda t: pairwise_topk_pallas(t, train, k=K)[0],
+    }
+    for tm, tn in ((1024, 8192), (2048, 4096), (1024, 16384),
+                   (2048, 8192), (4096, 8192), (2048, 16384)):
+        name = f"vmem_{tm}x{tn}"
+        configs[name] = (lambda t, tm=tm, tn=tn: launch(
+            t, train, tile_m=tm, tile_n=tn, n_acc=4,
+            vmem_limit=VMEM_LIMIT)[0])
+
+    chains = {}
+    for name, fn in configs.items():
+        try:
+            if name.startswith("vmem"):
+                tm = int(name.split("_")[1].split("x")[0])
+                tn = int(name.split("x")[1])
+                _, i_got = launch(test[:512], train, tile_m=tm, tile_n=tn,
+                                  n_acc=4, vmem_limit=VMEM_LIMIT)
+                r = recall_of(i_got, i_exact)
+                if r < 0.985:
+                    print(f"{name:18s} RECALL FAIL {r:.4f}")
+                    continue
+            chains[name] = chain_for(fn, test)
+            print(f"{name:18s} compiled ok")
+        except Exception as exc:
+            print(f"{name:18s} FAILED: {type(exc).__name__}: "
+                  f"{str(exc).splitlines()[0][:120]}")
+
+    best = {name: float("inf") for name in chains}
+    for _ in range(ROUNDS):
+        for name, chain in chains.items():
+            t0 = time.perf_counter()
+            np.asarray(chain(test))
+            best[name] = min(best[name], time.perf_counter() - t0)
+    print(f"\n# {M_TEST}x{N_TRAIN} D={D} k={K}, {ITERS} iters, "
+          f"best of {ROUNDS} interleaved rounds")
+    anchor = best.get("xla", float("nan"))
+    for name, t in sorted(best.items(), key=lambda kv: kv[1]):
+        rows = M_TEST * ITERS / t
+        print(f"{name:18s} {t*1e3:8.1f} ms  {rows/1e6:7.3f} M rows/s"
+              f"  {anchor/t:5.2f}x XLA")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Sweep 13 (round 3): approx_min_k recall_target on the deferred slab.
+
+recall_target is a GUARANTEE knob — the partial-reduction bucket count
+scales with it, but measured recall on real shapes sits far above the
+guarantee. The bench's own gate is measured recall >= 0.985 vs exact, so
+any target whose MEASURED recall clears the gate is admissible. Arms:
+deferred slab (sweep12: x2/clamp/divide moved to finalization) at targets
+0.99 / 0.95 / 0.90 / 0.80, vs the production xla + pallas paths.
+
+Run: PYTHONPATH=. python -u scripts/sweep13_recall_target.py
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas
+
+N_TRAIN = 65536
+M_TEST = 8192
+D = 9
+K = 5
+ITERS = 50
+ROUNDS = 5
+
+
+@partial(jax.jit, static_argnames=("k", "rt"))
+def topk_defer(x, y, *, k: int, rt: float):
+    y2 = jnp.sum(y * y, axis=1)
+    cross = lax.dot_general(
+        x.astype(jnp.bfloat16), y.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    metric = y2[None, :] - 2.0 * cross
+    d, i = lax.approx_min_k(metric, k, recall_target=rt)
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    sq = jnp.maximum(d + x2, 0.0) / D
+    return (jnp.asarray(jnp.rint(jnp.sqrt(sq) * 1000), jnp.int32),
+            i.astype(jnp.int32))
+
+
+def recall_of(i_got, i_ref):
+    return np.mean([len(set(np.asarray(a)[:K]) & set(np.asarray(b)[:K])) / K
+                    for a, b in zip(i_got, i_ref)])
+
+
+def chain_for(fn, test):
+    @jax.jit
+    def chain(t):
+        def body(t, _):
+            d = fn(t)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, d[0, 0]
+        _, outs = lax.scan(body, t, None, length=ITERS)
+        return outs
+    np.asarray(chain(test))
+    return chain
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    train = jnp.asarray(rng.random((N_TRAIN, D), dtype=np.float32))
+    test = jnp.asarray(rng.random((M_TEST, D), dtype=np.float32))
+    _, i_ex = pairwise_topk(test[:512], train, k=K, mode="exact")
+
+    arms = {
+        "xla_rt99": lambda t: pairwise_topk(t, train, k=K, mode="fast")[0],
+        "pallas": lambda t: pairwise_topk_pallas(t, train, k=K)[0],
+    }
+    for rt in (0.99, 0.95, 0.90, 0.80):
+        name = f"defer_rt{int(rt*100)}"
+        _, i_got = topk_defer(test[:512], train, k=K, rt=rt)
+        r = recall_of(i_got, i_ex)
+        print(f"{name:12s} measured recall={r:.4f}", flush=True)
+        if r < 0.985:
+            print(f"{name:12s} GATE FAIL — dropped", flush=True)
+            continue
+        arms[name] = lambda t, rt=rt: topk_defer(t, train, k=K, rt=rt)[0]
+
+    chains = {}
+    for name, fn in arms.items():
+        chains[name] = chain_for(fn, test)
+        print(f"{name:12s} compiled", flush=True)
+    best = {name: float("inf") for name in chains}
+    for _ in range(ROUNDS):
+        for name, chain in chains.items():
+            t0 = time.perf_counter()
+            np.asarray(chain(test))
+            best[name] = min(best[name], time.perf_counter() - t0)
+    print(f"\n# {M_TEST}x{N_TRAIN} D={D} k={K}, {ITERS} iters, "
+          f"best of {ROUNDS} interleaved rounds", flush=True)
+    anchor = best.get("xla_rt99", float("nan"))
+    for name, t in sorted(best.items(), key=lambda kv: kv[1]):
+        rows = M_TEST * ITERS / t
+        print(f"{name:12s} {t*1e3:8.1f} ms  {rows/1e6:7.3f} M rows/s"
+              f"  {anchor/t:5.2f}x xla_rt99", flush=True)
+
+
+if __name__ == "__main__":
+    main()

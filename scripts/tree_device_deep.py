@@ -20,12 +20,7 @@ from avenir_tpu.models import tree as T
 from avenir_tpu.utils.dataset import Featurizer
 
 
-def canon(n):
-    if n is None:
-        return None
-    return (n.attr_ordinal, n.split_key,
-            tuple(int(c) for c in n.class_counts),
-            tuple(sorted((k, canon(v)) for k, v in n.children.items())))
+canon = T.canonical_tree
 
 
 def tree_depth(n):

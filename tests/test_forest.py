@@ -44,14 +44,8 @@ class TestBootstrapWeights:
             labels=jnp.asarray(np.asarray(table.labels)[idx]),
             ids=[], n_rows=len(idx))
         materialized = T.grow_tree_device(resampled, cfg)
-
-        def canon(n):
-            return (None if n is None else
-                    (n.attr_ordinal, n.split_key,
-                     tuple(int(c) for c in n.class_counts),
-                     tuple(sorted((k, canon(v))
-                                  for k, v in n.children.items()))))
-        assert canon(weighted) == canon(materialized)
+        assert (T.canonical_tree(weighted)
+                == T.canonical_tree(materialized))
 
 
 class TestHostWeightedGrowth:
@@ -68,14 +62,7 @@ class TestHostWeightedGrowth:
                            row_weights=counts.astype(np.float32))
         dev = T.grow_tree_device(
             table, cfg, row_weights=jnp.asarray(counts, jnp.float32))
-
-        def canon(n):
-            return (None if n is None else
-                    (n.attr_ordinal, n.split_key,
-                     tuple(int(c) for c in n.class_counts),
-                     tuple(sorted((k, canon(v))
-                                  for k, v in n.children.items()))))
-        assert canon(host) == canon(dev)
+        assert T.canonical_tree(host) == T.canonical_tree(dev)
 
 
 class TestForest:

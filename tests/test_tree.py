@@ -137,12 +137,9 @@ class TestGrowTree:
 
 
 def _canon(n):
-    """Structure + counts + splits, order-insensitive over children."""
-    if n is None:
-        return None
-    return (n.attr_ordinal, n.split_key,
-            tuple(int(c) for c in n.class_counts),
-            tuple(sorted((k, _canon(v)) for k, v in n.children.items())))
+    """Structure + counts + splits, order-insensitive over children —
+    the shared definition in models/tree.py."""
+    return T.canonical_tree(n)
 
 
 class TestGrowTreeDevice:
