@@ -390,6 +390,27 @@ def predict_states(model: HmmModel, obs_rows: Sequence[Sequence[str]],
     return out
 
 
+def score_long(model: HmmModel, obs_row: Sequence[str], *,
+               mesh, axis_name: str = "data") -> float:
+    """log P(observations) for ONE long sequence with the time axis sharded
+    across the device mesh (parallel.seqpar.forward_sharded — the
+    sum-over-paths sibling of :func:`predict_states_long`; the reference's
+    per-line DP cannot express either). Padding is masked inside the
+    kernel."""
+    from avenir_tpu.parallel.seqpar import forward_sharded
+    o_idx = {o: i for i, o in enumerate(model.observations)}
+    codes = [o_idx[o] for o in obs_row]
+    if not codes:
+        raise ValueError("cannot score an empty observation sequence")
+    n_shards = mesh.shape[axis_name]
+    pad = (-len(codes)) % n_shards
+    padded = np.asarray(codes + [0] * pad, np.int32)
+    li, lt, le = _log_params(model)
+    return float(forward_sharded(li, lt, le, jnp.asarray(padded),
+                                 len(codes), mesh=mesh,
+                                 axis_name=axis_name))
+
+
 def predict_states_long(model: HmmModel, obs_row: Sequence[str], *,
                         mesh, axis_name: str = "data") -> List[str]:
     """Most-likely state path for ONE long observation sequence with the

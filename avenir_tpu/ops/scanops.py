@@ -36,6 +36,21 @@ def maxplus_eye(n: int, dtype=jnp.float32) -> jnp.ndarray:
     return jnp.where(jnp.eye(n, dtype=bool), 0.0, NEG_INF).astype(dtype)
 
 
+def lseplus(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(logsumexp, +) semiring matrix product over the last two dims
+    (batched): (a ⊗ b)[..., i, j] = logsumexp_k a[..., i, k] + b[..., k, j]
+    — the SUM-over-paths sibling of :func:`maxplus` (forward algorithm
+    instead of Viterbi). Associative, so block products parallelize the
+    HMM forward recurrence exactly like the max-plus path."""
+    return jax.nn.logsumexp(a[..., :, :, None] + b[..., None, :, :],
+                            axis=-2)
+
+
+# the (logsumexp, +) identity is the same 0/-inf diagonal matrix:
+# logsumexp over a row with one 0 and the rest -inf selects the 0 term
+lseplus_eye = maxplus_eye
+
+
 @partial(jax.jit, static_argnames=())
 def viterbi_path(log_init: jnp.ndarray, log_trans: jnp.ndarray,
                  log_emit: jnp.ndarray, obs: jnp.ndarray,

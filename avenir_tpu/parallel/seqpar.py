@@ -39,20 +39,40 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from avenir_tpu.ops.scanops import maxplus, maxplus_eye
+from avenir_tpu.ops.scanops import lseplus, lseplus_eye, maxplus, maxplus_eye
 
 
-def _tree_reduce_maxplus(mats: jnp.ndarray) -> jnp.ndarray:
-    """[T, S, S] -> the single max-plus product, by log-depth pairwise
-    combination (same total combines as a fold, no prefix storage)."""
+def _tree_reduce(mats: jnp.ndarray, op) -> jnp.ndarray:
+    """[T, S, S] -> the single semiring product under ``op`` (maxplus or
+    lseplus), by log-depth pairwise combination (same total combines as a
+    fold, no prefix storage)."""
     n = mats.shape[0]
     while n > 1:
         half = n // 2
-        paired = maxplus(mats[0:2 * half:2], mats[1:2 * half:2])
+        paired = op(mats[0:2 * half:2], mats[1:2 * half:2])
         if n % 2:
             paired = jnp.concatenate([paired, mats[-1:]], axis=0)
         mats, n = paired, paired.shape[0]
     return mats[0]
+
+
+def _step_mats(log_init, log_trans, log_emit, obs_local, length, p,
+               ident) -> jnp.ndarray:
+    """Per-step semiring matrices for one time shard, shared by the
+    Viterbi and forward bodies: M_t[i, j] = trans[i, j] + emit[j, obs_t];
+    the global t=0 "matrix" is the rank-1 broadcast of
+    alpha0 = init + emit[:, obs_0] (making the block fold uniform across
+    shards), and steps past the true sequence length become the semiring
+    identity — they freeze alpha, so padding never affects the result."""
+    n_states = log_init.shape[0]
+    t_local = obs_local.shape[0]
+    mats = log_trans[None, :, :] + log_emit.T[obs_local][:, None, :]
+    alpha0_mat = jnp.broadcast_to(
+        (log_init + log_emit[:, obs_local[0]])[None, :],
+        (n_states, n_states))
+    mats = mats.at[0].set(jnp.where(p == 0, alpha0_mat, mats[0]))
+    g = p * t_local + jnp.arange(t_local)
+    return jnp.where((g < length)[:, None, None], mats, ident[None, :, :])
 
 
 def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
@@ -60,24 +80,15 @@ def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
     p = lax.axis_index(axis_name)
     n_shards = lax.axis_size(axis_name)
     n_states = log_init.shape[0]
-    t_local = obs_local.shape[0]
 
-    # per-step max-plus matrices; the global t=0 "matrix" is the rank-1
-    # broadcast of alpha0 = init + emit[:, obs_0], making the block fold
-    # uniform across shards
-    mats = log_trans[None, :, :] + log_emit.T[obs_local][:, None, :]
-    alpha0_mat = jnp.broadcast_to(
-        (log_init + log_emit[:, obs_local[0]])[None, :], (n_states, n_states))
-    mats = mats.at[0].set(jnp.where(p == 0, alpha0_mat, mats[0]))
-    # steps past the true sequence length become max-plus identities: they
-    # freeze alpha and backtrack to themselves, so padding never affects the
-    # optimum (the sharded analogue of viterbi_path's active-mask)
-    ident = maxplus_eye(n_states, mats.dtype)
-    g = p * t_local + jnp.arange(t_local)
-    mats = jnp.where((g < length)[:, None, None], mats, ident[None, :, :])
+    # padded steps backtrack to themselves under the max-plus identity —
+    # the sharded analogue of viterbi_path's active-mask
+    ident = maxplus_eye(n_states, log_trans.dtype)
+    mats = _step_mats(log_init, log_trans, log_emit, obs_local, length, p,
+                      ident)
 
     # 1. block summary: combine the local mats into one [S, S] product
-    block = _tree_reduce_maxplus(mats)
+    block = _tree_reduce(mats, maxplus)
 
     # 2. boundary exchange: prefix of all blocks strictly before this shard
     blocks = lax.all_gather(block, axis_name)            # [P, S, S]
@@ -133,6 +144,62 @@ def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
                         jnp.arange(n_shards - 1, -1, -1))
     path_local = states_all[:, s_end].astype(jnp.int32)
     return path_local, best_score
+
+
+def _forward_body(log_init, log_trans, log_emit, obs_local, length,
+                  axis_name):
+    """shard_map body for the sharded forward pass: each device folds its
+    time shard's per-step matrices into one [S, S] block (sum-over-paths
+    semiring), then every device folds the all-gathered blocks with the
+    alpha0 row — only [S, S] summaries cross the interconnect."""
+    p = lax.axis_index(axis_name)
+    n_states = log_init.shape[0]
+
+    ident = lseplus_eye(n_states, log_trans.dtype)
+    mats = _step_mats(log_init, log_trans, log_emit, obs_local, length, p,
+                      ident)
+    block = _tree_reduce(mats, lseplus)
+    blocks = lax.all_gather(block, axis_name)            # [P, S, S]
+
+    # shard 0's block already folds alpha0 via its rank-1 first matrix, so
+    # its rows are constant and a uniform -log(S) seed selects them exactly
+    # (logsumexp over S equal rows adds log S; the seed cancels it)
+    seed = jnp.full((n_states,), -jnp.log(jnp.float32(n_states)))
+
+    def fold_step(v, b):
+        return jax.nn.logsumexp(v[:, None] + b, axis=0), None
+    alpha_t, _ = lax.scan(
+        fold_step, lax.pcast(seed, axis_name, to="varying"), blocks)
+    # every device computed the same scalar; pmax proves replication
+    return lax.pmax(jax.nn.logsumexp(alpha_t), axis_name)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis_name"))
+def forward_sharded(log_init: jnp.ndarray, log_trans: jnp.ndarray,
+                    log_emit: jnp.ndarray, obs: jnp.ndarray,
+                    length=None, *, mesh: Mesh, axis_name: str = "data"
+                    ) -> jnp.ndarray:
+    """log P(obs) of ONE long observation sequence under an HMM, with the
+    time axis sharded over ``mesh[axis_name]`` — the (logsumexp, +)
+    semiring sibling of :func:`viterbi_sharded` (the forward algorithm's
+    linear recurrence is associative in that semiring, SURVEY.md §5). The
+    padded obs length must divide the axis size; ``length`` masks trailing
+    padding (identity matrices freeze alpha). Returns the scalar
+    log-likelihood, equal to the sequential forward pass up to float
+    association."""
+    n_shards = mesh.shape[axis_name]
+    if obs.shape[0] % n_shards != 0:
+        raise ValueError(
+            f"sequence length {obs.shape[0]} not divisible by "
+            f"{n_shards}-way axis {axis_name!r}; right-pad and pass length=")
+    length = jnp.asarray(obs.shape[0] if length is None else length)
+    body = partial(_forward_body, axis_name=axis_name)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis_name), P()),
+        out_specs=P())
+    obs = jax.device_put(obs, NamedSharding(mesh, P(axis_name)))
+    return fn(log_init, log_trans, log_emit, obs, length)
 
 
 @partial(jax.jit, static_argnames=("mesh", "axis_name"))

@@ -1,0 +1,90 @@
+"""NB kernel A/B, round 3: re-validate the combined-index choice on jax 0.9.
+
+The KNN paths TRADED PLACES under the jax 0.9 toolchain (sweep11-13), and
+today's absolute NB number is far below round 1's (121M vs 274M
+samples/sec) — before attributing that to relay mood, re-run the round-2
+kernel A/B same-run interleaved: combined-index bf16 one-hot column-sum
+(production) vs the two-one-hot MXU einsum vs a bf16 einsum variant.
+
+Run: PYTHONPATH=. python -u scripts/exp_nb_variants3.py
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+N, F, BINS, CLASSES = 262_144, 5, 5, 2
+ITERS = 50
+ROUNDS = 5
+
+
+@partial(jax.jit, static_argnames=("n_classes", "n_bins"))
+def combined(bins, labels, *, n_classes, n_bins):
+    valid = (bins >= 0) & (bins < n_bins)
+    cid = jnp.where(valid, labels[:, None] * n_bins + bins, -1)
+    oh = jax.nn.one_hot(cid, n_classes * n_bins, dtype=jnp.bfloat16)
+    flat = jnp.sum(oh, axis=0, dtype=jnp.float32)
+    return flat.reshape(bins.shape[1], n_classes, n_bins).transpose(1, 0, 2)
+
+
+@partial(jax.jit, static_argnames=("n_classes", "n_bins"))
+def two_onehot(bins, labels, *, n_classes, n_bins):
+    oh_label = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
+    oh_bins = jax.nn.one_hot(bins, n_bins, dtype=jnp.float32)
+    return jnp.einsum("nc,nfb->cfb", oh_label, oh_bins)
+
+
+@partial(jax.jit, static_argnames=("n_classes", "n_bins"))
+def two_onehot_bf16(bins, labels, *, n_classes, n_bins):
+    oh_label = jax.nn.one_hot(labels, n_classes, dtype=jnp.bfloat16)
+    oh_bins = jax.nn.one_hot(bins, n_bins, dtype=jnp.bfloat16)
+    return jnp.einsum("nc,nfb->cfb", oh_label, oh_bins,
+                      preferred_element_type=jnp.float32)
+
+
+def chain_for(fn, bins, labels):
+    @jax.jit
+    def chain(lbl):
+        def body(l, _):
+            counts = fn(bins, l, n_classes=CLASSES, n_bins=BINS)
+            tot = jnp.sum(counts).astype(jnp.int32)
+            return l + jnp.minimum(tot, 0), counts[0, 0, 0]
+        _, outs = jax.lax.scan(body, lbl, None, length=ITERS)
+        return outs
+    np.asarray(chain(labels))
+    return chain
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, BINS, (N, F)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, CLASSES, N), jnp.int32)
+
+    fns = {"combined_bf16": combined, "two_onehot_f32": two_onehot,
+           "two_onehot_bf16": two_onehot_bf16}
+    # correctness first
+    ref = np.asarray(two_onehot(bins, labels, n_classes=CLASSES,
+                                n_bins=BINS))
+    for name, fn in fns.items():
+        got = np.asarray(fn(bins, labels, n_classes=CLASSES, n_bins=BINS))
+        assert np.allclose(got, ref), name
+    chains = {n: chain_for(f, bins, labels) for n, f in fns.items()}
+    best = {n: float("inf") for n in chains}
+    for _ in range(ROUNDS):
+        for name, chain in chains.items():
+            t0 = time.perf_counter()
+            np.asarray(chain(labels))
+            best[name] = min(best[name], time.perf_counter() - t0)
+    print(f"# {N} rows x {F} features, {CLASSES} classes x {BINS} bins, "
+          f"{ITERS} iters, best of {ROUNDS} interleaved", flush=True)
+    for name, t in sorted(best.items(), key=lambda kv: kv[1]):
+        print(f"{name:16s} {t*1e3:8.1f} ms  "
+              f"{N * ITERS / t / 1e6:8.1f} M samples/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -97,3 +97,66 @@ def test_sharded_path_scores_optimal(mesh):
     for t in range(1, len(obs)):
         s += lt[path[t - 1], path[t]] + le[path[t], obs[t]]
     assert abs(s - float(score)) < 1e-3
+
+
+def _forward_ll_reference(log_init, log_trans, log_emit, obs):
+    """Sequential forward pass in float64 numpy — the ground truth."""
+    from scipy.special import logsumexp
+    li = np.asarray(log_init, np.float64)
+    lt = np.asarray(log_trans, np.float64)
+    le = np.asarray(log_emit, np.float64)
+    alpha = li + le[:, obs[0]]
+    for t in range(1, len(obs)):
+        alpha = logsumexp(alpha[:, None] + lt, axis=0) + le[:, obs[t]]
+    return float(logsumexp(alpha))
+
+
+class TestForwardSharded:
+    """Sequence-parallel forward pass ((logsumexp, +) semiring blocks):
+    the sum-over-paths sibling of viterbi_sharded."""
+
+    @pytest.mark.parametrize("n_states,n_obs,t_len", [(5, 7, 64),
+                                                      (3, 4, 128)])
+    def test_matches_sequential(self, mesh, n_states, n_obs, t_len):
+        from avenir_tpu.parallel.seqpar import forward_sharded
+        rng = np.random.default_rng(7)
+        log_init, log_trans, log_emit = _random_hmm(rng, n_states, n_obs)
+        obs = jnp.asarray(rng.integers(0, n_obs, t_len), jnp.int32)
+        ll_par = float(forward_sharded(log_init, log_trans, log_emit, obs,
+                                       mesh=mesh))
+        ll_ref = _forward_ll_reference(log_init, log_trans, log_emit,
+                                       np.asarray(obs))
+        assert abs(ll_par - ll_ref) < 1e-3 * max(1.0, abs(ll_ref)), (
+            ll_par, ll_ref)
+
+    def test_masked_length(self, mesh):
+        from avenir_tpu.parallel.seqpar import forward_sharded
+        rng = np.random.default_rng(9)
+        log_init, log_trans, log_emit = _random_hmm(rng, 4, 5)
+        true_len = 37
+        pad_to = 40 if mesh.shape["data"] in (2, 4, 8) else 48
+        obs = np.zeros(pad_to, np.int32)
+        obs[:true_len] = rng.integers(0, 5, true_len)
+        ll_par = float(forward_sharded(
+            log_init, log_trans, log_emit, jnp.asarray(obs), true_len,
+            mesh=mesh))
+        ll_ref = _forward_ll_reference(log_init, log_trans, log_emit,
+                                       obs[:true_len])
+        assert abs(ll_par - ll_ref) < 1e-3 * max(1.0, abs(ll_ref)), (
+            ll_par, ll_ref)
+
+    def test_hmm_score_long(self, mesh):
+        from avenir_tpu.models import hmm as H
+        rng = np.random.default_rng(3)
+        rows = [[rng.choice(["a", "b", "c"]) for _ in range(20)]
+                for _ in range(60)]
+        model, _ = H.train_baum_welch(rows, ["a", "b", "c"], 2, n_iters=5)
+        row = [rng.choice(["a", "b", "c"]) for _ in range(101)]
+        ll = H.score_long(model, row, mesh=mesh)
+        li, lt, le = H._log_params(model)
+        ll_ref = _forward_ll_reference(li, lt, le,
+                                       np.asarray([["a", "b", "c"].index(o)
+                                                   for o in row]))
+        assert abs(ll - ll_ref) < 1e-3 * abs(ll_ref), (ll, ll_ref)
+        with pytest.raises(ValueError, match="empty"):
+            H.score_long(model, [], mesh=mesh)
