@@ -400,15 +400,55 @@ def run_forest_predictor(conf: JobConfig, in_path: str,
     _write_predictions(conf, out_path, table, pred, trees[0].class_values)
 
 
-def _select_split_attributes(conf: JobConfig, table) -> List[int]:
+USED_ATTRS_SIDECAR = "_used.attributes"
+
+
+def _find_used_attributes(in_path: str) -> List[int]:
+    """Split lineage of the path INTO a node: DataPartitioner writes
+    ``_used.attributes`` inside each ``split=<i>`` directory (a hidden
+    file — the MR input filters skip ``_``/``.`` names, so it never reads
+    as data), so a node's data at ``.../split=a/segment=b/data`` finds its
+    ancestors' choices by walking up — and a node's OWN choice (written
+    under its out dir's ``split=`` child) is never on its own walk, so
+    re-runs cannot poison themselves. The walk is BOUNDED by the node-tree
+    naming convention (``data`` / ``segment=`` / ``split=`` components):
+    it stops at the first foreign directory, so an unrelated run's sidecar
+    in some shared ancestor is never picked up."""
+    import os
+    d = in_path if os.path.isdir(in_path) else os.path.dirname(in_path)
+    d = os.path.abspath(d)
+    while True:
+        base = os.path.basename(d)
+        if base.startswith("split="):
+            cand = os.path.join(d, USED_ATTRS_SIDECAR)
+            if os.path.isfile(cand):
+                text = open(cand).read().strip()
+                return ([int(t) for t in text.split(",")] if text else [])
+            return []
+        if base != "data" and not base.startswith("segment="):
+            return []                 # left the split=i/segment=j tree
+        parent = os.path.dirname(d)
+        if parent == d:
+            return []
+        d = parent
+
+
+def _select_split_attributes(conf: JobConfig, table,
+                             in_path: str = "") -> List[int]:
     """``split.attribute.selection.strategy`` (ClassPartitionGenerator.java
-    :141, :160-196): userSpecified / all / random. ``random`` draws
-    ``random.split.set.size`` distinct feature ordinals (the random-forest
-    per-round subset, :176-189). Like the reference's bare Math.random()
-    it draws fresh entropy per invocation — so successive forest rounds get
-    different subsets — unless ``random.seed`` is set, which pins the draw
-    for reproducible runs. ``notUsedYet`` is an unimplemented TODO in the
-    reference itself (:171-175) and is rejected here too."""
+    :141, :160-196): userSpecified / all / random / notUsedYet. ``random``
+    draws ``random.split.set.size`` distinct feature ordinals (the
+    random-forest per-round subset, :176-189). Like the reference's bare
+    Math.random() it draws fresh entropy per invocation — so successive
+    forest rounds get different subsets — unless ``random.seed`` is set,
+    which pins the draw for reproducible runs.
+
+    ``notUsedYet`` COMPLETES the reference's TODO (:171-175 — it computes
+    ``allSplitAttrs`` minus used but leaves used as an unassigned TODO):
+    the used set comes from ``used.split.attributes`` when given, else
+    from the ``_used.attributes`` sidecar DataPartitioner leaves in each
+    node directory (the file-per-stage realization of "attributes on the
+    path from the root")."""
     from avenir_tpu.models.tree import splittable_ordinals
     splittable = splittable_ordinals(table)
     strategy = conf.get("split.attribute.selection.strategy", "userSpecified")
@@ -425,10 +465,16 @@ def _select_split_attributes(conf: JobConfig, table) -> List[int]:
         return sorted(int(o) for o in
                       rng.choice(splittable, size=size, replace=False))
     if strategy == "notUsedYet":
-        raise ValueError(
-            "split.attribute.selection.strategy=notUsedYet is a TODO in the "
-            "reference (ClassPartitionGenerator.java:171-175) and is not "
-            "implemented here either")
+        used = conf.get_int_list("used.split.attributes")
+        if used is None:
+            used = _find_used_attributes(in_path) if in_path else []
+        remaining = [a for a in splittable if a not in set(used)]
+        if not remaining:
+            raise ValueError(
+                f"notUsedYet: every splittable attribute {splittable} is "
+                f"already used on this path ({sorted(set(used))}); this "
+                "node cannot split further")
+        return remaining
     raise ValueError(
         f"invalid splitting attribute selection strategy {strategy!r}")
 
@@ -449,7 +495,7 @@ def run_class_partition_generator(conf: JobConfig, in_path: str,
         with open(out_path, "w") as fh:
             fh.write(repr(T.root_info(table, algorithm)) + "\n")
         return
-    attrs = _select_split_attributes(conf, table)
+    attrs = _select_split_attributes(conf, table, in_path=in_path)
     parent = conf.get_float("parent.info")
     max_groups = conf.get_int("max.cat.attr.split.groups", 3)
     class_probs = None
@@ -538,6 +584,16 @@ def run_data_partitioner(conf: JobConfig, in_path: str, out_path: str) -> None:
         with open(os.path.join(seg_dir, "partition.txt"), "w") as fh:
             for i in np.nonzero(np.asarray(segs) == seg)[0]:
                 fh.write(raw_lines[i] + "\n")
+    # split lineage sidecar INSIDE the split=<i> dir: parent's used
+    # attributes + this choice — only DESCENDANTS' walks find it (a
+    # notUsedYet selection at the next level excludes the path's
+    # attributes; re-running this node never reads its own choice)
+    used = _find_used_attributes(in_path)
+    if attr not in used:
+        used = used + [attr]
+    with open(os.path.join(out_path, f"split={split_index}",
+                           USED_ATTRS_SIDECAR), "w") as fh:
+        fh.write(",".join(str(a) for a in used) + "\n")
     print(f'{{"split.attribute": {attr}, "split.key": "{key}", '
           f'"split.index": {split_index}}}')
 

@@ -153,6 +153,7 @@ def _encode_padded_batch(obs_rows: Sequence[Sequence[str]],
 
 @partial(jax.jit, static_argnames=("n_states", "n_obs", "n_iters"))
 def _baum_welch_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
+                       seq_w: jnp.ndarray,
                        li0: jnp.ndarray, lt0: jnp.ndarray, le0: jnp.ndarray,
                        eps: jnp.ndarray,
                        *, n_states: int, n_obs: int, n_iters: int):
@@ -162,6 +163,12 @@ def _baum_welch_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
     ``eps`` is the traced M-step count smoothing, so changing it never
     recompiles; the host loop chains chunks and checks convergence between
     them — one readback per chunk, like logistic's _train_chunk.
+
+    ``seq_w`` is a per-sequence weight (1 real / 0 batch-padding) folded
+    into every expected count and the LL — which is also what makes the
+    batch axis SHARDABLE: pad B to the mesh axis, shard obs/lengths/seq_w
+    over it, and the batch-axis sums below become XLA-inserted psums (the
+    data-parallel E-step; dp sharding covered in tests/test_multichip.py).
     """
     bsz, t_max = obs.shape
     t_iota = jnp.arange(t_max)
@@ -215,13 +222,13 @@ def _baum_welch_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
         li, lt, le = params
         a_c, b_c, i_c, lls = jax.vmap(
             lambda o, n: e_step_one(li, lt, le, o, n))(obs, lengths)
-        a_sum = jnp.sum(a_c, axis=0) + eps
-        b_sum = jnp.sum(b_c, axis=0) + eps
-        i_sum = jnp.sum(i_c, axis=0) + eps
+        a_sum = jnp.sum(a_c * seq_w[:, None, None], axis=0) + eps
+        b_sum = jnp.sum(b_c * seq_w[:, None, None], axis=0) + eps
+        i_sum = jnp.sum(i_c * seq_w[:, None], axis=0) + eps
         lt_new = jnp.log(a_sum / jnp.sum(a_sum, axis=1, keepdims=True))
         le_new = jnp.log(b_sum / jnp.sum(b_sum, axis=1, keepdims=True))
         li_new = jnp.log(i_sum / jnp.sum(i_sum))
-        return (li_new, lt_new, le_new), jnp.sum(lls)
+        return (li_new, lt_new, le_new), jnp.sum(lls * seq_w)
 
     (li, lt, le), ll_hist = jax.lax.scan(
         em_iter, (li0, lt0, le0), None, length=n_iters)
@@ -242,7 +249,8 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
                      state_names: Optional[List[str]] = None,
                      smoothing: float = 1e-4,
                      ll_rel_tol: Optional[float] = None,
-                     chunk_size: int = 10
+                     chunk_size: int = 10,
+                     mesh=None, axis_name: str = "data"
                      ) -> Tuple[HmmModel, np.ndarray]:
     """Unsupervised HMM training — the leg the reference's
     HiddenMarkovModelBuilder never had (it requires fully or partially
@@ -254,6 +262,12 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
     convergence-without-per-iteration-readback contract as logistic's
     _train_chunk. Returns the model plus the per-iteration total
     log-likelihood — which EM guarantees non-decreasing, asserted in tests.
+
+    With a ``mesh``, the sequence batch shards over ``mesh[axis_name]``
+    (padded with weight-0 dummy rows to divide evenly): the E-step runs
+    data-parallel and XLA closes the expected-count and LL sums with psum
+    over the interconnect — same numbers as single-device up to float
+    reassociation.
 
     ``smoothing`` is the M-step additive count smoothing (traced, so tuning
     it never recompiles). ``ll_rel_tol``, when set, stops early once the
@@ -295,7 +309,27 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
     lt0 = rand_log_stochastic((n_states, n_states))
     le0 = rand_log_stochastic((n_states, len(observations)))
 
-    obs_j, len_j = jnp.asarray(batch), jnp.asarray(lengths)
+    seq_w = np.ones(len(batch), np.float32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        n_shards = mesh.shape[axis_name]
+        pad = (-len(batch)) % n_shards
+        if pad:
+            # dummy copies of row 0 at weight 0: they run the forward pass
+            # (valid length, so no n=0 hazard) but count for nothing
+            batch = np.concatenate([batch, np.repeat(batch[:1], pad, 0)])
+            lengths = np.concatenate(
+                [lengths, np.repeat(lengths[:1], pad)])
+            seq_w = np.concatenate([seq_w, np.zeros(pad, np.float32)])
+        shard = NamedSharding(mesh, PartitionSpec(axis_name))
+        # numpy straight to the sharded placement: jnp.asarray first would
+        # commit the whole batch to device 0 and then reshard it
+        obs_j = jax.device_put(batch, shard)
+        len_j = jax.device_put(lengths, shard)
+        w_j = jax.device_put(seq_w, shard)
+    else:
+        obs_j, len_j = jnp.asarray(batch), jnp.asarray(lengths)
+        w_j = jnp.asarray(seq_w)
     eps_j = jnp.asarray(smoothing, jnp.float32)
     # always dispatch FULL chunks — a remainder-sized tail chunk would
     # recompile the whole kernel for a handful of iterations; the budget is
@@ -306,7 +340,7 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
     hist: list = []
     while len(hist) < n_iters:
         li, lt, le, ll_c = _baum_welch_kernel(
-            obs_j, len_j, li, lt, le, eps_j, n_states=n_states,
+            obs_j, len_j, w_j, li, lt, le, eps_j, n_states=n_states,
             n_obs=len(observations), n_iters=chunk)
         hist.extend(np.asarray(jax.device_get(ll_c), np.float64).tolist())
         if ll_rel_tol is not None and ll_converged(hist, ll_rel_tol):

@@ -192,14 +192,60 @@ class TestSplitAttributeSelection:
         props = self._props(tmp_path, **{"split.attributes": "1,3"})
         assert self._attrs(tmp_path, props) == {1, 3}
 
-    def test_not_used_yet_rejected(self, tmp_path):
-        """notUsedYet is a TODO in the reference itself
-        (ClassPartitionGenerator.java:171-175): rejected, not guessed at."""
+    def test_not_used_yet_explicit_key(self, tmp_path):
+        """notUsedYet (round 3: COMPLETES the reference's TODO,
+        ClassPartitionGenerator.java:171-175): all splittable attributes
+        minus the explicitly-declared used set."""
         props = self._props(
             tmp_path,
-            **{"split.attribute.selection.strategy": "notUsedYet"})
-        with pytest.raises(ValueError, match="notUsedYet"):
+            **{"split.attribute.selection.strategy": "notUsedYet",
+               "used.split.attributes": "1,3"})
+        assert self._attrs(tmp_path, props) == {2}
+
+    def test_not_used_yet_all_used_rejected(self, tmp_path):
+        props = self._props(
+            tmp_path,
+            **{"split.attribute.selection.strategy": "notUsedYet",
+               "used.split.attributes": "1,2,3"})
+        with pytest.raises(ValueError, match="cannot split further"):
             self._attrs(tmp_path, props)
+
+    def test_not_used_yet_sidecar_pipeline(self, tmp_path, capsys):
+        """The file-per-stage realization: DataPartitioner leaves a
+        _used.attributes sidecar in the node directory; the next level's
+        SplitGenerator with notUsedYet excludes the path's attributes
+        without any explicit key."""
+        props = self._props(
+            tmp_path, **{"candidate.splits.path": tmp_path / "splits.txt"})
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "splits.txt"), "--conf", str(props)])
+        cli(["DataPartitioner", str(tmp_path / "data.csv"),
+             str(tmp_path), "--conf", str(props)])
+        picked = last_json(capsys)["split.attribute"]
+        [split_dir] = list(tmp_path.glob("split=*"))
+        sidecar = split_dir / "_used.attributes"
+        assert sidecar.read_text().strip() == str(picked)
+        part = sorted(tmp_path.glob("split=*/segment=*/data"))[0]
+        part_file = part / "partition.txt"
+        cli(["ClassPartitionGenerator", str(part_file),
+             str(tmp_path / "splits2.txt"), "--conf", str(props),
+             "-D", "split.attribute.selection.strategy=notUsedYet"])
+        with open(tmp_path / "splits2.txt") as fh:
+            attrs2 = {int(l.split(";")[0]) for l in fh.read().splitlines()}
+        assert picked not in attrs2 and attrs2, (picked, attrs2)
+        # second-level partition accumulates the lineage
+        cli(["DataPartitioner", str(part_file), str(part.parent / "node"),
+             "--conf", str(props),
+             "-D", f"candidate.splits.path={tmp_path / 'splits2.txt'}"])
+        picked2 = last_json(capsys)["split.attribute"]
+        [split_dir2] = list((part.parent / "node").glob("split=*"))
+        lineage = (split_dir2 / "_used.attributes").read_text()
+        assert set(lineage.strip().split(",")) == {str(picked),
+                                                   str(picked2)}
+        # re-running the SAME node must not read its own choice: the
+        # lineage its selection sees is still only the parent's
+        from avenir_tpu.cli.main import _find_used_attributes
+        assert _find_used_attributes(str(part_file)) == [picked]
 
     def test_unknown_strategy_rejected(self, tmp_path):
         props = self._props(
