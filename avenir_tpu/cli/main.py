@@ -26,6 +26,12 @@ from avenir_tpu.utils.dataset import Featurizer, read_csv_lines
 from avenir_tpu.utils.schema import FeatureSchema
 
 
+# tree/forest predictors auto-switch to on-device routing at this row
+# count: below it the host walk beats the jit compile; above it the device
+# path measured 12x (tree) / 6x (forest) at 1M rows (BASELINE.md)
+_DEVICE_PREDICT_ROWS = 100_000
+
+
 def _load_table(conf: JobConfig, in_path: str, for_predict: bool = False):
     schema = FeatureSchema.from_file(conf.get_required("feature.schema.file.path"))
     delim = conf.get("field.delim.regex", ",")
@@ -350,7 +356,11 @@ def run_tree_predictor(conf: JobConfig, in_path: str, out_path: str) -> None:
     with open(conf.get_required("tree.model.file.path")) as fh:
         model = json.load(fh)
     tree = T.TreeNode.from_dict(model["root"], model["classValues"])
-    pred = T.predict(tree, table)
+    # device routing pays a jit compile; identical output either way, so
+    # auto-switch on table size (device.predict overrides)
+    device = conf.get_bool("device.predict",
+                           table.n_rows >= _DEVICE_PREDICT_ROWS)
+    pred = (T.predict_device if device else T.predict)(tree, table)
     _write_predictions(conf, out_path, table, pred, model["classValues"])
 
 
@@ -396,7 +406,9 @@ def run_forest_predictor(conf: JobConfig, in_path: str,
     fz, rows = _load_table(conf, in_path, for_predict=True)
     table = fz.transform(rows, with_labels=validation)
     trees = F.load_forest(conf.get_required("forest.model.file.path"))
-    pred = F.predict_forest(trees, table)
+    device = conf.get_bool("device.predict",
+                           table.n_rows >= _DEVICE_PREDICT_ROWS)
+    pred = F.predict_forest(trees, table, device=device)
     _write_predictions(conf, out_path, table, pred, trees[0].class_values)
 
 

@@ -28,10 +28,11 @@ from dataclasses import dataclass, field, replace
 from typing import List, Optional, Sequence
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from avenir_tpu.models.tree import (
-    TreeConfig, TreeNode, grow_tree, grow_tree_device,
+    TreeConfig, TreeNode, _predict_device_raw, grow_tree, grow_tree_device,
     predict as predict_tree, splittable_ordinals)
 from avenir_tpu.utils.dataset import EncodedTable
 
@@ -99,13 +100,29 @@ def grow_forest(table: EncodedTable, config: ForestConfig
     return trees
 
 
-def predict_forest(trees: Sequence[TreeNode], table: EncodedTable
-                   ) -> np.ndarray:
+def predict_forest(trees: Sequence[TreeNode], table: EncodedTable,
+                   device: bool = False) -> np.ndarray:
     """Majority vote of the trees' per-row leaf predictions; the
-    (attr, key) row segmentations are computed once across all trees."""
+    (attr, key) row segmentations are computed once across all trees.
+    ``device=True`` routes every tree on device (tree.predict_device —
+    the batch-inference path for large tables); identical predictions
+    either way (asserted in tests)."""
     n_classes = len(trees[0].class_values)
-    votes = np.zeros((table.n_rows, n_classes), np.int64)
     seg_cache: dict = {}
+    if device:
+        # votes accumulate ON device; one readback for the whole ensemble
+        votes_d = jnp.zeros((table.n_rows, n_classes), jnp.int32)
+        all_ok = jnp.ones((1,), bool)
+        for tree in trees:
+            pred_d, oks = _predict_device_raw(tree, table, seg_cache)
+            votes_d = votes_d + jax.nn.one_hot(pred_d, n_classes,
+                                               dtype=jnp.int32)
+            all_ok = all_ok & jnp.all(oks)[None]
+        out, ok = jax.device_get((jnp.argmax(votes_d, axis=1), all_ok))
+        if not ok.all():
+            raise ValueError("split segment not found for some value")
+        return np.asarray(out, np.int64)
+    votes = np.zeros((table.n_rows, n_classes), np.int64)
     for tree in trees:
         pred = predict_tree(tree, table, seg_cache=seg_cache)
         votes[np.arange(table.n_rows), pred] += 1

@@ -257,3 +257,81 @@ class TestSplitClassProbs:
         # the read path ignores the suffix
         parsed = T.read_candidate_splits(path, ";")
         assert len(parsed) == len(cands)
+
+
+class TestPredictDevice:
+    """Device-routed batch inference must be bit-identical to the host
+    walk — including empty-segment fallback and the categorical
+    missing-value error."""
+
+    def test_matches_host_predict(self):
+        rows = retarget_rows(1500, seed=31)
+        table = Featurizer(retarget_schema()).fit_transform(rows)
+        for depth in (1, 3, 8):
+            tree = T.grow_tree_device(
+                table, T.TreeConfig(max_depth=depth, min_node_size=5))
+            np.testing.assert_array_equal(
+                T.predict_device(tree, table), T.predict(tree, table))
+
+    def test_leaf_root(self):
+        rows = retarget_rows(100, seed=1)
+        table = Featurizer(retarget_schema()).fit_transform(rows)
+        leaf = T.TreeNode(class_counts=np.asarray([10.0, 3.0]),
+                          class_values=table.class_values)
+        np.testing.assert_array_equal(T.predict_device(leaf, table),
+                                      np.zeros(100, np.int64))
+
+    def test_forest_device_matches(self):
+        from avenir_tpu.models import forest as F
+        rows = retarget_rows(1200, seed=21)
+        table = Featurizer(retarget_schema()).fit_transform(rows)
+        trees = F.grow_forest(table, F.ForestConfig(
+            n_trees=5, attrs_per_tree=2, seed=4,
+            tree=T.TreeConfig(max_depth=3)))
+        np.testing.assert_array_equal(
+            F.predict_forest(trees, table, device=True),
+            F.predict_forest(trees, table))
+
+    def test_unseen_segment_takes_majority_like_host(self):
+        """A segment DEFINED by the split but empty in training (so it has
+        no child) must route unseen rows to the node's majority on BOTH
+        paths — the device child table is sized by the split definition,
+        not the observed children, so an out-of-range-looking segment can
+        never spill into another node's row."""
+        train_rows = [[f"i{i}", str(v), "5", "gold",
+                       "yes" if v > 150 else "no"]
+                      for i, v in enumerate([0, 100, 120, 200, 260] * 20)]
+        table = Featurizer(retarget_schema()).fit_transform(train_rows)
+        tree = T.grow_tree_device(table, T.TreeConfig(
+            max_depth=1, split_attributes=(1,)))
+        assert not tree.is_leaf
+        n_def = T.split_segment_count(tree.split_key)
+        # drop the top child: rows above every split point now hit a
+        # childless segment
+        if (n_def - 1) in tree.children:
+            del tree.children[n_def - 1]
+        test_rows = [[f"t{i}", "480", "5", "gold", "yes"]
+                     for i in range(8)]
+        fz = Featurizer(retarget_schema())
+        fz.fit(train_rows)
+        test = fz.transform(test_rows)
+        host = T.predict(tree, test)
+        dev = T.predict_device(tree, test)
+        np.testing.assert_array_equal(dev, host)
+        assert (host == tree.prediction).all()
+
+    def test_missing_categorical_value_raises(self):
+        rows = retarget_rows(300, seed=2)
+        table = Featurizer(retarget_schema()).fit_transform(rows)
+        tree = T.grow_tree_device(table, T.TreeConfig(
+            max_depth=2, split_attributes=(3,)))     # loyalty (categorical)
+        assert tree.attr_ordinal == 3
+        # drop one vocab value from every group of the split key
+        groups = T.parse_categorical_split_key(tree.split_key)
+        victim = groups[0][0]
+        pruned = [[v for v in g if v != victim] for g in groups]
+        tree.split_key = T.categorical_split_key(pruned)
+        with pytest.raises(ValueError, match="not found"):
+            T.predict(tree, table)
+        with pytest.raises(ValueError, match="not found"):
+            T.predict_device(tree, table)
