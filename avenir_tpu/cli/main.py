@@ -706,7 +706,8 @@ def run_hmm_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
             state_names=conf.get_list("model.states"),
             smoothing=conf.get_float("prob.smoothing", 1e-4),
             ll_rel_tol=tol,
-            chunk_size=conf.get_int("iteration.chunk.size", 10))
+            chunk_size=conf.get_int("iteration.chunk.size", 10),
+            checkpoint_path=conf.get("checkpoint.file.path"))
         H.save_model(model, out_path, delim=conf.get("field.delim.out", ","))
         # converged = the tolerance test itself passed (deriving it from
         # iterations-vs-budget misreads a crossing on the final iteration)

@@ -23,6 +23,7 @@ Replaces the reference's HiddenMarkovModelBuilder MR
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
@@ -250,7 +251,8 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
                      smoothing: float = 1e-4,
                      ll_rel_tol: Optional[float] = None,
                      chunk_size: int = 10,
-                     mesh=None, axis_name: str = "data"
+                     mesh=None, axis_name: str = "data",
+                     checkpoint_path: Optional[str] = None
                      ) -> Tuple[HmmModel, np.ndarray]:
     """Unsupervised HMM training — the leg the reference's
     HiddenMarkovModelBuilder never had (it requires fully or partially
@@ -268,6 +270,13 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
     data-parallel and XLA closes the expected-count and LL sums with psum
     over the interconnect — same numbers as single-device up to float
     reassociation.
+
+    ``checkpoint_path`` makes the EM driver RESUMABLE (the logistic
+    coefficient-history contract, LogisticRegressionJob.java:238-255,
+    applied to this iterative driver): after every chunk the current
+    log-parameters + LL history are written atomically; a restart with the
+    same path continues from the saved iteration instead of the random
+    init, honoring the remaining budget and the convergence test.
 
     ``smoothing`` is the M-step additive count smoothing (traced, so tuning
     it never recompiles). ``ll_rel_tol``, when set, stops early once the
@@ -308,6 +317,33 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
         jnp.zeros((1,), jnp.float32))
     lt0 = rand_log_stochastic((n_states, n_states))
     le0 = rand_log_stochastic((n_states, len(observations)))
+    # fingerprint of (data, vocabulary, state count): a checkpoint from a
+    # DIFFERENT input must not resume — a rerun on updated data retrains
+    # from scratch instead of silently returning the stale model, and a
+    # same-size-but-different vocabulary cannot map emission columns to
+    # the wrong symbols
+    import hashlib
+    fp = hashlib.sha256()
+    fp.update(batch.tobytes())
+    fp.update(np.asarray(lengths).tobytes())
+    fp.update(repr(list(observations)).encode())
+    fp.update(str(n_states).encode())
+    data_fp = fp.hexdigest()
+
+    resumed_hist: list = []
+    if checkpoint_path is not None and os.path.exists(checkpoint_path):
+        with np.load(checkpoint_path) as ck:
+            if str(ck["data_fp"]) != data_fp:
+                import warnings
+                warnings.warn(
+                    f"checkpoint {checkpoint_path} belongs to different "
+                    "data/config (fingerprint mismatch); training fresh",
+                    stacklevel=2)
+            else:
+                li0 = jnp.asarray(ck["li"], jnp.float32)
+                lt0 = jnp.asarray(ck["lt"], jnp.float32)
+                le0 = jnp.asarray(ck["le"], jnp.float32)
+                resumed_hist = np.asarray(ck["ll"], np.float64).tolist()
 
     seq_w = np.ones(len(batch), np.float32)
     if mesh is not None:
@@ -337,14 +373,25 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
     # LL-non-decreasing iterations), mirroring the tolerance-check slack
     chunk = max(1, min(chunk_size, n_iters))
     li, lt, le = li0, lt0, le0
-    hist: list = []
-    while len(hist) < n_iters:
+    hist = list(resumed_hist)
+
+    def save_checkpoint():
+        li_h, lt_h, le_h = jax.device_get((li, lt, le))
+        # .npz suffix keeps np.savez from appending one: the tmp name is
+        # deterministic and the replace is atomic
+        tmp = checkpoint_path + ".tmp.npz"
+        np.savez(tmp, li=li_h, lt=lt_h, le=le_h,
+                 ll=np.asarray(hist, np.float64), data_fp=data_fp)
+        os.replace(tmp, checkpoint_path)
+
+    while len(hist) < n_iters and not (
+            ll_rel_tol is not None and ll_converged(hist, ll_rel_tol)):
         li, lt, le, ll_c = _baum_welch_kernel(
             obs_j, len_j, w_j, li, lt, le, eps_j, n_states=n_states,
             n_obs=len(observations), n_iters=chunk)
         hist.extend(np.asarray(jax.device_get(ll_c), np.float64).tolist())
-        if ll_rel_tol is not None and ll_converged(hist, ll_rel_tol):
-            break
+        if checkpoint_path is not None:
+            save_checkpoint()
     ll_hist = np.asarray(hist)
     li, lt, le = jax.device_get((li, lt, le))
 

@@ -249,6 +249,38 @@ class TestBaumWelch:
         assert np.all(np.diff(ll) >= -1e-2)
         assert abs(ll[-1] - ll[-2]) <= 1e-4 * max(1.0, abs(ll[-1]))
 
+    def test_checkpoint_resume(self, tmp_path):
+        """The iterative-driver resume contract (logistic's coeff-history
+        pattern): an interrupted run restarted over the same checkpoint
+        continues the SAME trajectory — identical params and LL history
+        to one uninterrupted run."""
+        rows, *_ , names = self._planted(n_seqs=60)
+        ck = str(tmp_path / "bw.ckpt")
+        m_full, ll_full = H.train_baum_welch(rows, names, 2, n_iters=20,
+                                             seed=3, chunk_size=5)
+        # "crash" after 10 iterations (2 chunks), then resume to 20
+        m_a, ll_a = H.train_baum_welch(rows, names, 2, n_iters=10, seed=3,
+                                       chunk_size=5, checkpoint_path=ck)
+        m_b, ll_b = H.train_baum_welch(rows, names, 2, n_iters=20, seed=3,
+                                       chunk_size=5, checkpoint_path=ck)
+        assert len(ll_b) == 20
+        np.testing.assert_allclose(ll_b[:10], ll_a, rtol=1e-6)
+        np.testing.assert_allclose(ll_b, ll_full, rtol=1e-5)
+        np.testing.assert_allclose(m_b.trans, m_full.trans, atol=1e-5)
+        np.testing.assert_allclose(m_b.emit, m_full.emit, atol=1e-5)
+        # rerunning the completed job on IDENTICAL data is idempotent
+        m_c, ll_c = H.train_baum_welch(rows, names, 2, n_iters=20, seed=3,
+                                       chunk_size=5, checkpoint_path=ck)
+        assert len(ll_c) == 20
+        np.testing.assert_allclose(m_c.trans, m_b.trans)
+        # different config/data (fingerprint mismatch): the stale
+        # checkpoint is IGNORED with a warning and training starts fresh —
+        # a rerun on updated input must never return the old model
+        with pytest.warns(UserWarning, match="fingerprint mismatch"):
+            m_d, ll_d = H.train_baum_welch(rows, names, 3, n_iters=5,
+                                           seed=3, checkpoint_path=ck)
+        assert m_d.trans.shape == (3, 3) and len(ll_d) == 5
+
     def test_smoothing_is_configurable(self):
         rows, *_ , names = self._planted(n_seqs=40)
         _, ll_soft = H.train_baum_welch(rows, names, 2, n_iters=5, seed=1,
