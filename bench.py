@@ -146,6 +146,10 @@ def main() -> None:
         impls["pallas"] = lambda t, tr: pairwise_topk_pallas(t, tr, k=K)
     if IMPL in ("xla", "auto") or not on_tpu:
         impls["xla"] = lambda t, tr: pairwise_topk(t, tr, k=K, mode="fast")
+    if not impls:
+        raise ValueError(
+            f"BENCH_IMPL={IMPL!r} selects no implementation "
+            "(expected 'auto', 'pallas', or 'xla')")
 
     chains = {}
     for name, topk in impls.items():
@@ -154,23 +158,22 @@ def main() -> None:
         chains[name] = _chain_for(topk)
         np.asarray(chains[name](test, train))       # compile + warm
 
-    # auto-select: 2 warm draws per impl, the faster takes the full sweep
-    # (the implementations' ordering moves with toolchain + relay mood)
-    if len(chains) > 1:
-        probe = {name: min(_timed(c, test, train) for _ in range(2))
-                 for name, c in chains.items()}
-        chosen = min(probe, key=probe.get)
-        print("impl probe: " + ", ".join(
-            f"{n}={t * 1e3:.1f}ms" for n, t in sorted(probe.items()))
+    # best-of-REPEATS, ROUND-ROBIN over the gated impls: the tunnel to the
+    # chip has time-varying load (±25% on minute scales), so a single draw
+    # is noise and a one-shot probe can commit to the wrong impl for the
+    # whole sweep — interleaving gives every impl the same exposure to the
+    # relay's mood and the min-over-draws per impl tracks each kernel's
+    # actual cost; the fastest impl's best draw is the framework's number
+    best = {name: float("inf") for name in chains}
+    for _ in range(REPEATS):
+        for name, chain in chains.items():
+            best[name] = min(best[name], _timed(chain, test, train))
+    chosen = min(best, key=best.get)
+    if len(best) > 1:
+        print("impl sweep: " + ", ".join(
+            f"{n}={t * 1e3:.1f}ms" for n, t in sorted(best.items()))
             + f" -> {chosen}", file=sys.stderr)
-    else:
-        chosen = next(iter(chains))
-    chain = chains[chosen]
-
-    # best-of-REPEATS: the tunnel to the chip has time-varying load, so a
-    # single timing draw is ±25%; the min over a few draws tracks the
-    # kernel's actual cost
-    elapsed = min(_timed(chain, test, train) for _ in range(REPEATS))
+    elapsed = best[chosen]
     rows_per_sec = M_TEST * ITERS / elapsed
 
     vs_baseline = 1.0
