@@ -471,6 +471,20 @@ def predict_states(model: HmmModel, obs_rows: Sequence[Sequence[str]],
     return out
 
 
+def _encode_one(obs_row: Sequence[str], observations: Sequence[str]
+                ) -> list:
+    """Token codes for one row, with the vocabulary error message the
+    padded-batch encoder gives (a bare KeyError names the symbol but not
+    the problem)."""
+    o_idx = {o: i for i, o in enumerate(observations)}
+    try:
+        return [o_idx[o] for o in obs_row]
+    except KeyError as exc:
+        raise ValueError(
+            f"observation {exc.args[0]!r} is not in the model's "
+            f"observation vocabulary") from None
+
+
 def score_long(model: HmmModel, obs_row: Sequence[str], *,
                mesh, axis_name: str = "data") -> float:
     """log P(observations) for ONE long sequence with the time axis sharded
@@ -479,8 +493,7 @@ def score_long(model: HmmModel, obs_row: Sequence[str], *,
     per-line DP cannot express either). Padding is masked inside the
     kernel."""
     from avenir_tpu.parallel.seqpar import forward_sharded
-    o_idx = {o: i for i, o in enumerate(model.observations)}
-    codes = [o_idx[o] for o in obs_row]
+    codes = _encode_one(obs_row, model.observations)
     if not codes:
         raise ValueError("cannot score an empty observation sequence")
     n_shards = mesh.shape[axis_name]
@@ -500,8 +513,7 @@ def predict_states_long(model: HmmModel, obs_row: Sequence[str], *,
     The sequence is right-padded to the axis size; padded steps are masked
     inside the kernel (max-plus identities) and dropped from the result."""
     from avenir_tpu.parallel.seqpar import viterbi_sharded
-    o_idx = {o: i for i, o in enumerate(model.observations)}
-    codes = [o_idx[o] for o in obs_row]
+    codes = _encode_one(obs_row, model.observations)
     if not codes:
         return []
     n_shards = mesh.shape[axis_name]
