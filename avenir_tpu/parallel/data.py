@@ -152,6 +152,32 @@ def _stream_global_rows(path: str, delim_regex: str, lo: int, hi: int,
         ordinal += 1
 
 
+def _pad_local_slice(start: int, stop: int, n_real: int, local_ids):
+    """Padding plan for one process's row slice [start, stop) of a file
+    with ``n_real`` real rows: (prep(array)->padded array, mask [stop-start]
+    f32, padded ids). The featurized slice held rows
+    [min(start, n_real), min(stop, n_real)) — or just the global LAST real
+    row when the slice is entirely padding — and every padding row is a
+    masked copy of that last row (identical semantics on every path).
+    Pure, so the all-padding branch is unit-testable without a multi-host
+    run."""
+    n_need = stop - start
+    n_have = min(stop, n_real) - min(start, n_real)
+
+    def prep(a):
+        if start >= n_real:            # all-padding: replicate the prototype
+            return np.repeat(a[-1:], n_need, axis=0)
+        if n_need > n_have:            # tail padding: copies of the last row
+            width = ((0, n_need - n_have),) + ((0, 0),) * (a.ndim - 1)
+            return np.pad(a, width, mode="edge")
+        return a
+
+    mask = ((start + np.arange(n_need)) < n_real).astype(np.float32)
+    ids = (list(local_ids) + [local_ids[-1]] * (n_need - len(local_ids))
+           if start < n_real else [local_ids[-1]] * n_need)
+    return prep, mask, ids
+
+
 def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
                        axis: str = DATA_AXIS, delim_regex: str = ",",
                        with_labels: bool = True,
@@ -218,21 +244,7 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
     binned, numeric, labels, local_ids = fz.transform_chunked_arrays(
         _stream_global_rows(path, delim_regex, lo, hi, prefix, windows),
         with_labels=with_labels, chunk_rows=chunk_rows)
-
-    n_need = stop - start
-    n_have = hi - lo
-
-    def prep(a):
-        if start >= n_real:            # all-padding: replicate the prototype
-            return np.repeat(a[-1:], n_need, axis=0)
-        if n_need > n_have:            # tail padding: copies of the last row
-            width = ((0, n_need - n_have),) + ((0, 0),) * (a.ndim - 1)
-            return np.pad(a, width, mode="edge")
-        return a
-
-    mask = ((start + np.arange(n_need)) < n_real).astype(np.float32)
-    ids = (local_ids + [local_ids[-1]] * (n_need - len(local_ids))
-           if start < n_real else [local_ids[-1]] * n_need)
+    prep, mask, ids = _pad_local_slice(start, stop, n_real, local_ids)
     # schema metadata via a zero-row table (nothing shipped to the device)
     meta = fz.table_from_arrays(
         binned[:0], numeric[:0],

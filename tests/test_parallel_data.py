@@ -137,6 +137,39 @@ class TestByteWindowStreaming:
         assert peak_stream < peak_inmem / 2, (peak_stream, peak_inmem)
 
 
+class TestPadLocalSlice:
+    """The per-process padding plan, incl. the all-padding slice a wide
+    mesh can hand a tail process (unreachable in a 2-process test run)."""
+
+    def _apply(self, start, stop, n_real, ids, arr):
+        from avenir_tpu.parallel.data import _pad_local_slice
+        prep, mask, out_ids = _pad_local_slice(start, stop, n_real, ids)
+        return prep(arr), mask, out_ids
+
+    def test_no_padding(self):
+        a, mask, ids = self._apply(0, 3, 10, ["a", "b", "c"],
+                                   np.arange(3)[:, None])
+        np.testing.assert_array_equal(a[:, 0], [0, 1, 2])
+        assert mask.tolist() == [1, 1, 1] and ids == ["a", "b", "c"]
+
+    def test_tail_padding(self):
+        # slice [8, 12) of a 10-row file: 2 real + 2 copies of the last
+        a, mask, ids = self._apply(8, 12, 10, ["x", "y"],
+                                   np.asarray([[8], [9]]))
+        np.testing.assert_array_equal(a[:, 0], [8, 9, 9, 9])
+        assert mask.tolist() == [1, 1, 0, 0]
+        assert ids == ["x", "y", "y", "y"]
+
+    def test_all_padding_slice(self):
+        # slice [12, 16) entirely past a 10-row file: the process holds
+        # only the prototype (global last row), replicated and fully masked
+        a, mask, ids = self._apply(12, 16, 10, ["last"],
+                                   np.asarray([[9]]))
+        np.testing.assert_array_equal(a[:, 0], [9, 9, 9, 9])
+        assert mask.tolist() == [0, 0, 0, 0]
+        assert ids == ["last"] * 4
+
+
 def test_load_sharded_matches_local(mesh, churn_fixture):
     rows, path, fz = churn_fixture
     st = load_sharded_table(fz, path, mesh)
