@@ -301,6 +301,35 @@ def bench_grouped_bandit_decisions() -> None:
                      "(state leaves read+write)")
 
 
+def bench_baum_welch() -> None:
+    """Unsupervised HMM training at a CI-scaled Markov-tutorial shape
+    (the full 80k-seq measurement lives in scripts/bw_scale.py /
+    BASELINE.md); chunked EM dispatches, one readback per chunk."""
+    from avenir_tpu.models.hmm import train_baum_welch
+    rng = np.random.default_rng(0)
+    n_seqs, t_len, s, o = 8192, 21, 3, 9
+    names = [f"o{i}" for i in range(o)]
+    rows = [[names[rng.integers(o)] for _ in range(t_len)]
+            for _ in range(n_seqs)]
+    n_iters = 10
+    train_baum_welch(rows, names, s, n_iters=n_iters, seed=1)  # compile
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        train_baum_welch(rows, names, s, n_iters=n_iters, seed=1)
+        best = min(best, time.perf_counter() - t0)
+    # VPU model: the log-space forward-backward + xi/gamma accumulation
+    # costs roughly 30 f32 ops per (t, s, s') cell per iteration
+    vpu_ops = 4 * 8 * 128 * (197e12 / (2 * 128 * 128 * 4))
+    ops_per_seq_iter = t_len * s * s * 30
+    emit("baum_welch_seq_iterations_per_sec",
+         n_seqs * n_iters / best,
+         f"seq-iterations/sec ({n_seqs} seqs x T={t_len}, S={s}, O={o})",
+         bound=vpu_ops / ops_per_seq_iter,
+         bound_model=f"VPU f32, ~{ops_per_seq_iter} ops/seq-iteration "
+                     "(forward-backward + xi/gamma)")
+
+
 if __name__ == "__main__":
     bench_naive_bayes()
     bench_knn()
@@ -309,3 +338,4 @@ if __name__ == "__main__":
     bench_markov_train()
     bench_bandit_decisions()
     bench_grouped_bandit_decisions()
+    bench_baum_welch()
