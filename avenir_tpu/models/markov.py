@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from avenir_tpu.utils.metrics import ConfusionMatrix
 from avenir_tpu.utils.tables import laplace_and_scale
@@ -64,18 +65,38 @@ def _bigram_counts(seqs: jnp.ndarray, lengths: jnp.ndarray,
                    n_states: int, n_classes: int) -> jnp.ndarray:
     """[B, T] padded sequences -> [n_classes, S, S] transition counts
     (n_classes=1 for the global model). One fused contraction: combiner,
-    shuffle and reducer of the reference in a single einsum."""
+    shuffle and reducer of the reference in a single matmul.
+
+    Formulation (round 3, measured interleaved on-chip,
+    scripts/exp_markov_variants2.txt): FLATTEN the (batch, time) axes and
+    contract [N, (C·)S] x [N, S] bf16 one-hots with f32 accumulation —
+    1.56x the batched "bc,bts,btu->csu" f32 einsum round 2 settled on
+    (bf16 alone on the batched form changed nothing; flatten + bf16
+    together is what pays). One-hot values are exact in bf16 and the MXU
+    accumulates f32, so counts are exact below 2^24 per cell — the same
+    envelope the f32 einsum had. The mask and (for class-conditional
+    models) the class id fold into the source one-hot via a combined
+    (class, state) index — measured 2.9x the old three-operand einsum at
+    C=2 (width C·S stays additive-comparable; the combined-index losing
+    regime starts when the combination squares, PERF_NOTES round-2
+    rule)."""
     src, dst = seqs[:, :-1], seqs[:, 1:]
-    bsz, tm1 = src.shape
+    tm1 = src.shape[1]
     pos = jnp.arange(tm1)[None, :]
-    mask = (pos + 1 < lengths[:, None]).astype(jnp.float32)      # [B, T-1]
-    oh_src = jax.nn.one_hot(src, n_states, dtype=jnp.float32) * mask[..., None]
-    oh_dst = jax.nn.one_hot(dst, n_states, dtype=jnp.float32)
+    mask = pos + 1 < lengths[:, None]                            # [B, T-1]
     if class_ids is None:
-        oh_cls = jnp.ones((bsz, 1), jnp.float32)
+        lhs_id = src
+        lhs_width = n_states
     else:
-        oh_cls = jax.nn.one_hot(class_ids, n_classes, dtype=jnp.float32)
-    return jnp.einsum("bc,bts,btu->csu", oh_cls, oh_src, oh_dst)
+        lhs_id = class_ids[:, None] * n_states + src
+        lhs_width = n_classes * n_states
+    oh_lhs = (jax.nn.one_hot(lhs_id.reshape(-1), lhs_width,
+                             dtype=jnp.bfloat16)
+              * mask.reshape(-1)[:, None].astype(jnp.bfloat16))
+    oh_dst = jax.nn.one_hot(dst.reshape(-1), n_states, dtype=jnp.bfloat16)
+    counts = lax.dot_general(oh_lhs, oh_dst, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return counts.reshape(n_classes, n_states, n_states)
 
 
 def train(sequences: Sequence[Sequence[str]], states: List[str],

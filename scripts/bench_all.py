@@ -312,12 +312,8 @@ def bench_baum_welch() -> None:
     rows = [[names[rng.integers(o)] for _ in range(t_len)]
             for _ in range(n_seqs)]
     n_iters = 10
-    train_baum_welch(rows, names, s, n_iters=n_iters, seed=1)  # compile
-    best = float("inf")
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        train_baum_welch(rows, names, s, n_iters=n_iters, seed=1)
-        best = min(best, time.perf_counter() - t0)
+    best = timed(lambda: train_baum_welch(rows, names, s,
+                                          n_iters=n_iters, seed=1)[1])
     # VPU model: the log-space forward-backward + xi/gamma accumulation
     # costs roughly 30 f32 ops per (t, s, s') cell per iteration
     vpu_ops = 4 * 8 * 128 * (197e12 / (2 * 128 * 128 * 4))

@@ -1,0 +1,104 @@
+"""Markov bigram kernel, round-3 variants (round 2's campaign tried the
+combined-index form and bf16 one-hots — both negative; these are the two
+shapes it did not try).
+
+Arms (same-run interleaved, best-of):
+  prod       production einsum "bc,bts,btu->csu" (f32 one-hots)
+  flat       batch/time axes flattened to one [N, S] x [N, S] matmul
+  flat_bf16  same with bf16 one-hots, f32 accumulation
+  flat_int8  same with int8 one-hots, int32 accumulation (MXU int8 path)
+
+Run: PYTHONPATH=. python -u scripts/exp_markov_variants2.py
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from avenir_tpu.models.markov import _bigram_counts
+
+B, T, S = 81_920, 64, 9
+ITERS = 50
+ROUNDS = 5
+
+
+def _masked_pairs(seqs, lengths):
+    src, dst = seqs[:, :-1], seqs[:, 1:]
+    pos = jnp.arange(T - 1)[None, :]
+    mask = (pos + 1 < lengths[:, None])
+    return src.reshape(-1), dst.reshape(-1), mask.reshape(-1)
+
+
+@partial(jax.jit, static_argnames=("n_states", "dtype_name"))
+def flat_counts(seqs, lengths, *, n_states, dtype_name="f32"):
+    src, dst, mask = _masked_pairs(seqs, lengths)
+    dt = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+          "int8": jnp.int8}[dtype_name]
+    acc = jnp.int32 if dtype_name == "int8" else jnp.float32
+    oh_src = jax.nn.one_hot(src, n_states, dtype=dt)
+    oh_src = oh_src * mask[:, None].astype(dt) if dt != jnp.int8 else (
+        oh_src * mask[:, None].astype(dt))
+    oh_dst = jax.nn.one_hot(dst, n_states, dtype=dt)
+    out = lax.dot_general(oh_src, oh_dst, (((0,), (0,)), ((), ())),
+                          preferred_element_type=acc)
+    return out.astype(jnp.float32)[None]
+
+
+def chain_for(fn, seqs, lengths):
+    @jax.jit
+    def chain(ln):
+        def body(l, _):
+            counts = fn(seqs, l)
+            tot = jnp.sum(counts).astype(jnp.int32)
+            return l + jnp.minimum(tot, 0), counts.reshape(-1)[0]
+        return lax.scan(body, ln, None, length=ITERS)[1]
+    np.asarray(chain(lengths))
+    return chain
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    seqs = jnp.asarray(rng.integers(0, S, (B, T)), jnp.int32)
+    lengths = jnp.asarray(rng.integers(2, T + 1, B), jnp.int32)
+
+    arms = {
+        "prod": lambda s, l: _bigram_counts(s, l, None, S, 1),
+        "flat": lambda s, l: flat_counts(s, l, n_states=S),
+        "flat_bf16": lambda s, l: flat_counts(s, l, n_states=S,
+                                              dtype_name="bf16"),
+        "flat_int8": lambda s, l: flat_counts(s, l, n_states=S,
+                                              dtype_name="int8"),
+    }
+    ref = np.asarray(arms["prod"](seqs, lengths))
+    chains = {}
+    for name, fn in arms.items():
+        try:
+            got = np.asarray(fn(seqs, lengths))
+            assert np.allclose(got, ref), f"{name} wrong counts"
+            chains[name] = chain_for(fn, seqs, lengths)
+            print(f"{name:10s} compiled + correct", flush=True)
+        except Exception as exc:
+            print(f"{name:10s} FAILED: {type(exc).__name__}: "
+                  f"{str(exc).splitlines()[0][:110]}", flush=True)
+
+    best = {n: float("inf") for n in chains}
+    for _ in range(ROUNDS):
+        for name, chain in chains.items():
+            t0 = time.perf_counter()
+            np.asarray(chain(lengths))
+            best[name] = min(best[name], time.perf_counter() - t0)
+    print(f"\n# {B} seqs x T={T}, S={S}, {ITERS} iters, best of {ROUNDS} "
+          f"interleaved", flush=True)
+    anchor = best.get("prod", float("nan"))
+    for name, t in sorted(best.items(), key=lambda kv: kv[1]):
+        print(f"{name:10s} {t*1e3:8.1f} ms  {B*ITERS/t/1e6:7.1f} M seqs/s"
+              f"  {anchor/t:5.2f}x prod", flush=True)
+
+
+if __name__ == "__main__":
+    main()
