@@ -67,19 +67,21 @@ def _bigram_counts(seqs: jnp.ndarray, lengths: jnp.ndarray,
     (n_classes=1 for the global model). One fused contraction: combiner,
     shuffle and reducer of the reference in a single matmul.
 
-    Formulation (round 3, measured interleaved on-chip,
-    scripts/exp_markov_variants2.txt): FLATTEN the (batch, time) axes and
-    contract [N, (C·)S] x [N, S] bf16 one-hots with f32 accumulation —
-    1.56x the batched "bc,bts,btu->csu" f32 einsum round 2 settled on
-    (bf16 alone on the batched form changed nothing; flatten + bf16
-    together is what pays). One-hot values are exact in bf16 and the MXU
-    accumulates f32, so counts are exact below 2^24 per cell — the same
-    envelope the f32 einsum had. The mask and (for class-conditional
-    models) the class id fold into the source one-hot via a combined
-    (class, state) index — measured 2.9x the old three-operand einsum at
-    C=2 (width C·S stays additive-comparable; the combined-index losing
-    regime starts when the combination squares, PERF_NOTES round-2
-    rule)."""
+    Formulation (round 3, measured interleaved on-chip against the
+    round-2 kernel — kept as the explicit ``old_einsum`` baseline arm in
+    scripts/exp_markov_variants2.py so the comparison reproduces):
+    FLATTEN the (batch, time) axes and contract [N, (C·)S] x [N, S] bf16
+    one-hots with f32 accumulation. Measured 1.13x-1.56x the batched
+    "bc,bts,btu->csu" f32 einsum across same-run interleaved sessions
+    (never slower; the gap itself moves with relay mood — bf16 alone on
+    the batched form had changed nothing, flatten + bf16 together is what
+    pays). One-hot values are exact in bf16 and the MXU accumulates f32,
+    so counts are exact below 2^24 per cell — the same envelope the f32
+    einsum had. The mask and (for class-conditional models) the class id
+    fold into the source one-hot via a combined (class, state) index —
+    2.4x-2.9x the old three-operand einsum at C=2 (width C·S stays
+    additive-comparable; the combined-index losing regime starts when the
+    combination squares, PERF_NOTES round-2 rule)."""
     src, dst = seqs[:, :-1], seqs[:, 1:]
     tm1 = src.shape[1]
     pos = jnp.arange(tm1)[None, :]

@@ -1,12 +1,21 @@
-"""Markov bigram kernel, round-3 variants (round 2's campaign tried the
-combined-index form and bf16 one-hots — both negative; these are the two
-shapes it did not try).
+"""Markov bigram kernel, round-3 variants.
 
-Arms (same-run interleaved, best-of):
-  prod       production einsum "bc,bts,btu->csu" (f32 one-hots)
-  flat       batch/time axes flattened to one [N, S] x [N, S] matmul
-  flat_bf16  same with bf16 one-hots, f32 accumulation
-  flat_int8  same with int8 one-hots, int32 accumulation (MXU int8 path)
+Round 2's campaign tried the combined-index form and bf16 one-hots on the
+BATCHED "bc,bts,btu->csu" einsum — both negative. These arms flatten the
+(batch, time) axes into ONE [N, S] x [N, S] contraction first:
+
+  old_einsum   the round-2 production kernel (batched f32 einsum),
+               defined here explicitly so this comparison reproduces even
+               though production has since adopted the winner
+  prod         the CURRENT production _bigram_counts (after round 3's
+               adoption this is the flattened bf16 matmul)
+  flat_f32     flattened matmul, f32 one-hots
+  flat_int8    flattened matmul, int8 one-hots, int32 MXU accumulation
+
+A second section compares the class-conditional (C=2) paths: the old
+three-operand einsum vs production's combined (class, state) source index.
+All counts are asserted identical before timing; timing is same-run
+interleaved, best-of.
 
 Run: PYTHONPATH=. python -u scripts/exp_markov_variants2.py
 """
@@ -22,28 +31,38 @@ from jax import lax
 
 from avenir_tpu.models.markov import _bigram_counts
 
-B, T, S = 81_920, 64, 9
+B, T, S, C = 81_920, 64, 9, 2
 ITERS = 50
 ROUNDS = 5
 
 
-def _masked_pairs(seqs, lengths):
+@partial(jax.jit, static_argnames=("n_states", "n_classes"))
+def old_einsum(seqs, lengths, class_ids, *, n_states, n_classes):
+    """The round-2 production kernel, verbatim: batched f32 einsum."""
     src, dst = seqs[:, :-1], seqs[:, 1:]
-    pos = jnp.arange(T - 1)[None, :]
-    mask = (pos + 1 < lengths[:, None])
-    return src.reshape(-1), dst.reshape(-1), mask.reshape(-1)
+    bsz = src.shape[0]
+    pos = jnp.arange(src.shape[1])[None, :]
+    mask = (pos + 1 < lengths[:, None]).astype(jnp.float32)
+    oh_src = (jax.nn.one_hot(src, n_states, dtype=jnp.float32)
+              * mask[..., None])
+    oh_dst = jax.nn.one_hot(dst, n_states, dtype=jnp.float32)
+    if class_ids is None:
+        oh_cls = jnp.ones((bsz, 1), jnp.float32)
+    else:
+        oh_cls = jax.nn.one_hot(class_ids, n_classes, dtype=jnp.float32)
+    return jnp.einsum("bc,bts,btu->csu", oh_cls, oh_src, oh_dst)
 
 
 @partial(jax.jit, static_argnames=("n_states", "dtype_name"))
-def flat_counts(seqs, lengths, *, n_states, dtype_name="f32"):
-    src, dst, mask = _masked_pairs(seqs, lengths)
-    dt = {"f32": jnp.float32, "bf16": jnp.bfloat16,
-          "int8": jnp.int8}[dtype_name]
+def flat_counts(seqs, lengths, *, n_states, dtype_name):
+    src, dst = seqs[:, :-1], seqs[:, 1:]
+    pos = jnp.arange(T - 1)[None, :]
+    mask = (pos + 1 < lengths[:, None]).reshape(-1)
+    dt = {"f32": jnp.float32, "int8": jnp.int8}[dtype_name]
     acc = jnp.int32 if dtype_name == "int8" else jnp.float32
-    oh_src = jax.nn.one_hot(src, n_states, dtype=dt)
-    oh_src = oh_src * mask[:, None].astype(dt) if dt != jnp.int8 else (
-        oh_src * mask[:, None].astype(dt))
-    oh_dst = jax.nn.one_hot(dst, n_states, dtype=dt)
+    oh_src = (jax.nn.one_hot(src.reshape(-1), n_states, dtype=dt)
+              * mask[:, None].astype(dt))
+    oh_dst = jax.nn.one_hot(dst.reshape(-1), n_states, dtype=dt)
     out = lax.dot_general(oh_src, oh_dst, (((0,), (0,)), ((), ())),
                           preferred_element_type=acc)
     return out.astype(jnp.float32)[None]
@@ -61,43 +80,50 @@ def chain_for(fn, seqs, lengths):
     return chain
 
 
-def main() -> None:
-    rng = np.random.default_rng(0)
-    seqs = jnp.asarray(rng.integers(0, S, (B, T)), jnp.int32)
-    lengths = jnp.asarray(rng.integers(2, T + 1, B), jnp.int32)
-
-    arms = {
-        "prod": lambda s, l: _bigram_counts(s, l, None, S, 1),
-        "flat": lambda s, l: flat_counts(s, l, n_states=S),
-        "flat_bf16": lambda s, l: flat_counts(s, l, n_states=S,
-                                              dtype_name="bf16"),
-        "flat_int8": lambda s, l: flat_counts(s, l, n_states=S,
-                                              dtype_name="int8"),
-    }
-    ref = np.asarray(arms["prod"](seqs, lengths))
+def run_section(title, arms, seqs, lengths, anchor_name):
+    ref = None
     chains = {}
     for name, fn in arms.items():
-        try:
-            got = np.asarray(fn(seqs, lengths))
-            assert np.allclose(got, ref), f"{name} wrong counts"
-            chains[name] = chain_for(fn, seqs, lengths)
-            print(f"{name:10s} compiled + correct", flush=True)
-        except Exception as exc:
-            print(f"{name:10s} FAILED: {type(exc).__name__}: "
-                  f"{str(exc).splitlines()[0][:110]}", flush=True)
-
+        got = np.asarray(fn(seqs, lengths))
+        if ref is None:
+            ref = got
+        assert np.allclose(got, ref), f"{name} wrong counts"
+        chains[name] = chain_for(fn, seqs, lengths)
     best = {n: float("inf") for n in chains}
     for _ in range(ROUNDS):
         for name, chain in chains.items():
             t0 = time.perf_counter()
             np.asarray(chain(lengths))
             best[name] = min(best[name], time.perf_counter() - t0)
-    print(f"\n# {B} seqs x T={T}, S={S}, {ITERS} iters, best of {ROUNDS} "
-          f"interleaved", flush=True)
-    anchor = best.get("prod", float("nan"))
+    print(f"\n# {title}: {B} seqs x T={T}, S={S}, {ITERS} iters, "
+          f"best of {ROUNDS} interleaved (counts identical)", flush=True)
+    anchor = best[anchor_name]
     for name, t in sorted(best.items(), key=lambda kv: kv[1]):
-        print(f"{name:10s} {t*1e3:8.1f} ms  {B*ITERS/t/1e6:7.1f} M seqs/s"
-              f"  {anchor/t:5.2f}x prod", flush=True)
+        print(f"{name:12s} {t*1e3:8.1f} ms  {B*ITERS/t/1e6:7.1f} M seqs/s"
+              f"  {anchor/t:5.2f}x {anchor_name}", flush=True)
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    seqs = jnp.asarray(rng.integers(0, S, (B, T)), jnp.int32)
+    lengths = jnp.asarray(rng.integers(2, T + 1, B), jnp.int32)
+    cls = jnp.asarray(rng.integers(0, C, B), jnp.int32)
+
+    run_section("GLOBAL model", {
+        "old_einsum": lambda s, l: old_einsum(s, l, None, n_states=S,
+                                              n_classes=1),
+        "prod": lambda s, l: _bigram_counts(s, l, None, S, 1),
+        "flat_f32": lambda s, l: flat_counts(s, l, n_states=S,
+                                             dtype_name="f32"),
+        "flat_int8": lambda s, l: flat_counts(s, l, n_states=S,
+                                              dtype_name="int8"),
+    }, seqs, lengths, "old_einsum")
+
+    run_section(f"CLASS-CONDITIONAL (C={C})", {
+        "old_einsum": lambda s, l: old_einsum(s, l, cls, n_states=S,
+                                              n_classes=C),
+        "prod": lambda s, l: _bigram_counts(s, l, cls, S, C),
+    }, seqs, lengths, "old_einsum")
 
 
 if __name__ == "__main__":
