@@ -84,11 +84,19 @@ def run_section(title, arms, seqs, lengths, anchor_name):
     ref = None
     chains = {}
     for name, fn in arms.items():
-        got = np.asarray(fn(seqs, lengths))
-        if ref is None:
-            ref = got
-        assert np.allclose(got, ref), f"{name} wrong counts"
-        chains[name] = chain_for(fn, seqs, lengths)
+        try:
+            got = np.asarray(fn(seqs, lengths))
+            if ref is None:
+                ref = got
+            assert np.allclose(got, ref), f"{name} wrong counts"
+            chains[name] = chain_for(fn, seqs, lengths)
+        except Exception as exc:   # e.g. int8 MXU unsupported off-TPU:
+            print(f"{name:12s} FAILED: {type(exc).__name__}: "
+                  f"{str(exc).splitlines()[0][:110]}", flush=True)
+    if anchor_name not in chains:
+        print(f"# {title}: anchor {anchor_name} unavailable — skipped",
+              flush=True)
+        return
     best = {n: float("inf") for n in chains}
     for _ in range(ROUNDS):
         for name, chain in chains.items():
