@@ -35,11 +35,16 @@ timing, anchored on the XLA ``approx_min_k`` path):
   time scales with tile_m·tile_n fold work on top of the fixed cost; and
   halving the accumulator blocks (n_acc=2) makes it *slower* — the
   read-modify-write chains on the accumulators bind before raw VPU ops;
-- at the production tile the kernel reaches ~25-31% of the padded-K=128
-  MXU slab ceiling (197 TFLOP/s datasheet → 7.7e11 pairs/s), ~12-15%
-  of HBM, and ~21% of the 6-op VPU-fold ceiling (round-3 accounting,
-  scripts/roofline_knn_results.txt) — none saturates *because* the fold's
-  serialized RMW structure holds them. ROUND-3 UPDATE (jax 0.9): this
+- TRANSPORT-FREE utilization (round-3 differential accounting,
+  scripts/roofline_knn_results.txt — earlier bulk numbers folded the
+  relay's ~100ms per-call cost into the kernel): the production tile
+  reaches ~54-77% of the padded-K=128 MXU slab ceiling and ~40-54% of
+  the 6-op VPU-fold ceiling, snapshot-dependent under shared-chip
+  contention (kernel time itself ranged 685-968µs/iter same-day,
+  sweep14). The padded DOT, not the fold, is the larger cost once
+  transport is removed; the transposed-contraction escape measured
+  1.37× in one run and 0.89× in the gated re-run — inside the
+  contention band, not adopted. ROUND-3 UPDATE (jax 0.9): this
   kernel and the XLA ``approx_min_k`` path TRADE PLACES run-to-run
   (0.96×–1.22× same day, interleaved — scripts/sweep11-13_results.txt);
   bench.py gates both against exact and auto-selects per run. Raising

@@ -282,6 +282,10 @@ def _launch(variant: str, x, y):
 
 
 def _time_variant(variant: str, test, train) -> float:
+    """Pure per-ITERS kernel time, measured DIFFERENTIALLY (chains of
+    ITERS and 4*ITERS, extra time / 3): the relay's ~100ms fixed per-call
+    cost otherwise dominates these ~100-300ms chains and compresses every
+    utilization column (round-3 PERF_NOTES 'fixed-cost contamination')."""
     if variant == "xla":
         def run(t):
             return pairwise_topk(t, train, k=K, mode="fast")[0]
@@ -289,18 +293,24 @@ def _time_variant(variant: str, test, train) -> float:
         def run(t):
             return _launch(variant, t, train)[0]
 
-    @jax.jit
-    def chain(t):
-        def body(t, _):
-            d = run(t)
-            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
-            return t + eps, d[0, 0]
-        _, outs = lax.scan(body, t, None, length=ITERS)
-        return outs
+    def chain_for(n_iters):
+        @jax.jit
+        def chain(t):
+            def body(t, _):
+                d = run(t)
+                eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+                return t + eps, d[0, 0]
+            _, outs = lax.scan(body, t, None, length=n_iters)
+            return outs
+        np.asarray(chain(test))      # compile + warm
+        return chain
 
-    np.asarray(chain(test))          # compile + warm
-    best = min(_time(chain, test) for _ in range(REPEATS))
-    return best
+    c_lo, c_hi = chain_for(ITERS), chain_for(4 * ITERS)
+    t_lo = min(_time(c_lo, test) for _ in range(REPEATS))
+    t_hi = min(_time(c_hi, test) for _ in range(REPEATS))
+    if t_hi - t_lo < 0.2 * t_hi:     # noise guard: fall back to bulk
+        return t_hi / 4
+    return (t_hi - t_lo) / 3
 
 
 def _time(chain, test) -> float:
