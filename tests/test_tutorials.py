@@ -896,3 +896,153 @@ class TestBayesArbitrationCli:
         tags = {l.rsplit(",", 1)[-1]
                 for l in open(tmp_path / "pred.txt").read().splitlines()}
         assert tags == {"ambiguous", "classified"}  # both outcomes present
+
+
+class TestRemainingVerbPlumbing:
+    """CLI-level coverage for the seven verbs whose library cores were
+    tested but whose verb plumbing (key parsing, IO formats) was not."""
+
+    def _bandit_rows(self, tmp_path, seed=4):
+        rng = np.random.default_rng(seed)
+        lines = []
+        for g in ("g0", "g1", "g2"):
+            for i, a in enumerate(("a0", "a1", "a2", "a3")):
+                lines.append([g, a, str(int(rng.integers(3, 20))),
+                              str(int(30 + 10 * i))])
+        write_csv(tmp_path / "agg.txt", lines)
+        props = tmp_path / "b.properties"
+        write_props(props, **{"field.delim.regex": ",",
+                              "current.round.num": "50"})
+        return props
+
+    @pytest.mark.parametrize("verb", ["SoftMaxBandit",
+                                      "RandomFirstGreedyBandit"])
+    def test_batch_bandit_verbs(self, verb, tmp_path, capsys):
+        props = self._bandit_rows(tmp_path)
+        cli([verb, str(tmp_path / "agg.txt"), str(tmp_path / "sel.txt"),
+             "--conf", str(props)])
+        sels = [l.split(",") for l in
+                open(tmp_path / "sel.txt").read().splitlines()]
+        assert sels and {s[0] for s in sels} == {"g0", "g1", "g2"}
+        assert all(s[1] in ("a0", "a1", "a2", "a3") for s in sels)
+
+    def test_heterogeneity_reduction_correlation(self, tmp_path, capsys):
+        rng = np.random.default_rng(7)
+        rows = []
+        for _ in range(800):
+            a = rng.choice(["x", "y"])
+            b = a if rng.random() < 0.9 else rng.choice(["x", "y"])
+            c = rng.choice(["p", "q"])            # independent
+            rows.append([a, b, c])
+        write_csv(tmp_path / "d.csv", rows)
+        schema = {"entity": {"name": "t", "fields": [
+            {"name": "a", "ordinal": 0, "dataType": "categorical",
+             "feature": True, "cardinality": ["x", "y"]},
+            {"name": "b", "ordinal": 1, "dataType": "categorical",
+             "feature": True, "cardinality": ["x", "y"]},
+            {"name": "c", "ordinal": 2, "dataType": "categorical",
+             "feature": True, "cardinality": ["p", "q"]}]}}
+        with open(tmp_path / "s.json", "w") as fh:
+            json.dump(schema, fh)
+        props = tmp_path / "h.properties"
+        write_props(props, **{"field.delim.regex": ",",
+                              "feature.schema.file.path": tmp_path / "s.json",
+                              "correlation.attr.pairs": "0:1,0:2"})
+        # default algorithm = concentrationCoeff (the verb's registration);
+        # uncertaintyCoeff is the other reference hook
+        cli(["HeterogeneityReductionCorrelation", str(tmp_path / "d.csv"),
+             str(tmp_path / "corr.txt"), "--conf", str(props)])
+        out = {tuple(l.split(",")[:2]): float(l.split(",")[2])
+               for l in open(tmp_path / "corr.txt").read().splitlines()}
+        assert out[("0", "1")] > out[("0", "2")]  # dependence detected
+        cli(["HeterogeneityReductionCorrelation", str(tmp_path / "d.csv"),
+             str(tmp_path / "corr2.txt"), "--conf", str(props),
+             "-D", "correlation.algorithm=uncertaintyCoeff"])
+        out2 = {tuple(l.split(",")[:2]): float(l.split(",")[2])
+                for l in open(tmp_path / "corr2.txt").read().splitlines()}
+        assert out2[("0", "1")] > out2[("0", "2")]
+
+    def test_under_sampling_balancer(self, tmp_path):
+        rows = [[f"i{i}", "maj" if i % 10 else "min"] for i in range(500)]
+        write_csv(tmp_path / "d.csv", rows)
+        props = tmp_path / "u.properties"
+        write_props(props, **{"field.delim.regex": ",",
+                              "class.attr.ord": "1"})
+        cli(["UnderSamplingBalancer", str(tmp_path / "d.csv"),
+             str(tmp_path / "out.csv"), "--conf", str(props)])
+        kept = [l.split(",") for l in
+                open(tmp_path / "out.csv").read().splitlines()]
+        src = {",".join(r) for r in rows}
+        assert all(",".join(k) in src for k in kept)   # subset of input
+        n_min = sum(1 for k in kept if k[1] == "min")
+        n_maj = sum(1 for k in kept if k[1] == "maj")
+        assert n_min == 50                              # minority intact
+        assert n_maj < 250                              # majority reduced
+
+    def test_bagging_sampler(self, tmp_path):
+        rows = [[f"i{i}", str(i)] for i in range(300)]
+        write_csv(tmp_path / "d.csv", rows)
+        props = tmp_path / "g.properties"
+        write_props(props, **{"field.delim.regex": ",",
+                              "batch.size": "100"})
+        cli(["BaggingSampler", str(tmp_path / "d.csv"),
+             str(tmp_path / "out.csv"), "--conf", str(props)])
+        out = open(tmp_path / "out.csv").read().splitlines()
+        src = {",".join(r) for r in rows}
+        assert len(out) == 300 and all(l in src for l in out)
+        assert len(set(out)) < 300        # with-replacement: duplicates
+
+    def test_logistic_regression_job(self, tmp_path, capsys):
+        rng = np.random.default_rng(3)
+        rows = []
+        for _ in range(600):
+            x1, x2 = rng.normal(0, 1, 2)
+            label = "pos" if x1 + 0.5 * x2 > 0 else "neg"
+            rows.append([f"{x1:.4f}", f"{x2:.4f}", label])
+        write_csv(tmp_path / "d.csv", rows)
+        props = tmp_path / "l.properties"
+        write_props(props, **{
+            "field.delim.regex": ",",
+            "feature.field.ordinals": "0,1",
+            "class.attr.ord": "2",
+            "positive.class.value": "pos",
+            "iteration.limit": "200",
+            "coeff.file.path": tmp_path / "coeff.txt"})
+        cli(["LogisticRegressionJob", str(tmp_path / "d.csv"),
+             str(tmp_path / "w.txt"), "--conf", str(props)])
+        stats = last_json(capsys)
+        w = [float(v) for v in
+             open(tmp_path / "w.txt").read().strip().split(",")]
+        assert w[0] > 0 and stats["iterations"] > 1   # planted direction
+        assert (tmp_path / "coeff.txt").exists()      # resumable history
+        hist = open(tmp_path / "coeff.txt").read().splitlines()
+        assert len(hist) == stats["iterations"]
+
+    def test_fisher_discriminant(self, tmp_path):
+        rng = np.random.default_rng(5)
+        rows = []
+        for _ in range(400):
+            cls = rng.choice(["a", "b"])
+            v = rng.normal(0.0 if cls == "a" else 3.0, 1.0)
+            rows.append([f"i{len(rows)}", f"{v:.4f}", cls])
+        write_csv(tmp_path / "d.csv", rows)
+        schema = {"entity": {"name": "t", "fields": [
+            {"name": "id", "ordinal": 0, "dataType": "string", "id": True},
+            {"name": "v", "ordinal": 1, "dataType": "double",
+             "feature": True},
+            {"name": "cls", "ordinal": 2, "dataType": "categorical",
+             "classAttribute": True, "cardinality": ["a", "b"]}]}}
+        with open(tmp_path / "s.json", "w") as fh:
+            json.dump(schema, fh)
+        props = tmp_path / "f.properties"
+        write_props(props, **{"field.delim.regex": ",",
+                              "feature.schema.file.path":
+                              tmp_path / "s.json"})
+        cli(["FisherDiscriminant", str(tmp_path / "d.csv"),
+             str(tmp_path / "fd.txt"), "--conf", str(props)])
+        out = open(tmp_path / "fd.txt").read().splitlines()
+        assert out
+        # the planted boundary sits between the class means (~1.5)
+        fields = out[0].split(",")
+        boundary = float(fields[-1])
+        assert 0.5 < boundary < 2.5, out[0]
