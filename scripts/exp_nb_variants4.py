@@ -1,0 +1,125 @@
+"""NB kernel A/B, round 3b: differential (transport-free) timing.
+
+exp_nb_variants3 compared kernels in BULK terms, where the ~100ms fixed
+relay cost compresses gaps (PERF_NOTES "fixed-cost contamination"). With
+the true kernel time visible (~60us/iter), re-judge the formulation:
+
+  prod            combined-(class,bin) index bf16 one-hot, f32 column-sum
+  combined_int8   same with int8 one-hot, int32 accumulation
+  flat_matmul     [N*F] combined one-hot [N*F, C*B] contracted against a
+                  ones vector on the MXU (bf16, f32 accum)
+  two_onehot      the [N,C]x[N,F,B] einsum (round-2 loser, for reference)
+
+Counts asserted identical; timing differential over 200/1600-iter chains,
+same-run interleaved.
+
+Run: PYTHONPATH=. python -u scripts/exp_nb_variants4.py
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+N, F, BINS, CLASSES = 262_144, 5, 5, 2
+N_LO, N_HI = 200, 1600
+ROUNDS = 4
+
+
+@partial(jax.jit, static_argnames=("n_classes", "n_bins"))
+def prod(bins, labels, *, n_classes, n_bins):
+    valid = (bins >= 0) & (bins < n_bins)
+    cid = jnp.where(valid, labels[:, None] * n_bins + bins, -1)
+    oh = jax.nn.one_hot(cid, n_classes * n_bins, dtype=jnp.bfloat16)
+    flat = jnp.sum(oh, axis=0, dtype=jnp.float32)
+    return flat.reshape(bins.shape[1], n_classes, n_bins).transpose(1, 0, 2)
+
+
+@partial(jax.jit, static_argnames=("n_classes", "n_bins"))
+def combined_int8(bins, labels, *, n_classes, n_bins):
+    valid = (bins >= 0) & (bins < n_bins)
+    cid = jnp.where(valid, labels[:, None] * n_bins + bins, -1)
+    oh = jax.nn.one_hot(cid, n_classes * n_bins, dtype=jnp.int8)
+    flat = jnp.sum(oh.astype(jnp.int32), axis=0)
+    return flat.astype(jnp.float32).reshape(
+        bins.shape[1], n_classes, n_bins).transpose(1, 0, 2)
+
+
+@partial(jax.jit, static_argnames=("n_classes", "n_bins"))
+def flat_matmul(bins, labels, *, n_classes, n_bins):
+    valid = (bins >= 0) & (bins < n_bins)
+    cid = jnp.where(valid, labels[:, None] * n_bins + bins, -1)  # [N, F]
+    width = n_classes * n_bins
+    f = bins.shape[1]
+    # offset each feature into its own slot range -> one [N*F, F*C*B]
+    # one-hot contracted with ones on the MXU
+    fid = cid + jnp.arange(f)[None, :] * width
+    fid = jnp.where(cid >= 0, fid, -1).reshape(-1)
+    oh = jax.nn.one_hot(fid, f * width, dtype=jnp.bfloat16)
+    ones = jnp.ones((1, oh.shape[0]), jnp.bfloat16)
+    flat = lax.dot_general(ones, oh, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)[0]
+    return flat.reshape(f, n_classes, n_bins).transpose(1, 0, 2)
+
+
+@partial(jax.jit, static_argnames=("n_classes", "n_bins"))
+def two_onehot(bins, labels, *, n_classes, n_bins):
+    oh_label = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
+    oh_bins = jax.nn.one_hot(bins, n_bins, dtype=jnp.float32)
+    return jnp.einsum("nc,nfb->cfb", oh_label, oh_bins)
+
+
+def diff_time(fn, bins, labels):
+    def chain_for(n):
+        @jax.jit
+        def chain(lbl):
+            def body(l, _):
+                counts = fn(bins, l, n_classes=CLASSES, n_bins=BINS)
+                tot = jnp.sum(counts).astype(jnp.int32)
+                return l + jnp.minimum(tot, 0), counts[0, 0, 0]
+            return lax.scan(body, lbl, None, length=n)[1]
+        np.asarray(chain(labels))
+        return chain
+    c_lo, c_hi = chain_for(N_LO), chain_for(N_HI)
+    t_lo = min((lambda t0: (np.asarray(c_lo(labels)),
+                time.perf_counter() - t0)[1])(time.perf_counter())
+               for _ in range(ROUNDS))
+    t_hi = min((lambda t0: (np.asarray(c_hi(labels)),
+                time.perf_counter() - t0)[1])(time.perf_counter())
+               for _ in range(ROUNDS))
+    return (t_hi - t_lo) / (N_HI - N_LO)
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, BINS, (N, F)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, CLASSES, N), jnp.int32)
+
+    fns = {"prod": prod, "combined_int8": combined_int8,
+           "flat_matmul": flat_matmul, "two_onehot": two_onehot}
+    ref = None
+    for name, fn in fns.items():
+        got = np.asarray(fn(bins, labels, n_classes=CLASSES, n_bins=BINS))
+        if ref is None:
+            ref = got
+        assert np.allclose(got, ref), name
+    print("counts identical across variants", flush=True)
+    times = {}
+    for name, fn in fns.items():
+        times[name] = diff_time(fn, bins, labels)
+        print(f"{name:14s} measured", flush=True)
+    print(f"\n# {N} rows x {F} feats, differential {N_LO}/{N_HI} chains",
+          flush=True)
+    anchor = times["prod"]
+    for name, t in sorted(times.items(), key=lambda kv: kv[1]):
+        print(f"{name:14s} {t*1e6:7.2f} us/iter  "
+              f"{N/t/1e9:6.2f} G samples/s  {anchor/t:5.2f}x prod",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
