@@ -434,7 +434,8 @@ def _find_used_attributes(in_path: str) -> List[int]:
         if base.startswith("split="):
             cand = os.path.join(d, USED_ATTRS_SIDECAR)
             if os.path.isfile(cand):
-                text = open(cand).read().strip()
+                with open(cand) as fh:
+                    text = fh.read().strip()
                 return ([int(t) for t in text.split(",")] if text else [])
             return []
         if base != "data" and not base.startswith("segment="):

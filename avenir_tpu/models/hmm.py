@@ -152,18 +152,15 @@ def _encode_padded_batch(obs_rows: Sequence[Sequence[str]],
 # unsupervised training: Baum-Welch EM (completing the reference's contract)
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("n_states", "n_obs", "n_iters"))
-def _baum_welch_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
-                       seq_w: jnp.ndarray,
-                       li0: jnp.ndarray, lt0: jnp.ndarray, le0: jnp.ndarray,
-                       eps: jnp.ndarray,
-                       *, n_states: int, n_obs: int, n_iters: int):
-    """A CHUNK of EM iterations in one dispatch (log-space forward-backward,
-    vmapped over the padded [B, T] batch with length masks). Returns
-    (log initial, log trans, log emit, per-iteration total log-likelihood).
-    ``eps`` is the traced M-step count smoothing, so changing it never
-    recompiles; the host loop chains chunks and checks convergence between
-    them — one readback per chunk, like logistic's _train_chunk.
+def _bw_em_iter(obs, lengths, seq_w, eps, n_states, n_obs):
+    """Returns the ONE-EM-iteration closure ``em_iter((li,lt,le), _) ->
+    ((li',lt',le'), total weighted LL under the INPUT params)`` — the
+    shared core the chunked scan kernel and the while-loop kernel both
+    trace, so the two training paths cannot drift numerically.
+
+    E-step: log-space forward-backward vmapped over the padded [B, T]
+    batch with length masks. ``eps`` is the traced M-step count smoothing,
+    so changing it never recompiles.
 
     ``seq_w`` is a per-sequence weight (1 real / 0 batch-padding) folded
     into every expected count and the LL — which is also what makes the
@@ -231,9 +228,66 @@ def _baum_welch_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
         li_new = jnp.log(i_sum / jnp.sum(i_sum))
         return (li_new, lt_new, le_new), jnp.sum(lls * seq_w)
 
+    return em_iter
+
+
+@partial(jax.jit, static_argnames=("n_states", "n_obs", "n_iters"))
+def _baum_welch_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
+                       seq_w: jnp.ndarray,
+                       li0: jnp.ndarray, lt0: jnp.ndarray, le0: jnp.ndarray,
+                       eps: jnp.ndarray,
+                       *, n_states: int, n_obs: int, n_iters: int):
+    """A CHUNK of EM iterations in one dispatch; the host loop chains
+    chunks and checks convergence between them — one readback per chunk,
+    like logistic's _train_chunk. This is the CHECKPOINTING path (the host
+    can write a checkpoint between chunks); the single-dispatch
+    convergence path is :func:`_baum_welch_while_kernel`. Returns
+    (log initial, log trans, log emit, per-iteration total LL)."""
+    em_iter = _bw_em_iter(obs, lengths, seq_w, eps, n_states, n_obs)
     (li, lt, le), ll_hist = jax.lax.scan(
         em_iter, (li0, lt0, le0), None, length=n_iters)
     return li, lt, le, ll_hist
+
+
+@partial(jax.jit, static_argnames=("n_states", "n_obs", "max_iters"))
+def _baum_welch_while_kernel(obs: jnp.ndarray, lengths: jnp.ndarray,
+                             seq_w: jnp.ndarray,
+                             li0: jnp.ndarray, lt0: jnp.ndarray,
+                             le0: jnp.ndarray, eps: jnp.ndarray,
+                             ll_rel_tol: jnp.ndarray,
+                             *, n_states: int, n_obs: int, max_iters: int):
+    """EM to convergence in ONE dispatch (VERDICT round-3 item 5): a
+    ``lax.while_loop`` carries the parameters and runs the SAME
+    :func:`ll_converged` test on device after every iteration, instead of
+    the chunk-of-10 + host-readback loop whose transport dominated the
+    CI-shape ledger row (0.03% utilization). ``ll_rel_tol`` is traced
+    (negative disables early stop — the loop then runs exactly
+    ``max_iters``). Returns (li, lt, le, ll_hist [max_iters] NaN-padded
+    past the stop, n_done).
+
+    The chunked kernel remains the checkpointing path (a while_loop cannot
+    pause for host-side checkpoint writes)."""
+    em_iter = _bw_em_iter(obs, lengths, seq_w, eps, n_states, n_obs)
+
+    def cond(carry):
+        li, lt, le, hist, i, ll_prev, ll_prev2 = carry
+        gain = jnp.abs(ll_prev - ll_prev2)
+        conv = (i >= 2) & (ll_rel_tol >= 0) & (
+            gain <= ll_rel_tol * jnp.maximum(1.0, jnp.abs(ll_prev)))
+        return (i < max_iters) & ~conv
+
+    def body(carry):
+        li, lt, le, hist, i, ll_prev, _ = carry
+        (li2, lt2, le2), ll = em_iter((li, lt, le), None)
+        hist = hist.at[i].set(ll)
+        return li2, lt2, le2, hist, i + 1, ll, ll_prev
+
+    hist0 = jnp.full((max_iters,), jnp.nan, jnp.float32)
+    li, lt, le, hist, n_done, _, _ = jax.lax.while_loop(
+        cond, body, (li0, lt0, le0, hist0, jnp.asarray(0, jnp.int32),
+                     jnp.asarray(jnp.inf, jnp.float32),
+                     jnp.asarray(-jnp.inf, jnp.float32)))
+    return li, lt, le, hist, n_done
 
 
 def ll_converged(hist: Sequence[float], ll_rel_tol: float) -> bool:
@@ -280,11 +334,15 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
 
     ``smoothing`` is the M-step additive count smoothing (traced, so tuning
     it never recompiles). ``ll_rel_tol``, when set, stops early once the
-    per-iteration LL gain falls to ``ll_rel_tol * max(1, |LL|)`` — checked
-    at chunk boundaries, so up to ``chunk_size - 1`` extra (harmless,
-    LL-non-decreasing) iterations may run past the crossing. ``n_iters``
-    is the iteration budget, rounded up to whole chunks (a remainder-sized
-    tail dispatch would recompile the kernel for a handful of iterations).
+    per-iteration LL gain falls to ``ll_rel_tol * max(1, |LL|)``. Without
+    a checkpoint path the whole EM loop is ONE dispatch
+    (:func:`_baum_welch_while_kernel`): the tolerance test runs on device
+    after every iteration, so training stops within one iteration of the
+    crossing and ``len(ll_hist) <= n_iters`` EXACTLY. With a checkpoint
+    path the host checks between chunk dispatches (it must regain control
+    to write checkpoints), and the final chunk is clamped to the
+    remaining budget — the budget contract is exact on both paths
+    (round 4; previously rounded up to whole chunks).
 
     Returns (HmmModel in the reference wire format, log-likelihood history
     [iterations actually run]). States are synthetic names ``s0..s{K-1}``
@@ -367,11 +425,6 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
         obs_j, len_j = jnp.asarray(batch), jnp.asarray(lengths)
         w_j = jnp.asarray(seq_w)
     eps_j = jnp.asarray(smoothing, jnp.float32)
-    # always dispatch FULL chunks — a remainder-sized tail chunk would
-    # recompile the whole kernel for a handful of iterations; the budget is
-    # therefore rounded up to whole chunks (up to chunk-1 extra harmless,
-    # LL-non-decreasing iterations), mirroring the tolerance-check slack
-    chunk = max(1, min(chunk_size, n_iters))
     li, lt, le = li0, lt0, le0
     hist = list(resumed_hist)
 
@@ -384,13 +437,37 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
                  ll=np.asarray(hist, np.float64), data_fp=data_fp)
         os.replace(tmp, checkpoint_path)
 
-    while len(hist) < n_iters and not (
-            ll_rel_tol is not None and ll_converged(hist, ll_rel_tol)):
-        li, lt, le, ll_c = _baum_welch_kernel(
-            obs_j, len_j, w_j, li, lt, le, eps_j, n_states=n_states,
-            n_obs=len(observations), n_iters=chunk)
-        hist.extend(np.asarray(jax.device_get(ll_c), np.float64).tolist())
-        if checkpoint_path is not None:
+    if checkpoint_path is None:
+        # single-dispatch path (round 4): the convergence test runs ON
+        # DEVICE after every iteration inside a lax.while_loop — no
+        # per-chunk readbacks, exact n_iters budget, stop within one
+        # iteration of the tolerance crossing instead of within a chunk
+        budget = n_iters - len(hist)
+        if budget > 0 and not (ll_rel_tol is not None
+                               and ll_converged(hist, ll_rel_tol)):
+            tol_j = jnp.asarray(
+                -1.0 if ll_rel_tol is None else ll_rel_tol, jnp.float32)
+            li, lt, le, ll_h, n_done = _baum_welch_while_kernel(
+                obs_j, len_j, w_j, li, lt, le, eps_j, tol_j,
+                n_states=n_states, n_obs=len(observations),
+                max_iters=budget)
+            hist.extend(np.asarray(jax.device_get(ll_h), np.float64)
+                        [:int(n_done)].tolist())
+    else:
+        # chunked path: the host must regain control between chunks to
+        # write checkpoints. Chunks are full-sized except the LAST, which
+        # is clamped to the remaining budget (one extra compile of a
+        # smaller scan, in exchange for an exact n_iters contract —
+        # ADVICE round 3: the budget no longer rounds up to whole chunks)
+        chunk = max(1, min(chunk_size, n_iters))
+        while len(hist) < n_iters and not (
+                ll_rel_tol is not None and ll_converged(hist, ll_rel_tol)):
+            take = min(chunk, n_iters - len(hist))
+            li, lt, le, ll_c = _baum_welch_kernel(
+                obs_j, len_j, w_j, li, lt, le, eps_j, n_states=n_states,
+                n_obs=len(observations), n_iters=take)
+            hist.extend(np.asarray(jax.device_get(ll_c),
+                                   np.float64).tolist())
             save_checkpoint()
     ll_hist = np.asarray(hist)
     li, lt, le = jax.device_get((li, lt, le))

@@ -112,19 +112,51 @@ class RedisQueues:
         # the reference's RedisRewardReader walks the list from the tail
         # (oldest under lpush producers) with a negative decrementing cursor
         self._reward_cursor = -1
+        # ledger entries are the RAW popped payloads; ack callers pass an
+        # event *id*, which today equals the whole payload but need not in
+        # a future multi-field event format — remember id→raw so ack always
+        # LREMs the verbatim ledger bytes (ADVICE round 3)
+        self._pending_raw: dict = {}
 
     def pop_event(self) -> Optional[str]:
         if self.pending_queue is not None:
             raw = self._r.rpoplpush(self.event_queue, self.pending_queue)
         else:
             raw = self._r.rpop(self.event_queue)
-        return raw.decode() if raw is not None else None
+        if raw is None:
+            return None
+        decoded = raw.decode()
+        if self.pending_queue is not None:
+            # key by the id prefix too, so ack_event(event_id) retires the
+            # right entry even when the payload carries extra fields. Each
+            # key holds a FIFO of raw payloads: two un-acked events sharing
+            # an id prefix must not overwrite each other (the ack then
+            # retires the OLDEST matching entry, mirroring LREM count=1
+            # head-side semantics)
+            self._pending_raw.setdefault(decoded, []).append(raw)
+            self._pending_raw.setdefault(
+                decoded.partition(self.delim)[0], []).append(raw)
+        return decoded
 
     def ack_event(self, event_id: str) -> None:
         """Retire one ledger entry — called AFTER the answer is written, so
-        a consumer death between pop and ack leaves the event replayable."""
+        a consumer death between pop and ack leaves the event replayable.
+        ``event_id`` may be the full event payload or its id field; either
+        resolves to the verbatim raw bytes RPOPLPUSH stored in the ledger."""
         if self.pending_queue is not None:
-            self._r.lrem(self.pending_queue, 1, event_id)
+            fifo = self._pending_raw.get(event_id)
+            raw = fifo.pop(0) if fifo else event_id
+            if isinstance(raw, bytes):
+                # drop this payload from BOTH alias fifos (full payload /
+                # id prefix), whichever the caller used
+                decoded = raw.decode()
+                for key in (decoded, decoded.partition(self.delim)[0]):
+                    entries = self._pending_raw.get(key)
+                    if entries and raw in entries:
+                        entries.remove(raw)
+                    if entries == []:
+                        del self._pending_raw[key]
+            self._r.lrem(self.pending_queue, 1, raw)
 
     def drain_rewards(self) -> List[Tuple[str, float]]:
         """lindex-cursor scan like RedisRewardReader: read tail-first

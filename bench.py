@@ -6,10 +6,24 @@ reference outsources this exact computation to an O(N²·D) Hadoop MR job
 shuffle + reduce for top-K; here it is one jitted streaming kernel
 (bf16 cross-term on the MXU + ``lax.approx_min_k``).
 
-Timing method: the TPU is reached through a relay that adds ~150ms fixed
+Timing method: the TPU is reached through a relay that adds ~100ms fixed
 latency per host transfer and whose ``block_until_ready`` acks dispatch, not
 completion — so we chain ITERS data-dependent kernel invocations inside one
 jitted ``lax.scan`` and fetch a scalar at the end, amortizing the fixed cost.
+
+ROUND-4 TRANSPORT FIX (documented loudly because it moves vs_baseline):
+rounds 1-3 implemented the "fetch a scalar" design as
+``np.asarray(chain(...))`` on a TUPLE of two per-iteration arrays — numpy
+converts each element separately, i.e. TWO sequential ~100ms relay fetches,
+not one. Measured decomposition (scripts/sweep15_transport.py, best-of-6
+interleaved): fixed cost 198.6ms with the tuple fetch vs 99.3ms with a
+single scalar fetch; kernel time unchanged (~97ms/100 iters). The chain now
+returns one scalar (a data-dependent reduction of both outputs), matching
+the documented method. This is HARNESS transport, not kernel speed — so the
+stderr audit also times one draw of the legacy two-fetch chain and prints
+the legacy-method bulk number next to the new one, and BASELINE.md records
+the like-for-like adjustment of the recorded baseline (~2.77M bulk under
+the legacy harness corresponds to ~4.18M under the fixed harness).
 
 The reference publishes no numbers (BASELINE.md), so this repo establishes
 the baseline: ``vs_baseline`` is relative to BENCH_BASELINE.json when
@@ -118,7 +132,7 @@ def _parity_gate(test, train, candidate, name: str) -> None:
           file=sys.stderr)
 
 
-def _chain_for_iters(topk, n_iters):
+def _chain_for_iters(topk, n_iters, legacy_tuple=False):
     @jax.jit
     def chain(test, train):
         def body(t, _):
@@ -127,7 +141,12 @@ def _chain_for_iters(topk, n_iters):
             eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
             return t + eps, (d[0, 0], i[0, 0])
         _, outs = jax.lax.scan(body, test, None, length=n_iters)
-        return outs
+        if legacy_tuple:
+            return outs          # rounds 1-3 shape: two arrays, two fetches
+        # ONE scalar, ONE fetch — data-dependent on every iteration's
+        # distance AND index outputs
+        return jnp.sum(outs[0].astype(jnp.float32)) + \
+            jnp.sum(outs[1].astype(jnp.float32))
     return chain
 
 
@@ -141,6 +160,12 @@ def main() -> None:
     train = jnp.asarray(rng.random((N_TRAIN, N_FEATURES), dtype=np.float32))
     test = jnp.asarray(rng.random((M_TEST, N_FEATURES), dtype=np.float32))
 
+    if IMPL not in ("auto", "pallas", "xla"):
+        # validate up front: previously a typo (e.g. 'palas') fell through
+        # to the XLA path on non-TPU backends and benched silently
+        # (ADVICE round 3)
+        raise ValueError(
+            f"BENCH_IMPL={IMPL!r} not one of 'auto', 'pallas', 'xla'")
     on_tpu = jax.devices()[0].platform == "tpu"
     if IMPL == "pallas" and not on_tpu:
         # a pinned pallas request must not silently time the XLA path
@@ -183,7 +208,8 @@ def main() -> None:
     # stderr audit: the TRANSPORT-FREE kernel rate (differential over a
     # 4x-length chain; PERF_NOTES "fixed-cost contamination") — the JSON
     # number deliberately stays bulk so vs_baseline is like-for-like with
-    # rounds 1-2, but the kernel's own speed is worth the record
+    # rounds 1-3 MODULO the round-4 single-fetch fix (module docstring),
+    # whose effect the legacy-chain line below quantifies in-run
     try:
         long_chain = _chain_for_iters(impls[chosen], 4 * ITERS)
         np.asarray(long_chain(test, train))
@@ -196,6 +222,16 @@ def main() -> None:
                   file=sys.stderr)
     except Exception as exc:     # audit line must never sink the bench
         print(f"kernel-rate audit skipped: {exc!r}", file=sys.stderr)
+    try:
+        legacy = _chain_for_iters(impls[chosen], ITERS, legacy_tuple=True)
+        np.asarray(legacy(test, train))
+        t_leg = min(_timed(legacy, test, train) for _ in range(2))
+        print(f"legacy two-fetch chain (rounds 1-3 harness): "
+              f"{M_TEST * ITERS / t_leg / 1e6:.2f}M rows/s bulk — the "
+              f"single-fetch fix accounts for the difference vs the "
+              f"{rows_per_sec / 1e6:.2f}M JSON value", file=sys.stderr)
+    except Exception as exc:
+        print(f"legacy-chain audit skipped: {exc!r}", file=sys.stderr)
 
     vs_baseline = 1.0
     base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")

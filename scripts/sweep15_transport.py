@@ -1,0 +1,135 @@
+"""Sweep 15 (round 4): decompose the bulk metric's fixed transport cost.
+
+The round-3 decomposition: bulk elapsed ~303ms = ~204ms fixed + ~99ms
+kernel (100 iters).  bench.py's docstring says "fetch a scalar at the
+end", but ``_timed`` actually calls ``np.asarray(chain(test, train))``
+where the chain returns a TUPLE of two 100-element arrays (f32 distances,
+i32 indices) — numpy converts each element separately, so the final fetch
+may be TWO sequential relay round-trips (~100ms each), not one.
+
+This sweep times, interleaved round-robin (the only protocol that means
+anything on the shared relay — scripts/PERF_NOTES.md):
+
+  tuple@1     current chain shape, 1 iteration   -> fixed cost, 2-fetch
+  tuple@100   current chain shape, 100 iters     -> bulk as bench.py times
+  scalar@1    chain returns ONE f32 scalar       -> fixed cost, 1-fetch
+  scalar@100  ditto, 100 iters
+  stack@100   chain returns one stacked f32 [2,100] array (same info,
+              one transfer) — the minimal-diff fix candidate
+
+Run: PYTHONPATH=. python -u scripts/sweep15_transport.py
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas
+
+N_TRAIN = 65536
+M_TEST = 8192
+D = 9
+K = 5
+ROUNDS = 6
+
+
+def topk(t, tr):
+    return pairwise_topk_pallas(t, tr, k=K)
+
+
+def chain_tuple(n_iters):
+    @jax.jit
+    def chain(test, train):
+        def body(t, _):
+            d, i = topk(t, train)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, (d[0, 0], i[0, 0])
+        _, outs = jax.lax.scan(body, test, None, length=n_iters)
+        return outs
+    return chain
+
+
+def chain_scalar(n_iters):
+    @jax.jit
+    def chain(test, train):
+        def body(t, _):
+            d, i = topk(t, train)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, (d[0, 0], i[0, 0])
+        _, outs = jax.lax.scan(body, test, None, length=n_iters)
+        # one f32 scalar carrying a data dependency on BOTH outputs
+        return jnp.sum(outs[0].astype(jnp.float32)) + \
+            jnp.sum(outs[1].astype(jnp.float32))
+    return chain
+
+
+def chain_stack(n_iters):
+    @jax.jit
+    def chain(test, train):
+        def body(t, _):
+            d, i = topk(t, train)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, (d[0, 0], i[0, 0])
+        _, outs = jax.lax.scan(body, test, None, length=n_iters)
+        return jnp.stack([outs[0].astype(jnp.float32),
+                          outs[1].astype(jnp.float32)])
+    return chain
+
+
+def fetch(x):
+    if isinstance(x, tuple):
+        return tuple(np.asarray(v) for v in x)
+    return np.asarray(x)
+
+
+def fetch_naive(x):
+    return np.asarray(x)          # exactly what bench.py does today
+
+
+def main():
+    rng = np.random.default_rng(0)
+    train = jnp.asarray(rng.random((N_TRAIN, D), dtype=np.float32))
+    test = jnp.asarray(rng.random((M_TEST, D), dtype=np.float32))
+
+    cands = {
+        "tuple@1": (chain_tuple(1), fetch_naive),
+        "tuple@100": (chain_tuple(100), fetch_naive),
+        "scalar@1": (chain_scalar(1), fetch),
+        "scalar@100": (chain_scalar(100), fetch),
+        "stack@100": (chain_stack(100), fetch),
+    }
+    for name, (c, f) in cands.items():
+        f(c(test, train))          # compile + warm
+        print(f"warmed {name}", flush=True)
+
+    best = {n: float("inf") for n in cands}
+    for r in range(ROUNDS):
+        for name, (c, f) in cands.items():
+            t0 = time.perf_counter()
+            f(c(test, train))
+            dt = time.perf_counter() - t0
+            best[name] = min(best[name], dt)
+            print(f"round {r} {name:12s} {dt * 1e3:8.1f}ms", flush=True)
+
+    print("\n# best-of-%d" % ROUNDS)
+    for name, t in best.items():
+        print(f"{name:12s} {t * 1e3:8.1f}ms")
+    fixed_2f = best["tuple@1"]
+    fixed_1f = best["scalar@1"]
+    kern = best["scalar@100"] - best["scalar@1"]
+    print(f"\n# fixed cost, tuple double-fetch: {fixed_2f * 1e3:.1f}ms")
+    print(f"# fixed cost, single scalar fetch: {fixed_1f * 1e3:.1f}ms")
+    print(f"# implied kernel/100it: {kern * 1e3:.1f}ms")
+    print(f"# bulk rows/s today (tuple@100):  "
+          f"{M_TEST * 100 / best['tuple@100'] / 1e6:.2f}M")
+    print(f"# bulk rows/s scalar (scalar@100): "
+          f"{M_TEST * 100 / best['scalar@100'] / 1e6:.2f}M")
+    print(f"# bulk rows/s stack (stack@100):  "
+          f"{M_TEST * 100 / best['stack@100'] / 1e6:.2f}M")
+
+
+if __name__ == "__main__":
+    main()

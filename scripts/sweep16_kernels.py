@@ -1,0 +1,345 @@
+"""Sweep 16 (round 4): KNN kernel restructure candidates vs production.
+
+The round-3 roofline: production kernel ~968us/iter of which the
+D=9-padded-to-K=128 bf16 dot is ~700us (96%+ of the padded-slab MXU
+ceiling) and the 6-op VPU fold adds ~270us on top. Two structural attacks:
+
+  dot side   int8 operands double the MXU rate on v5e (394 TOPS vs 197
+             TFLOPs); quantization error (1/254 per dim after scaling)
+             perturbs the metric LESS than the bf16 cross term already
+             does.
+  fold side  (1) the 2-op epilogue ``y2 - 2*cross`` can ride the dot's
+             padded K lanes as augmented columns (the padding is free —
+             K pads 9 -> 128 regardless); (2) the per-chunk global-index
+             iota-add + select can become a single scalar-tag select
+             (tag = global chunk id, broadcast; the lane is recovered
+             from the bucket position at extraction) — 6 VPU ops/element
+             down to 3.
+
+Variants (all reuse the production accumulator-bucket fold topology,
+tile (1024, 4096), n_acc=4):
+
+  prod      production pairwise_topk_pallas           (anchor)
+  augbf16   bf16 dot over [x | 1] x [-2y | y2], tag fold   -> 0 epilogue
+  int8epi   int8 dot, int32 epilogue (y2 - 2*cross), tag fold
+  int8aug   int8 dot over augmented columns: the -2 factor rides the x
+            side (scale 63), y2 decomposed EXACTLY into 10 int8 columns
+            (r = y2 mod 127 against x-const 1; y2//127 spread over 9
+            columns of (q+i)//9 against x-const 127 — sum telescopes to
+            q exactly)                                 -> 0 epilogue
+
+Each variant is recall/distance-gated against the exact XLA path before
+timing. Timing is DIFFERENTIAL (chains of 25 and 100 iters; removes the
+relay's ~100ms per-call fixed cost) and INTERLEAVED round-robin
+(shared-chip contention swings per-iteration time 685-968us same-day —
+sweep14); the decision statistic is the per-round ratio vs prod, adopted
+on the MEDIAN ACROSS >=3 SESSIONS spread over hours (VERDICT round 3).
+
+Run: PYTHONPATH=/root/.axon_site:. python -u scripts/sweep16_kernels.py
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.ops.pallas_distance import (
+    BIG, INT_BIG, LANES, _pad_rows, pairwise_topk_pallas)
+
+N_TRAIN = 65536
+M_TEST = 8192
+D = 9
+K = 5
+ITERS_LO, ITERS_HI = 25, 100
+ROUNDS = 5
+TILE_M, TILE_N, N_ACC = 1024, 4096, 4
+
+
+# --------------------------------------------------------------------------
+# shared tag-fold kernel body: metric comes in as the RAW dot output (the
+# epilogue, if any, was folded into the operands), indices are tracked as
+# scalar chunk tags and reconstructed at extraction
+# --------------------------------------------------------------------------
+
+def _tag_kernel(x_ref, y_ref, out_d_ref, out_i_ref, acc_d, acc_i, *,
+                k: int, tn: int, n_acc: int, acc_dtype, big,
+                epilogue_y2: bool, y2_ref=None):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_d[:] = jnp.full(acc_d.shape, big, acc_dtype)
+        acc_i[:] = jnp.full(acc_i.shape, -1, jnp.int32)
+
+    x = x_ref[:]
+    y = y_ref[:]
+    cross = lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                            preferred_element_type=acc_dtype)
+    if epilogue_y2:
+        metric = y2_ref[:] - 2 * cross
+    else:
+        metric = cross
+
+    tm = metric.shape[0]
+    n_chunks = tn // LANES
+    for c in range(n_chunks):
+        s = c % n_acc
+        chunk = metric[:, c * LANES:(c + 1) * LANES]
+        cur_d = acc_d[:, s * LANES:(s + 1) * LANES]
+        better = chunk < cur_d
+        tag = j * n_chunks + c               # scalar broadcast, no iota add
+        acc_d[:, s * LANES:(s + 1) * LANES] = jnp.where(better, chunk, cur_d)
+        cur_i = acc_i[:, s * LANES:(s + 1) * LANES]
+        acc_i[:, s * LANES:(s + 1) * LANES] = jnp.where(better, tag, cur_i)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        val = acc_d[:]
+        tags = acc_i[:]
+        # global index = tag*128 + lane-within-chunk; the bucket layout
+        # preserves the lane, so it is recoverable from the COLUMN position
+        # (once per test tile — the per-chunk iota-add this replaces ran
+        # per element of the whole train sweep)
+        col = lax.broadcasted_iota(jnp.int32, val.shape, 1)
+        idx = tags * LANES + (col % LANES)
+        idx = jnp.where(tags < 0, -1, idx)
+        new_d = jnp.full((tm, LANES), big, acc_dtype)
+        new_i = jnp.full((tm, LANES), -1, jnp.int32)
+        slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+        for slot in range(k):
+            min_d = jnp.min(val, axis=1, keepdims=True)
+            min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
+                            axis=1, keepdims=True)
+            new_d = jnp.where(slot_lane == slot, min_d, new_d)
+            new_i = jnp.where(slot_lane == slot, min_i, new_i)
+            val = jnp.where((val == min_d) & (idx == min_i), big, val)
+        out_d_ref[:] = new_d
+        out_i_ref[:] = new_i
+
+
+def _launch(xa, ya, *, k, acc_dtype, big, y2=None):
+    """xa [M, Dk], ya [N, Dk] pre-augmented/quantized operands."""
+    m = xa.shape[0]
+    d = xa.shape[1]
+    xp = _pad_rows(xa, TILE_M)
+    yp = _pad_rows(ya, TILE_N)
+    grid = (xp.shape[0] // TILE_M, yp.shape[0] // TILE_N)
+    epi = y2 is not None
+    in_specs = [
+        pl.BlockSpec((TILE_M, d), lambda i, j: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((TILE_N, d), lambda i, j: (j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [xp, yp]
+    if epi:
+        in_specs.append(pl.BlockSpec((1, TILE_N), lambda i, j: (0, j),
+                                     memory_space=pltpu.VMEM))
+        args.append(y2)
+
+    def kern(*refs):
+        if epi:
+            x_ref, y_ref, y2_ref, od, oi, ad, ai = refs
+        else:
+            x_ref, y_ref, od, oi, ad, ai = refs
+            y2_ref = None
+        _tag_kernel(x_ref, y_ref, od, oi, ad, ai, k=k, tn=TILE_N,
+                    n_acc=N_ACC, acc_dtype=acc_dtype, big=big,
+                    epilogue_y2=epi, y2_ref=y2_ref)
+
+    out_d, out_i = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((TILE_M, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_M, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), acc_dtype),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TILE_M, N_ACC * LANES), acc_dtype),
+            pltpu.VMEM((TILE_M, N_ACC * LANES), jnp.int32),
+        ],
+    )(*args)
+    return out_d[:m], out_i[:m]
+
+
+# --------------------------------------------------------------------------
+# variant wrappers (jitted end-to-end, same finalization semantics as
+# production: scaled-int sqrt distance over rms-normalized-ish inputs —
+# here raw [0,1) features, n_attrs=D, distance_scale=1000)
+# --------------------------------------------------------------------------
+
+SCALE = 1000
+
+
+def _finalize_f32(raw_d, raw_i, x2):
+    found = raw_i >= 0
+    sq = jnp.maximum(raw_d + x2, 0.0) / D
+    dist = jnp.sqrt(sq)
+    scaled = jnp.where(found, jnp.asarray(jnp.rint(dist * SCALE), jnp.int32),
+                       INT_BIG)
+    return scaled, jnp.where(found, raw_i, -1)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def augbf16_topk(x, y, *, k):
+    ones = jnp.ones((x.shape[0], 1), jnp.float32)
+    xa = jnp.concatenate([x, ones], 1).astype(jnp.bfloat16)
+    y2 = jnp.sum(y * y, axis=1, keepdims=True)
+    ya = jnp.concatenate([-2.0 * y, y2], 1).astype(jnp.bfloat16)
+    raw_d, raw_i = _launch(xa, ya, k=k, acc_dtype=jnp.float32, big=BIG)
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    return _finalize_f32(raw_d[:, :k].astype(jnp.float32), raw_i[:, :k], x2)
+
+
+def _quant(x, y, qmax):
+    s = qmax / jnp.maximum(jnp.max(jnp.abs(x)), jnp.max(jnp.abs(y)))
+    x8 = jnp.asarray(jnp.rint(x * s), jnp.int8)
+    y8 = jnp.asarray(jnp.rint(y * s), jnp.int8)
+    return x8, y8, s
+
+
+def _finalize_int(raw_d, raw_i, x2_i, s):
+    found = raw_i >= 0
+    sq = jnp.maximum(raw_d + x2_i, 0).astype(jnp.float32) / (s * s) / D
+    dist = jnp.sqrt(sq)
+    scaled = jnp.where(found, jnp.asarray(jnp.rint(dist * SCALE), jnp.int32),
+                       INT_BIG)
+    return scaled, jnp.where(found, raw_i, -1)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def int8epi_topk(x, y, *, k):
+    x8, y8, s = _quant(x, y, 127.0)
+    y2 = jnp.sum(jnp.asarray(y8, jnp.int32) ** 2, axis=1)
+    pad = (-y8.shape[0]) % TILE_N
+    y2p = jnp.pad(y2, (0, pad), constant_values=INT_BIG)[None, :]
+    raw_d, raw_i = _launch(x8, y8, k=k, acc_dtype=jnp.int32, big=INT_BIG,
+                           y2=y2p)
+    x2_i = jnp.sum(jnp.asarray(x8, jnp.int32) ** 2, axis=1, keepdims=True)
+    return _finalize_int(raw_d[:, :k], raw_i[:, :k], x2_i, s)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def int8aug_topk(x, y, *, k):
+    # -2 rides the x side, so the base quantization range is 63
+    x8, y8, s = _quant(x, y, 63.0)
+    m, n = x8.shape[0], y8.shape[0]
+    ones = jnp.ones((m, 1), jnp.int8)
+    c127 = jnp.full((m, 9), 127, jnp.int8)
+    xa = jnp.concatenate(
+        [jnp.asarray(-2 * jnp.asarray(x8, jnp.int32), jnp.int8), ones, c127],
+        axis=1)
+    y2 = jnp.sum(jnp.asarray(y8, jnp.int32) ** 2, axis=1)      # <= 9*63^2
+    q, r = jnp.divmod(y2, 127)
+    # sum_{i=0..8} (q+i)//9 == q exactly; each digit <= (q_max+8)//9 = 127
+    digits = jnp.stack([(q + i) // 9 for i in range(9)], axis=1)
+    ya = jnp.concatenate(
+        [y8, jnp.asarray(r, jnp.int8)[:, None],
+         jnp.asarray(digits, jnp.int8)], axis=1)
+    raw_d, raw_i = _launch(xa, ya, k=k, acc_dtype=jnp.int32,
+                           big=INT_BIG)
+    x2_i = jnp.sum(jnp.asarray(x8, jnp.int32) ** 2, axis=1, keepdims=True)
+    return _finalize_int(raw_d[:, :k], raw_i[:, :k], x2_i, s)
+
+
+# --------------------------------------------------------------------------
+# harness
+# --------------------------------------------------------------------------
+
+def _chain(topk, n_iters):
+    @jax.jit
+    def chain(test, train):
+        def body(t, _):
+            d, i = topk(t, train)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, (d[0, 0], i[0, 0])
+        _, outs = jax.lax.scan(body, test, None, length=n_iters)
+        return jnp.sum(outs[0].astype(jnp.float32)) + \
+            jnp.sum(outs[1].astype(jnp.float32))
+    return chain
+
+
+def _gate(name, topk, test, train):
+    d_ex, i_ex = pairwise_topk(test[:512], train, k=K, mode="exact")
+    d_c, i_c = topk(test[:512], train)
+    d_ex, i_ex, d_c, i_c = map(np.asarray, (d_ex, i_ex, d_c, i_c))
+    recall = np.mean([len(set(i_ex[r]) & set(i_c[r])) / K
+                      for r in range(i_ex.shape[0])])
+    err, nm = 0, 0
+    for r in range(i_ex.shape[0]):
+        ex = {int(i): float(d) for i, d in zip(i_ex[r], d_ex[r])}
+        for i, d in zip(i_c[r], d_c[r]):
+            if int(i) in ex:
+                err = max(err, abs(int(round(float(d) - ex[int(i)]))))
+                nm += 1
+    print(f"gate {name:9s} recall={recall:.4f} dist_err={err} "
+          f"(n={nm})", flush=True)
+    return recall >= 0.985 and err <= 25
+
+
+def main():
+    rng = np.random.default_rng(0)
+    train = jnp.asarray(rng.random((N_TRAIN, D), dtype=np.float32))
+    test = jnp.asarray(rng.random((M_TEST, D), dtype=np.float32))
+
+    cands = {
+        "prod": lambda t, tr: pairwise_topk_pallas(t, tr, k=K),
+        "augbf16": lambda t, tr: augbf16_topk(t, tr, k=K),
+        "int8epi": lambda t, tr: int8epi_topk(t, tr, k=K),
+        "int8aug": lambda t, tr: int8aug_topk(t, tr, k=K),
+    }
+    ok = {}
+    for name, fn in cands.items():
+        try:
+            ok[name] = _gate(name, fn, test, train)
+        except Exception as exc:
+            print(f"gate {name} FAILED: {type(exc).__name__}: {exc}",
+                  flush=True)
+            ok[name] = False
+    cands = {n: f for n, f in cands.items() if ok[n]}
+    if "prod" not in cands:
+        raise SystemExit("anchor failed its own gate — relay broken?")
+
+    chains = {}
+    for name, fn in cands.items():
+        chains[name] = (_chain(fn, ITERS_LO), _chain(fn, ITERS_HI))
+        for c in chains[name]:
+            np.asarray(c(test, train))
+        print(f"warmed {name}", flush=True)
+
+    per_round = {n: [] for n in chains}
+    for r in range(ROUNDS):
+        for name, (clo, chi) in chains.items():
+            t0 = time.perf_counter()
+            np.asarray(clo(test, train))
+            tlo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            np.asarray(chi(test, train))
+            thi = time.perf_counter() - t0
+            us = (thi - tlo) / (ITERS_HI - ITERS_LO) * 1e6
+            per_round[name].append(us)
+            print(f"round {r} {name:9s} {us:8.1f} us/iter", flush=True)
+
+    print("\n# per-variant median us/iter and ratio vs prod (this session)")
+    med = {n: float(np.median(v)) for n, v in per_round.items()}
+    for n, m in sorted(med.items(), key=lambda kv: kv[1]):
+        print(f"{n:9s} {m:8.1f} us/iter   {med['prod'] / m:5.2f}x prod   "
+              f"{M_TEST / m:7.2f}M rows/s kernel")
+
+
+if __name__ == "__main__":
+    main()

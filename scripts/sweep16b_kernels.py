@@ -1,0 +1,383 @@
+"""Sweep 16b (round 4): kernel candidates, recall-fixed after sweep16.
+
+sweep16 lesson: all three restructures FAILED the 0.985 recall gate
+(0.89-0.92) with small distance errors — per-candidate metric BIAS
+(bf16-cast y2: +-0.035; int8 quantization: ~0.02) reorders rank-5/6
+neighbors whose metric gap is ~0.01 at 65536 uniform train rows. The
+values were fine; the sets were not. Fixed candidates:
+
+  prod      production kernel                                (anchor)
+  tagfold   prod numerics exactly (f32 y2 epilogue, bf16 cross) but the
+            scalar-tag index fold: 6 VPU ops/elem -> 4       [fold only]
+  augv2     y2 split into TWO bf16 columns (hi + residual, error 2^-16
+            rel — below prod's own cross-term error) so the epilogue
+            rides the dot's padded K lanes: [x|1|1] x [-2y|y2hi|y2lo],
+            tag fold: 6 ops -> 3, dot unchanged
+  int8rr    int8aug dot (2x MXU rate, zero epilogue: -2 on the x side at
+            scale 63, y2 decomposed exactly into 10 int8 columns), tag
+            fold, top-16 bucket extraction, then EXACT f32 re-rank of the
+            16 candidates outside the kernel (recall rescue + exact
+            reported distances)
+  int8pk    like int8rr but a PACKED single-accumulator fold:
+            packed = metric*2048 + tag (exact in int32, |metric| < 2^18,
+            tag < 2^11), one min-select chain, HALF the accumulator
+            scratch/RMW traffic; decode at extraction
+
+Gate + interleaved differential timing as sweep16; adopt on median
+across >=3 sessions (VERDICT round 3 protocol).
+
+Run: PYTHONPATH=/root/.axon_site:. python -u scripts/sweep16b_kernels.py
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.ops.pallas_distance import (
+    BIG, INT_BIG, LANES, _pad_rows, pairwise_topk_pallas)
+
+N_TRAIN = 65536
+M_TEST = 8192
+D = 9
+K = 5
+K_CAND = 16          # int8 paths: candidates handed to the exact re-rank
+ITERS_LO, ITERS_HI = 25, 100
+ROUNDS = 5
+TILE_M, TILE_N, N_ACC = 1024, 4096, 4
+SCALE = 1000
+
+
+# --------------------------------------------------------------------------
+# kernels
+# --------------------------------------------------------------------------
+
+def _extract(val, idx, k, tm, big, out_d_ref, out_i_ref):
+    new_d = jnp.full((tm, LANES), big, val.dtype)
+    new_i = jnp.full((tm, LANES), -1, jnp.int32)
+    slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+    for slot in range(k):
+        min_d = jnp.min(val, axis=1, keepdims=True)
+        min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
+                        axis=1, keepdims=True)
+        new_d = jnp.where(slot_lane == slot, min_d, new_d)
+        new_i = jnp.where(slot_lane == slot, min_i, new_i)
+        val = jnp.where((val == min_d) & (idx == min_i), big, val)
+    out_d_ref[:] = new_d
+    out_i_ref[:] = new_i
+
+
+def _tag_kernel(refs, *, k, tn, n_acc, acc_dtype, big, epi):
+    if epi:
+        x_ref, y_ref, y2_ref, od, oi, acc_d, acc_i = refs
+    else:
+        x_ref, y_ref, od, oi, acc_d, acc_i = refs
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_d[:] = jnp.full(acc_d.shape, big, acc_dtype)
+        acc_i[:] = jnp.full(acc_i.shape, -1, jnp.int32)
+
+    cross = lax.dot_general(x_ref[:], y_ref[:], (((1,), (1,)), ((), ())),
+                            preferred_element_type=acc_dtype)
+    metric = (y2_ref[:] - 2 * cross) if epi else cross
+
+    tm = metric.shape[0]
+    n_chunks = tn // LANES
+    for c in range(n_chunks):
+        s = c % n_acc
+        chunk = metric[:, c * LANES:(c + 1) * LANES]
+        cur_d = acc_d[:, s * LANES:(s + 1) * LANES]
+        better = chunk < cur_d
+        tag = j * n_chunks + c
+        acc_d[:, s * LANES:(s + 1) * LANES] = jnp.where(better, chunk, cur_d)
+        cur_i = acc_i[:, s * LANES:(s + 1) * LANES]
+        acc_i[:, s * LANES:(s + 1) * LANES] = jnp.where(better, tag, cur_i)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        val = acc_d[:]
+        tags = acc_i[:]
+        col = lax.broadcasted_iota(jnp.int32, val.shape, 1)
+        idx = jnp.where(tags < 0, -1, tags * LANES + (col % LANES))
+        _extract(val, idx, k, tm, big, od, oi)
+
+
+def _packed_kernel(refs, *, k, tn, n_acc):
+    """int32 packed fold: one accumulator, packed = metric*2048 + tag."""
+    x_ref, y_ref, od, oi, acc = refs
+    j = pl.program_id(1)
+    big = INT_BIG
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.full(acc.shape, big, jnp.int32)
+
+    metric = lax.dot_general(x_ref[:], y_ref[:], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.int32)
+    tm = metric.shape[0]
+    n_chunks = tn // LANES
+    for c in range(n_chunks):
+        s = c % n_acc
+        tag = j * n_chunks + c
+        packed = metric[:, c * LANES:(c + 1) * LANES] * 2048 + tag
+        cur = acc[:, s * LANES:(s + 1) * LANES]
+        acc[:, s * LANES:(s + 1) * LANES] = jnp.minimum(packed, cur)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        val = acc[:]
+        col = lax.broadcasted_iota(jnp.int32, val.shape, 1)
+        # arithmetic shift right keeps negative metrics ordered; tag is in
+        # the low 11 bits
+        found = val < big
+        tags = val & 2047
+        idx = jnp.where(found, tags * LANES + (col % LANES), -1)
+        metric_v = jnp.where(found, lax.shift_right_arithmetic(val, 11), big)
+        _extract(metric_v, idx, k, tm, big, od, oi)
+
+
+def _launch(xa, ya, *, k, acc_dtype, big, y2=None, packed=False):
+    m, d = xa.shape
+    xp = _pad_rows(xa, TILE_M)
+    yp = _pad_rows(ya, TILE_N)
+    grid = (xp.shape[0] // TILE_M, yp.shape[0] // TILE_N)
+    epi = y2 is not None
+    in_specs = [
+        pl.BlockSpec((TILE_M, d), lambda i, j: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((TILE_N, d), lambda i, j: (j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [xp, yp]
+    if epi:
+        in_specs.append(pl.BlockSpec((1, TILE_N), lambda i, j: (0, j),
+                                     memory_space=pltpu.VMEM))
+        args.append(y2)
+    if packed:
+        kern = lambda *refs: _packed_kernel(refs, k=k, tn=TILE_N,
+                                            n_acc=N_ACC)
+        scratch = [pltpu.VMEM((TILE_M, N_ACC * LANES), jnp.int32)]
+    else:
+        kern = lambda *refs: _tag_kernel(refs, k=k, tn=TILE_N, n_acc=N_ACC,
+                                         acc_dtype=acc_dtype, big=big,
+                                         epi=epi)
+        scratch = [pltpu.VMEM((TILE_M, N_ACC * LANES), acc_dtype),
+                   pltpu.VMEM((TILE_M, N_ACC * LANES), jnp.int32)]
+    out_d, out_i = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((TILE_M, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_M, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), acc_dtype),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+        ],
+        scratch_shapes=scratch,
+    )(*args)
+    return out_d[:m], out_i[:m]
+
+
+# --------------------------------------------------------------------------
+# variant wrappers
+# --------------------------------------------------------------------------
+
+def _finalize_f32(raw_d, raw_i, x2):
+    found = raw_i >= 0
+    sq = jnp.maximum(raw_d + x2, 0.0) / D
+    scaled = jnp.where(found,
+                       jnp.asarray(jnp.rint(jnp.sqrt(sq) * SCALE),
+                                   jnp.int32), INT_BIG)
+    return scaled, jnp.where(found, raw_i, -1)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def tagfold_topk(x, y, *, k):
+    xb = x.astype(jnp.bfloat16)
+    yb = y.astype(jnp.bfloat16)
+    y2 = jnp.sum(y * y, axis=1)
+    pad = (-y.shape[0]) % TILE_N
+    y2p = jnp.pad(y2, (0, pad), constant_values=BIG)[None, :]
+    raw_d, raw_i = _launch(xb, yb, k=k, acc_dtype=jnp.float32, big=BIG,
+                           y2=y2p)
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    return _finalize_f32(raw_d[:, :k], raw_i[:, :k], x2)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def augv2_topk(x, y, *, k):
+    ones = jnp.ones((x.shape[0], 1), jnp.float32)
+    xa = jnp.concatenate([x, ones, ones], 1).astype(jnp.bfloat16)
+    y2 = jnp.sum(y * y, axis=1, keepdims=True)
+    y2hi = y2.astype(jnp.bfloat16)
+    y2lo = (y2 - y2hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    ya = jnp.concatenate([(-2.0 * y).astype(jnp.bfloat16), y2hi, y2lo], 1)
+    # padded train rows: zero rows give metric 0 which WOULD win a min;
+    # pad y2hi with BIG instead by padding rows before concat
+    pad = (-y.shape[0]) % TILE_N
+    if pad:
+        fill = jnp.zeros((pad, ya.shape[1]), ya.dtype).at[:, D].set(
+            jnp.bfloat16(BIG))
+        ya = jnp.concatenate([ya, fill], 0)
+    raw_d, raw_i = _launch(xa, ya, k=k, acc_dtype=jnp.float32, big=BIG)
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    return _finalize_f32(raw_d[:, :k].astype(jnp.float32), raw_i[:, :k], x2)
+
+
+def _int8_aug_operands(x, y):
+    s = 63.0 / jnp.maximum(jnp.max(jnp.abs(x)), jnp.max(jnp.abs(y)))
+    x8 = jnp.asarray(jnp.rint(x * s), jnp.int8)
+    y8 = jnp.asarray(jnp.rint(y * s), jnp.int8)
+    m = x8.shape[0]
+    ones = jnp.ones((m, 1), jnp.int8)
+    c127 = jnp.full((m, 9), 127, jnp.int8)
+    xa = jnp.concatenate(
+        [jnp.asarray(-2 * jnp.asarray(x8, jnp.int32), jnp.int8), ones, c127],
+        axis=1)
+    y2 = jnp.sum(jnp.asarray(y8, jnp.int32) ** 2, axis=1)
+    q, r = jnp.divmod(y2, 127)
+    digits = jnp.stack([(q + i) // 9 for i in range(9)], axis=1)
+    ya = jnp.concatenate(
+        [y8, jnp.asarray(r, jnp.int8)[:, None],
+         jnp.asarray(digits, jnp.int8)], axis=1)
+    # padded train rows: all-zero encodes metric 0 which would win mins.
+    # Encode the max representable positive value instead (> any real
+    # metric: real <= 9*63^2 + 2*9*63*63 ~ 107k < 127*126*9 = 144k)
+    pad = (-y.shape[0]) % TILE_N
+    if pad:
+        fill = jnp.zeros((pad, ya.shape[1]), jnp.int8).at[:, D + 1:].set(126)
+        ya = jnp.concatenate([ya, fill], 0)
+    return xa, ya, s
+
+
+def _exact_rerank(x, y, cand_i, k):
+    """Exact f32 distances for the candidate set, then true top-k."""
+    g = y[jnp.maximum(cand_i, 0)]                       # [M, C, D]
+    d2 = jnp.sum((x[:, None, :] - g) ** 2, axis=2)      # [M, C]
+    d2 = jnp.where(cand_i >= 0, d2, jnp.inf)
+    neg, sel = lax.top_k(-d2, k)
+    idx = jnp.take_along_axis(cand_i, sel, axis=1)
+    dist = jnp.sqrt(jnp.maximum(-neg, 0.0) / D)
+    scaled = jnp.where(idx >= 0,
+                       jnp.asarray(jnp.rint(dist * SCALE), jnp.int32),
+                       INT_BIG)
+    return scaled, idx
+
+
+@partial(jax.jit, static_argnames=("k",))
+def int8rr_topk(x, y, *, k):
+    xa, ya, _ = _int8_aug_operands(x, y)
+    raw_d, raw_i = _launch(xa, ya, k=K_CAND, acc_dtype=jnp.int32,
+                           big=INT_BIG)
+    return _exact_rerank(x, y, raw_i[:, :K_CAND], k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def int8pk_topk(x, y, *, k):
+    xa, ya, _ = _int8_aug_operands(x, y)
+    raw_d, raw_i = _launch(xa, ya, k=K_CAND, acc_dtype=jnp.int32,
+                           big=INT_BIG, packed=True)
+    return _exact_rerank(x, y, raw_i[:, :K_CAND], k)
+
+
+# --------------------------------------------------------------------------
+# harness (same protocol as sweep16)
+# --------------------------------------------------------------------------
+
+def _chain(topk, n_iters):
+    @jax.jit
+    def chain(test, train):
+        def body(t, _):
+            d, i = topk(t, train)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, (d[0, 0], i[0, 0])
+        _, outs = jax.lax.scan(body, test, None, length=n_iters)
+        return jnp.sum(outs[0].astype(jnp.float32)) + \
+            jnp.sum(outs[1].astype(jnp.float32))
+    return chain
+
+
+def _gate(name, topk, test, train):
+    d_ex, i_ex = pairwise_topk(test[:512], train, k=K, mode="exact")
+    d_c, i_c = topk(test[:512], train)
+    d_ex, i_ex, d_c, i_c = map(np.asarray, (d_ex, i_ex, d_c, i_c))
+    recall = np.mean([len(set(i_ex[r]) & set(i_c[r])) / K
+                      for r in range(i_ex.shape[0])])
+    err, nm = 0, 0
+    for r in range(i_ex.shape[0]):
+        ex = {int(i): float(d) for i, d in zip(i_ex[r], d_ex[r])}
+        for i, d in zip(i_c[r], d_c[r]):
+            if int(i) in ex:
+                err = max(err, abs(int(round(float(d) - ex[int(i)]))))
+                nm += 1
+    print(f"gate {name:9s} recall={recall:.4f} dist_err={err} (n={nm})",
+          flush=True)
+    return recall >= 0.985 and err <= 25
+
+
+def main():
+    rng = np.random.default_rng(0)
+    train = jnp.asarray(rng.random((N_TRAIN, D), dtype=np.float32))
+    test = jnp.asarray(rng.random((M_TEST, D), dtype=np.float32))
+
+    cands = {
+        "prod": lambda t, tr: pairwise_topk_pallas(t, tr, k=K),
+        "tagfold": lambda t, tr: tagfold_topk(t, tr, k=K),
+        "augv2": lambda t, tr: augv2_topk(t, tr, k=K),
+        "int8rr": lambda t, tr: int8rr_topk(t, tr, k=K),
+        "int8pk": lambda t, tr: int8pk_topk(t, tr, k=K),
+    }
+    ok = {}
+    for name, fn in cands.items():
+        try:
+            ok[name] = _gate(name, fn, test, train)
+        except Exception as exc:
+            print(f"gate {name} FAILED: {type(exc).__name__}: {exc}",
+                  flush=True)
+            ok[name] = False
+    cands = {n: f for n, f in cands.items() if ok[n]}
+    if "prod" not in cands:
+        raise SystemExit("anchor failed its own gate — relay broken?")
+
+    chains = {}
+    for name, fn in cands.items():
+        chains[name] = (_chain(fn, ITERS_LO), _chain(fn, ITERS_HI))
+        for c in chains[name]:
+            np.asarray(c(test, train))
+        print(f"warmed {name}", flush=True)
+
+    per_round = {n: [] for n in chains}
+    for r in range(ROUNDS):
+        for name, (clo, chi) in chains.items():
+            t0 = time.perf_counter()
+            np.asarray(clo(test, train))
+            tlo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            np.asarray(chi(test, train))
+            thi = time.perf_counter() - t0
+            us = (thi - tlo) / (ITERS_HI - ITERS_LO) * 1e6
+            per_round[name].append(us)
+            print(f"round {r} {name:9s} {us:8.1f} us/iter", flush=True)
+
+    print("\n# per-variant median us/iter and ratio vs prod (this session)")
+    med = {n: float(np.median(v)) for n, v in per_round.items()}
+    for n, m in sorted(med.items(), key=lambda kv: kv[1]):
+        print(f"{n:9s} {m:8.1f} us/iter   {med['prod'] / m:5.2f}x prod   "
+              f"{M_TEST / m:7.2f}M rows/s kernel")
+
+
+if __name__ == "__main__":
+    main()

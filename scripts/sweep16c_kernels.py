@@ -1,0 +1,275 @@
+"""Sweep 16c (round 4): int8 KNN kernel, recall-engineered.
+
+sweep16b diagnosis:
+  - tagfold == prod speed (1.00x): VPU fold op-count micro-opts are dead;
+    the padded-K bf16 dot (~700us of ~970us/iter) is the binder.
+  - int8pk recall 0.9262 decomposes as (a) bucket collisions at C=16
+    candidates over 512 buckets (~15/1024 per neighbor) and (b) the
+    quantizer wasting half the int8 range (features are >=0, x-side -2
+    headroom forced scale 63).
+  - int8rr OOM'd scoped VMEM: int32 slab at tile_m=1024 is 16MB alone.
+
+Fixes here: CENTER features before quantizing (squared distance is
+translation-invariant; range doubles to +-63 over [-0.5,0.5] => per-dim
+error 1/252), n_acc=8 (1024 buckets), tile_m=512 (slab 8MB + packed
+single accumulator 2MB), candidates C=8 with exact f32 re-rank.
+
+  prod      production kernel (anchor)
+  int8pk8   int8 packed fold, centered, C=8, n_acc=8, rerank
+  int8pk16  same with C=16, n_acc=16 (coverage margin probe)
+
+Gate prints recall AND candidate coverage (|top5_exact & topC|/5) so a
+failure attributes to coverage vs collision vs rerank.
+
+Run: PYTHONPATH=/root/.axon_site:. python -u scripts/sweep16c_kernels.py
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.ops.pallas_distance import (
+    INT_BIG, LANES, _pad_rows, pairwise_topk_pallas)
+
+N_TRAIN = 65536
+M_TEST = 8192
+D = 9
+K = 5
+ITERS_LO, ITERS_HI = 25, 100
+ROUNDS = 5
+TILE_N = 4096
+SCALE = 1000
+
+
+def _packed_kernel(x_ref, y_ref, od, oi, acc, *, c_out, tn, n_acc):
+    j = pl.program_id(1)
+    big = INT_BIG
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.full(acc.shape, big, jnp.int32)
+
+    metric = lax.dot_general(x_ref[:], y_ref[:], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.int32)
+    tm = metric.shape[0]
+    n_chunks = tn // LANES
+    for c in range(n_chunks):
+        s = c % n_acc
+        tag = j * n_chunks + c
+        packed = metric[:, c * LANES:(c + 1) * LANES] * 2048 + tag
+        cur = acc[:, s * LANES:(s + 1) * LANES]
+        acc[:, s * LANES:(s + 1) * LANES] = jnp.minimum(packed, cur)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        val = acc[:]
+        col = lax.broadcasted_iota(jnp.int32, val.shape, 1)
+        found = val < big
+        idx = jnp.where(found, (val & 2047) * LANES + (col % LANES), -1)
+        metric_v = jnp.where(found, lax.shift_right_arithmetic(val, 11), big)
+        new_d = jnp.full((tm, LANES), big, jnp.int32)
+        new_i = jnp.full((tm, LANES), -1, jnp.int32)
+        slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+        for slot in range(c_out):
+            min_d = jnp.min(metric_v, axis=1, keepdims=True)
+            min_i = jnp.min(jnp.where(metric_v == min_d, idx, INT_BIG),
+                            axis=1, keepdims=True)
+            new_d = jnp.where(slot_lane == slot, min_d, new_d)
+            new_i = jnp.where(slot_lane == slot, min_i, new_i)
+            metric_v = jnp.where((metric_v == min_d) & (idx == min_i),
+                                 big, metric_v)
+        od[:] = new_d
+        oi[:] = new_i
+
+
+def _launch_packed(xa, ya, *, c_out, tile_m, n_acc):
+    m, d = xa.shape
+    xp = _pad_rows(xa, tile_m)
+    yp = _pad_rows(ya, TILE_N)
+    grid = (xp.shape[0] // tile_m, yp.shape[0] // TILE_N)
+    out_d, out_i = pl.pallas_call(
+        partial(_packed_kernel, c_out=c_out, tn=TILE_N, n_acc=n_acc),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_m, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_N, d), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((tile_m, n_acc * LANES), jnp.int32)],
+    )(xp, yp)
+    return out_d[:m], out_i[:m]
+
+
+def _int8_centered_operands(x, y):
+    """Center jointly, quantize to +-63 base range (the -2 factor on the x
+    side then spans +-126), y2 decomposed exactly into 10 int8 columns."""
+    lo = jnp.minimum(jnp.min(x), jnp.min(y))
+    hi = jnp.maximum(jnp.max(x), jnp.max(y))
+    mid = 0.5 * (lo + hi)
+    s = 63.0 / jnp.maximum(0.5 * (hi - lo), 1e-12)
+    x8 = jnp.asarray(jnp.rint((x - mid) * s), jnp.int8)
+    y8 = jnp.asarray(jnp.rint((y - mid) * s), jnp.int8)
+    m = x8.shape[0]
+    ones = jnp.ones((m, 1), jnp.int8)
+    c127 = jnp.full((m, 9), 127, jnp.int8)
+    xa = jnp.concatenate(
+        [jnp.asarray(-2 * jnp.asarray(x8, jnp.int32), jnp.int8), ones, c127],
+        axis=1)
+    y2 = jnp.sum(jnp.asarray(y8, jnp.int32) ** 2, axis=1)
+    q, r = jnp.divmod(y2, 127)
+    digits = jnp.stack([(q + i) // 9 for i in range(9)], axis=1)
+    ya = jnp.concatenate(
+        [y8, jnp.asarray(r, jnp.int8)[:, None],
+         jnp.asarray(digits, jnp.int8)], axis=1)
+    pad = (-y.shape[0]) % TILE_N
+    if pad:
+        fill = jnp.zeros((pad, ya.shape[1]), jnp.int8).at[:, D + 1:].set(126)
+        ya = jnp.concatenate([ya, fill], 0)
+    return xa, ya, s
+
+
+def _exact_rerank(x, y, cand_i, k):
+    g = y[jnp.maximum(cand_i, 0)]
+    d2 = jnp.sum((x[:, None, :] - g) ** 2, axis=2)
+    d2 = jnp.where(cand_i >= 0, d2, jnp.inf)
+    neg, sel = lax.top_k(-d2, k)
+    idx = jnp.take_along_axis(cand_i, sel, axis=1)
+    dist = jnp.sqrt(jnp.maximum(-neg, 0.0) / D)
+    scaled = jnp.where(idx >= 0,
+                       jnp.asarray(jnp.rint(dist * SCALE), jnp.int32),
+                       INT_BIG)
+    return scaled, idx
+
+
+def make_int8pk(c_out, tile_m, n_acc):
+    @partial(jax.jit, static_argnames=("k", "with_cand"))
+    def topk(x, y, *, k, with_cand=False):
+        xa, ya, _ = _int8_centered_operands(x, y)
+        _, raw_i = _launch_packed(xa, ya, c_out=c_out, tile_m=tile_m,
+                                  n_acc=n_acc)
+        cand = raw_i[:, :c_out]
+        d, i = _exact_rerank(x, y, cand, k)
+        if with_cand:
+            return d, i, cand
+        return d, i
+    return topk
+
+
+def _chain(topk, n_iters):
+    @jax.jit
+    def chain(test, train):
+        def body(t, _):
+            d, i = topk(t, train)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, (d[0, 0], i[0, 0])
+        _, outs = jax.lax.scan(body, test, None, length=n_iters)
+        return jnp.sum(outs[0].astype(jnp.float32)) + \
+            jnp.sum(outs[1].astype(jnp.float32))
+    return chain
+
+
+def _gate(name, topk, test, train, cand_fn=None):
+    d_ex, i_ex = pairwise_topk(test[:512], train, k=K, mode="exact")
+    d_c, i_c = topk(test[:512], train)
+    d_ex, i_ex, d_c, i_c = map(np.asarray, (d_ex, i_ex, d_c, i_c))
+    recall = np.mean([len(set(i_ex[r]) & set(i_c[r])) / K
+                      for r in range(i_ex.shape[0])])
+    err, nm = 0, 0
+    for r in range(i_ex.shape[0]):
+        ex = {int(i): float(d) for i, d in zip(i_ex[r], d_ex[r])}
+        for i, d in zip(i_c[r], d_c[r]):
+            if int(i) in ex:
+                err = max(err, abs(int(round(float(d) - ex[int(i)]))))
+                nm += 1
+    cov = float("nan")
+    if cand_fn is not None:
+        _, _, cand = cand_fn(test[:512], train)
+        cand = np.asarray(cand)
+        cov = np.mean([len(set(i_ex[r]) & set(cand[r])) / K
+                       for r in range(i_ex.shape[0])])
+    print(f"gate {name:9s} recall={recall:.4f} dist_err={err} (n={nm}) "
+          f"candidate_coverage={cov:.4f}", flush=True)
+    return recall >= 0.985 and err <= 25
+
+
+def main():
+    rng = np.random.default_rng(0)
+    train = jnp.asarray(rng.random((N_TRAIN, D), dtype=np.float32))
+    test = jnp.asarray(rng.random((M_TEST, D), dtype=np.float32))
+
+    pk8 = make_int8pk(8, 512, 8)
+    pk16 = make_int8pk(16, 512, 16)
+    cands = {
+        "prod": (lambda t, tr: pairwise_topk_pallas(t, tr, k=K), None),
+        "int8pk8": (lambda t, tr: pk8(t, tr, k=K),
+                    lambda t, tr: pk8(t, tr, k=K, with_cand=True)),
+        "int8pk16": (lambda t, tr: pk16(t, tr, k=K),
+                     lambda t, tr: pk16(t, tr, k=K, with_cand=True)),
+    }
+    gate_ok = {}
+    for name, (fn, cf) in cands.items():
+        try:
+            gate_ok[name] = _gate(name, fn, test, train, cf)
+        except Exception as exc:
+            print(f"gate {name} FAILED: {type(exc).__name__}: {exc}",
+                  flush=True)
+            gate_ok[name] = False
+
+    # time everything that COMPILES (recall failures still get timed — the
+    # point of this sweep is to learn whether the int8 line is worth more
+    # recall engineering), but mark gated-out variants
+    chains = {}
+    for name, (fn, _) in cands.items():
+        if name != "prod" and gate_ok.get(name) is False and \
+                not np.isfinite(1.0):
+            continue
+        try:
+            chains[name] = (_chain(fn, ITERS_LO), _chain(fn, ITERS_HI))
+            for c in chains[name]:
+                np.asarray(c(test, train))
+            print(f"warmed {name}", flush=True)
+        except Exception as exc:
+            print(f"warm {name} FAILED: {type(exc).__name__}", flush=True)
+
+    per_round = {n: [] for n in chains}
+    for r in range(ROUNDS):
+        for name, (clo, chi) in chains.items():
+            t0 = time.perf_counter()
+            np.asarray(clo(test, train))
+            tlo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            np.asarray(chi(test, train))
+            thi = time.perf_counter() - t0
+            us = (thi - tlo) / (ITERS_HI - ITERS_LO) * 1e6
+            per_round[name].append(us)
+            print(f"round {r} {name:9s} {us:8.1f} us/iter", flush=True)
+
+    print("\n# medians (gate status marked)")
+    med = {n: float(np.median(v)) for n, v in per_round.items()}
+    for n, m in sorted(med.items(), key=lambda kv: kv[1]):
+        mark = "PASS" if gate_ok.get(n) else "gate-FAIL"
+        print(f"{n:9s} {m:8.1f} us/iter   {med['prod'] / m:5.2f}x prod   "
+              f"{M_TEST / m:7.2f}M rows/s   [{mark}]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Sweep 17 (round 4): the contention-proof tpose adjudication.
+
+History: the transposed-contraction kernel (operands [D, M] x [D, N],
+contraction on the SUBLANE axis so D=9 pads to 16 instead of 128) measured
+1.37x prod in the round-3 roofline and 0.89x in the sweep14 gated rerun —
+both runs timed each kernel's draws in a contiguous window, so minute-scale
+relay/contention drift sits fully inside the comparison. VERDICT round 3
+prescribes: interleaved A/B pairs, repeated across >=3 sessions/days,
+adopt on median.
+
+This script runs ONE session: per round, the four timings are interleaved
+prod_lo, tpose_lo, prod_hi, tpose_hi (differential per kernel per round),
+and the per-round RATIO is the statistic — contention that drifts between
+rounds cancels; only sub-round drift (seconds) remains. Append each
+session's output to sweep17_results.txt; the adoption decision takes the
+median ratio across all sessions.
+
+Run: PYTHONPATH=/root/.axon_site:. python -u scripts/sweep17_tpose_protocol.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, "scripts")
+from sweep14_tpose import tpose_topk            # noqa: E402
+
+from avenir_tpu.ops.distance import pairwise_topk          # noqa: E402
+from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas  # noqa: E402
+
+N_TRAIN = 65536
+M_TEST = 8192
+D = 9
+K = 5
+ITERS_LO, ITERS_HI = 25, 100
+ROUNDS = 6
+
+
+def chain_for(fn, n):
+    @jax.jit
+    def chain(t, train):
+        def body(t, _):
+            d, _i = fn(t, train)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, d[0, 0]
+        outs = lax.scan(body, t, None, length=n)[1]
+        return jnp.sum(outs)
+    return chain
+
+
+def main():
+    rng = np.random.default_rng(0)
+    train = jnp.asarray(rng.random((N_TRAIN, D), dtype=np.float32))
+    test = jnp.asarray(rng.random((M_TEST, D), dtype=np.float32))
+
+    d_ex, i_ex = pairwise_topk(test[:512], train, k=K, mode="exact")
+    d_tp, i_tp = tpose_topk(test[:512], train, k=K)
+    i_ex, i_tp = np.asarray(i_ex), np.asarray(i_tp)
+    recall = np.mean([len(set(a) & set(b)) / K for a, b in zip(i_tp, i_ex)])
+    print(f"tpose recall vs exact: {recall:.4f}", flush=True)
+    if recall < 0.985:
+        print("GATE FAIL")
+        return
+
+    fns = {"prod": lambda t, tr: pairwise_topk_pallas(t, tr, k=K),
+           "tpose": lambda t, tr: tpose_topk(t, tr, k=K)}
+    chains = {n: (chain_for(f, ITERS_LO), chain_for(f, ITERS_HI))
+              for n, f in fns.items()}
+    for n, (lo, hi) in chains.items():
+        np.asarray(lo(test, train)), np.asarray(hi(test, train))
+        print(f"warmed {n}", flush=True)
+
+    ratios = []
+    for r in range(ROUNDS):
+        t = {}
+        for phase in ("lo", "hi"):
+            for n, (lo, hi) in chains.items():
+                c = lo if phase == "lo" else hi
+                t0 = time.perf_counter()
+                np.asarray(c(test, train))
+                t[(n, phase)] = time.perf_counter() - t0
+        us = {n: (t[(n, "hi")] - t[(n, "lo")]) /
+              (ITERS_HI - ITERS_LO) * 1e6 for n in fns}
+        ratio = us["prod"] / us["tpose"]
+        ratios.append(ratio)
+        print(f"round {r}: prod {us['prod']:7.1f} us/iter  "
+              f"tpose {us['tpose']:7.1f} us/iter  ratio {ratio:.3f}",
+              flush=True)
+
+    med = float(np.median(ratios))
+    print(f"\n# session median tpose speedup: {med:.3f}x  "
+          f"({time.strftime('%Y-%m-%d %H:%M:%S')})")
+
+
+if __name__ == "__main__":
+    main()

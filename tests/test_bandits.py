@@ -4,6 +4,8 @@ factory parity, grouped vmapped learners, batch bandits, online loop."""
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 from avenir_tpu.datagen import price_opt_arms
 from avenir_tpu.models import bandits as B
 from avenir_tpu.stream.loop import GroupedLearner, InProcQueues, OnlineLearnerLoop
@@ -258,3 +260,101 @@ class TestRedisWireProtocol:
         assert len(actions) == 40
         assert all(a.split(",")[1] in ("page1", "page2", "page3")
                    for a in actions)
+
+
+class TestFusedMicroBatch:
+    """Round-4 micro-batch stepping (the bolt's reward-drain pattern,
+    ReinforcementLearnerBolt.java:96-99): R selections / R reward-applies
+    per dispatch. Reward aggregation must equal the sequential fold
+    EXACTLY where a fast path exists; selection fast paths advance decay
+    schedules in closed form, checked against the scalar step's schedule."""
+
+    @pytest.mark.parametrize("learner_type", [
+        "softMax", "randomGreedy", "upperConfidenceBoundOne",
+        "exponentialWeight", "actionPursuit", "rewardComparison",
+        "sampsonSampler", "intervalEstimator"])
+    def test_reward_fused_equals_sequential(self, learner_type):
+        from avenir_tpu.models.bandits.learners import (
+            ALGORITHMS, LearnerConfig, set_rewards_fused)
+        import jax
+        cfg = LearnerConfig()
+        algo = ALGORITHMS[learner_type]
+        state = algo.init(jax.random.PRNGKey(3), 4, cfg)
+        rng = np.random.default_rng(0)
+        actions = jnp.asarray(rng.integers(0, 4, 33), jnp.int32)
+        rewards = jnp.asarray(rng.uniform(0, 90, 33), jnp.float32)
+        seq = state
+        for a, r in zip(actions, rewards):
+            seq = algo.set_reward(seq, a, r, cfg=cfg)
+        fused = set_rewards_fused(algo, state, actions, rewards, cfg)
+        for leaf_s, leaf_f in zip(jax.tree.leaves(seq),
+                                  jax.tree.leaves(fused)):
+            np.testing.assert_allclose(np.asarray(leaf_s),
+                                       np.asarray(leaf_f), rtol=2e-5)
+
+    @pytest.mark.parametrize("learner_type,sched", [
+        ("softMax", "linear"), ("softMax", "logLinear"), ("softMax", "none"),
+        ("randomGreedy", "linear")])
+    def test_select_fused_schedule_matches_scalar(self, learner_type, sched):
+        """The closed-form decay schedule must land on the same final
+        temperature/counts as R scalar steps (PRNG draws differ by design;
+        schedule state and count totals must not)."""
+        from avenir_tpu.models.bandits.learners import (
+            ALGORITHMS, LearnerConfig, next_actions_fused)
+        import jax
+        key = {"softMax": "temp_reduction_algorithm",
+               "randomGreedy": "prob_reduction_algorithm"}[learner_type]
+        cfg = LearnerConfig(**{key: sched, "min_temp_constant": 2.0,
+                               "temp_constant": 50.0})
+        algo = ALGORITHMS[learner_type]
+        state = algo.init(jax.random.PRNGKey(5), 4, cfg)
+        # advance a few scalar steps first so t0 > 0
+        for _ in range(3):
+            state, _ = algo.next_action(state, cfg)
+        r = 17
+        seq = state
+        for _ in range(r):
+            seq, _ = algo.next_action(seq, cfg)
+        fused, acts = next_actions_fused(algo, state, cfg, r)
+        assert acts.shape == (r,)
+        assert int(fused.total_trials) == int(seq.total_trials)
+        np.testing.assert_allclose(float(fused.scalar_a),
+                                   float(seq.scalar_a), rtol=1e-5)
+        # counts: fused bincounts its own draws; totals must agree
+        assert int(jnp.sum(fused.trial_counts)) == \
+            int(jnp.sum(seq.trial_counts))
+
+    def test_fused_scan_fallback_exact(self):
+        """Algorithms without a fast path (UCB2) go through the scan
+        fallback — bit-identical to sequential scalar calls."""
+        from avenir_tpu.models.bandits.learners import (
+            ALGORITHMS, LearnerConfig, next_actions_fused)
+        import jax
+        cfg = LearnerConfig()
+        algo = ALGORITHMS["upperConfidenceBoundTwo"]
+        state = algo.init(jax.random.PRNGKey(2), 3, cfg)
+        seq, seq_actions = state, []
+        for _ in range(9):
+            seq, a = algo.next_action(seq, cfg)
+            seq_actions.append(int(a))
+        fused, acts = next_actions_fused(algo, state, cfg, 9)
+        assert [int(a) for a in acts] == seq_actions
+        np.testing.assert_array_equal(np.asarray(seq.trial_counts),
+                                      np.asarray(fused.trial_counts))
+
+    def test_microbatch_convergence(self):
+        """End-to-end sanity: micro-batched softMax still converges to the
+        best arm (the ledger workload's semantics)."""
+        from avenir_tpu.models.bandits.learners import (
+            ALGORITHMS, LearnerConfig, next_actions_fused,
+            set_rewards_fused)
+        import jax
+        cfg = LearnerConfig(temp_constant=20.0)
+        algo = ALGORITHMS["softMax"]
+        arm_rewards = jnp.asarray([10.0, 80.0, 30.0, 20.0])
+        state = algo.init(jax.random.PRNGKey(0), 4, cfg)
+        for _ in range(30):
+            state, acts = next_actions_fused(algo, state, cfg, 16)
+            rws = arm_rewards[acts]
+            state = set_rewards_fused(algo, state, acts, rws, cfg)
+        assert int(jnp.argmax(state.reward_count)) == 1

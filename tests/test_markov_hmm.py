@@ -290,6 +290,42 @@ class TestBaumWelch:
         # heavy smoothing pulls the model toward uniform: lower likelihood
         assert ll_sharp[-1] > ll_soft[-1]
 
+    def test_budget_is_exact_on_both_paths(self, tmp_path):
+        """Round-4 contract (ADVICE round 3): len(ll) never exceeds
+        n_iters — the while-kernel path stops exactly, and the chunked
+        checkpoint path clamps its final chunk instead of rounding the
+        budget up to whole chunks."""
+        rows, *_, names = self._planted(n_seqs=40)
+        _, ll = H.train_baum_welch(rows, names, 2, n_iters=13, seed=1)
+        assert len(ll) == 13
+        ck = str(tmp_path / "bw13.ckpt")
+        _, ll_ck = H.train_baum_welch(rows, names, 2, n_iters=13, seed=1,
+                                      chunk_size=5, checkpoint_path=ck)
+        assert len(ll_ck) == 13
+        np.testing.assert_allclose(ll, ll_ck, rtol=1e-5)
+
+    def test_while_kernel_matches_chunked(self, tmp_path):
+        """The single-dispatch while_loop path and the chunked checkpoint
+        path trace the same em_iter: same LL trajectory, same model."""
+        rows, *_, names = self._planted(n_seqs=50)
+        m_w, ll_w = H.train_baum_welch(rows, names, 2, n_iters=12, seed=2)
+        ck = str(tmp_path / "bw12.ckpt")
+        m_c, ll_c = H.train_baum_welch(rows, names, 2, n_iters=12, seed=2,
+                                       chunk_size=4, checkpoint_path=ck)
+        np.testing.assert_allclose(ll_w, ll_c, rtol=1e-5)
+        np.testing.assert_allclose(m_w.trans, m_c.trans, atol=1e-5)
+        np.testing.assert_allclose(m_w.emit, m_c.emit, atol=1e-5)
+
+    def test_while_path_stops_within_one_iteration_of_tol(self):
+        rows, *_, names = self._planted(n_seqs=80)
+        _, ll = H.train_baum_welch(rows, names, 2, n_iters=200, seed=1,
+                                   ll_rel_tol=1e-4)
+        assert len(ll) < 200
+        # the stop is tight: the PREVIOUS gain was above threshold
+        assert abs(ll[-1] - ll[-2]) <= 1e-4 * max(1.0, abs(ll[-1]))
+        if len(ll) >= 3:
+            assert abs(ll[-2] - ll[-3]) > 1e-4 * max(1.0, abs(ll[-2]))
+
 
 class TestTransactionStates:
     """The email-marketing tutorial's pre/post stages (xaction_state.rb /
