@@ -562,18 +562,131 @@ def run_split_generator(conf: JobConfig, in_path: str, out_path: str) -> None:
     run_class_partition_generator(conf, in_path, out_path)
 
 
+def _run_data_partitioner_batched(conf: JobConfig, in_path: str,
+                                  out_path: str, table, raw_lines,
+                                  levels: int) -> None:
+    """L rounds of SplitGenerator→DataPartitioner in ONE invocation and
+    ONE device dispatch (VERDICT round-3 item 9; ``grow_levels_batched``).
+    Writes, per visited node, the same artifacts the sequential rounds
+    would: a ``splits/part-r-00000`` candidate file (skipped where one
+    already exists — e.g. the operator's own SplitGenerator output at the
+    root), ``split=<i>/segment=<j>/data/partition.txt`` partitions, and
+    the ``_used.attributes`` lineage sidecar. Restrictions (each checked):
+    path-independent attribute selection (``all``/``userSpecified`` — a
+    per-node ``notUsedYet``/``random`` draw needs per-node invocations)
+    and ``best`` split selection (device routing is argmax). Descent stops
+    at pure or singleton children, whose further rounds are degenerate
+    (gain-0 candidate files over one class)."""
+    import os
+    import numpy as np
+    from avenir_tpu.models import tree as T
+    strategy = conf.get("split.attribute.selection.strategy", "all")
+    if strategy not in ("all", "userSpecified"):
+        raise ValueError(
+            f"tree.levels.per.invocation={levels} requires a "
+            "path-independent attribute selection strategy ('all' or "
+            f"'userSpecified'), got {strategy!r} — run per-level instead")
+    if conf.get("split.selection.strategy", "best") != "best":
+        raise ValueError(
+            "tree.levels.per.invocation requires "
+            "split.selection.strategy=best (device selection is argmax)")
+    algorithm = conf.get("split.algorithm", "giniIndex")
+    delim = conf.get("field.delim.out", ";")
+    attrs = _select_split_attributes(conf, table, in_path=in_path)
+    records, keys = T.grow_levels_batched(
+        table, attrs, algorithm, levels,
+        max_cat_attr_split_groups=conf.get_int(
+            "max.cat.attr.split.groups", 3),
+        min_node_size=conf.get_int("tree.batch.min.node.rows", 2),
+        node_budget=conf.get_int("tree.device.node.budget", 2048))
+
+    data_dir = (in_path if os.path.isdir(in_path)
+                else os.path.dirname(in_path))
+    root_splits = conf.get("candidate.splits.path") or os.path.join(
+        os.path.dirname(data_dir), "splits", "part-r-00000")
+    used0 = _find_used_attributes(in_path)
+    # host routing caches one full-table segment vector per chosen split
+    seg_cache: dict = {}
+    # level-0 node: rows = all, node dir = out_path, splits artifact at
+    # the contract location next to the input data
+    nodes = {0: (out_path, np.arange(table.n_rows), used0, root_splits)}
+    n_nodes_written = 0
+    for level, rec in enumerate(records):
+        ratio = np.asarray(rec["ratio"])
+        next_nodes: dict = {}
+        for slot, (node_dir, row_idx, used, splits_path) in nodes.items():
+            cands = [T.CandidateSplit(a, k, float(ratio[t, slot]),
+                                      float(ratio[t, slot]),
+                                      float(ratio[t, slot]))
+                     for t, (a, k, _s) in enumerate(keys)]
+            splits_dir = os.path.dirname(splits_path)
+            if splits_dir:
+                os.makedirs(splits_dir, exist_ok=True)
+            if not os.path.exists(splits_path):
+                T.write_candidate_splits(cands, splits_path, delim)
+            n_nodes_written += 1
+            # the ROOT is partitioned unconditionally — the sequential
+            # DataPartitioner partitions whatever node it is invoked on;
+            # only CHILDREN are pruned at pure/singleton (their rounds
+            # would be degenerate)
+            if not bool(rec["split"][slot]) and level > 0:
+                continue
+            t_best = int(rec["best_t"][slot])
+            attr, key, _n_seg = keys[t_best]
+            if t_best not in seg_cache:
+                seg_cache[t_best] = np.asarray(
+                    T.segment_of_rows(table, attr, key))
+            segs = seg_cache[t_best][row_idx]
+            split_dir = os.path.join(node_dir, f"split={t_best}")
+            for seg in sorted(set(int(s) for s in segs)):
+                seg_rows = row_idx[segs == seg]
+                seg_dir = os.path.join(split_dir, f"segment={seg}", "data")
+                os.makedirs(seg_dir, exist_ok=True)
+                with open(os.path.join(seg_dir, "partition.txt"),
+                          "w") as fh:
+                    for i in seg_rows:
+                        fh.write(raw_lines[i] + "\n")
+            new_used = used if attr in used else used + [attr]
+            with open(os.path.join(split_dir, USED_ATTRS_SIDECAR),
+                      "w") as fh:
+                fh.write(",".join(str(a) for a in new_used) + "\n")
+            if level + 1 < len(records):
+                for seg in range(rec["child_slot"].shape[1]):
+                    child = int(rec["child_slot"][slot, seg])
+                    if child < 0:
+                        continue
+                    child_dir = os.path.join(split_dir, f"segment={seg}")
+                    child_splits = os.path.join(child_dir, "splits",
+                                                "part-r-00000")
+                    next_nodes[child] = (
+                        child_dir, row_idx[segs == seg], new_used,
+                        child_splits)
+        nodes = next_nodes
+        if not nodes:
+            break
+    print(f'{{"tree.levels": {len(records)}, '
+          f'"tree.nodes.visited": {n_nodes_written}}}')
+
+
 def run_data_partitioner(conf: JobConfig, in_path: str, out_path: str) -> None:
     """Partition node data by the best candidate split (reference
     tree.DataPartitioner): reads the sibling ``splits`` artifact, sorts by
     stat descending, routes rows into
     ``<out>/split=<rank>/segment=<j>/data/partition.txt`` (DataPartitioner
     .java:59-129). ``in_path`` is the node's data file; ``out_path`` the
-    node directory."""
+    node directory. With ``tree.levels.per.invocation=L`` (> 1), L
+    consecutive rounds run in one invocation and one device dispatch —
+    see :func:`_run_data_partitioner_batched`."""
     import os
     import numpy as np
     from avenir_tpu.models import tree as T
     fz, rows = _load_table(conf, in_path)
     table = fz.transform(rows)
+    levels = conf.get_int("tree.levels.per.invocation", 1)
+    if levels > 1:
+        _run_data_partitioner_batched(conf, in_path, out_path, table,
+                                      _read_raw_lines(in_path), levels)
+        return
     delim = conf.get("field.delim.out", ";")
     # sibling `splits/` of the node's data: for a part-file dir input the
     # data component IS in_path; for a file input it is the parent dir

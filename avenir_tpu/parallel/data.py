@@ -206,7 +206,9 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
     if jax.process_count() == 1:
         # multi-process runs always stream; one process defaults to the
         # native whole-file fast path and takes the chunked bounded-memory
-        # reader only on request (stream=True honors chunk_rows here too)
+        # reader only on request. Round 4: stream=True prefers the native
+        # WINDOWED pass (peak = outputs + one 32MB window); chunk_rows
+        # governs only its Python fallback — see transform_file_streamed
         from avenir_tpu.native.loader import (transform_file,
                                               transform_file_streamed)
         local = (transform_file_streamed(fz, path, delim_regex,

@@ -127,7 +127,8 @@ class TestByteWindowStreaming:
 
         from avenir_tpu.native.loader import transform_file_streamed
         tracemalloc.start()
-        streamed = transform_file_streamed(fz, path, chunk_rows=1024)
+        streamed = transform_file_streamed(fz, path, chunk_rows=1024,
+                                           force_python=True)
         _, peak_stream = tracemalloc.get_traced_memory()
         tracemalloc.stop()
 
@@ -135,6 +136,43 @@ class TestByteWindowStreaming:
         # output arrays alone are ~20000*5*8 bytes; the token lists are the
         # dominant in-memory term the streamer must never hold
         assert peak_stream < peak_inmem / 2, (peak_stream, peak_inmem)
+
+        # round-4 native windowed leg: same bound at a window smaller than
+        # the file (several windows + a carry tail), same output
+        from avenir_tpu.native import _load
+        if _load() is not None:
+            tracemalloc.start()
+            windowed = transform_file_streamed(fz, path,
+                                               window_bytes=64 * 1024)
+            _, peak_win = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
+            assert windowed.n_rows == 20000
+            np.testing.assert_array_equal(np.asarray(windowed.binned),
+                                          np.asarray(streamed.binned))
+            np.testing.assert_array_equal(np.asarray(windowed.labels),
+                                          np.asarray(streamed.labels))
+            assert windowed.ids == streamed.ids
+            assert peak_win < peak_inmem / 2, (peak_win, peak_inmem)
+
+    def test_native_windowed_matches_whole_file(self, churn_fixture):
+        """encode_file_windowed at a tiny window (forcing many windows and
+        the no-newline carry path) is bit-identical to the whole-file
+        native pass."""
+        rows, path, fz = churn_fixture
+        from avenir_tpu.native import _load
+        if _load() is None:
+            import pytest
+            pytest.skip("native library unavailable")
+        from avenir_tpu.native.loader import encode_file, encode_file_windowed
+        a = encode_file(fz, path)
+        b = encode_file_windowed(fz, path, window_bytes=256)
+        np.testing.assert_array_equal(np.asarray(a.binned),
+                                      np.asarray(b.binned))
+        np.testing.assert_array_equal(np.asarray(a.numeric),
+                                      np.asarray(b.numeric))
+        np.testing.assert_array_equal(np.asarray(a.labels),
+                                      np.asarray(b.labels))
+        assert a.ids == b.ids
 
 
 class TestPadLocalSlice:
