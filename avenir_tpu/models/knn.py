@@ -112,9 +112,12 @@ def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
 def _vote_kernel(dist: jnp.ndarray, nbr_labels: jnp.ndarray,
                  nbr_post: Optional[jnp.ndarray],
                  kernel_function: str, kernel_param: int, n_classes: int,
-                 class_cond_weighted: bool, inverse_distance_weighted: bool
+                 class_cond_weighted: bool, inverse_distance_weighted: bool,
+                 valid: Optional[jnp.ndarray] = None
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Kernel scores + per-class vote. Returns (scores [M,C], raw_scores [M,k])."""
+    """Kernel scores + per-class vote. Returns (scores [M,C], raw_scores
+    [M,k]). ``valid`` masks padded neighbor slots (precomputed-neighbor
+    input may hold fewer than k records per test entity)."""
     if kernel_function == "none":
         score = jnp.ones_like(dist)
     elif kernel_function == "linearMultiplicative":
@@ -133,6 +136,8 @@ def _vote_kernel(dist: jnp.ndarray, nbr_labels: jnp.ndarray,
         w = jnp.where(nbr_post > 0, w * nbr_post, w)
     if inverse_distance_weighted:
         w = w / jnp.maximum(dist.astype(jnp.float32), 1.0)
+    if valid is not None:
+        w = w * valid.astype(jnp.float32)
 
     oh = jax.nn.one_hot(nbr_labels, n_classes, dtype=jnp.float32)  # [M, k, C]
     votes = jnp.einsum("mk,mkc->mc", w, oh)
@@ -146,6 +151,85 @@ class KnnPrediction:
     class_prob: Optional[np.ndarray]   # [M, C] int percent (PROB_SCALE)
     neighbor_idx: np.ndarray         # [M, k]
     neighbor_dist: np.ndarray        # [M, k] scaled int
+
+
+def _decide(votes_np: np.ndarray, config: KnnConfig,
+            class_values) -> Tuple[np.ndarray, np.ndarray]:
+    """(predicted class index, int-percent class probs) from the vote
+    matrix — the decision-threshold / argmax / PROB_SCALE arbitration
+    shared by the fused and precomputed-neighbor paths
+    (Neighborhood.classify :272-312)."""
+    if config.decision_threshold > 0:
+        if config.positive_class is None or len(class_values) != 2:
+            raise ValueError("decision threshold needs binary classes and "
+                             "positive.class.value")
+        pos = list(class_values).index(config.positive_class)
+        neg = 1 - pos
+        ratio = votes_np[:, pos] / np.maximum(votes_np[:, neg], 1e-9)
+        predicted = np.where(ratio > config.decision_threshold, pos, neg)
+    else:
+        predicted = np.argmax(votes_np, axis=1)
+    total = votes_np.sum(axis=1, keepdims=True)
+    prob = np.floor(votes_np * PROB_SCALE /
+                    np.maximum(total, 1e-9)).astype(np.int64)
+    return predicted.astype(np.int64), prob
+
+
+def classify_from_neighbors(records, config: KnnConfig, class_values
+                            ) -> Tuple[KnnPrediction, list, list]:
+    """Classify from PRECOMPUTED neighbor records — the reference
+    TopMatchesMapper's actual input (NearestNeighbor.java:150-159 plain
+    layout ``trainId,testId,rank,trainClass[,testClass]``; :135-149
+    class-conditional layout ``testId[,testClass],trainId,rank,trainClass,
+    postProb``), so a pipeline holding sifarish-format distance files
+    replays against this framework without re-deriving distances.
+
+    ``records``: iterable of dicts with keys ``test_id``, ``train_class``
+    (name), ``rank`` (scaled-int distance), optional ``post`` (float
+    class-conditional prob) and ``test_class``. Grouped per test id
+    (first-seen order), sorted ascending by rank, cut at top-K — the
+    secondary-sort + reducer cutoff (:317-348) — then the SAME vote
+    kernel and arbitration as the fused path. Returns (prediction,
+    test ids in order, test classes where present else None)."""
+    k = config.top_match_count
+    cls_idx = {c: i for i, c in enumerate(class_values)}
+    order: list = []
+    groups: dict = {}
+    test_cls: dict = {}
+    for r in records:
+        tid = r["test_id"]
+        if tid not in groups:
+            groups[tid] = []
+            order.append(tid)
+        groups[tid].append((int(r["rank"]), cls_idx[r["train_class"]],
+                            float(r.get("post") or 0.0)))
+        if r.get("test_class") is not None:
+            test_cls[tid] = r["test_class"]
+    m = len(order)
+    dist = np.full((m, k), 0, np.int32)
+    labels = np.zeros((m, k), np.int32)
+    post = np.zeros((m, k), np.float32)
+    valid = np.zeros((m, k), np.float32)
+    for i, tid in enumerate(order):
+        top = sorted(groups[tid])[:k]
+        for j, (d, c, p) in enumerate(top):
+            dist[i, j], labels[i, j], post[i, j] = d, c, p
+            valid[i, j] = 1.0
+    use_post = config.class_cond_weighted and bool(np.any(post > 0))
+    votes, _ = _vote_kernel(
+        jnp.asarray(dist), jnp.asarray(labels),
+        jnp.asarray(post) if use_post else None,
+        config.kernel_function, config.kernel_param, len(class_values),
+        use_post, config.inverse_distance_weighted,
+        valid=jnp.asarray(valid))
+    votes_np = np.asarray(votes)
+    predicted, prob = _decide(votes_np, config, class_values)
+    pred = KnnPrediction(predicted=predicted, class_votes=votes_np,
+                         class_prob=prob, neighbor_idx=labels,
+                         neighbor_dist=dist)
+    classes = ([test_cls.get(t) for t in order]
+               if test_cls else None)
+    return pred, order, classes
 
 
 def classify(train: EncodedTable, test: EncodedTable, config: KnnConfig,
@@ -172,23 +256,8 @@ def classify(train: EncodedTable, test: EncodedTable, config: KnnConfig,
         config.class_cond_weighted and feature_post is not None,
         config.inverse_distance_weighted)
     votes_np = np.asarray(votes)
-
-    if config.decision_threshold > 0:
-        if config.positive_class is None or train.n_classes != 2:
-            raise ValueError("decision threshold needs binary classes and "
-                             "positive.class.value")
-        pos = train.class_values.index(config.positive_class)
-        neg = 1 - pos
-        ratio = votes_np[:, pos] / np.maximum(votes_np[:, neg], 1e-9)
-        predicted = np.where(ratio > config.decision_threshold, pos, neg)
-    else:
-        predicted = np.argmax(votes_np, axis=1)
-
-    total = votes_np.sum(axis=1, keepdims=True)
-    prob = np.floor(votes_np * PROB_SCALE /
-                    np.maximum(total, 1e-9)).astype(np.int64)
-
-    return KnnPrediction(predicted=predicted.astype(np.int64),
+    predicted, prob = _decide(votes_np, config, train.class_values)
+    return KnnPrediction(predicted=predicted,
                          class_votes=votes_np, class_prob=prob,
                          neighbor_idx=np.asarray(idx),
                          neighbor_dist=np.asarray(dist))

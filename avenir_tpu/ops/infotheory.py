@@ -67,7 +67,8 @@ def intrinsic_info_content(counts: jnp.ndarray) -> jnp.ndarray:
     return entropy(seg_count, axis=-1)
 
 
-def hellinger_distance(counts: jnp.ndarray) -> jnp.ndarray:
+def hellinger_distance(counts: jnp.ndarray,
+                       reference_absent: bool = False) -> jnp.ndarray:
     """Hellinger distance between per-class segment distributions.
 
     ``counts``: [..., S, C]. For C=2 this is exactly the reference's
@@ -78,13 +79,17 @@ def hellinger_distance(counts: jnp.ndarray) -> jnp.ndarray:
     class pairs, which reduces to the reference's value at C=2 and keeps
     the same "how differently do classes distribute over segments" reading.
 
-    Documented deviation (absent classes): pairs involving a class with ZERO
-    rows are excluded from the average, at every C *including C=2*. The
-    reference's C=2 formula would read the absent side's distribution as
-    all-zero and emit a constant sqrt(sum(n_s/n)) = 1.0 for every candidate;
-    this build emits the equally candidate-independent constant 0.0 instead.
-    Rankings are unaffected either way (both are constants across
-    candidates); only the CLI-emitted stat value differs in that edge case.
+    Documented deviation (absent classes): by default, pairs involving a
+    class with ZERO rows are excluded from the average, at every C
+    *including C=2*. The reference's C=2 formula reads the absent side's
+    distribution as all-zero and emits a constant sqrt(sum(n_s/n)) = 1.0
+    for every candidate; this build emits the equally candidate-
+    independent constant 0.0 instead. Rankings are unaffected either way
+    (both are constants across candidates); only the CLI-emitted stat
+    value differs in that edge. ``reference_absent=True`` (the
+    ``hellinger.absent.class.value=reference`` compat flag, round 4)
+    keeps absent-class pairs in the average, reproducing the reference's
+    wire-level constant exactly at C=2.
     """
     class_tot = jnp.sum(counts, axis=-2, keepdims=True)  # [..., 1, C]
     frac = counts / jnp.where(class_tot > 0, class_tot, 1.0)
@@ -93,11 +98,16 @@ def hellinger_distance(counts: jnp.ndarray) -> jnp.ndarray:
     pair_d = jnp.sqrt(jnp.sum(diff * diff, axis=-3))     # [..., C, C]
     c = counts.shape[-1]
     triu = jnp.triu(jnp.ones((c, c), counts.dtype), k=1)
-    # pairs with an ABSENT class would read as phantom distance-1 pairs
-    # (the absent side's distribution is all-zero) and inflate every
-    # candidate's stat by a constant: average over PRESENT pairs only
-    present = (class_tot[..., 0, :] > 0).astype(counts.dtype)  # [..., C]
-    pairs = triu * present[..., :, None] * present[..., None, :]
+    if reference_absent:
+        # reference wire compat: absent-class pairs stay in (their side's
+        # distribution reads all-zero -> pair distance sqrt(sum n_s/n)=1)
+        pairs = jnp.broadcast_to(triu, pair_d.shape)
+    else:
+        # pairs with an ABSENT class would read as phantom distance-1
+        # pairs and inflate every candidate's stat by a constant: average
+        # over PRESENT pairs only
+        present = (class_tot[..., 0, :] > 0).astype(counts.dtype)
+        pairs = triu * present[..., :, None] * present[..., None, :]
     n_pairs = jnp.maximum(jnp.sum(pairs, axis=(-2, -1)), 1.0)
     return jnp.sum(pair_d * pairs, axis=(-2, -1)) / n_pairs
 
@@ -123,11 +133,17 @@ SPLIT_ALGORITHMS = ("entropy", "giniIndex", "hellingerDistance",
 
 
 def split_stat(counts: jnp.ndarray, algorithm: str) -> jnp.ndarray:
-    """Dispatch on the reference's ``split.algorithm`` config values."""
+    """Dispatch on the reference's ``split.algorithm`` config values.
+    ``hellingerDistance:reference`` selects the absent-class wire-compat
+    variant (``hellinger.absent.class.value=reference``) — a suffix so the
+    flag rides the existing static ``algorithm`` argument through every
+    jitted kernel unchanged."""
     if algorithm in ("entropy", "giniIndex"):
         return split_info_content(counts, algorithm)
     if algorithm == "hellingerDistance":
         return hellinger_distance(counts)
+    if algorithm == "hellingerDistance:reference":
+        return hellinger_distance(counts, reference_absent=True)
     if algorithm == "classConfidenceRatio":
         return class_confidence_ratio(counts)
     raise ValueError(f"unknown split algorithm {algorithm!r}")

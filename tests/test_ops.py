@@ -139,3 +139,64 @@ class TestInfoTheory:
             assert np.isfinite(v)
         with pytest.raises(ValueError):
             it.split_stat(counts, "bogus")
+
+
+class TestHellingerReferenceCompat:
+    """hellinger.absent.class.value=reference (round 4, VERDICT item 10):
+    the C=2 absent-class edge emits the reference's constant
+    sqrt(sum n_s/n) = 1.0 (AttributeSplitStat.java:244-282 with the absent
+    side's distribution reading all-zero); the default keeps this build's
+    equally candidate-independent 0.0."""
+
+    def test_absent_class_constants(self):
+        import jax.numpy as jnp
+        import pytest
+        from avenir_tpu.ops import infotheory as it
+        # class 1 absent from the node entirely
+        counts = jnp.asarray([[4.0, 0.0], [2.0, 0.0]])
+        assert float(it.hellinger_distance(counts)) == pytest.approx(0.0)
+        assert float(it.hellinger_distance(
+            counts, reference_absent=True)) == pytest.approx(1.0)
+        assert float(it.split_stat(
+            counts, "hellingerDistance:reference")) == pytest.approx(1.0)
+
+    def test_present_classes_identical_between_modes(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import pytest
+        from avenir_tpu.ops import infotheory as it
+        counts = jnp.asarray([[6.0, 1.0], [2.0, 3.0]])
+        a = float(it.hellinger_distance(counts))
+        b = float(it.hellinger_distance(counts, reference_absent=True))
+        assert a == pytest.approx(b)
+
+    def test_cli_flag_golden(self, tmp_path, capsys):
+        """CLI golden test: a node whose rows are all one class, hellinger
+        algorithm, compat flag on -> every candidate line carries the
+        reference's constant 1.0."""
+        import json
+        from avenir_tpu.cli.main import main as cli
+        from avenir_tpu.datagen import generators as G
+        rows = [r for r in G.retarget_rows(400, seed=3) if r[4] == "no"][:80]
+        with open(tmp_path / "data.csv", "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows))
+        with open(tmp_path / "schema.json", "w") as fh:
+            json.dump(G._RETARGET_SCHEMA_JSON, fh)
+        props = tmp_path / "h.properties"
+        with open(props, "w") as fh:
+            fh.write("feature.schema.file.path=%s\n" %
+                     (tmp_path / "schema.json"))
+            fh.write("split.algorithm=hellingerDistance\n"
+                     "field.delim.out=;\nparent.info=1.0\n")
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "splits_ref.txt"), "--conf", str(props),
+             "-D", "hellinger.absent.class.value=reference"])
+        cli(["ClassPartitionGenerator", str(tmp_path / "data.csv"),
+             str(tmp_path / "splits_def.txt"), "--conf", str(props)])
+        ref = [l.split(";") for l in
+               open(tmp_path / "splits_ref.txt").read().splitlines()]
+        def_ = [l.split(";") for l in
+                open(tmp_path / "splits_def.txt").read().splitlines()]
+        assert ref and len(ref) == len(def_)
+        assert all(abs(float(l[2]) - 1.0) < 1e-6 for l in ref)
+        assert all(abs(float(l[2])) < 1e-6 for l in def_)
