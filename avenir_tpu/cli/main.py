@@ -162,9 +162,6 @@ def run_same_type_similarity(conf: JobConfig, in_path: str, out_path: str) -> No
     import numpy as np
     from avenir_tpu.ops.distance import pairwise_full
     from avenir_tpu.models.knn import _split_features
-    fz, rows = _load_table(conf, in_path)
-    table = fz.transform(rows)
-    num, cat, n_bins = _split_features(table)
     inter = conf.get_bool("inter.set.matching", False)
     if inter:
         # fit on the TRAIN set and transform both with it (the fused
@@ -172,11 +169,16 @@ def run_same_type_similarity(conf: JobConfig, in_path: str, out_path: str) -> No
         # would crash on train-only categorical levels and put
         # data-dependent numeric scales on a test-derived range
         fz, rows2 = _load_table(conf, conf.get_required("train.data.path"))
+        delim_in = conf.get("field.delim.regex", ",")
+        rows = read_csv_lines(in_path, delim_in)
         table = fz.transform(rows)
         num, cat, n_bins = _split_features(table)
         other = fz.transform(rows2)
         o_num, o_cat, _ = _split_features(other)
     else:
+        fz, rows = _load_table(conf, in_path)
+        table = fz.transform(rows)
+        num, cat, n_bins = _split_features(table)
         other, o_num, o_cat = table, num, cat
     dist = np.asarray(pairwise_full(
         num, o_num, cat, o_cat,
@@ -261,17 +263,38 @@ def run_feature_cond_prob_joiner(conf: JobConfig, in_path: str,
     print(f'{{"Join.Records": {n}}}')
 
 
+def _iter_rows_any(path: str, delim: str):
+    """Streaming sibling of read_csv_lines: tokenized rows one at a time,
+    walking MR part-file dirs with the same sidecar filter — neighbor/
+    distance files are |test| x |train| records, far too large to
+    materialize as Python token lists (round-4 review finding)."""
+    import os
+    from avenir_tpu.utils.dataset import iter_csv_rows
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            if name.startswith(("_", ".")) or not os.path.isfile(full):
+                continue
+            yield from _iter_rows_any(full, delim)
+        return
+    yield from iter_csv_rows(path, delim)
+
+
 def _parse_neighbor_records(conf: JobConfig, path: str, class_cond: bool,
                             validation: bool):
     """The reference TopMatchesMapper input layouts
     (NearestNeighbor.java:135-159) plus the raw 3-field distance file,
-    normalized to classify_from_neighbors dicts."""
+    normalized to classify_from_neighbors dicts — a GENERATOR, so the
+    record stream never materializes (the consumer keeps a bounded
+    per-test-entity top-K)."""
     delim = conf.get("field.delim.regex", ",")
-    lines = read_csv_lines(path, delim)
-    if not lines:
-        return []
-    width = len(lines[0])
-    records = []
+    rows = _iter_rows_any(path, delim)
+    first = next(rows, None)
+    if first is None:
+        return
+    import itertools
+    width = len(first)
+    stream = itertools.chain([first], rows)
     if width == 3:
         # raw computeDistance output: join train classes in-line
         fz, train_rows = _load_table(conf,
@@ -279,28 +302,27 @@ def _parse_neighbor_records(conf: JobConfig, path: str, class_cond: bool,
         id_f = fz.schema.find_id_field()
         cls_f = fz.schema.find_class_attr_field()
         cls_of = {r[id_f.ordinal]: r[cls_f.ordinal] for r in train_rows}
-        for it in lines:
-            records.append({"test_id": it[0], "rank": it[2],
-                            "train_class": cls_of[it[1]]})
+        for it in stream:
+            yield {"test_id": it[0], "rank": it[2],
+                   "train_class": cls_of[it[1]]}
     elif class_cond:
         # 6 fields: testId, testClass, trainId, rank, trainClass, postProb
         # 5 fields (non-validation emitters that drop the class column):
         #          testId, trainId, rank, trainClass, postProb
         off = 1 if width >= 6 else 0
-        for it in lines:
-            records.append({"test_id": it[0],
-                            "test_class": (it[1] or None) if off else None,
-                            "rank": it[2 + off],
-                            "train_class": it[3 + off],
-                            "post": it[4 + off]})
+        for it in stream:
+            yield {"test_id": it[0],
+                   "test_class": (it[1] or None) if off else None,
+                   "rank": it[2 + off],
+                   "train_class": it[3 + off],
+                   "post": it[4 + off]}
     else:
         # trainId, testId, rank, trainClass [, testClass]
-        for it in lines:
-            records.append({"test_id": it[1], "rank": it[2],
-                            "train_class": it[3],
-                            "test_class": (it[4] if validation
-                                           and len(it) > 4 else None)})
-    return records
+        for it in stream:
+            yield {"test_id": it[1], "rank": it[2],
+                   "train_class": it[3],
+                   "test_class": (it[4] if validation
+                                  and len(it) > 4 else None)}
 
 
 def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
@@ -358,8 +380,16 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
             for i, tid in enumerate(test_ids):
                 fh.write(delim.join(
                     [tid, class_values[int(pred.predicted[i])]]) + "\n")
-        if validation and test_classes and all(
-                c is not None for c in test_classes):
+        if validation:
+            if not test_classes or any(c is None for c in test_classes):
+                # silent-misconfiguration guard: a validation run whose
+                # records carry no test class must fail loudly, not exit
+                # 0 without the report (3-field distance files and
+                # 5-field class-cond records have no class column)
+                raise ValueError(
+                    "validation.mode=true but the neighbor records carry "
+                    "no test-class column; use the 5/6-field layouts with "
+                    "testClass or drop validation.mode")
             from avenir_tpu.utils.metrics import ConfusionMatrix
             cm = ConfusionMatrix(
                 class_values,

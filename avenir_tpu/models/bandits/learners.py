@@ -556,28 +556,31 @@ class intervalEstimator:
 # of the scalar step — still one dispatch, exact semantics.
 # --------------------------------------------------------------------------
 
-def _sample_cdf(key, probs: jnp.ndarray, r: int) -> jnp.ndarray:
-    """[*] or [r, A] probability rows -> [r] draws by inverse CDF: ONE
-    uniform per draw + A lane compares. jax.random.categorical's gumbel
-    trick costs two transcendentals per LANE per draw — measured to bind
-    the fused micro-batch step (R-scaling saturated at ~115M decisions/s
-    for any R); the CDF form is pure compares on the VPU."""
-    if probs.ndim == 1:
-        probs = jnp.broadcast_to(probs[None, :], (r, probs.shape[0]))
-    cum = jnp.cumsum(probs, axis=-1)
+def _sample_cdf(key, probs_ar: jnp.ndarray, r: int) -> jnp.ndarray:
+    """[A] or [A, r] probability COLUMNS -> [r] draws by inverse CDF: ONE
+    uniform per draw + A compares. Two deliberate layout choices, both
+    measured on the fused micro-batch step: (1) no gumbel trick —
+    jax.random.categorical costs two transcendentals per arm per draw;
+    (2) the ARM axis leads and the DRAW axis is LAST: TPU tiles put the
+    last dim on 128 lanes, so an [..., R, A] layout with A~12 wastes ~90%
+    of every vector register and HBM tile, and the step was
+    bandwidth-bound on exactly those intermediates."""
+    if probs_ar.ndim == 1:
+        probs_ar = probs_ar[:, None]
+    cum = jnp.cumsum(probs_ar, axis=0)                   # [A, r or 1]
     # normalize against accumulated rounding so the last bucket closes at 1
-    u = jax.random.uniform(key, (r, 1)) * cum[:, -1:]
-    return jnp.minimum(jnp.sum(cum < u, axis=-1),
-                       probs.shape[-1] - 1).astype(jnp.int32)
+    u = jax.random.uniform(key, (1, r)) * cum[-1:, :]
+    return jnp.minimum(jnp.sum(cum < u, axis=0),
+                       probs_ar.shape[0] - 1).astype(jnp.int32)
 
 
-def _one_hot_f32(actions, n: int) -> jnp.ndarray:
-    """[R] action ids -> [R, n] one-hot. Dense on purpose: a scatter-add
+def _one_hot_ar(actions, n: int) -> jnp.ndarray:
+    """[R] action ids -> [n, R] one-hot (arms on sublanes, draws on
+    lanes — see _sample_cdf). Dense on purpose: a scatter-add
     (`.at[actions].add`) serializes on TPU and under vmap becomes a batched
     scatter that costs ~30x the whole step (measured: the first micro-batch
-    bench ran 3.5ms/step vs 128us for the scalar path); the one-hot
-    contraction is a dense VPU/MXU reduction instead."""
-    return (actions[:, None] == jnp.arange(n)[None, :]).astype(jnp.float32)
+    bench ran 3.5ms/step vs 128us for the scalar path)."""
+    return (actions[None, :] == jnp.arange(n)[:, None]).astype(jnp.float32)
 
 
 def _reward_many_additive(state: LearnerState, actions, rewards,
@@ -585,16 +588,16 @@ def _reward_many_additive(state: LearnerState, actions, rewards,
     """Aggregated _base_reward: addition commutes, so a segment-sum equals
     the sequential fold exactly."""
     n = state.reward_sum.shape[0]
-    oh = _one_hot_f32(actions, n)                       # [R, A]
-    seg = (rewards / scale) @ oh                        # [A]
-    cnt = jnp.sum(oh, axis=0)
+    oh = _one_hot_ar(actions, n)                        # [A, R]
+    seg = oh @ (rewards / scale)                        # [A]
+    cnt = jnp.sum(oh, axis=1)
     return state.replace(reward_sum=state.reward_sum + seg,
                          reward_count=state.reward_count + cnt)
 
 
 def _counts_after(state: LearnerState, actions) -> LearnerState:
     n = state.trial_counts.shape[0]
-    cnt = jnp.sum(_one_hot_f32(actions, n), axis=0).astype(jnp.int32)
+    cnt = jnp.sum(_one_hot_ar(actions, n), axis=1).astype(jnp.int32)
     return state.replace(
         total_trials=state.total_trials + actions.shape[0],
         trial_counts=state.trial_counts + cnt)
@@ -629,9 +632,10 @@ def _softmax_select_many(state: LearnerState, cfg: LearnerConfig, r: int):
             [temps[:1], jnp.maximum(temps[1:], cfg.min_temp_constant)])
         final = jnp.maximum(final, cfg.min_temp_constant)
     temps = jnp.maximum(temps, 1e-6)
-    logits = _avg_reward(state)[None, :] / temps[:, None]        # [R, A]
+    # arms lead, draws on lanes (layout note in _sample_cdf)
+    logits = _avg_reward(state)[:, None] / temps[None, :]        # [A, R]
     key, k1 = jax.random.split(state.key)
-    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jax.nn.softmax(logits, axis=0)
     actions = _sample_cdf(k1, probs, r)
     state = state.replace(key=key, scalar_a=final)
     return _counts_after(state, actions), actions
@@ -717,8 +721,8 @@ def _exp_weight_reward_many(state: LearnerState, actions, rewards,
     k_arms = state.probs.shape[0]
     n = state.weights.shape[0]
     scaled = rewards / cfg.reward_scale
-    oh = _one_hot_f32(actions, n)                       # [R, A]
-    exponent = (scaled / jnp.maximum(state.probs[actions], 1e-9)) @ oh
+    oh = _one_hot_ar(actions, n)                        # [A, R]
+    exponent = oh @ (scaled / jnp.maximum(state.probs[actions], 1e-9))
     return state.replace(
         weights=state.weights * jnp.exp(gamma * exponent / k_arms))
 

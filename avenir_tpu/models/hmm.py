@@ -168,14 +168,58 @@ def _bw_em_iter(obs, lengths, seq_w, eps, n_states, n_obs):
     over it, and the batch-axis sums below become XLA-inserted psums (the
     data-parallel E-step; dp sharding covered in tests/test_multichip.py).
     """
+    from avenir_tpu.ops.scanops import lseplus, lseplus_eye
     bsz, t_max = obs.shape
     t_iota = jnp.arange(t_max)
     lse = jax.nn.logsumexp
     NEG = -1e30
+    # FORMULATION CHOICE (static — shapes are compile-time): the
+    # forward/backward recurrences run either as 2T sequential [B, S]
+    # scan steps (S^2 flops/step, per-step launch latency) or as
+    # ceil(log2 T) lax.associative_scan combines over (logsumexp,+)
+    # semiring matrices (S^3 flops/step, the seqpar formulation INSIDE
+    # the E-step). Small batches are latency-bound — the associative form
+    # measured 2.9x at the 8192-seq CI shape — while at 80k sequences the
+    # sequential form's steps are big enough to be compute-bound and the
+    # S x extra flops showed up as a 15% regression. The boundary below
+    # keeps both measured winners on their sides.
+    use_assoc = bsz * n_states <= 65536
 
-    def e_step_one(li, lt, le, o, n):
-        """Expected counts for one sequence o[:n] (padded to t_max)."""
+    def e_step_one_assoc(li, lt, le, o, n):
+        """Expected counts for one sequence o[:n] (padded to t_max),
+        associative formulation. Step 0's matrix is the rank-1 broadcast
+        of alpha0 and steps past the true length are the semiring
+        identity (the _step_mats convention, parallel/seqpar.py) — so
+        prefixes freeze at la[n-1] and suffix products of padding
+        collapse to identity, making ragged lengths exact."""
         valid = t_iota < n                                  # [T]
+        ident = lseplus_eye(n_states)
+        mats = lt[None, :, :] + le.T[o][:, None, :]         # [T, S, S]
+        alpha0 = li + le[:, o[0]]
+        mats = mats.at[0].set(jnp.broadcast_to(
+            alpha0[None, :], (n_states, n_states)))
+        mats = jnp.where(valid[:, None, None], mats, ident[None, :, :])
+
+        prefix = jax.lax.associative_scan(lseplus, mats)    # [T, S, S]
+        la = prefix[:, 0, :]                                # [T, S]
+        ll = lse(la[-1])            # frozen at la[n-1] by the identities
+
+        # suffix products of steps t+1..: lb_t[i] = lse_j (M_{t+1} o ...
+        # o M_{T-1})[i, j]; past-length suffixes are identity -> lb = 0.
+        # associative_scan(reverse=True) composes the NON-commutative
+        # product in reversed order (M_{T-1} o ... o M_t — verified
+        # empirically), so scan the TRANSPOSES ((A o B)^T = B^T o A^T)
+        # and read the row-reduction off axis -2
+        suffix_t = jax.lax.associative_scan(
+            lseplus, jnp.swapaxes(mats, -1, -2), reverse=True)
+        lb = jnp.concatenate(
+            [lse(suffix_t[1:], axis=-2),
+             jnp.zeros((1, n_states))], axis=0)             # [T, S]
+        return la, lb, ll
+
+    def e_step_one_seq(li, lt, le, o, n):
+        """Sequential formulation (large-batch path)."""
+        valid = t_iota < n
 
         def fwd(carry, t):
             la_prev = carry
@@ -185,8 +229,7 @@ def _bw_em_iter(obs, lengths, seq_w, eps, n_states, n_obs):
             la_t = jnp.where(valid[t], la_t, la_prev)
             return la_t, la_t
         _, la = jax.lax.scan(fwd, jnp.full((n_states,), NEG), t_iota)
-
-        ll = lse(la[n - 1])                                 # log P(o)
+        ll = lse(la[n - 1])
 
         def bwd(carry, t):
             lb_next = carry
@@ -197,7 +240,13 @@ def _bw_em_iter(obs, lengths, seq_w, eps, n_states, n_obs):
             return lb_t, lb_t
         _, lb_rev = jax.lax.scan(bwd, jnp.zeros((n_states,)),
                                  t_iota[::-1])
-        lb = lb_rev[::-1]                                   # [T, S]
+        lb = lb_rev[::-1]
+        return la, lb, ll
+
+    def e_step_one(li, lt, le, o, n):
+        valid = t_iota < n
+        la, lb, ll = (e_step_one_assoc if use_assoc else e_step_one_seq)(
+            li, lt, le, o, n)
 
         lgamma = la + lb - ll                               # [T, S]
         gamma = jnp.where(valid[:, None], jnp.exp(lgamma), 0.0)
