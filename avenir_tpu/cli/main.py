@@ -284,45 +284,64 @@ def _parse_neighbor_records(conf: JobConfig, path: str, class_cond: bool,
                             validation: bool):
     """The reference TopMatchesMapper input layouts
     (NearestNeighbor.java:135-159) plus the raw 3-field distance file,
-    normalized to classify_from_neighbors dicts — a GENERATOR, so the
-    record stream never materializes (the consumer keeps a bounded
-    per-test-entity top-K)."""
+    normalized to classify_from_neighbors dicts. Returns
+    ``(records, width)`` — the full record list (the caller needs
+    more than one pass) and the source-file field count."""
     delim = conf.get("field.delim.regex", ",")
-    rows = _iter_rows_any(path, delim)
-    first = next(rows, None)
+    it = _iter_rows_any(path, delim)
+    first = next(it, None)
     if first is None:
-        return
+        return [], 0
     import itertools
     width = len(first)
-    stream = itertools.chain([first], rows)
+    rows = itertools.chain([first], it)     # stream: only records persists
+    records = []
     if width == 3:
-        # raw computeDistance output: join train classes in-line
+        # raw computeDistance output: join train classes in-line; test
+        # classes come from test.class.path when validation needs them
+        # (the same key FeatureCondProbJoiner uses for its join)
         fz, train_rows = _load_table(conf,
                                      conf.get_required("train.data.path"))
         id_f = fz.schema.find_id_field()
         cls_f = fz.schema.find_class_attr_field()
         cls_of = {r[id_f.ordinal]: r[cls_f.ordinal] for r in train_rows}
-        for it in stream:
-            yield {"test_id": it[0], "rank": it[2],
-                   "train_class": cls_of[it[1]]}
+        tcls_of = {}
+        tcls_path = conf.get("test.class.path")
+        if validation and tcls_path:
+            _, test_rows = _load_table(conf, tcls_path)
+            tcls_of = {r[id_f.ordinal]: r[cls_f.ordinal] for r in test_rows}
+        for rec in rows:
+            if rec[1] not in cls_of:
+                raise ValueError(
+                    f"distance record references train entity {rec[1]!r} "
+                    f"not present in train.data.path "
+                    f"({conf.get('train.data.path')})")
+            if tcls_of and rec[0] not in tcls_of:
+                raise ValueError(
+                    f"distance record references test entity {rec[0]!r} "
+                    f"not present in test.class.path ({tcls_path})")
+            records.append({"test_id": rec[0], "rank": rec[2],
+                            "train_class": cls_of[rec[1]],
+                            "test_class": tcls_of.get(rec[0])})
     elif class_cond:
         # 6 fields: testId, testClass, trainId, rank, trainClass, postProb
         # 5 fields (non-validation emitters that drop the class column):
         #          testId, trainId, rank, trainClass, postProb
         off = 1 if width >= 6 else 0
-        for it in stream:
-            yield {"test_id": it[0],
-                   "test_class": (it[1] or None) if off else None,
-                   "rank": it[2 + off],
-                   "train_class": it[3 + off],
-                   "post": it[4 + off]}
+        for rec in rows:
+            records.append({"test_id": rec[0],
+                            "test_class": (rec[1] or None) if off else None,
+                            "rank": rec[2 + off],
+                            "train_class": rec[3 + off],
+                            "post": rec[4 + off]})
     else:
         # trainId, testId, rank, trainClass [, testClass]
-        for it in stream:
-            yield {"test_id": it[1], "rank": it[2],
-                   "train_class": it[3],
-                   "test_class": (it[4] if validation
-                                  and len(it) > 4 else None)}
+        for rec in rows:
+            records.append({"test_id": rec[1], "rank": rec[2],
+                            "train_class": rec[3],
+                            "test_class": (rec[4] if validation
+                                           and len(rec) > 4 else None)})
+    return records, width
 
 
 def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
@@ -358,8 +377,8 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
                     "classification") != "classification":
             raise ValueError("neighbor.data.path supports classification "
                              "(regression needs the fused path)")
-        records = _parse_neighbor_records(conf, neighbor_path, class_cond,
-                                          validation)
+        records, rec_width = _parse_neighbor_records(
+            conf, neighbor_path, class_cond, validation)
         class_values = sorted(
             {r["train_class"] for r in records} |
             {r["test_class"] for r in records
@@ -382,10 +401,18 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
                     [tid, class_values[int(pred.predicted[i])]]) + "\n")
         if validation:
             if not test_classes or any(c is None for c in test_classes):
+                if rec_width == 3 and not conf.get("test.class.path"):
+                    # a raw 3-field distance file can never carry test
+                    # classes; shared pipeline props routinely leave
+                    # validation.mode on — skip the report, don't fail
+                    print("validation.mode=true skipped: 3-field distance "
+                          "records carry no test class (set "
+                          "test.class.path to join them)")
+                    return
                 # silent-misconfiguration guard: a validation run whose
-                # records carry no test class must fail loudly, not exit
-                # 0 without the report (3-field distance files and
-                # 5-field class-cond records have no class column)
+                # records SHOULD carry a test class but don't must fail
+                # loudly, not exit 0 without the report (5-field
+                # class-cond records have no class column)
                 raise ValueError(
                     "validation.mode=true but the neighbor records carry "
                     "no test-class column; use the 5/6-field layouts with "

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# End-of-round gate (round-4 VERDICT item 1): the snapshot must never be
+# taken on a red suite again. Runs the full pytest suite and a bench smoke
+# (tiny shapes, CPU ok) and exits non-zero on any failure — run this before
+# every milestone commit and ALWAYS before the final commit of a round.
+#
+# Usage: scripts/preflight.sh [--fast]
+#   --fast: skip the bench smoke (suite only)
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== preflight: pytest =="
+python -m pytest tests/ -q --maxfail=5
+
+if [ "${1:-}" != "--fast" ]; then
+    echo "== preflight: bench smoke (tiny shapes) =="
+    BENCH_N_TRAIN=2048 BENCH_M_TEST=256 BENCH_ITERS=4 BENCH_REPEATS=1 \
+        python bench.py > /tmp/preflight_bench.json
+    python - <<'EOF'
+import json
+with open("/tmp/preflight_bench.json") as fh:
+    out = json.loads(fh.read().strip().splitlines()[-1])
+assert {"metric", "value", "unit", "vs_baseline"} <= set(out), out
+assert out["value"] > 0, out
+print("bench smoke ok:", out["metric"], out["value"])
+EOF
+    echo "== preflight: graft entry compile-check =="
+    python - <<'EOF'
+import __graft_entry__ as g
+fn, args = g.entry()
+import jax
+jax.eval_shape(fn, *args)
+print("entry() traces ok")
+EOF
+fi
+
+echo "== preflight PASS =="
