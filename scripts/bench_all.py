@@ -435,6 +435,79 @@ def bench_grouped_bandit_microbatch() -> None:
                      "(state leaves read+write once per R-round batch)")
 
 
+def bench_serving_batch() -> None:
+    """Round-5 (VERDICT item 5): the HOST-side serving API — the
+    OnlineLearnerLoop hot path ``Learner.next_action_batch`` /
+    ``set_reward_batch`` — now routed through the fused micro-batch fast
+    paths. This row deliberately includes the host<->device round-trips
+    (they ARE the serving cost on a relay-attached chip): the fused route
+    needs one dispatch per 256-decision chunk where the round-4 masked
+    scan needed one per 64-step bucket with a scalar-step body, so the
+    ratio printed in the unit string is dominated by dispatch count. Both
+    paths timed same-run, best-of-3."""
+    import time
+    from avenir_tpu.models.bandits.learners import create
+    actions = [f"p{i}" for i in range(12)]
+    lr = create("softMax", actions, {"temp.constant": "50"}, seed=0)
+    batch = 256
+    pairs = [(actions[i % 12], 10.0 + (i % 7)) for i in range(batch)]
+    lr.next_action_batch(batch)               # compile fused chunks
+    lr.set_reward_batch(pairs)
+
+    def timed(fn):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def fused_path():
+        lr.next_action_batch(batch)
+        lr.set_reward_batch(pairs)
+        # the reward fold is enqueued async; without this the tail of the
+        # timed region leaks into the next iteration (review finding)
+        jax.block_until_ready(lr.state)
+    t_fused = timed(fused_path)
+
+    # round-4 path, same learner state shapes: the masked scalar-step scan
+    # (still the min-trial fallback) driven directly
+    def masked_path():
+        n = batch
+        while n > 0:
+            take = min(n, lr._SCAN_BUCKET_MAX)
+            b = lr._bucket(take)
+            active = np.zeros(b, bool)
+            active[:take] = True
+            lr.state, acts = lr._select_many(lr.state, jnp.asarray(active))
+            np.asarray(acts)
+            n -= take
+        resolved = [(lr.actions.index(a), float(r)) for a, r in pairs]
+        pos = 0
+        while pos < len(resolved):
+            chunk = resolved[pos:pos + lr._SCAN_BUCKET_MAX]
+            pos += len(chunk)
+            b = lr._bucket(len(chunk))
+            idx = np.zeros(b, np.int32)
+            rew = np.zeros(b, np.float32)
+            active = np.zeros(b, bool)
+            for i, (ai, rw) in enumerate(chunk):
+                idx[i], rew[i], active[i] = ai, rw, True
+            lr.state = lr._reward_many(lr.state, jnp.asarray(idx),
+                                       jnp.asarray(rew), jnp.asarray(active))
+        jax.block_until_ready(lr.state)
+    masked_path()                             # compile
+    t_masked = timed(masked_path)
+
+    emit("bandit_serving_batch_decisions_per_sec", 2 * batch / t_fused,
+         f"serve+reward ops/sec (host-side Learner API, 256-decision "
+         f"batches incl. relay RTTs; round-4 masked-scan path same-run: "
+         f"{2 * batch / t_masked:.0f}/s -> {t_masked / t_fused:.1f}x)",
+         bound_model="dispatch-latency-bound: one relay RTT per chunk "
+                     "dominates; the fused route cuts chunks 4x and the "
+                     "in-chunk scalar scan to a vectorized body")
+
+
 def bench_baum_welch() -> None:
     """Unsupervised HMM training at a CI-scaled Markov-tutorial shape
     (the full 80k-seq measurement lives in scripts/bw_scale.py /
@@ -491,4 +564,5 @@ if __name__ == "__main__":
     bench_bandit_decisions()
     bench_grouped_bandit_decisions()
     bench_grouped_bandit_microbatch()
+    bench_serving_batch()
     bench_baum_welch()
