@@ -7,6 +7,10 @@
   keep-probability uses the exact class counts over the whole (device-
   resident) table, which is the limit the reference's running estimate
   converges to — one vectorized bernoulli draw instead of a row loop.
+- ``under_sample_streaming``: the reference's running-count semantics
+  replayed exactly (round 5 compat mode): prefix counts via one cumsum,
+  held-batch rows evaluated at bootstrap-time counts — still one
+  vectorized draw, no row loop.
 - ``bagging_sample``: BaggingSampler (:90-122) — within each consecutive
   ``batch.size`` window, sample ``batch`` rows with replacement.
 """
@@ -29,6 +33,41 @@ def under_sample(labels: jnp.ndarray, key: jax.Array,
     keep_prob = jnp.where(counts > min_count, min_count / counts, 1.0)
     row_prob = keep_prob[labels]
     return jax.random.uniform(key, labels.shape) < row_prob
+
+
+def _streaming_keep_probs(labels: jnp.ndarray, n_classes: int,
+                          bootstrap_rows: int) -> jnp.ndarray:
+    """Per-row keep probabilities under the reference's STREAMING bootstrap
+    (UnderSamplingBalancer.java:92-131): the first ``bootstrap_rows`` rows
+    are held and emitted with the class counts as of the bootstrap row;
+    every later row uses the running prefix counts at its own position.
+    minCount at each point is the smallest count among classes seen so
+    far. Exposed separately so the semantics are golden-testable without
+    going through the bernoulli draw."""
+    # int32 prefix counts: a float32 cumsum silently saturates at 2^24
+    # rows of one class (review finding) — int stays exact to 2^31
+    oh = jax.nn.one_hot(labels, n_classes, dtype=jnp.int32)
+    cum = jnp.cumsum(oh, axis=0)                  # counts AFTER each row
+    n = labels.shape[0]
+    b = min(max(bootstrap_rows - 1, 0), max(n - 1, 0))
+    eff = cum[jnp.maximum(jnp.arange(n), b)]      # [N, C]
+    min_count = jnp.min(jnp.where(eff > 0, eff, jnp.iinfo(jnp.int32).max),
+                        axis=1)
+    cnt = jnp.take_along_axis(eff, labels[:, None], axis=1)[:, 0]
+    return jnp.where(cnt > min_count,
+                     min_count.astype(jnp.float32) /
+                     cnt.astype(jnp.float32), 1.0)
+
+
+def under_sample_streaming(labels: jnp.ndarray, key: jax.Array,
+                           n_classes: int, bootstrap_rows: int
+                           ) -> jnp.ndarray:
+    """Keep-mask with the reference's streaming-bootstrap count estimates
+    (``streaming.bootstrap=true`` compat mode) — converges to
+    :func:`under_sample`'s exact-count behavior as ``bootstrap_rows``
+    approaches the table size."""
+    probs = _streaming_keep_probs(labels, n_classes, bootstrap_rows)
+    return jax.random.uniform(key, labels.shape) < probs
 
 
 def bagging_sample(n_rows: int, key: jax.Array,

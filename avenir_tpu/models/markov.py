@@ -123,6 +123,84 @@ def train(sequences: Sequence[Sequence[str]], states: List[str],
                        class_trans=per_class)
 
 
+def train_streamed(path: str, states: List[str], delim_regex: str = ",",
+                   skip_fields: int = 0, class_label_ord: int = -1,
+                   label_values: Optional[List[str]] = None,
+                   scale: int = 1000, chunk_rows: int = 65536
+                   ) -> MarkovModel:
+    """Out-of-core transition-model training (round 5): stream byte-window
+    CSV rows, fold each chunk's bigram counts into the on-device [C, S, S]
+    count array and discard the chunk — host memory stays O(model) + one
+    chunk, the reference streaming mapper's semantics
+    (MarkovStateTransitionModel.java mapper emits per-pair counts). Each
+    chunk's counts are exact in f32 (chunk_rows x max length stays far
+    under 2^24 transitions/cell) and the cross-chunk accumulation runs on
+    the host in float64 (exact to 2^53 — a device f32 accumulator would
+    silently saturate a cell crossing 2^24, the very regime this path
+    exists for), so the streamed model is BIT-IDENTICAL to ``train`` on
+    the same data.
+
+    For class-conditional models pass ``label_values`` (the reference
+    configures them); absent that a lightweight label-discovery pass runs
+    first (still O(1) memory). Chunk row/time axes pad to power-of-two
+    buckets so the jit cache stays small across ragged chunks."""
+    from avenir_tpu.utils.dataset import iter_csv_rows
+    n_states = len(states)
+    if class_label_ord >= 0 and label_values is None:
+        seen = set()
+        for row in iter_csv_rows(path, delim_regex):
+            seen.add(row[class_label_ord])
+        label_values = sorted(seen)
+    n_classes = len(label_values) if class_label_ord >= 0 else 1
+    lab_index = ({v: i for i, v in enumerate(label_values)}
+                 if class_label_ord >= 0 else None)
+    eff_skip = skip_fields + (1 if class_label_ord >= 0 else 0)
+    counts = None
+    pending: List[List[str]] = []
+
+    def flush():
+        nonlocal counts
+        if not pending:
+            return
+        batch, lengths = encode_sequences([r[eff_skip:] for r in pending],
+                                          states)
+        b, t = batch.shape
+        bb, bt = 1, 1
+        while bb < b:
+            bb *= 2
+        while bt < t:
+            bt *= 2
+        batch = jnp.pad(batch, ((0, bb - b), (0, bt - t)))
+        lengths = jnp.pad(lengths, (0, bb - b))    # padded rows mask out
+        cids = None
+        if lab_index is not None:
+            cids = jnp.asarray(
+                [lab_index[r[class_label_ord]] for r in pending]
+                + [0] * (bb - b), jnp.int32)
+        part = np.asarray(
+            _bigram_counts(batch, lengths, cids, n_states, n_classes),
+            np.float64)
+        counts = part if counts is None else counts + part
+        pending.clear()
+
+    for row in iter_csv_rows(path, delim_regex):
+        pending.append(row)
+        if len(pending) >= chunk_rows:
+            flush()
+    flush()
+    if counts is None:
+        raise ValueError(f"no rows in {path}")
+    if lab_index is None:
+        return MarkovModel(states=list(states), scale=scale,
+                           trans=laplace_and_scale(np.asarray(counts[0]),
+                                                   scale))
+    per_class = {
+        label: laplace_and_scale(np.asarray(counts[i]), scale)
+        for i, label in enumerate(label_values)}
+    return MarkovModel(states=list(states), scale=scale,
+                       class_trans=per_class)
+
+
 # --------------------------------------------------------------------------
 # wire format
 # --------------------------------------------------------------------------

@@ -107,9 +107,11 @@ def _build_specs(fz: Featurizer, with_labels: bool):
 
 
 def _encode_buffer(lib, fz: Featurizer, buf: bytes, delim: str, specs,
-                   n_threads: int):
+                   n_threads: int, want_ids: bool = True):
     """One ``avt_encode_parallel`` pass over ``buf`` -> host numpy arrays
-    (binned, numeric, labels|None, ids list)."""
+    (binned, numeric, labels|None, ids list). ``want_ids=False`` skips the
+    per-row Python string decode — training folds never read ids, and at
+    out-of-core scale 20M interned strings dominated peak RSS (round 5)."""
     (has_id, use_labels, n_ord, kinds, feat_slot, bucket_width,
      bin_offset, vocab_blob, vocab_counts) = specs
     n_feat = len(fz.encoders)
@@ -142,7 +144,7 @@ def _encode_buffer(lib, fz: Featurizer, buf: bytes, delim: str, specs,
             id_spans.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     finally:
         lib.avt_free(handle)
-    if has_id:
+    if has_id and want_ids:
         ids = [buf[a:b].decode() for a, b in id_spans]
     else:
         ids = None
@@ -179,22 +181,26 @@ def encode_file(fz: Featurizer, path: str, delim_regex: str = ",",
     return _wrap_table(fz, binned, numeric, labels, ids)
 
 
-def encode_file_windowed(fz: Featurizer, path: str, delim_regex: str = ",",
+def iter_encoded_windows(fz: Featurizer, path: str, delim_regex: str = ",",
                          with_labels: bool = True, n_threads: int = 0,
-                         window_bytes: int = 32 << 20) -> EncodedTable:
-    """Native featurize in LINE-ALIGNED BYTE WINDOWS (round 4, VERDICT
-    item 4): peak memory is the output arrays plus ONE window of file
-    bytes — the ``parallel/data.py`` byte-window semantics applied to the
-    C++ parser, so out-of-core inputs keep native parse speed instead of
-    falling back to the ~0.75MB/s Python chunk path. Each window extends
-    to the next newline (the HDFS-split boundary rule: a row belongs to
-    the window its first byte falls in)."""
+                         window_bytes: int = 32 << 20,
+                         want_ids: bool = True, specs=None):
+    """Yield ``(binned, numeric, labels|None, ids|None)`` numpy tuples per
+    line-aligned byte window — the streaming primitive under
+    :func:`encode_file_windowed` and the round-5 out-of-core TRAINING
+    paths (models fold each window into their count arrays and discard
+    it, so host memory stays O(model) + one window — the semantics of the
+    reference's streaming mapper, BayesianDistribution.java:138-179).
+    Encoders are schema-driven (bins, vocab, class values all come from
+    the Featurizer), so window boundaries cannot change the encoding.
+    ``specs`` lets a caller that already built the encode specs (the
+    vocab-blob assembly is non-trivial for wide vocabularies) pass them
+    in instead of paying ``_build_specs`` twice."""
     lib, delim = _native_lib_and_delim(fz, delim_regex)
-    specs = _build_specs(fz, with_labels)
-    use_labels = specs[1]
+    if specs is None:
+        specs = _build_specs(fz, with_labels)
     import os
     remaining = os.path.getsize(path)
-    parts = []
     carry = b""
     with open(path, "rb") as fh:
         while remaining > 0:
@@ -212,11 +218,31 @@ def encode_file_windowed(fz: Featurizer, path: str, delim_regex: str = ",",
                 carry = buf
                 continue
             window, carry = buf[:cut + 1], buf[cut + 1:]
-            parts.append(_encode_buffer(lib, fz, window, delim, specs,
-                                        n_threads))
+            yield _encode_buffer(lib, fz, window, delim, specs, n_threads,
+                                 want_ids=want_ids)
     if carry.strip():
-        parts.append(_encode_buffer(lib, fz, carry, delim, specs,
-                                    n_threads))
+        yield _encode_buffer(lib, fz, carry, delim, specs, n_threads,
+                             want_ids=want_ids)
+
+
+def encode_file_windowed(fz: Featurizer, path: str, delim_regex: str = ",",
+                         with_labels: bool = True, n_threads: int = 0,
+                         window_bytes: int = 32 << 20) -> EncodedTable:
+    """Native featurize in LINE-ALIGNED BYTE WINDOWS (round 4, VERDICT
+    item 4): peak memory is the output arrays plus ONE window of file
+    bytes — the ``parallel/data.py`` byte-window semantics applied to the
+    C++ parser, so out-of-core inputs keep native parse speed instead of
+    falling back to the ~0.75MB/s Python chunk path. Each window extends
+    to the next newline (the HDFS-split boundary rule: a row belongs to
+    the window its first byte falls in). The encoded table still
+    materializes fully — for datasets where even THAT exceeds host RAM,
+    use the window->accumulate training paths built on
+    :func:`iter_encoded_windows` (naive_bayes.train_streamed,
+    markov.train_streamed)."""
+    specs = _build_specs(fz, with_labels)
+    use_labels = specs[1]
+    parts = list(iter_encoded_windows(fz, path, delim_regex, with_labels,
+                                      n_threads, window_bytes, specs=specs))
     if not parts:
         return _wrap_table(
             fz, np.zeros((0, len(fz.encoders)), np.int32),

@@ -67,6 +67,22 @@ timing, anchored on the XLA ``approx_min_k`` path):
   tile_m=1024); and pl.ds dynamic-slice loads where static slices serve
   (measured 60% slower — they defeat Mosaic's load fusion).
 
+ROUND-5 ADDENDUM (scripts/sweep18_results.txt + PERF_NOTES round-5):
+the "bf16" cast feeding the dot is ELIDED by the compiler
+(``--xla_allow_excess_precision`` is set in this toolchain's XLA flags)
+— an XLA probe measured the cast-then-dot metric error at exactly 0.0
+vs the f32 dot, i.e. the production dot executes an f32-precision
+multi-pass algorithm. Two consequences: (1) the "72.6% of the
+padded-K128 bf16 ceiling" numbers above UNDERSTATE true utilization
+~2-3x — the dot is effectively saturated for its real precision, which
+explains why the transposed 8x-less-MXU-work contraction (sweep17,
+median 1.04x), the scalar-tag fold cut (sweep18 tpose_tag, median
+~0.99x), and n_acc=8 (tpose_tag8, 1.00x) are all nulls; (2) any
+restructure that commits REAL bf16 operands to the dot (the augmented
+y2 hi+lo columns, sweep18 tpose_aug) forfeits the elision and fails the
+recall gate (0.915 — quantization err ~4e-3 vs rank-5/6 gaps p10
+~5e-4). The kernel stands at its empirical ceiling on this toolchain.
+
 Categorical attributes ride the same MXU contraction: a one-hot encoding
 scaled by 1/√2 makes squared euclidean equal the mismatch count
 (``ops.distance.categorical_mismatch`` computes the identical quantity as an
@@ -236,6 +252,116 @@ def encode_mixed(num: Optional[jnp.ndarray], cat: Optional[jnp.ndarray],
 MAX_ENCODED_WIDTH = 512
 
 
+def _tpose_tag_kernel(xt_ref, yt_ref, y2_ref, out_d_ref, out_i_ref,
+                      acc_d, acc_i, *, k: int, tn: int, n_acc: int,
+                      use_bf16: bool):
+    """Transposed-contraction variant of ``_topk_kernel``: operands arrive
+    PRE-TRANSPOSED ([D, TM] x [D, TN]) so the dot contracts the sublane
+    axis (D pads to 16, not 128 lanes), and the fold tracks a SCALAR chunk
+    tag instead of a per-lane index vector (decoded to global train
+    indices at extraction: tag*128 + lane). Numerically identical to the
+    production kernel (same f32 y2 epilogue, same in-kernel cast — which
+    the compiler elides to an f32-precision dot, see the round-5 module
+    addendum; gate-verified recall 0.998 / dist err 0 in
+    scripts/sweep18_results.txt). Speed is statistically EQUAL to prod
+    (sweep18 median ~1.00x) but its draw-to-draw jitter is independent,
+    so bench.py's min-over-draws auto-select gains a third arm."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_d[:] = jnp.full(acc_d.shape, BIG, jnp.float32)
+        acc_i[:] = jnp.full(acc_i.shape, -1, jnp.int32)
+
+    xt = xt_ref[:]
+    yt = yt_ref[:]
+    if use_bf16:
+        xt = xt.astype(jnp.bfloat16)
+        yt = yt.astype(jnp.bfloat16)
+    cross = lax.dot_general(xt, yt, (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    metric = y2_ref[:] - 2.0 * cross
+    tm = metric.shape[0]
+    n_chunks = tn // LANES
+    for c in range(n_chunks):
+        s = c % n_acc
+        chunk = metric[:, c * LANES:(c + 1) * LANES]
+        cur_d = acc_d[:, s * LANES:(s + 1) * LANES]
+        better = chunk < cur_d
+        tag = j * n_chunks + c                    # SCALAR per chunk
+        acc_d[:, s * LANES:(s + 1) * LANES] = jnp.where(better, chunk, cur_d)
+        cur_i = acc_i[:, s * LANES:(s + 1) * LANES]
+        acc_i[:, s * LANES:(s + 1) * LANES] = jnp.where(better, tag, cur_i)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        val = acc_d[:]
+        tags = acc_i[:]
+        col = lax.broadcasted_iota(jnp.int32, val.shape, 1)
+        idx = jnp.where(tags < 0, -1, tags * LANES + (col % LANES))
+        new_d = jnp.full((tm, LANES), BIG, jnp.float32)
+        new_i = jnp.full((tm, LANES), -1, jnp.int32)
+        slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+        for slot in range(k):
+            min_d = jnp.min(val, axis=1, keepdims=True)
+            min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
+                            axis=1, keepdims=True)
+            new_d = jnp.where(slot_lane == slot, min_d, new_d)
+            new_i = jnp.where(slot_lane == slot, min_i, new_i)
+            val = jnp.where((val == min_d) & (idx == min_i), BIG, val)
+        out_d_ref[:] = new_d
+        out_i_ref[:] = new_i
+
+
+@partial(jax.jit, static_argnames=("k", "tile_m", "tile_n", "n_acc", "mode",
+                                   "interpret"))
+def _pallas_topk_tpose_raw(x: jnp.ndarray, y: jnp.ndarray, *, k: int,
+                           tile_m: int, tile_n: int, n_acc: int, mode: str,
+                           interpret: bool
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Raw transposed-layout launch; same contract as ``_pallas_topk_raw``."""
+    m, d = x.shape
+    n = y.shape[0]
+    xp = _pad_rows(x, tile_m)
+    yp = _pad_rows(y, tile_n)
+    y2 = jnp.sum(y * y, axis=1)
+    y2p = jnp.pad(y2, (0, yp.shape[0] - n), constant_values=BIG)[None, :]
+    xt = xp.T                                     # [D, Mp]
+    yt = yp.T                                     # [D, Np]
+
+    grid = (xp.shape[0] // tile_m, yp.shape[0] // tile_n)
+    kernel = partial(_tpose_tag_kernel, k=k, tn=tile_n, n_acc=n_acc,
+                     use_bf16=mode == "fast")
+    out_d, out_i = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((d, tile_m), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, tile_n), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_m, n_acc * LANES), jnp.float32),
+            pltpu.VMEM((tile_m, n_acc * LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xt, yt, y2p)
+    return out_d[:m], out_i[:m]
+
+
 def supported(*, algorithm: str, k: int, mode: str,
               encoded_width: int = 0) -> bool:
     return (algorithm == "euclidean" and mode == "fast" and
@@ -244,7 +370,7 @@ def supported(*, algorithm: str, k: int, mode: str,
 
 @partial(jax.jit, static_argnames=("k", "n_cat_bins", "distance_scale",
                                    "tile_m", "tile_n", "n_acc", "mode",
-                                   "interpret"))
+                                   "interpret", "layout"))
 def pairwise_topk_pallas(x_num: Optional[jnp.ndarray],
                          y_num: Optional[jnp.ndarray],
                          x_cat: Optional[jnp.ndarray] = None,
@@ -253,12 +379,17 @@ def pairwise_topk_pallas(x_num: Optional[jnp.ndarray],
                          distance_scale: int = 1000,
                          tile_m: int = 1024, tile_n: int = 4096,
                          n_acc: int = 4, mode: str = "fast",
-                         interpret: bool = False
+                         interpret: bool = False, layout: str = "lane"
                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Drop-in for ``ops.distance.pairwise_topk`` (euclidean, fast mode):
     (scaled-int distances [M, min(k, N)], train indices [M, min(k, N)]) —
     the same shape the XLA path returns; tile-padding rows never leak into
-    the results. Per-attribute rms normalization like the XLA path."""
+    the results. Per-attribute rms normalization like the XLA path.
+
+    ``layout="lane"`` is the production kernel (features on the 128-lane
+    contraction axis); ``layout="tpose"`` contracts the sublane axis with
+    the scalar-tag fold (``_tpose_tag_kernel``) — same numerics, equal
+    median speed, independent jitter (bench.py A/Bs all arms per run)."""
     x = encode_mixed(x_num, x_cat, n_cat_bins)
     y = encode_mixed(y_num, y_cat, n_cat_bins)
     n_attrs = ((x_num.shape[1] if x_num is not None else 0) +
@@ -275,9 +406,11 @@ def pairwise_topk_pallas(x_num: Optional[jnp.ndarray],
     # the test tile in step so the accumulator scratch stays a few MB of VMEM
     n_acc_eff = max(n_acc, (17 * k_eff + LANES - 1) // LANES)
     tm = tile_m if n_acc_eff <= 8 else max(min(tile_m, 256), 8)
-    raw_d, raw_i = _pallas_topk_raw(x, y, k=k_eff, tile_m=tm,
-                                    tile_n=tn, n_acc=n_acc_eff, mode=mode,
-                                    interpret=interpret)
+    raw_fn = (_pallas_topk_tpose_raw if layout == "tpose"
+              else _pallas_topk_raw)
+    raw_d, raw_i = raw_fn(x, y, k=k_eff, tile_m=tm,
+                          tile_n=tn, n_acc=n_acc_eff, mode=mode,
+                          interpret=interpret)
     raw_d, raw_i = raw_d[:, :k_eff], raw_i[:, :k_eff]
     x2 = jnp.sum(x * x, axis=1, keepdims=True)
     found = raw_i >= 0

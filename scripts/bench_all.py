@@ -357,8 +357,11 @@ def bench_grouped_bandit_decisions() -> None:
             def body(st, _):
                 st, actions = jax.vmap(
                     lambda s: algo.next_action(s, cfg))(st)
-                rewards = jnp.take_along_axis(
-                    arm_rewards, actions[:, None], axis=1)[:, 0]
+                # one-hot env reward lookup (not a gather) — see the
+                # round-5 attribution note in bench_grouped_bandit_microbatch
+                oh = (actions[:, None] ==
+                      jnp.arange(n_actions)[None, :]).astype(jnp.float32)
+                rewards = jnp.sum(oh * arm_rewards, axis=1)
                 st = jax.vmap(
                     lambda s, a, r: algo.set_reward(s, a, r, cfg=cfg)
                 )(st, actions, rewards)
@@ -413,7 +416,19 @@ def bench_grouped_bandit_microbatch() -> None:
             def body(st, _):
                 st, actions = jax.vmap(
                     lambda s: next_actions_fused(algo, s, cfg, r_rounds))(st)
-                rewards = jnp.take_along_axis(arm_rewards, actions, axis=1)
+                # ROUND-5 ATTRIBUTION CLOSE (VERDICT item 6): the env's
+                # reward lookup is a one-hot contraction, NOT
+                # take_along_axis — the [G, A] x [G, R] batched GATHER was
+                # the ENTIRE round-4 "~8.5ns/decision unattributed floor"
+                # (isolation: gather_only 8.05ns/dec ~= the full step;
+                # every learner component <=0.3ns/dec; scripts/PERF_NOTES
+                # round-5 section). TPU gathers lower pathologically — the
+                # mirror of the round-2 scatter finding — and the gather
+                # was harness environment, not learner.
+                oh = (actions[:, None, :] ==
+                      jnp.arange(n_actions)[None, :, None]).astype(
+                          jnp.float32)
+                rewards = jnp.sum(oh * arm_rewards[:, :, None], axis=1)
                 st = jax.vmap(
                     lambda s, a, rw: set_rewards_fused(algo, s, a, rw, cfg)
                 )(st, actions, rewards)
@@ -424,12 +439,15 @@ def bench_grouped_bandit_microbatch() -> None:
             return outs
         return chain
 
-    rate, method = differential_rate(chain_for, states0, 50, 400,
+    # the de-gathered step is ~40x faster, so the chain lengths grow to
+    # keep the differential signal above relay noise
+    rate, method = differential_rate(chain_for, states0, 200, 1600,
                                      n_groups * r_rounds)
     bytes_per_decision = 2 * 6 * n_actions * 4 / r_rounds
     emit("bandit_grouped_microbatch_decisions_per_sec", rate,
          f"decisions/sec ({n_groups} contexts x {n_actions} arms, "
-         f"R={r_rounds} rounds/dispatch micro-batch; {method})",
+         f"R={r_rounds} rounds/dispatch micro-batch, one-hot env rewards; "
+         f"{method})",
          bound=HBM_BPS / bytes_per_decision,
          bound_model=f"HBM stream, {bytes_per_decision:.0f}B/decision "
                      "(state leaves read+write once per R-round batch)")

@@ -130,6 +130,47 @@ class TestSampling:
         assert ((idx[100:200] >= 100) & (idx[100:200] < 200)).all()
         assert (idx[200:] >= 200).all()
 
+    def test_streaming_bootstrap_keep_probs_golden(self):
+        """Round-5 compat mode vs a hand-walk of the reference mapper
+        (UnderSamplingBalancer.java:92-131): labels a,a,b,a,b,a with
+        distr.batch.size=4. Counts after row 3 (the bootstrap point):
+        a=3, b=1, min=1 -> held rows 0-2 and current row 3 use those;
+        row 4 (b): counts a=3,b=2, min=2, cnt=2 -> 1.0;
+        row 5 (a): counts a=4,b=2, min=2, cnt=4 -> 0.5."""
+        labels = jnp.asarray([0, 0, 1, 0, 1, 0])
+        probs = np.asarray(sampling._streaming_keep_probs(labels, 2, 4))
+        np.testing.assert_allclose(
+            probs, [1 / 3, 1 / 3, 1.0, 1 / 3, 1.0, 0.5], rtol=1e-6)
+
+    def test_streaming_bootstrap_converges_to_exact_mode(self):
+        """With the bootstrap window covering the whole table, every row
+        uses the exact global counts — the default mode's probabilities."""
+        rng = np.random.default_rng(0)
+        labels = jnp.asarray(rng.integers(0, 3, 500))
+        counts = np.bincount(np.asarray(labels), minlength=3).astype(float)
+        expected = np.where(counts > counts.min(),
+                            counts.min() / counts, 1.0)[np.asarray(labels)]
+        probs = np.asarray(sampling._streaming_keep_probs(labels, 3, 500))
+        np.testing.assert_allclose(probs, expected, rtol=1e-6)
+
+    def test_streaming_bootstrap_cli_mode(self, tmp_path):
+        """The verb honors streaming.bootstrap=true + distr.batch.size and
+        still balances."""
+        from avenir_tpu.cli.main import main as cli
+        rows = [f"r{i},{'maj' if i % 10 else 'min'}" for i in range(1000)]
+        (tmp_path / "in.csv").write_text("\n".join(rows) + "\n")
+        props = tmp_path / "u.properties"
+        props.write_text("field.delim.regex=,\nclass.attr.ord=1\n")
+        cli(["UnderSamplingBalancer", str(tmp_path / "in.csv"),
+             str(tmp_path / "out.csv"), "--conf", str(props),
+             "-D", "streaming.bootstrap=true",
+             "-D", "distr.batch.size=200"])
+        kept = (tmp_path / "out.csv").read_text().splitlines()
+        kept_min = sum(1 for l in kept if l.endswith(",min"))
+        kept_maj = len(kept) - kept_min
+        assert kept_min == 100                    # minority fully kept
+        assert 40 < kept_maj < 220                # majority ~minCount
+
 
 class TestLogistic:
     def _data(self, n=2000, seed=0):

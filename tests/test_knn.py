@@ -189,6 +189,24 @@ class TestElearnEndToEnd:
         acc_ex = (pred_ex.predicted == truth).mean()
         assert abs(acc_p - acc_ex) <= 0.015, (acc_p, acc_ex)
 
+    def test_pallas_tpose_layout_matches_lane(self, split):
+        """Round-5 third bench arm: the transposed-contraction layout
+        (sublane dot + scalar-tag fold) must report the same neighbors and
+        scaled distances as the production lane layout (interpret mode —
+        identical bucket structure, so the sets match exactly)."""
+        from avenir_tpu.ops import pallas_distance as P
+        train, test = split
+        te_num, te_cat, n_bins = knn._split_features(test)
+        tr_num, tr_cat, _ = knn._split_features(train)
+        d_lane, i_lane = P.pairwise_topk_pallas(
+            te_num, tr_num, te_cat, tr_cat, k=5, n_cat_bins=n_bins,
+            interpret=True)
+        d_t, i_t = P.pairwise_topk_pallas(
+            te_num, tr_num, te_cat, tr_cat, k=5, n_cat_bins=n_bins,
+            interpret=True, layout="tpose")
+        np.testing.assert_array_equal(np.asarray(i_lane), np.asarray(i_t))
+        np.testing.assert_array_equal(np.asarray(d_lane), np.asarray(d_t))
+
     def test_decision_threshold(self, split):
         train, test = split
         cfg_lo = knn.KnnConfig(top_match_count=5, decision_threshold=0.2,
