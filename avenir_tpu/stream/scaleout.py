@@ -94,6 +94,77 @@ class _StoppableQueues(RedisQueues):
         return event
 
 
+def shuffle_worker_main(host: str, port: int, worker_id: int,
+                        n_workers: int, groups: Sequence[str],
+                        learner_type: str, actions: Sequence[str],
+                        config: Dict, seed: int, replay: bool = False,
+                        decision_io_ms: float = 0.0) -> Dict:
+    """The reference's ACTUAL grouping discipline
+    (ReinforcementLearnerTopology.java:74 ``shuffleGrouping``): any worker
+    may serve any event, and each worker keeps PRIVATE learners — safe in
+    Storm only because bolt-local learner state is never shared, and
+    replayed here faithfully: one shared event queue all workers pop
+    (RPOPLPUSH into a per-WORKER pending ledger), one private learner per
+    group per worker, and every worker drains EVERY group's reward queue
+    with its own non-destructive cursor (the RedisRewardReader lindex
+    walk — this is exactly why the reference reads rewards by cursor
+    rather than popping). Weaker consistency than the fieldsGrouping-style
+    ownership mode (`worker_main`): a group's selections come from N
+    independently-exploring learners, each trained on the union reward
+    stream but only its own 1/N of the selection feedback loop. Offered
+    for contract parity; the ownership mode remains the default."""
+    from avenir_tpu.models.bandits.learners import create
+    client = MiniRedisClient(host, port)
+    pending = f"pendingQueue:shuffle:w{worker_id}"
+    replayed = 0
+    if replay:
+        replayed = reclaim_pending(client, pending, "eventQueue")
+    events_q = RedisQueues(event_queue="eventQueue",
+                           action_queue="actionQueue",
+                           client=client, pending_queue=pending)
+    reward_q = {g: RedisQueues(reward_queue=f"rewardQueue:{g}",
+                               client=client) for g in groups}
+    learners = {
+        g: create(learner_type, list(actions), dict(config),
+                  seed=seed + 1000 * worker_id + i)
+        for i, g in enumerate(groups)}
+    events = rewards = 0
+    idle_sleep = 0.001
+    while True:
+        for g, q in reward_q.items():
+            for action_id, reward in q.drain_rewards():
+                learners[g].set_reward(action_id, reward)
+                rewards += 1
+        event_id = events_q.pop_event()
+        if event_id is None:
+            time.sleep(idle_sleep)
+            idle_sleep = min(idle_sleep * 2, 0.016)
+            continue
+        idle_sleep = 0.001
+        if event_id == STOP_SENTINEL:
+            events_q.ack_event(event_id)
+            break                 # driver pushes one sentinel per worker
+        g = event_id.partition(":")[0]
+        selections = learners[g].next_actions()
+        events_q.write_actions(event_id, selections)
+        events_q.ack_event(event_id)   # ack AFTER the answer, as always
+        events += 1
+        if decision_io_ms > 0:
+            time.sleep(decision_io_ms / 1e3)
+    # final drain: rewards the driver pushed between this worker's last
+    # in-loop drain and its sentinel must still reach the private
+    # learners — the driver pushes all rewards before any sentinel, so
+    # after this pass every worker has seen the full stream
+    for g, q in reward_q.items():
+        for action_id, reward in q.drain_rewards():
+            learners[g].set_reward(action_id, reward)
+            rewards += 1
+    client.close()
+    return {"worker": worker_id, "events": events, "rewards": rewards,
+            "replayed": replayed, "groups": sorted(groups),
+            "grouping": "shuffle"}
+
+
 def worker_main(host: str, port: int, worker_id: int, n_workers: int,
                 groups: Sequence[str], learner_type: str,
                 actions: Sequence[str], config: Dict, seed: int,
@@ -197,8 +268,8 @@ def _broker(host: str, server: Optional[MiniRedisServer] = None):
 def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
                   groups: Sequence[str], learner_type: str,
                   actions: Sequence[str], config: Dict, seed: int,
-                  replay: bool = False,
-                  decision_io_ms: float = 0.0) -> subprocess.Popen:
+                  replay: bool = False, decision_io_ms: float = 0.0,
+                  grouping: str = "fields") -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
            "--host", host, "--port", str(port),
@@ -206,7 +277,8 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
            "--n-workers", str(n_workers), "--groups", ",".join(groups),
            "--learner-type", learner_type, "--actions", ",".join(actions),
            "--config", json.dumps(config), "--seed", str(seed),
-           "--decision-io-ms", str(decision_io_ms)]
+           "--decision-io-ms", str(decision_io_ms),
+           "--grouping", grouping]
     if replay:
         cmd.append("--replay")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
@@ -216,10 +288,11 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
 def _spawn_workers(host: str, port: int, n_workers: int,
                    groups: Sequence[str], learner_type: str,
                    actions: Sequence[str], config: Dict, seed: int,
-                   decision_io_ms: float = 0.0) -> List[subprocess.Popen]:
+                   decision_io_ms: float = 0.0,
+                   grouping: str = "fields") -> List[subprocess.Popen]:
     return [_spawn_worker(host, port, w, n_workers, groups, learner_type,
                           actions, config, seed,
-                          decision_io_ms=decision_io_ms)
+                          decision_io_ms=decision_io_ms, grouping=grouping)
             for w in range(n_workers)]
 
 
@@ -244,17 +317,23 @@ def _consume_one(client: MiniRedisClient, ctr, rng, t_push,
 def _drive(client: MiniRedisClient, groups: Sequence[str],
            ctr: Dict[str, Dict[str, float]], n_events: int,
            rate: Optional[float], rng, t_push: Dict[str, float],
-           latencies: List[float], picks: List[Tuple[str, str]]) -> None:
+           latencies: List[float], picks: List[Tuple[str, str]],
+           shuffle: bool = False) -> None:
     """Throughput mode (``rate=None``): BURST all events up-front so every
     group carries backlog and worker parallelism — not this driver's serial
     reward loop — sets the drain time. Paced mode: inject at ``rate``/s and
-    consume as answers arrive, measuring per-event serving latency."""
+    consume as answers arrive, measuring per-event serving latency.
+    ``shuffle`` pushes every event onto the single shared ``eventQueue``
+    (the shuffleGrouping spout) instead of the per-group queues."""
+    def push(sent):
+        g = groups[sent % len(groups)]
+        event_id = f"{g}:{sent}"
+        t_push[event_id] = time.perf_counter()
+        client.lpush("eventQueue" if shuffle else f"eventQueue:{g}",
+                     event_id)
     if rate is None:
         for sent in range(n_events):
-            g = groups[sent % len(groups)]
-            event_id = f"{g}:{sent}"
-            t_push[event_id] = time.perf_counter()
-            client.lpush(f"eventQueue:{g}", event_id)
+            push(sent)
         answered = 0
         while answered < n_events:
             if _consume_one(client, ctr, rng, t_push, latencies, picks):
@@ -266,11 +345,10 @@ def _drive(client: MiniRedisClient, groups: Sequence[str],
     next_at = time.perf_counter()
     while answered < n_events:
         if sent < n_events and time.perf_counter() >= next_at:
-            g = groups[sent % len(groups)]
-            event_id = f"{g}:{sent}"
-            t_push[event_id] = time.perf_counter()
+            # schedule the next slot BEFORE the lpush so the broker RTT
+            # does not silently shave the injection rate (review finding)
             next_at = time.perf_counter() + 1.0 / rate
-            client.lpush(f"eventQueue:{g}", event_id)
+            push(sent)
             sent += 1
         if not _consume_one(client, ctr, rng, t_push, latencies, picks):
             time.sleep(0.0005)
@@ -283,9 +361,13 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
                  paced_rate: float = 100.0, learner_type: str = "softMax",
                  seed: int = 7, host: str = "localhost",
                  server: Optional[MiniRedisServer] = None,
-                 decision_io_ms: float = 0.0) -> ScaleoutResult:
+                 decision_io_ms: float = 0.0,
+                 grouping: str = "fields") -> ScaleoutResult:
     """Measure N serving workers against one broker (started here unless
-    passed in). Every event must come back answered exactly once."""
+    passed in). Every event must come back answered exactly once.
+    ``grouping="shuffle"`` runs the reference's shuffleGrouping discipline
+    (shared event queue, private per-worker learners — see
+    :func:`shuffle_worker_main`) instead of per-group ownership."""
     import numpy as np
     rng = np.random.default_rng(seed)
     groups = [f"g{i}" for i in range(n_groups)]
@@ -302,30 +384,37 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
     # parallelism, not the driver's serial reward loop, sets throughput
     config = {"current.decision.round": 1, "batch.size": 8}
 
+    shuffle = grouping == "shuffle"
     with _broker(host, server) as (client, broker_host, broker_port):
         procs = _spawn_workers(broker_host, broker_port, n_workers, groups,
                                learner_type, actions, config, seed,
-                               decision_io_ms=decision_io_ms)
+                               decision_io_ms=decision_io_ms,
+                               grouping=grouping)
         try:
             t_push: Dict[str, float] = {}
             latencies: List[float] = []
             picks: List[Tuple[str, str]] = []
             # warmup: first dispatch per worker pays jit compile; excluded
             _drive(client, groups, ctr, 4 * n_groups, None, rng,
-                   t_push, [], [])
+                   t_push, [], [], shuffle=shuffle)
             t_push.clear()
 
             t0 = time.perf_counter()
             _drive(client, groups, ctr, throughput_events, None, rng,
-                   t_push, [], picks)
+                   t_push, [], picks, shuffle=shuffle)
             throughput_s = time.perf_counter() - t0
 
             t_push.clear()
             _drive(client, groups, ctr, paced_events, paced_rate, rng,
-                   t_push, latencies, picks)
+                   t_push, latencies, picks, shuffle=shuffle)
 
-            for g in groups:
-                client.lpush(f"eventQueue:{g}", STOP_SENTINEL)
+            if shuffle:
+                # one sentinel per worker on the shared queue
+                for _ in range(n_workers):
+                    client.lpush("eventQueue", STOP_SENTINEL)
+            else:
+                for g in groups:
+                    client.lpush(f"eventQueue:{g}", STOP_SENTINEL)
             worker_stats = []
             for p in procs:
                 out, err = p.communicate(timeout=120)
@@ -342,7 +431,11 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
             raise RuntimeError(
                 f"workers answered {total} events, expected {expected}")
         # the ack ledger must retire every entry on the happy path
-        left = sum(client.llen(f"pendingQueue:{g}") for g in groups)
+        if shuffle:
+            left = sum(client.llen(f"pendingQueue:shuffle:w{w}")
+                       for w in range(n_workers))
+        else:
+            left = sum(client.llen(f"pendingQueue:{g}") for g in groups)
         if left:
             raise RuntimeError(f"{left} un-acked ledger entries left behind")
 
@@ -481,6 +574,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--decision-io-ms", type=float, default=0.0,
                     help="simulated blocking IO per served event: the "
                          "regime where workers scale even on one core")
+    ap.add_argument("--grouping", default="fields",
+                    choices=("fields", "shuffle"),
+                    help="fields = per-group ownership (default, stronger "
+                         "semantics); shuffle = the reference's "
+                         "shuffleGrouping with private per-worker learners")
     args = ap.parse_args(argv)
 
     if args.worker:
@@ -492,21 +590,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from jax.extend.backend import clear_backends
         clear_backends()
         jax.config.update("jax_platforms", "cpu")
-        stats = worker_main(args.host, args.port, args.worker_id,
-                            args.n_workers, args.groups.split(","),
-                            args.learner_type, args.actions.split(","),
-                            json.loads(args.config), args.seed,
-                            replay=args.replay,
-                            decision_io_ms=args.decision_io_ms)
+        fn = (shuffle_worker_main if args.grouping == "shuffle"
+              else worker_main)
+        stats = fn(args.host, args.port, args.worker_id,
+                   args.n_workers, args.groups.split(","),
+                   args.learner_type, args.actions.split(","),
+                   json.loads(args.config), args.seed,
+                   replay=args.replay,
+                   decision_io_ms=args.decision_io_ms)
         print(json.dumps(stats), flush=True)
         return 0
 
     for n in [int(v) for v in args.sweep.split(",")]:
         r = run_scaleout(n, throughput_events=args.events,
                          learner_type=args.learner_type,
-                         decision_io_ms=args.decision_io_ms)
+                         decision_io_ms=args.decision_io_ms,
+                         grouping=args.grouping)
         print(json.dumps({
             "n_workers": r.n_workers,
+            "grouping": args.grouping,
             "decision_io_ms": args.decision_io_ms,
             "decisions_per_sec": round(r.decisions_per_sec, 1),
             "p50_latency_ms": round(r.p50_latency_ms, 2),

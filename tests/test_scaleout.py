@@ -160,6 +160,32 @@ class TestScaleout:
         # so assert a lean, not convergence
         assert r.best_action_fraction > 0.4
 
+    def test_shuffle_grouping_mode(self):
+        """Round-5 contract-parity mode: the reference's shuffleGrouping
+        (ReinforcementLearnerTopology.java:74) — one shared event queue,
+        private per-worker learners, every worker cursor-reading every
+        reward stream. Contract: every event answered exactly once, BOTH
+        workers served events (the shared queue spreads load — no
+        ownership), learners still lean onto the planted arms despite the
+        split selection feedback."""
+        r = run_scaleout(2, n_groups=4, throughput_events=150,
+                         paced_events=50, paced_rate=500.0, seed=11,
+                         grouping="shuffle")
+        assert len(r.worker_stats) == 2
+        assert all(w.get("grouping") == "shuffle" for w in r.worker_stats)
+        # no ownership: every worker keeps private learners for ALL groups
+        assert all(len(w["groups"]) == 4 for w in r.worker_stats)
+        total = sum(w["events"] for w in r.worker_stats)
+        assert total == 16 + 150 + 50
+        # load spread is OPPORTUNISTIC under a shared queue (a worker that
+        # compiles late can legitimately serve few/none) — the guaranteed
+        # property is the exactly-once TOTAL above, not per-worker counts.
+        # What IS guaranteed: every worker's private learners drank the
+        # FULL reward stream (cursor reads + the worker's final drain)
+        rewards = [w["rewards"] for w in r.worker_stats]
+        assert rewards[0] == rewards[1] > 0
+        assert r.best_action_fraction > 0.4
+
 
 class TestChaos:
     def test_sigkill_mid_stream_loses_nothing(self):
