@@ -128,6 +128,15 @@ def shuffle_worker_main(host: str, port: int, worker_id: int,
         g: create(learner_type, list(actions), dict(config),
                   seed=seed + 1000 * worker_id + i)
         for i, g in enumerate(groups)}
+    # self-warmup: compile every private learner's select path BEFORE
+    # entering the pop loop. Fields mode warms through per-group warmup
+    # events, but a shared queue cannot target workers — a fast worker
+    # could drain all warmup events and leave a late worker's first
+    # compile inside the driver's timed window (review finding). The
+    # warm draws are discarded (never written to a queue); each private
+    # learner just starts its exploration one batch ahead.
+    for lr in learners.values():
+        lr.next_actions()
     events = rewards = 0
     idle_sleep = 0.001
     while True:

@@ -164,10 +164,11 @@ class TestScaleout:
         """Round-5 contract-parity mode: the reference's shuffleGrouping
         (ReinforcementLearnerTopology.java:74) — one shared event queue,
         private per-worker learners, every worker cursor-reading every
-        reward stream. Contract: every event answered exactly once, BOTH
-        workers served events (the shared queue spreads load — no
-        ownership), learners still lean onto the planted arms despite the
-        split selection feedback."""
+        reward stream. Asserted contract: every event answered exactly
+        once IN TOTAL (per-worker spread is opportunistic under a shared
+        queue), every worker holds private learners for all groups and
+        sees the full reward stream, and learners still lean onto the
+        planted arms despite the split selection feedback."""
         r = run_scaleout(2, n_groups=4, throughput_events=150,
                          paced_events=50, paced_rate=500.0, seed=11,
                          grouping="shuffle")
