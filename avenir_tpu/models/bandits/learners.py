@@ -1031,6 +1031,15 @@ class Learner:
         return self.actions[int(action)]
 
     def next_actions(self):
+        """The nextActions() batch contract (ReinforcementLearner.java:
+        86-91): ``batch.size`` scalar draws, bit-stable with the scalar
+        path. DELIBERATELY not routed through the fused batch: with the
+        reference's factorial temperature collapse, which arm gets lucky
+        in the first draws decides convergence, and serving deployments
+        (OnlineLearnerLoop.step) depend on this path's historical
+        realization stream. Callers that want the fused single-dispatch
+        semantics use ``next_action_batch`` (the loop's ``run`` batch
+        mode already does)."""
         return [self.next_action() for _ in range(self.cfg.batch_size)]
 
     def next_action_batch(self, n: int):
