@@ -1509,6 +1509,11 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--conf", required=True, help="properties file")
     parser.add_argument("-D", action="append", default=[], metavar="key=val",
                         help="config overrides")
+    parser.add_argument("--metrics-out", metavar="PATH", default=None,
+                        help="enable telemetry and dump the merged report "
+                             "(spans, compile counts, RSS, counters) after "
+                             "the job: JSONL events at PATH, Prometheus "
+                             "text exposition at PATH.prom")
     args = parser.parse_args(argv)
 
     conf = JobConfig.from_file(args.conf)
@@ -1530,6 +1535,14 @@ def main(argv: List[str] = None) -> int:
     timer = profiling.StepTimer(args.verb)
     ctx = (profiling.trace(trace_dir) if trace_dir
            else contextlib.nullcontext())
+    # telemetry (ISSUE 2): --metrics-out arms the whole obs layer — span
+    # tracer, compile listener, RSS sampler, MetricsRegistry sink — for
+    # exactly this job, and dumps the merged report after it
+    tel_hub = None
+    if args.metrics_out:
+        from avenir_tpu.obs import exporters as obs_exporters
+        from avenir_tpu.obs import telemetry as obs_telemetry
+        tel_hub = obs_exporters.hub().enable()
     # the reference's task-retry budget (mapreduce.map.maxattempts=2,
     # resource/knn.properties:5-6) applied at the job level: transient
     # runtime/IO failures (e.g. a dropped accelerator connection) re-run the
@@ -1546,20 +1559,50 @@ def main(argv: List[str] = None) -> int:
         # verbs that manage their own durability (checkpoint + replay)
         # would emit partial output on a re-run, not a full overwrite
         attempts = 1
-    with ctx, timer.step():
-        for attempt in range(1, attempts + 1):
-            try:
-                VERBS[args.verb](conf, args.input, args.output)
-                break
-            except (ValueError, KeyError, FileNotFoundError, TypeError,
-                    IndexError):
-                # deterministic input/config defects: a re-run cannot succeed
-                raise
-            except Exception:
-                if attempt == attempts:
+    job_span = (obs_telemetry.span(f"job.{args.verb}") if tel_hub
+                else contextlib.nullcontext())
+    try:
+        with ctx, timer.step(), job_span:
+            for attempt in range(1, attempts + 1):
+                reg_mark = (tel_hub.registry_mark() if tel_hub else 0)
+                try:
+                    VERBS[args.verb](conf, args.input, args.output)
+                    break
+                except (ValueError, KeyError, FileNotFoundError, TypeError,
+                        IndexError):
+                    # deterministic input/config defects: a re-run cannot
+                    # succeed
                     raise
-                logger.warning("attempt %d/%d of %s failed; retrying",
-                               attempt, attempts, args.verb, exc_info=True)
+                except Exception:
+                    if attempt == attempts:
+                        raise
+                    if tel_hub is not None:
+                        # counters() SUMS registries: the dead attempt's
+                        # partial counters must not double into the
+                        # retry's report
+                        tel_hub.drop_registries_since(reg_mark)
+                    logger.warning("attempt %d/%d of %s failed; retrying",
+                                   attempt, attempts, args.verb,
+                                   exc_info=True)
+    finally:
+        if tel_hub is not None:
+            # the wall-time summary (now with p50/p95/p99) rides along as
+            # gauges; dump even on failure — a crashed job's partial
+            # telemetry is exactly what the postmortem needs
+            for key, value in timer.summary().items():
+                tel_hub.set_gauge(f"job.{key}", value)
+            try:
+                paths = tel_hub.write(args.metrics_out)
+            except OSError as exc:
+                # an unwritable report path must not fail a finished job
+                # (or mask the real exception of a failed one)
+                logger.warning("telemetry report not written to %s: %s",
+                               args.metrics_out, exc)
+            else:
+                logger.info("telemetry report: %s + %s",
+                            paths["jsonl"], paths["prom"])
+            finally:
+                tel_hub.disable()
     if debug_on:
         logger.debug("timing %s", timer.summary())
     return 0

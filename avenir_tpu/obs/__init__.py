@@ -1,0 +1,34 @@
+"""Unified telemetry: spans + histograms, runtime collectors, exporters.
+
+The measurement substrate every job and loop reports into (ISSUE 2):
+
+- :mod:`avenir_tpu.obs.telemetry` — ``span()`` tracer + fixed-bucket
+  latency histograms with p50/p95/p99, disabled-by-default and free when
+  disabled.
+- :mod:`avenir_tpu.obs.runtime` — JAX compile counters (jax.monitoring
+  listener), /proc RSS sampling, device memory, background sampler.
+- :mod:`avenir_tpu.obs.exporters` — JSONL event log + Prometheus text
+  exposition, merged by the :class:`TelemetryHub` singleton together
+  with ``MetricsRegistry`` counters.
+
+One switch: ``obs.hub().enable()`` (the CLI's ``--metrics-out`` flag).
+"""
+
+from avenir_tpu.obs.exporters import (TelemetryHub, hub, prometheus_text,
+                                      read_jsonl, report_to_events,
+                                      events_to_report, write_jsonl)
+from avenir_tpu.obs.runtime import (CompileTracker, RuntimeSampler,
+                                    device_memory_stats,
+                                    install_compile_listener,
+                                    read_proc_status, snapshot_brief)
+from avenir_tpu.obs.telemetry import (BUCKET_BOUNDS_MS, LatencyHistogram,
+                                      Tracer, enable, percentiles, span,
+                                      tracer)
+
+__all__ = [
+    "BUCKET_BOUNDS_MS", "CompileTracker", "LatencyHistogram",
+    "RuntimeSampler", "TelemetryHub", "Tracer", "device_memory_stats",
+    "enable", "events_to_report", "hub", "install_compile_listener",
+    "percentiles", "prometheus_text", "read_jsonl", "read_proc_status",
+    "report_to_events", "snapshot_brief", "span", "tracer", "write_jsonl",
+]

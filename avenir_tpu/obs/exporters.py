@@ -1,0 +1,274 @@
+"""Exporters + the TelemetryHub that merges every signal into one report.
+
+Two wire formats, both chosen because something standard already reads
+them (PAPERS.md: Prometheus exposition, Dapper-style span dumps):
+
+- **JSONL event log**: one JSON object per line, each with a ``type``
+  discriminator (``span`` / ``counter`` / ``gauge`` / ``runtime`` /
+  ``meta``). Grep-able, streamable, and :func:`read_jsonl` round-trips it.
+- **Prometheus text exposition** (version 0.0.4): counters and gauges as
+  single samples, span histograms as classic ``_bucket``/``_sum``/
+  ``_count`` families with cumulative ``le`` labels — scrapeable by an
+  actual Prometheus if one is pointed at the file.
+
+:class:`TelemetryHub` is the process singleton gluing the subsystems
+together: the global tracer's span histograms, a :class:`RuntimeSampler`
++ :class:`CompileTracker`, ad-hoc gauges, and every
+:class:`~avenir_tpu.utils.metrics.MetricsRegistry` constructed while
+telemetry is enabled (the registry publishes itself through a sink hook
+in utils.metrics). Registries are held STRONGLY until ``reset()``: jobs
+build them as locals and drop them before the report is written, so a
+weak set would lose exactly the counters the report exists to carry.
+Everything is disabled by default; ``hub().enable()`` is the one switch
+(the CLI's ``--metrics-out`` flips it).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+from avenir_tpu.obs import runtime as _runtime
+from avenir_tpu.obs import telemetry as _telemetry
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize a dotted/slashed name into a Prometheus metric name."""
+    clean = _NAME_RE.sub("_", name)
+    if clean and clean[0].isdigit():
+        clean = "_" + clean
+    return clean
+
+
+def _prom_label(value: str) -> str:
+    return value.replace("\\", r"\\").replace('"', r"\"").replace(
+        "\n", r"\n")
+
+
+def report_to_events(report: Dict) -> List[Dict]:
+    """Flatten a merged report into the JSONL event list."""
+    events: List[Dict] = [{"type": "meta", **report.get("meta", {})}]
+    for name, snap in report.get("spans", {}).items():
+        events.append({"type": "span", "name": name, **snap})
+    for name, value in sorted(report.get("counters", {}).items()):
+        events.append({"type": "counter", "name": name, "value": value})
+    for name, value in sorted(report.get("gauges", {}).items()):
+        events.append({"type": "gauge", "name": name, "value": value})
+    if "runtime" in report:
+        events.append({"type": "runtime", **report["runtime"]})
+    return events
+
+
+def write_jsonl(events: Iterable[Dict], path: str) -> None:
+    with open(path, "w") as fh:
+        for event in events:
+            fh.write(json.dumps(event, sort_keys=True) + "\n")
+
+
+def read_jsonl(path: str) -> List[Dict]:
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def events_to_report(events: Iterable[Dict]) -> Dict:
+    """Inverse of :func:`report_to_events` (modulo key ordering): rebuild
+    the merged-report dict from a JSONL event list."""
+    report: Dict = {"spans": {}, "counters": {}, "gauges": {}}
+    for event in events:
+        kind = event.get("type")
+        body = {k: v for k, v in event.items() if k != "type"}
+        if kind == "span":
+            report["spans"][body.pop("name")] = body
+        elif kind == "counter":
+            report["counters"][body["name"]] = body["value"]
+        elif kind == "gauge":
+            report["gauges"][body["name"]] = body["value"]
+        elif kind == "runtime":
+            report["runtime"] = body
+        elif kind == "meta":
+            report["meta"] = body
+    return report
+
+
+def prometheus_text(report: Dict, prefix: str = "avenir") -> str:
+    """Render the merged report as Prometheus text exposition 0.0.4."""
+    lines: List[str] = []
+
+    def emit(name: str, kind: str, samples: List[str]) -> None:
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(samples)
+
+    for name, value in sorted(report.get("counters", {}).items()):
+        metric = f"{prefix}_{_prom_name(name)}"
+        emit(metric, "counter", [f"{metric} {value}"])
+    for name, value in sorted(report.get("gauges", {}).items()):
+        metric = f"{prefix}_{_prom_name(name)}"
+        emit(metric, "gauge", [f"{metric} {value}"])
+
+    runtime = report.get("runtime", {})
+    for key in ("rss_kb_last", "rss_kb_max", "vm_hwm_kb", "samples"):
+        if key in runtime:
+            metric = f"{prefix}_runtime_{_prom_name(key)}"
+            emit(metric, "gauge", [f"{metric} {runtime[key]}"])
+    for key, value in sorted(runtime.get("compile", {}).items()):
+        if key == "available":
+            continue
+        metric = f"{prefix}_compile_{_prom_name(key)}"
+        emit(metric, "counter", [f"{metric} {value}"])
+
+    spans = report.get("spans", {})
+    if spans:
+        metric = f"{prefix}_span_latency_ms"
+        lines.append(f"# TYPE {metric} histogram")
+        for name, snap in sorted(spans.items()):
+            label = _prom_label(name)
+            count = snap.get("count", 0)
+            for le, cum in snap.get("buckets", {}).items():
+                lines.append(
+                    f'{metric}_bucket{{span="{label}",le="{le}"}} {cum}')
+            if "buckets" not in snap:
+                # empty histogram still exposes the +Inf terminal
+                lines.append(
+                    f'{metric}_bucket{{span="{label}",le="+Inf"}} {count}')
+            lines.append(
+                f'{metric}_sum{{span="{label}"}} {snap.get("sum_ms", 0.0)}')
+            lines.append(f'{metric}_count{{span="{label}"}} {count}')
+    return "\n".join(lines) + "\n"
+
+
+class TelemetryHub:
+    """Process-wide merge point: spans + runtime + counters -> one report.
+
+    Use :func:`hub` for the singleton. ``enable()`` turns the global
+    tracer on, baselines the compile tracker, starts the RSS sampler, and
+    arms the MetricsRegistry sink; ``disable()`` undoes all of it (the
+    collected data survives until ``reset()``)."""
+
+    _instance: Optional["TelemetryHub"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self.tracer = _telemetry.tracer()
+        self.sampler = _runtime.RuntimeSampler()
+        self.compile_tracker = _runtime.CompileTracker()
+        self._registries: List = []   # strong refs; cleared by reset()
+        self._gauges: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._enabled = False
+        self._enabled_at: Optional[float] = None
+
+    @classmethod
+    def get(cls) -> "TelemetryHub":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = TelemetryHub()
+            return cls._instance
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, sample_interval_s: float = 0.25) -> "TelemetryHub":
+        from avenir_tpu.utils import metrics as _metrics
+        self._enabled = True
+        self._enabled_at = time.time()
+        _telemetry.enable(True)
+        self.compile_tracker.start()
+        self.sampler.interval_s = sample_interval_s
+        self.sampler.start()
+        _metrics._OBS_SINK = self._registries.append
+        return self
+
+    def disable(self) -> None:
+        from avenir_tpu.utils import metrics as _metrics
+        if _metrics._OBS_SINK is not None:
+            _metrics._OBS_SINK = None
+        self.sampler.stop()
+        _telemetry.enable(False)
+        self._enabled = False
+
+    def reset(self) -> None:
+        """Drop collected data (tests; between jobs in one process).
+
+        Safe while enabled: the old sampler thread is stopped before the
+        replacement starts, and the MetricsRegistry sink is re-bound to
+        the fresh registry list (it captures ``.append`` of a specific
+        list object, which this method just replaced)."""
+        from avenir_tpu.utils import metrics as _metrics
+        self.tracer.reset()
+        self._registries = []
+        with self._lock:
+            self._gauges.clear()
+        self.sampler.stop()
+        self.sampler = _runtime.RuntimeSampler(
+            interval_s=self.sampler.interval_s)
+        if self._enabled:
+            self.sampler.start()
+            _metrics._OBS_SINK = self._registries.append
+        self.compile_tracker.start()
+
+    # -- inputs ------------------------------------------------------------
+    def attach_registry(self, registry) -> None:
+        """Merge a MetricsRegistry into future reports (held until
+        ``reset()``)."""
+        if registry not in self._registries:
+            self._registries.append(registry)
+
+    def registry_mark(self) -> int:
+        """Position marker for :meth:`drop_registries_since` — taken
+        before work that may be retried."""
+        return len(self._registries)
+
+    def drop_registries_since(self, mark: int) -> None:
+        """Forget registries attached after ``mark``. The CLI calls this
+        before re-running a failed attempt: counters() SUMS registries,
+        so a dead attempt's partial counters would otherwise double into
+        the retried attempt's report."""
+        del self._registries[mark:]
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    # -- outputs -----------------------------------------------------------
+    def counters(self) -> Dict[str, float]:
+        merged: Dict[str, float] = {}
+        for registry in list(self._registries):
+            for key, value in registry.as_dict().items():
+                merged[key] = merged.get(key, 0.0) + value
+        return merged
+
+    def report(self) -> Dict:
+        runtime = self.sampler.snapshot()
+        runtime["compile"] = self.compile_tracker.snapshot()
+        with self._lock:
+            gauges = dict(self._gauges)
+        return {
+            "meta": {"generated_at": time.time(),
+                     "enabled_at": self._enabled_at,
+                     "format": "avenir-telemetry-v1"},
+            "spans": self.tracer.snapshot(),
+            "counters": self.counters(),
+            "gauges": gauges,
+            "runtime": runtime,
+        }
+
+    def write(self, path: str) -> Dict[str, str]:
+        """Dump the merged report: JSONL events at ``path``, Prometheus
+        text at ``path + ".prom"``. Returns the paths written."""
+        report = self.report()
+        write_jsonl(report_to_events(report), path)
+        prom_path = path + ".prom"
+        with open(prom_path, "w") as fh:
+            fh.write(prometheus_text(report))
+        return {"jsonl": path, "prom": prom_path}
+
+
+def hub() -> TelemetryHub:
+    return TelemetryHub.get()

@@ -1,0 +1,227 @@
+"""Runtime collectors: JAX compile activity, host RSS, device memory.
+
+The reference surfaced runtime health through the JobTracker UI (task
+counters, JVM heap); the port had nothing. Three collectors, all
+poll-or-listen, none touching the hot path:
+
+- **Compile tracking** hooks ``jax.monitoring`` duration events
+  (``/jax/core/compile/backend_compile_duration`` et al., fired by
+  dispatch.py on every trace/lower/compile) into process-wide totals;
+  :class:`CompileTracker` snapshots deltas from an ``start()`` baseline,
+  so one job's report shows *its* compiles, not the warmup's. A growing
+  compile count over a steady workload is the compile-cache-leak signal
+  (the varying-shape trap in streaming folds).
+- **Host RSS** is parsed from ``/proc/self/status`` (``VmRSS``/``VmHWM``).
+  ``ru_maxrss`` is unreliable in this sandbox — it reports the container
+  host's peak, not this process — so nothing here touches ``resource``.
+- **Device memory** comes from ``Device.memory_stats()`` where the backend
+  provides it (TPU does; CPU returns None) — always optional.
+
+:class:`RuntimeSampler` runs the pollers on a daemon thread with
+idempotent start/stop, keeping a bounded ring of samples for the report.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# compile tracking (jax.monitoring listener)
+# ---------------------------------------------------------------------------
+
+# process-wide totals, updated by the listener below. Listener registration
+# in jax is permanent (there is no single-listener unregister), so the
+# listener always accumulates here and trackers snapshot deltas.
+_COMPILE_TOTALS = {
+    "backend_compile_count": 0,
+    "backend_compile_secs": 0.0,
+    "jaxpr_trace_count": 0,
+    "jaxpr_trace_secs": 0.0,
+    "lowering_count": 0,
+    "lowering_secs": 0.0,
+}
+_COMPILE_LOCK = threading.Lock()
+_LISTENER_INSTALLED = False
+
+_EVENT_KEYS = {
+    "/jax/core/compile/backend_compile_duration":
+        ("backend_compile_count", "backend_compile_secs"),
+    "/jax/core/compile/jaxpr_trace_duration":
+        ("jaxpr_trace_count", "jaxpr_trace_secs"),
+    "/jax/core/compile/jaxpr_to_mlir_module_duration":
+        ("lowering_count", "lowering_secs"),
+}
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    keys = _EVENT_KEYS.get(event)
+    if keys is None:
+        return
+    count_key, secs_key = keys
+    with _COMPILE_LOCK:
+        _COMPILE_TOTALS[count_key] += 1
+        _COMPILE_TOTALS[secs_key] += float(duration)
+
+
+def install_compile_listener() -> bool:
+    """Register the jax.monitoring listener once per process. Safe to call
+    repeatedly; returns False when jax (or its monitoring API) is absent,
+    leaving compile counts permanently zero rather than failing."""
+    global _LISTENER_INSTALLED
+    with _COMPILE_LOCK:
+        if _LISTENER_INSTALLED:
+            return True
+        try:
+            import jax.monitoring
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration)
+        except Exception:
+            return False
+        _LISTENER_INSTALLED = True
+        return True
+
+
+def compile_totals() -> Dict[str, float]:
+    with _COMPILE_LOCK:
+        return dict(_COMPILE_TOTALS)
+
+
+class CompileTracker:
+    """Delta view over the process compile totals: ``start()`` pins a
+    baseline, ``snapshot()`` reports activity since then."""
+
+    def __init__(self):
+        self._baseline: Dict[str, float] = dict.fromkeys(_COMPILE_TOTALS, 0)
+        self.available = install_compile_listener()
+
+    def start(self) -> None:
+        self.available = install_compile_listener()
+        self._baseline = compile_totals()
+
+    def snapshot(self) -> Dict[str, float]:
+        now = compile_totals()
+        out: Dict[str, float] = {
+            k: (round(v - self._baseline[k], 6)
+                if isinstance(v, float) else v - self._baseline[k])
+            for k, v in now.items()}
+        out["available"] = self.available
+        return out
+
+
+# ---------------------------------------------------------------------------
+# host + device memory
+# ---------------------------------------------------------------------------
+
+def read_proc_status() -> Dict[str, int]:
+    """``{"rss_kb": VmRSS, "hwm_kb": VmHWM}`` from /proc/self/status;
+    empty dict where procfs is unavailable (macOS, restricted mounts)."""
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    out["rss_kb"] = int(line.split()[1])
+                elif line.startswith("VmHWM:"):
+                    out["hwm_kb"] = int(line.split()[1])
+    except OSError:
+        pass
+    return out
+
+
+def device_memory_stats() -> Optional[Dict[str, float]]:
+    """First device's ``memory_stats()`` (bytes_in_use etc.) when the
+    backend exposes it; None on CPU/interpret backends. Imports jax lazily
+    so report generation works in processes that never touched it."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: float(v) for k, v in stats.items()}
+
+
+def snapshot_brief() -> Dict:
+    """One-shot runtime snapshot (no sampler thread): what bench.py embeds
+    in its JSON artifact."""
+    out: Dict = dict(read_proc_status())
+    out["compile"] = compile_totals()
+    dev = device_memory_stats()
+    if dev is not None:
+        out["device_memory"] = dev
+    return out
+
+
+class RuntimeSampler:
+    """Background RSS/device-memory sampler with clean start/stop.
+
+    Samples ``(t_monotonic, rss_kb)`` every ``interval_s`` into a bounded
+    ring (the report needs the envelope, not an unbounded trace). Both
+    ``start`` and ``stop`` are idempotent: a second ``start`` while running
+    is a no-op, ``stop`` on a stopped sampler returns immediately, and a
+    stopped sampler can be started again (fresh thread, samples retained).
+    """
+
+    def __init__(self, interval_s: float = 0.25, max_samples: int = 2048):
+        self.interval_s = interval_s
+        self._samples: Deque[Tuple[float, int]] = collections.deque(
+            maxlen=max_samples)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            status = read_proc_status()
+            if status:
+                self._samples.append(
+                    (time.monotonic(), status.get("rss_kb", 0)))
+            self._stop.wait(self.interval_s)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "RuntimeSampler":
+        with self._lock:
+            if self.running:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="avenir-obs-sampler", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            thread = self._thread
+            if thread is None:
+                return
+            self._stop.set()
+            thread.join(timeout=5.0)
+            self._thread = None
+        # one final sample so even a start/stop shorter than interval_s
+        # leaves the report an RSS number
+        status = read_proc_status()
+        if status:
+            self._samples.append((time.monotonic(), status.get("rss_kb", 0)))
+
+    def snapshot(self) -> Dict:
+        samples: List[Tuple[float, int]] = list(self._samples)
+        out: Dict = {"samples": len(samples),
+                     "interval_s": self.interval_s}
+        if samples:
+            rss = [s[1] for s in samples]
+            out.update(rss_kb_last=rss[-1], rss_kb_max=max(rss),
+                       rss_kb_min=min(rss))
+        status = read_proc_status()
+        if "hwm_kb" in status:
+            out["vm_hwm_kb"] = status["hwm_kb"]
+        dev = device_memory_stats()
+        if dev is not None:
+            out["device_memory"] = dev
+        return out

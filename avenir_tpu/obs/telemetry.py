@@ -1,0 +1,235 @@
+"""Spans + fixed-bucket latency histograms — the tracer half of ``obs``.
+
+The reference's only latency visibility is the JobTracker UI's per-task wall
+times (SURVEY.md §5); nothing in the port measured step latency
+*distributions*. This module is the Dapper-shaped substrate (PAPERS.md): a
+``span("knn.predict")`` context manager records wall time into a fixed
+log2-bucket histogram keyed by the span's nesting path, thread-safe and
+cheap enough to leave compiled into every hot path.
+
+Design constraints, in order:
+
+- **Disabled is free.** ``Tracer.span`` on a disabled tracer returns one
+  shared no-op context manager — no allocation, no clock read, no lock.
+  The streaming loop keeps its instrumentation permanently; the smoke
+  script (scripts/obs_smoke.py) holds this path to <5% of a bare loop.
+- **Fixed buckets.** Prometheus-style cumulative buckets with log2-spaced
+  upper bounds (1µs .. ~134s). Recording is a bisect + two adds under a
+  lock; percentiles are estimated from bucket edges at *export* time, so
+  the record path never sorts.
+- **Nesting is the key.** A span opened inside another span records under
+  ``"outer/inner"`` (thread-local stack), so ``loop.run/select`` and a
+  bare ``select`` are separate distributions.
+
+Pure stdlib — no jax import — so profiling/metrics can depend on it
+without ordering constraints.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# log2-spaced bucket UPPER bounds in milliseconds: 0.001ms .. ~134s.
+# 28 finite buckets + one overflow; fixed forever so histograms from
+# different processes/runs merge and compare bucket-for-bucket.
+BUCKET_BOUNDS_MS: Tuple[float, ...] = tuple(
+    0.001 * 2.0 ** i for i in range(28))
+
+_PCTS = (50, 95, 99)
+
+
+def percentiles(values: Sequence[float],
+                qs: Sequence[int] = _PCTS) -> Dict[int, float]:
+    """Nearest-rank percentiles of raw samples (shared with StepTimer).
+
+    Empty input yields 0.0 for every requested percentile — summaries stay
+    total functions, like ``StepTimer.summary`` on an unused timer.
+    """
+    out = {q: 0.0 for q in qs}
+    if not values:
+        return out
+    ordered = sorted(values)
+    n = len(ordered)
+    for q in qs:
+        rank = max(1, math.ceil(q / 100.0 * n))
+        out[q] = float(ordered[min(rank, n) - 1])
+    return out
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency accumulator with p50/p95/p99 estimation.
+
+    Buckets are cumulative-on-export (Prometheus ``le`` semantics);
+    internally each slot counts only its own range so recording touches
+    one cell. Percentiles interpolate to the bucket upper edge, clamped to
+    the observed [min, max] — with log2 buckets the estimate is within 2x,
+    which is what a latency SLO dashboard needs (exact quantiles would
+    require keeping every sample; see ``percentiles`` for that path).
+    """
+
+    __slots__ = ("_counts", "count", "sum_ms", "min_ms", "max_ms", "_lock")
+
+    def __init__(self):
+        self._counts = [0] * (len(BUCKET_BOUNDS_MS) + 1)
+        self.count = 0
+        self.sum_ms = 0.0
+        self.min_ms = float("inf")
+        self.max_ms = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, ms: float) -> None:
+        idx = bisect.bisect_left(BUCKET_BOUNDS_MS, ms)
+        with self._lock:
+            self._counts[idx] += 1
+            self.count += 1
+            self.sum_ms += ms
+            if ms < self.min_ms:
+                self.min_ms = ms
+            if ms > self.max_ms:
+                self.max_ms = ms
+
+    def percentile_ms(self, q: float) -> float:
+        """Bucket-edge estimate of the q-th percentile (q in [0, 100])."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            target = max(1, math.ceil(q / 100.0 * self.count))
+            seen = 0
+            for i, c in enumerate(self._counts):
+                seen += c
+                if seen >= target:
+                    edge = (BUCKET_BOUNDS_MS[i]
+                            if i < len(BUCKET_BOUNDS_MS) else self.max_ms)
+                    return float(min(max(edge, self.min_ms), self.max_ms))
+            return float(self.max_ms)  # unreachable; counts sum to count
+
+    def snapshot(self) -> Dict:
+        """Export dict: count/sum/min/max, p50/p95/p99, non-empty buckets
+        as ``{le_ms: cumulative_count}`` plus the ``+Inf`` terminal."""
+        pcts = {f"p{q}_ms": self.percentile_ms(q) for q in _PCTS}
+        with self._lock:
+            if self.count == 0:
+                return {"count": 0, "sum_ms": 0.0, **pcts}
+            buckets: Dict[str, int] = {}
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if c and i < len(BUCKET_BOUNDS_MS):
+                    buckets[repr(BUCKET_BOUNDS_MS[i])] = cum
+            buckets["+Inf"] = self.count
+            return {"count": self.count,
+                    "sum_ms": self.sum_ms,
+                    "min_ms": self.min_ms,
+                    "max_ms": self.max_ms,
+                    **pcts,
+                    "buckets": buckets}
+
+
+class _NullSpan:
+    """Shared, reentrant no-op context manager — the disabled-tracer span."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: pushes its name on the thread-local stack so nested
+    spans key under ``parent/child``, then records elapsed wall time."""
+
+    __slots__ = ("_tracer", "_name", "_path", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str):
+        self._tracer = tracer
+        self._name = name
+
+    def __enter__(self):
+        stack = self._tracer._stack()
+        stack.append(self._name)
+        self._path = "/".join(stack)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        ms = (time.perf_counter() - self._t0) * 1e3
+        stack = self._tracer._stack()
+        if stack and stack[-1] == self._name:
+            stack.pop()
+        self._tracer.record(self._path, ms)
+        return False
+
+
+class Tracer:
+    """Span factory + histogram store. One per process is the norm
+    (``tracer()`` below); tests build private instances freely."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._hists: Dict[str, LatencyHistogram] = {}
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def span(self, name: str):
+        """Context manager timing its block into histogram ``name`` (or
+        ``parent/name`` when nested). Free when the tracer is disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name)
+
+    def record(self, name: str, ms: float) -> None:
+        """Record a latency directly (batch loops that amortize one clock
+        read over N events use this instead of N spans)."""
+        if not self.enabled:
+            return
+        hist = self._hists.get(name)
+        if hist is None:
+            with self._lock:
+                hist = self._hists.setdefault(name, LatencyHistogram())
+        hist.record(ms)
+
+    def histogram(self, name: str) -> Optional[LatencyHistogram]:
+        return self._hists.get(name)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """{span_path: histogram snapshot} for every recorded span."""
+        with self._lock:
+            items = list(self._hists.items())
+        return {name: h.snapshot() for name, h in sorted(items)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hists.clear()
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    """The process-wide tracer every instrumented subsystem records into."""
+    return _TRACER
+
+
+def span(name: str):
+    """Module-level convenience: ``with telemetry.span("knn.predict"):``."""
+    return _TRACER.span(name)
+
+
+def enable(on: bool = True) -> None:
+    _TRACER.enabled = on

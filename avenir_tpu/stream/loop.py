@@ -28,11 +28,14 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import time
+
 import jax
 import jax.numpy as jnp
 
 from avenir_tpu.models.bandits.learners import (
     ALGORITHMS, Learner, LearnerConfig)
+from avenir_tpu.obs import telemetry
 
 
 # --------------------------------------------------------------------------
@@ -70,6 +73,10 @@ class InProcQueues:
 
     def pop_action(self):
         return self.actions.pop() if self.actions else None
+
+    def depth(self) -> Optional[int]:
+        """Pending-event count (telemetry queue-depth gauge)."""
+        return len(self.events)
 
 
 class RedisQueues:
@@ -176,6 +183,14 @@ class RedisQueues:
         self._r.lpush(self.action_queue,
                       self.delim.join([event_id] + list(actions)))
 
+    def depth(self) -> Optional[int]:
+        """Pending-event count — one broker RTT, so the loop polls it only
+        when telemetry is enabled."""
+        try:
+            return int(self._r.llen(self.event_queue))
+        except Exception:
+            return None
+
 
 def reclaim_pending(client, pending_queue: str, event_queue: str) -> int:
     """Replay a dead consumer's un-acked events back onto their event queue
@@ -198,6 +213,16 @@ class LoopStats:
     events: int = 0
     rewards: int = 0
     actions_written: int = 0
+    # telemetry gauges (ISSUE 2). Only the three counters above are
+    # checkpointed (utils.checkpoint._COUNTER_NAMES); these reset with the
+    # process, which is right for gauges. reward_lag always updates;
+    # queue_depth and the latency percentiles populate only while
+    # telemetry is enabled (the disabled hot loop must stay bare).
+    queue_depth: int = 0        # pending events after the last batch/step
+    reward_lag: int = 0         # events served minus rewards folded
+    event_p50_ms: float = 0.0   # per-event serving latency percentiles
+    event_p95_ms: float = 0.0   # (batch mode: batch wall time / batch size)
+    event_p99_ms: float = 0.0
 
 
 class OnlineLearnerLoop:
@@ -216,6 +241,11 @@ class OnlineLearnerLoop:
         self.learner = Learner(learner_type, actions, config, seed)
         self.queues = queues
         self.stats = LoopStats()
+        # process-wide tracer: free no-ops while telemetry is disabled
+        # (the default), span histograms + gauges when obs.hub() enables it
+        self._tel = telemetry.tracer()
+        # per-event serving latencies (ms), bounded ring -> p50/p95/p99
+        self._event_ms: deque = deque(maxlen=2048)
         self._ckpt = None
         self._ckpt_mod = None
         self._ckpt_interval = max(int(checkpoint_interval), 1)
@@ -267,7 +297,40 @@ class OnlineLearnerLoop:
               != self.stats.events // self._ckpt_interval):
             self._save_checkpoint()
 
+    def refresh_latency_stats(self) -> None:
+        """Fold the recorded per-event latencies into the LoopStats
+        percentile gauges. Called on ``run`` exit and ``close`` (not per
+        event: nearest-rank percentiles sort the ring, which would be
+        measurable in the hot loop)."""
+        if not self._event_ms:
+            return
+        pct = telemetry.percentiles(list(self._event_ms))
+        self.stats.event_p50_ms = pct[50]
+        self.stats.event_p95_ms = pct[95]
+        self.stats.event_p99_ms = pct[99]
+
+    def _observe_event(self, n_events: int, elapsed_ms: float) -> None:
+        """Per-event latency + queue-depth/reward-lag gauges after serving
+        ``n_events`` in ``elapsed_ms``. The reward-lag counter always
+        updates (two int ops); everything else — latency ring, span
+        histogram, broker-RTT depth poll — runs only while telemetry is
+        enabled, keeping the default path inside the smoke script's 5%
+        bound (scripts/obs_smoke.py)."""
+        self.stats.reward_lag = max(
+            0, self.stats.events - self.stats.rewards)
+        if not self._tel.enabled:
+            return
+        per_event = elapsed_ms / max(n_events, 1)
+        self._event_ms.extend([per_event] * n_events)
+        for _ in range(n_events):
+            self._tel.record("loop.event", per_event)
+        depth = self.queues.depth() if hasattr(
+            self.queues, "depth") else None
+        if depth is not None:
+            self.stats.queue_depth = depth
+
     def close(self) -> None:
+        self.refresh_latency_stats()
         if self._ckpt:
             self._ckpt.close()
             self._ckpt = None
@@ -281,11 +344,15 @@ class OnlineLearnerLoop:
     def step(self) -> bool:
         """Process one event (rewards drained first, like the bolt
         :96-99). Returns False when the event queue is empty."""
+        t0 = time.perf_counter()
         for action_id, reward in self._drain_new_rewards():
             self.learner.set_reward(action_id, reward)
             self.stats.rewards += 1
         event_id = self.queues.pop_event()
         if event_id is None:
+            # empty polls are not serving latency: no histogram record
+            self.stats.reward_lag = max(
+                0, self.stats.events - self.stats.rewards)
             return False
         selections = self.learner.next_actions()
         self.queues.write_actions(event_id, selections)
@@ -294,6 +361,7 @@ class OnlineLearnerLoop:
         self.queues.ack_event(event_id)
         self.stats.events += 1
         self.stats.actions_written += len(selections)
+        self._observe_event(1, (time.perf_counter() - t0) * 1e3)
         self._maybe_checkpoint()
         return True
 
@@ -310,9 +378,11 @@ class OnlineLearnerLoop:
         batch_size = self.learner.cfg.batch_size
         event_cap = Learner._SCAN_BUCKET_MAX
         while max_events is None or processed < max_events:
+            t_batch = time.perf_counter()
             pairs = self._drain_new_rewards()
             if pairs:
-                self.learner.set_reward_batch(pairs)
+                with self._tel.span("loop.reward_fold"):
+                    self.learner.set_reward_batch(pairs)
                 self.stats.rewards += len(pairs)
             events: List[str] = []
             while (len(events) < event_cap
@@ -323,9 +393,12 @@ class OnlineLearnerLoop:
                     break
                 events.append(event_id)
             if not events:
+                self.stats.reward_lag = max(
+                    0, self.stats.events - self.stats.rewards)
                 break
-            selections = self.learner.next_action_batch(
-                len(events) * batch_size)
+            with self._tel.span("loop.select"):
+                selections = self.learner.next_action_batch(
+                    len(events) * batch_size)
             events_before = self.stats.events
             for i, event_id in enumerate(events):
                 sel = selections[i * batch_size:(i + 1) * batch_size]
@@ -334,7 +407,12 @@ class OnlineLearnerLoop:
                 self.stats.events += 1
                 self.stats.actions_written += len(sel)
             processed += len(events)
+            # batch wall time amortized per event: the micro-batched
+            # serving latency each event actually observed
+            self._observe_event(
+                len(events), (time.perf_counter() - t_batch) * 1e3)
             self._maybe_checkpoint(events_before)
+        self.refresh_latency_stats()
         return self.stats
 
 

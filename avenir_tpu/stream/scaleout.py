@@ -60,11 +60,80 @@ from avenir_tpu.stream.miniredis import (
 
 STOP_SENTINEL = "__STOP__"
 
+# worker liveness: every worker lpushes a JSON heartbeat through the same
+# broker its queues live on (the "job UI" the port lost — per-worker
+# progress was only visible in the JobTracker). One shared list; the
+# driver drains it after the run (or mid-run, for live monitoring).
+HEARTBEAT_QUEUE = "heartbeatQueue"
+HEARTBEAT_EVERY = 25  # events between heartbeats (plus start + exit)
+
 
 def owned_groups(groups: Sequence[str], worker_id: int,
                  n_workers: int) -> List[str]:
     """Group i -> worker i mod N (fieldsGrouping: stable ownership)."""
     return [g for i, g in enumerate(groups) if i % n_workers == worker_id]
+
+
+def push_heartbeat(client, worker_id: int, events: int, rewards: int,
+                   grouping: str = "fields") -> None:
+    client.lpush(HEARTBEAT_QUEUE, json.dumps(
+        {"worker": worker_id, "events": events, "rewards": rewards,
+         "ts": time.time(), "grouping": grouping}))
+
+
+def read_heartbeats(client) -> List[Dict]:
+    """Drain every pending heartbeat (driver side), oldest first."""
+    out: List[Dict] = []
+    while True:
+        raw = client.rpop(HEARTBEAT_QUEUE)
+        if raw is None:
+            return out
+        out.append(json.loads(raw.decode()))
+
+
+def worker_throughput(heartbeats: Sequence[Dict]) -> Dict[int, float]:
+    """events/sec per worker over its first->last heartbeat interval.
+    A worker with a single heartbeat (or zero elapsed time) reports its
+    raw event count — a finite, comparable stand-in."""
+    per: Dict[int, List[Dict]] = {}
+    for hb in heartbeats:
+        per.setdefault(int(hb["worker"]), []).append(hb)
+    out: Dict[int, float] = {}
+    for worker, hbs in per.items():
+        hbs.sort(key=lambda h: h["ts"])
+        dt = hbs[-1]["ts"] - hbs[0]["ts"]
+        served = hbs[-1]["events"] - hbs[0]["events"]
+        out[worker] = served / dt if dt > 0 else float(hbs[-1]["events"])
+    return out
+
+
+def detect_stragglers(heartbeats: Sequence[Dict],
+                      min_events_fraction: float = 0.5,
+                      stale_after_s: Optional[float] = None,
+                      now: Optional[float] = None) -> List[int]:
+    """Straggler = a worker whose LATEST heartbeat reports under
+    ``min_events_fraction`` of the median worker's served events, or (with
+    ``stale_after_s``) one whose last heartbeat is older than that — the
+    dead-worker signal during a live run. Returns sorted worker ids."""
+    latest: Dict[int, Dict] = {}
+    for hb in heartbeats:
+        worker = int(hb["worker"])
+        cur = latest.get(worker)
+        if cur is None or hb["ts"] >= cur["ts"]:
+            latest[worker] = hb
+    if not latest:
+        return []
+    counts = sorted(h["events"] for h in latest.values())
+    median = counts[len(counts) // 2]
+    flagged = set()
+    for worker, hb in latest.items():
+        if hb["events"] < min_events_fraction * median:
+            flagged.add(worker)
+        if stale_after_s is not None:
+            t_now = time.time() if now is None else now
+            if t_now - hb["ts"] > stale_after_s:
+                flagged.add(worker)
+    return sorted(flagged)
 
 
 class _StoppableQueues(RedisQueues):
@@ -138,6 +207,7 @@ def shuffle_worker_main(host: str, port: int, worker_id: int,
     for lr in learners.values():
         lr.next_actions()
     events = rewards = 0
+    push_heartbeat(client, worker_id, 0, 0, "shuffle")  # alive + warmed
     idle_sleep = 0.001
     while True:
         for g, q in reward_q.items():
@@ -158,6 +228,8 @@ def shuffle_worker_main(host: str, port: int, worker_id: int,
         events_q.write_actions(event_id, selections)
         events_q.ack_event(event_id)   # ack AFTER the answer, as always
         events += 1
+        if events % HEARTBEAT_EVERY == 0:
+            push_heartbeat(client, worker_id, events, rewards, "shuffle")
         if decision_io_ms > 0:
             time.sleep(decision_io_ms / 1e3)
     # final drain: rewards the driver pushed between this worker's last
@@ -168,6 +240,7 @@ def shuffle_worker_main(host: str, port: int, worker_id: int,
         for action_id, reward in q.drain_rewards():
             learners[g].set_reward(action_id, reward)
             rewards += 1
+    push_heartbeat(client, worker_id, events, rewards, "shuffle")  # final
     client.close()
     return {"worker": worker_id, "events": events, "rewards": rewards,
             "replayed": replayed, "groups": sorted(groups),
@@ -204,6 +277,8 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
             seed=seed + 1000 * worker_id + list(groups).index(g))
     active = set(loops)
     idle_sleep = 0.001
+    served_total = 0
+    push_heartbeat(client, worker_id, 0, 0)  # alive, loops constructed
     while active:
         progressed = False
         for g in list(active):
@@ -213,8 +288,14 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
                 continue
             # one event per visit keeps groups fair; rewards drain inside
             served = loop.step()
-            if served and decision_io_ms > 0:
-                time.sleep(decision_io_ms / 1e3)
+            if served:
+                served_total += 1
+                if served_total % HEARTBEAT_EVERY == 0:
+                    push_heartbeat(
+                        client, worker_id, served_total,
+                        sum(l.stats.rewards for l in loops.values()))
+                if decision_io_ms > 0:
+                    time.sleep(decision_io_ms / 1e3)
             progressed = served or progressed
         if progressed:
             idle_sleep = 0.001
@@ -223,11 +304,14 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
             # with poll round-trips (each visit costs 2 RTTs per group)
             time.sleep(idle_sleep)
             idle_sleep = min(idle_sleep * 2, 0.016)
+    events_total = sum(l.stats.events for l in loops.values())
+    rewards_total = sum(l.stats.rewards for l in loops.values())
+    push_heartbeat(client, worker_id, events_total, rewards_total)  # final
     client.close()
     return {
         "worker": worker_id,
-        "events": sum(l.stats.events for l in loops.values()),
-        "rewards": sum(l.stats.rewards for l in loops.values()),
+        "events": events_total,
+        "rewards": rewards_total,
         "replayed": replayed,
         "groups": sorted(loops),
     }
@@ -243,6 +327,12 @@ class ScaleoutResult:
     p90_latency_ms: float
     best_action_fraction: float   # last-30% convergence onto planted arms
     worker_stats: List[Dict] = field(default_factory=list)
+    # heartbeat-derived (ISSUE 2): per-worker events/sec over each
+    # worker's own heartbeat interval, and the workers flagged by
+    # detect_stragglers on the final heartbeat set
+    worker_throughput: Dict[int, float] = field(default_factory=dict)
+    stragglers: List[int] = field(default_factory=list)
+    heartbeats: int = 0
 
 
 @contextlib.contextmanager
@@ -448,6 +538,8 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
         if left:
             raise RuntimeError(f"{left} un-acked ledger entries left behind")
 
+        heartbeats = read_heartbeats(client)
+
         tail = picks[-int(0.3 * len(picks)):]
         best_frac = sum(ctr[g][a] > 0.5 for g, a in tail) / max(len(tail), 1)
         lat = sorted(latencies)
@@ -459,7 +551,10 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
             p50_latency_ms=1e3 * lat[len(lat) // 2] if lat else 0.0,
             p90_latency_ms=1e3 * lat[int(0.9 * len(lat))] if lat else 0.0,
             best_action_fraction=best_frac,
-            worker_stats=worker_stats)
+            worker_stats=worker_stats,
+            worker_throughput=worker_throughput(heartbeats),
+            stragglers=detect_stragglers(heartbeats),
+            heartbeats=len(heartbeats))
 
 
 @dataclass
@@ -623,6 +718,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "p50_latency_ms": round(r.p50_latency_ms, 2),
             "p90_latency_ms": round(r.p90_latency_ms, 2),
             "best_action_fraction": round(r.best_action_fraction, 3),
+            "worker_throughput": {str(w): round(t, 1) for w, t
+                                  in sorted(r.worker_throughput.items())},
+            "stragglers": r.stragglers,
         }))
     return 0
 

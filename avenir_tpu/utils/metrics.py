@@ -20,11 +20,20 @@ import numpy as np
 import jax.numpy as jnp
 
 
+# telemetry sink: avenir_tpu.obs.exporters points this at the hub's weak
+# registry set while telemetry is enabled, so every registry a job builds
+# lands in the merged report. None (the default) keeps construction free
+# of any obs import or overhead.
+_OBS_SINK = None
+
+
 class MetricsRegistry:
     """Named counters, grouped like Hadoop counter groups."""
 
     def __init__(self):
         self._counters: Dict[str, float] = {}
+        if _OBS_SINK is not None:
+            _OBS_SINK(self)
 
     def incr(self, group: str, name: str, amount: float = 1) -> None:
         key = f"{group}.{name}"
@@ -59,12 +68,38 @@ class ConfusionMatrix:
         self.positive_class = positive_class
         n = len(self.class_values)
         self.matrix = np.zeros((n, n), dtype=np.int64)  # [truth, predicted]
+        self.invalid = 0  # index pairs rejected by update()
 
-    def update(self, predicted: jnp.ndarray, truth: jnp.ndarray) -> None:
-        """Accumulate from index arrays (one histogram op, no per-row loop)."""
+    def update(self, predicted: jnp.ndarray, truth: jnp.ndarray,
+               strict: bool = False) -> None:
+        """Accumulate from index arrays (one histogram op, no per-row loop).
+
+        Indices outside ``[0, n_classes)`` previously overflowed the
+        ``true * n + pred`` flattening and crashed the ``reshape`` (or,
+        worse, an out-of-range ``pred`` with in-range ``true`` landed in
+        the WRONG cell). They are now rejected: counted in ``invalid``
+        (surfaced as the ``Validation.Invalid`` counter) and dropped, or
+        raised with the offending values under ``strict=True``.
+        """
         n = len(self.class_values)
         pred = np.asarray(predicted).astype(np.int64).ravel()
         true = np.asarray(truth).astype(np.int64).ravel()
+        if pred.shape != true.shape:
+            raise ValueError(
+                f"predicted and truth disagree on length: {pred.shape[0]} "
+                f"vs {true.shape[0]}")
+        ok = (pred >= 0) & (pred < n) & (true >= 0) & (true < n)
+        n_bad = int(pred.shape[0] - ok.sum())
+        if n_bad:
+            if strict:
+                bad_rows = np.nonzero(~ok)[0][:5]
+                pairs = [(int(true[i]), int(pred[i])) for i in bad_rows]
+                raise ValueError(
+                    f"{n_bad} (truth, predicted) index pairs fall outside "
+                    f"[0, {n}) for {n} classes; first offenders "
+                    f"(truth, pred) at rows {bad_rows.tolist()}: {pairs}")
+            self.invalid += n_bad
+            pred, true = pred[ok], true[ok]
         flat = np.bincount(true * n + pred, minlength=n * n)
         self.matrix += flat.reshape(n, n)
 
@@ -119,6 +154,10 @@ class ConfusionMatrix:
         metrics = metrics or MetricsRegistry()
         metrics.set("Validation", "Total", self.total)
         metrics.set("Validation", "Accuracy", self.accuracy)
+        if self.invalid:
+            # only when non-zero: existing consumers of the report dict
+            # (and its JSON) see no new key on clean runs
+            metrics.set("Validation", "Invalid", self.invalid)
         if self.positive_class is not None:
             metrics.set("Validation", "TruePositive", self.true_positive)
             metrics.set("Validation", "FalsePositive", self.false_positive)

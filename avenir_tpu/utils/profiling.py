@@ -18,10 +18,13 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import time
 from typing import Any, Dict, Iterator, Optional
 
 import jax
+
+from avenir_tpu.obs.telemetry import percentiles
 
 
 @contextlib.contextmanager
@@ -68,11 +71,18 @@ class StepTimer:
         if not self.times_ms:
             return {f"{self.name}.steps": 0}
         arr = self.times_ms
+        # exact nearest-rank percentiles (raw samples are retained here,
+        # unlike the fixed-bucket obs histograms, which estimate) via the
+        # shared helper; existing keys unchanged
+        pct = percentiles(arr)
         return {
             f"{self.name}.steps": len(arr),
             f"{self.name}.mean_ms": sum(arr) / len(arr),
             f"{self.name}.min_ms": min(arr),
             f"{self.name}.max_ms": max(arr),
+            f"{self.name}.p50_ms": pct[50],
+            f"{self.name}.p95_ms": pct[95],
+            f"{self.name}.p99_ms": pct[99],
         }
 
 
@@ -83,15 +93,37 @@ def get_logger(name: str,
     ``debug_on=None`` leaves an already-configured logger's level alone
     (first configuration defaults to WARNING) so a later default-args call
     cannot silently disable DEBUG enabled by an earlier caller.
+
+    A process whose ROOT logger is already configured (``basicConfig``,
+    a host framework, pytest's capture handler) gets NO handler from us:
+    the record propagates to the root handlers instead, so it is emitted
+    exactly once. Only in a bare process — no root handlers — do we attach
+    our own stderr handler and stop propagation.
+
+    ``AVENIR_TPU_LOG_LEVEL`` (DEBUG/INFO/WARNING/ERROR), when set to a
+    valid level name, pins the logger's level and wins over ``debug_on``
+    — the operator's environment overrides per-call switches.
     """
     logger = logging.getLogger(f"avenir_tpu.{name}")
-    if not logger.handlers:
-        handler = logging.StreamHandler()
-        handler.setFormatter(logging.Formatter(
-            "%(asctime)s level=%(levelname)s logger=%(name)s %(message)s"))
-        logger.addHandler(handler)
-        logger.propagate = False
+    if not getattr(logger, "_avenir_configured", False):
+        if logging.getLogger().handlers:
+            # root already emits records: adding our own handler here
+            # would print every record twice (ours + root's)
+            logger.propagate = True
+        else:
+            handler = logging.StreamHandler()
+            handler.setFormatter(logging.Formatter(
+                "%(asctime)s level=%(levelname)s logger=%(name)s "
+                "%(message)s"))
+            logger.addHandler(handler)
+            logger.propagate = False
         logger.setLevel(logging.WARNING)
-    if debug_on is not None:
+        logger._avenir_configured = True  # type: ignore[attr-defined]
+    env_level = getattr(
+        logging, os.environ.get("AVENIR_TPU_LOG_LEVEL", "").strip().upper(),
+        None)
+    if isinstance(env_level, int):
+        logger.setLevel(env_level)
+    elif debug_on is not None:
         logger.setLevel(logging.DEBUG if debug_on else logging.WARNING)
     return logger

@@ -157,6 +157,11 @@ def _chain_for(topk):
 
 def main() -> None:
     import sys
+    # telemetry (obs layer): count compiles from here on so the JSON
+    # artifact records how much of the run was compilation — registration
+    # is listener-based and adds nothing to the timed path
+    from avenir_tpu.obs import runtime as obs_runtime
+    obs_runtime.install_compile_listener()
     rng = np.random.default_rng(0)
     train = jnp.asarray(rng.random((N_TRAIN, N_FEATURES), dtype=np.float32))
     test = jnp.asarray(rng.random((M_TEST, N_FEATURES), dtype=np.float32))
@@ -287,6 +292,13 @@ def main() -> None:
         base_elapsed = M_TEST * ITERS / legacy
         adj = M_TEST * ITERS / max(base_elapsed - 0.0993, 1e-9)
         out["vs_baseline_like_for_like"] = round(rows_per_sec / adj, 3)
+    try:
+        # runtime snapshot in the artifact: RSS/HWM from /proc (ru_maxrss
+        # is unreliable here), compile count+time since main() started,
+        # device memory when the backend exposes it
+        out["telemetry"] = obs_runtime.snapshot_brief()
+    except Exception as exc:   # the snapshot must never sink the bench
+        print(f"telemetry snapshot skipped: {exc!r}", file=sys.stderr)
     print(json.dumps(out))
 
 

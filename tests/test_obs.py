@@ -1,0 +1,456 @@
+"""Unified telemetry layer (ISSUE 2): spans + histograms, runtime
+collectors, exporters, CLI --metrics-out, loop gauges, heartbeats."""
+
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.obs import exporters as E
+from avenir_tpu.obs import runtime as R
+from avenir_tpu.obs import telemetry as T
+
+
+class TestPercentiles:
+    def test_nearest_rank(self):
+        values = list(range(1, 101))          # 1..100
+        pct = T.percentiles(values)
+        assert pct == {50: 50.0, 95: 95.0, 99: 99.0}
+
+    def test_empty_and_single(self):
+        assert T.percentiles([]) == {50: 0.0, 95: 0.0, 99: 0.0}
+        assert T.percentiles([7.0]) == {50: 7.0, 95: 7.0, 99: 7.0}
+
+
+class TestLatencyHistogram:
+    def test_bucket_edges(self):
+        """A value exactly on a bound counts into that bound's bucket
+        (Prometheus ``le`` semantics); one past it goes to the next."""
+        h = T.LatencyHistogram()
+        b0, b1 = T.BUCKET_BOUNDS_MS[0], T.BUCKET_BOUNDS_MS[1]
+        h.record(b0)               # == first bound -> le=b0
+        h.record(b0 * 1.5)         # between bounds -> le=b1
+        h.record(b1)               # == second bound -> le=b1
+        snap = h.snapshot()
+        assert snap["count"] == 3
+        assert snap["buckets"][repr(b0)] == 1          # cumulative
+        assert snap["buckets"][repr(b1)] == 3
+        assert snap["buckets"]["+Inf"] == 3
+
+    def test_overflow_bucket(self):
+        h = T.LatencyHistogram()
+        huge = T.BUCKET_BOUNDS_MS[-1] * 10
+        h.record(huge)
+        snap = h.snapshot()
+        assert snap["count"] == 1
+        assert snap["buckets"] == {"+Inf": 1}
+        assert snap["max_ms"] == huge
+        assert h.percentile_ms(99) == huge     # clamped to observed max
+
+    def test_percentiles_ordered_and_clamped(self):
+        h = T.LatencyHistogram()
+        for ms in [1.0, 2.0, 3.0, 100.0]:
+            h.record(ms)
+        p50, p95, p99 = (h.percentile_ms(q) for q in (50, 95, 99))
+        assert p50 <= p95 <= p99
+        assert h.snapshot()["min_ms"] <= p50
+        assert p99 <= h.snapshot()["max_ms"]
+        assert T.LatencyHistogram().percentile_ms(50) == 0.0
+
+    def test_thread_safety_count(self):
+        h = T.LatencyHistogram()
+
+        def hammer():
+            for _ in range(1000):
+                h.record(0.5)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.count == 4000
+        assert h.snapshot()["buckets"]["+Inf"] == 4000
+
+
+class TestTracerSpans:
+    def test_nesting_paths(self):
+        tr = T.Tracer(enabled=True)
+        with tr.span("outer"):
+            with tr.span("inner"):
+                pass
+            with tr.span("inner"):
+                pass
+        with tr.span("inner"):      # same leaf, top level: separate hist
+            pass
+        snap = tr.snapshot()
+        assert set(snap) == {"outer", "outer/inner", "inner"}
+        assert snap["outer/inner"]["count"] == 2
+        assert snap["outer"]["count"] == 1
+
+    def test_disabled_is_noop_singleton(self):
+        tr = T.Tracer(enabled=False)
+        cm1, cm2 = tr.span("a"), tr.span("b")
+        assert cm1 is cm2           # one shared object, no allocation
+        with cm1:
+            pass
+        assert tr.snapshot() == {}
+        tr.record("a", 1.0)         # record is also gated
+        assert tr.snapshot() == {}
+
+    def test_span_records_on_exception(self):
+        tr = T.Tracer(enabled=True)
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("x")
+        assert tr.snapshot()["boom"]["count"] == 1
+        # the stack unwound: the next span is NOT nested under boom
+        with tr.span("after"):
+            pass
+        assert "after" in tr.snapshot()
+
+
+class TestRuntimeCollectors:
+    def test_read_proc_status(self):
+        status = R.read_proc_status()
+        # this sandbox is linux; VmRSS must be present and plausible.
+        # VmHWM is OPTIONAL: stripped-down /proc (gVisor-style) omits it,
+        # which is why the sampler tracks its own rss_kb_max.
+        assert status["rss_kb"] > 1000
+        if "hwm_kb" in status:
+            assert status["hwm_kb"] >= status["rss_kb"]
+
+    def test_compile_tracker_counts_jit(self):
+        import jax
+        import jax.numpy as jnp
+        tracker = R.CompileTracker()
+        tracker.start()
+        # a fresh lambda defeats the jit cache -> at least one compile
+        jax.jit(lambda x: x * 2 + 1)(jnp.ones(17)).block_until_ready()
+        snap = tracker.snapshot()
+        assert snap["available"]
+        assert snap["backend_compile_count"] >= 1
+        assert snap["backend_compile_secs"] > 0
+        # a second start() re-pins the baseline
+        tracker.start()
+        assert tracker.snapshot()["backend_compile_count"] == 0
+
+    def test_sampler_start_stop_idempotent(self):
+        s = R.RuntimeSampler(interval_s=0.01)
+        assert not s.running
+        s.start()
+        first_thread = s._thread
+        s.start()                    # no-op while running
+        assert s._thread is first_thread
+        time.sleep(0.05)
+        s.stop()
+        assert not s.running
+        s.stop()                     # no-op when stopped
+        snap = s.snapshot()
+        assert snap["samples"] >= 2
+        assert snap["rss_kb_last"] > 0
+        assert snap["rss_kb_max"] >= snap["rss_kb_min"]
+        # restartable after stop
+        s.start()
+        assert s.running
+        s.stop()
+
+
+class TestExporters:
+    def _report(self):
+        tr = T.Tracer(enabled=True)
+        for ms in (0.5, 1.0, 300.0):
+            tr.record("knn.predict", ms)
+        return {
+            "meta": {"format": "avenir-telemetry-v1"},
+            "spans": tr.snapshot(),
+            "counters": {"Validation.Total": 100.0,
+                         "Validation.TruePositive": 42.0},
+            "gauges": {"loop.queue_depth": 7},
+            "runtime": {"rss_kb_last": 12345, "samples": 3,
+                        "compile": {"backend_compile_count": 2,
+                                    "backend_compile_secs": 0.5,
+                                    "available": True}},
+        }
+
+    def test_jsonl_round_trip(self, tmp_path):
+        report = self._report()
+        path = str(tmp_path / "metrics.jsonl")
+        E.write_jsonl(E.report_to_events(report), path)
+        back = E.events_to_report(E.read_jsonl(path))
+        assert back["spans"] == report["spans"]
+        assert back["counters"] == report["counters"]
+        assert back["gauges"] == report["gauges"]
+        assert back["runtime"] == report["runtime"]
+
+    _METRIC_LINE = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.+eEinfa]+$')
+
+    def test_prometheus_exposition_format(self):
+        text = E.prometheus_text(self._report())
+        lines = [l for l in text.splitlines() if l]
+        assert lines, "empty exposition"
+        for line in lines:
+            if line.startswith("# TYPE "):
+                continue
+            assert self._METRIC_LINE.match(line), f"bad line: {line!r}"
+        # counter rendered with sanitized name
+        assert "avenir_Validation_Total 100.0" in lines
+        # histogram contract: +Inf bucket == _count == recorded count
+        inf = [l for l in lines if 'le="+Inf"' in l and "knn.predict" in l]
+        cnt = [l for l in lines if l.startswith(
+            'avenir_span_latency_ms_count{span="knn.predict"}')]
+        assert inf and cnt
+        assert inf[0].rsplit(" ", 1)[1] == "3"
+        assert cnt[0].rsplit(" ", 1)[1] == "3"
+        # every family is typed
+        assert any(l == "# TYPE avenir_span_latency_ms histogram"
+                   for l in lines)
+
+    def test_hub_merges_registry_and_gauges(self):
+        from avenir_tpu.utils.metrics import MetricsRegistry
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=0.01)
+        try:
+            reg = MetricsRegistry()      # registers via the sink
+            reg.incr("Group", "Thing", 3)
+            with T.span("merged.span"):
+                pass
+            hub.set_gauge("depth", 4)
+            report = hub.report()
+        finally:
+            hub.disable()
+        assert report["counters"]["Group.Thing"] == 3.0
+        assert "merged.span" in report["spans"]
+        assert report["gauges"]["depth"] == 4.0
+        assert report["runtime"]["compile"]["available"] in (True, False)
+        hub.reset()
+
+    def test_reset_while_enabled_rebinds_sink_and_sampler(self):
+        """reset() between jobs in one enabled process: the NEXT job's
+        registries must still land in the report (the sink re-binds to
+        the fresh list) and the sampler must keep running."""
+        from avenir_tpu.utils.metrics import MetricsRegistry
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=0.01)
+        try:
+            MetricsRegistry().incr("Job1", "N")
+            hub.reset()                       # between jobs
+            assert hub.sampler.running
+            reg2 = MetricsRegistry()
+            reg2.incr("Job2", "N", 7)
+            counters = hub.report()["counters"]
+        finally:
+            hub.disable()
+        assert counters == {"Job2.N": 7.0}    # job1 gone, job2 present
+        hub.reset()
+
+    def test_registry_mark_drops_failed_attempt(self):
+        """The CLI retry loop's double-count guard: registries attached
+        after a mark can be dropped so a dead attempt's counters do not
+        sum into the retry's."""
+        from avenir_tpu.utils.metrics import MetricsRegistry
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=0.01)
+        try:
+            mark = hub.registry_mark()
+            MetricsRegistry().incr("Attempt", "Records", 300)  # dies
+            hub.drop_registries_since(mark)
+            MetricsRegistry().incr("Attempt", "Records", 300)  # retry
+            counters = hub.report()["counters"]
+        finally:
+            hub.disable()
+        assert counters["Attempt.Records"] == 300.0
+        hub.reset()
+
+    def test_hub_disabled_registry_not_tracked(self):
+        from avenir_tpu.utils.metrics import MetricsRegistry
+        hub = E.hub()
+        hub.reset()
+        assert not hub.enabled
+        reg = MetricsRegistry()
+        reg.incr("G", "N")
+        assert hub.report()["counters"] == {}
+
+
+class TestConfusionMatrixValidation:
+    def test_out_of_range_rejected_and_counted(self):
+        from avenir_tpu.utils.metrics import ConfusionMatrix
+        cm = ConfusionMatrix(["a", "b"])
+        cm.update(np.array([0, 1, 5, 0]), np.array([0, 1, 0, -3]))
+        assert cm.matrix.tolist() == [[1, 0], [0, 1]]
+        assert cm.invalid == 2
+        assert cm.report().get("Validation", "Invalid") == 2.0
+
+    def test_strict_raises_with_offenders(self):
+        from avenir_tpu.utils.metrics import ConfusionMatrix
+        cm = ConfusionMatrix(["a", "b"])
+        with pytest.raises(ValueError, match=r"outside \[0, 2\)"):
+            cm.update(np.array([0, 9]), np.array([0, 0]), strict=True)
+
+    def test_length_mismatch_raises(self):
+        from avenir_tpu.utils.metrics import ConfusionMatrix
+        cm = ConfusionMatrix(["a", "b"])
+        with pytest.raises(ValueError, match="disagree on length"):
+            cm.update(np.array([0, 1]), np.array([0]))
+
+    def test_clean_report_has_no_invalid_key(self):
+        from avenir_tpu.utils.metrics import ConfusionMatrix
+        cm = ConfusionMatrix(["a", "b"])
+        cm.update(np.array([0, 1]), np.array([1, 0]))
+        assert "Validation.Invalid" not in cm.report().as_dict()
+
+
+class TestLoopTelemetry:
+    def _run_loop(self, n_events=12):
+        from avenir_tpu.stream.loop import InProcQueues, OnlineLearnerLoop
+        queues = InProcQueues()
+        for i in range(n_events):
+            queues.push_event(f"e{i}")
+        loop = OnlineLearnerLoop(
+            "softMax", ["x", "y"],
+            {"current.decision.round": 1, "batch.size": 2}, queues, seed=0)
+        return loop.run(), queues
+
+    def test_gauges_without_telemetry(self):
+        stats, _ = self._run_loop()
+        assert stats.events == 12
+        assert stats.reward_lag == 12          # no rewards ever arrived
+        # latency percentiles stay untouched on the disabled (default)
+        # path — the hot loop must not pay for the ring or the sort
+        assert stats.event_p50_ms == 0.0
+
+    def test_spans_and_queue_depth_with_telemetry(self):
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=0.01)
+        try:
+            stats, queues = self._run_loop()
+            report = hub.report()
+        finally:
+            hub.disable()
+        assert stats.queue_depth == 0          # drained
+        assert 0 < stats.event_p50_ms <= stats.event_p95_ms
+        assert stats.event_p95_ms <= stats.event_p99_ms
+        spans = report["spans"]
+        assert "loop.select" in spans
+        assert spans["loop.event"]["count"] == 12
+        assert report["runtime"]["samples"] >= 0
+        hub.reset()
+
+
+class TestHeartbeats:
+    def _hb(self, worker, events, ts):
+        return {"worker": worker, "events": events, "rewards": 0, "ts": ts}
+
+    def test_straggler_by_event_count(self):
+        from avenir_tpu.stream.scaleout import detect_stragglers
+        beats = [self._hb(0, 100, 10.0), self._hb(1, 98, 10.0),
+                 self._hb(2, 10, 10.0)]
+        assert detect_stragglers(beats) == [2]
+
+    def test_straggler_by_staleness(self):
+        from avenir_tpu.stream.scaleout import detect_stragglers
+        beats = [self._hb(0, 50, 100.0), self._hb(1, 50, 40.0)]
+        assert detect_stragglers(beats, stale_after_s=30.0,
+                                 now=105.0) == [1]
+        assert detect_stragglers(beats, stale_after_s=120.0,
+                                 now=105.0) == []
+
+    def test_latest_heartbeat_wins(self):
+        from avenir_tpu.stream.scaleout import detect_stragglers
+        # worker 1 was behind early but caught up: not a straggler
+        beats = [self._hb(0, 100, 10.0),
+                 self._hb(1, 5, 5.0), self._hb(1, 99, 10.0)]
+        assert detect_stragglers(beats) == []
+
+    def test_worker_throughput(self):
+        from avenir_tpu.stream.scaleout import worker_throughput
+        beats = [self._hb(0, 0, 0.0), self._hb(0, 100, 10.0),
+                 self._hb(1, 40, 3.0)]
+        tp = worker_throughput(beats)
+        assert tp[0] == pytest.approx(10.0)
+        assert tp[1] == 40.0                   # single beat: raw count
+
+    def test_two_worker_scaleout_reports_heartbeats(self):
+        """End-to-end: 2 workers, broker subprocess, heartbeats flow back
+        and neither balanced worker is flagged a straggler."""
+        from avenir_tpu.stream.scaleout import run_scaleout
+        r = run_scaleout(2, n_groups=2, n_actions=3, throughput_events=80,
+                         paced_events=20, paced_rate=400.0, seed=11)
+        assert r.heartbeats >= 4               # start + final per worker
+        assert sorted(r.worker_throughput) == [0, 1]
+        assert all(t > 0 for t in r.worker_throughput.values())
+        assert r.stragglers == []
+
+
+class TestCliMetricsOut:
+    def test_batch_job_merged_report(self, tmp_path):
+        """--metrics-out after a batch CLI job: JSONL + .prom, with the
+        job span (p50/p95/p99), compile counts, RSS, and the job's own
+        MetricsRegistry counters merged in."""
+        from avenir_tpu.cli.main import main as cli
+        from avenir_tpu.datagen import generators as G
+        rows = G.churn_rows(150, seed=5)
+        (tmp_path / "data.csv").write_text(
+            "\n".join(",".join(r) for r in rows))
+        with open(tmp_path / "churn.json", "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        (tmp_path / "p.properties").write_text(
+            f"feature.schema.file.path={tmp_path}/churn.json\n")
+        out = str(tmp_path / "metrics.jsonl")
+        cli(["BayesianDistribution", str(tmp_path / "data.csv"),
+             str(tmp_path / "model.txt"),
+             "--conf", str(tmp_path / "p.properties"),
+             "--metrics-out", out])
+        events = E.read_jsonl(out)
+        report = E.events_to_report(events)
+        # span histogram for the job, with percentile estimates
+        job_spans = [n for n in report["spans"]
+                     if "job.BayesianDistribution" in n]
+        assert job_spans
+        snap = report["spans"][job_spans[0]]
+        assert snap["count"] == 1
+        assert all(k in snap for k in ("p50_ms", "p95_ms", "p99_ms"))
+        # the job's MetricsRegistry flowed through the sink
+        assert report["counters"]["Distribution Data.Records"] == 150
+        # runtime: rss + compile activity during the job
+        assert report["runtime"].get("rss_kb_last", 0) > 0
+        assert report["runtime"]["compile"]["backend_compile_count"] >= 1
+        # exact wall-time gauges from StepTimer rode along
+        assert report["gauges"]["job.BayesianDistribution.steps"] == 1
+        assert "job.BayesianDistribution.p95_ms" in report["gauges"]
+        # prometheus sibling parses
+        prom = (tmp_path / "metrics.jsonl.prom").read_text()
+        assert "# TYPE avenir_span_latency_ms histogram" in prom
+        assert "avenir_runtime_rss_kb_last" in prom
+        # telemetry is off again after the CLI returns
+        assert not E.hub().enabled
+        E.hub().reset()
+
+    def test_unwritable_metrics_path_does_not_fail_job(self, tmp_path):
+        """--metrics-out into a missing directory: the job still exits 0
+        (warning logged), and telemetry is disabled afterwards."""
+        from avenir_tpu.cli.main import main as cli
+        from avenir_tpu.datagen import generators as G
+        rows = G.churn_rows(60, seed=6)
+        (tmp_path / "data.csv").write_text(
+            "\n".join(",".join(r) for r in rows))
+        with open(tmp_path / "churn.json", "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        (tmp_path / "p.properties").write_text(
+            f"feature.schema.file.path={tmp_path}/churn.json\n")
+        rc = cli(["BayesianDistribution", str(tmp_path / "data.csv"),
+                  str(tmp_path / "model.txt"),
+                  "--conf", str(tmp_path / "p.properties"),
+                  "--metrics-out", str(tmp_path / "no" / "such" / "m.jsonl")])
+        assert rc == 0
+        assert (tmp_path / "model.txt").exists()   # the job itself ran
+        assert not E.hub().enabled
+        E.hub().reset()

@@ -20,6 +20,9 @@ class TestStepTimer:
         assert s["train.steps"] == 3
         assert s["train.mean_ms"] >= 0.0
         assert s["train.min_ms"] <= s["train.max_ms"]
+        # nearest-rank percentiles (shared helper with obs histograms)
+        assert s["train.min_ms"] <= s["train.p50_ms"] <= s["train.p95_ms"]
+        assert s["train.p95_ms"] <= s["train.p99_ms"] <= s["train.max_ms"]
 
     def test_empty_summary(self):
         assert StepTimer("x").summary() == {"x.steps": 0}
@@ -31,13 +34,30 @@ class TestLogger:
         off = get_logger("job.b", debug_on=False)
         assert on.level == logging.DEBUG
         assert off.level == logging.WARNING
+        # exactly-once emission: with a configured root logger (pytest's
+        # capture handlers here) we add NO handler and propagate; in a
+        # bare process we add one stderr handler and stop propagation
+        if logging.getLogger().handlers:
+            assert on.propagate and not on.handlers
+        else:
+            assert not on.propagate and len(on.handlers) == 1
         # same name returns the same configured logger, no handler pileup;
         # default (None) leaves the earlier DEBUG level untouched
         again = get_logger("job.a")
-        assert again is on and len(again.handlers) == 1
+        assert again is on and again.handlers == on.handlers
         assert again.level == logging.DEBUG
         # explicit False is an intentional override
         assert get_logger("job.a", debug_on=False).level == logging.WARNING
+
+    def test_env_level_override(self, monkeypatch):
+        # AVENIR_TPU_LOG_LEVEL pins the level over per-call debug_on
+        monkeypatch.setenv("AVENIR_TPU_LOG_LEVEL", "error")
+        logger = get_logger("job.envtest", debug_on=True)
+        assert logger.level == logging.ERROR
+        # invalid names fall back to the normal debug_on behavior
+        monkeypatch.setenv("AVENIR_TPU_LOG_LEVEL", "bogus")
+        assert get_logger("job.envtest2",
+                          debug_on=True).level == logging.DEBUG
 
 
 class TestTrace:
