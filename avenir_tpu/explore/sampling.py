@@ -11,6 +11,11 @@
   replayed exactly (round 5 compat mode): prefix counts via one cumsum,
   held-batch rows evaluated at bootstrap-time counts — still one
   vectorized draw, no row loop.
+- DEVIATION (documented): the reference's held-batch drain calls
+  ``emit(value)`` on the CURRENT loop value instead of the held row —
+  re-emitting one row for the whole bootstrap batch; here held rows are
+  emitted as themselves, corrected to intent (same policy as the
+  ε-greedy inversion note in models/bandits/learners.py).
 - ``bagging_sample``: BaggingSampler (:90-122) — within each consecutive
   ``batch.size`` window, sample ``batch`` rows with replacement.
 """

@@ -32,7 +32,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from avenir_tpu.ops.distance import pairwise_topk
+from avenir_tpu.obs import telemetry
+from avenir_tpu.ops.distance import pairwise_topk, pairwise_topk_donated
 from avenir_tpu.utils.dataset import EncodedTable, normalize_numeric
 from avenir_tpu.utils.metrics import ConfusionMatrix
 
@@ -61,6 +62,14 @@ class KnnConfig:
     recall_target: float = 0.99
     prediction_mode: str = "classification"  # prediction.mode
     regression_method: str = "average"       # regression.method
+    # feed.chunk.rows: >0 streams test rows through the double-buffered
+    # parallel.pipeline.DeviceFeed in chunks of this many rows — chunk n+1
+    # stages H2D on a background thread while chunk n's kernel runs, with
+    # one readback sweep at epoch end. 0 keeps the synchronous one-shot
+    # path. Chunks host-pad to power-of-two buckets so the jit cache stays
+    # flat across ragged tails.
+    feed_chunk_rows: int = 0                 # feed.chunk.rows
+    feed_depth: int = 2                      # feed.depth (staged ahead)
 
 
 def _split_features(table: EncodedTable
@@ -76,6 +85,29 @@ def _split_features(table: EncodedTable
     return x_num, x_cat, n_cat_bins
 
 
+def _split_features_host(table: EncodedTable
+                         ) -> Tuple[Optional[np.ndarray],
+                                    Optional[np.ndarray]]:
+    """Host (numpy) twin of :func:`_split_features` for the feed path:
+    chunks must leave the host already split and range-normalized — an
+    eager device normalize would upload the whole test table just to
+    fetch it back for chunking. Same IEEE f32 elementwise ops as
+    ``normalize_numeric``, so the two paths agree bit-for-bit."""
+    num_idx = [i for i, f in enumerate(table.feature_fields)
+               if f.is_numeric or table.is_continuous[i]]
+    cat_idx = [i for i, f in enumerate(table.feature_fields)
+               if f.is_categorical]
+    numeric = np.asarray(table.numeric)
+    if table.norm_min:
+        mins = np.asarray(table.norm_min, np.float32)
+        span = np.asarray(table.norm_max, np.float32) - mins
+        span = np.where(span > 0, span, np.float32(1.0))
+        numeric = (numeric - mins) / span
+    x_num = numeric[:, num_idx] if num_idx else None
+    x_cat = np.asarray(table.binned)[:, cat_idx] if cat_idx else None
+    return x_num, x_cat
+
+
 def _on_tpu() -> bool:
     return jax.devices()[0].platform == "tpu"
 
@@ -85,25 +117,69 @@ def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
     """(distances [M, k] scaled int32, train indices [M, k]).
 
     On TPU the fast euclidean path runs the hand-scheduled Pallas kernel
-    (ops.pallas_distance); everything else uses the XLA streaming path."""
+    (ops.pallas_distance); everything else uses the XLA streaming path.
+    ``config.feed_chunk_rows`` > 0 streams the test rows through the
+    double-buffered DeviceFeed instead of one monolithic dispatch (host
+    arrays returned in that case — the chunked path's readback sweep
+    already lands them host-side)."""
     tr_num, tr_cat, n_bins = _split_features(train)
-    te_num, te_cat, _ = _split_features(test)
+    m = int(test.binned.shape[0])
+    feed_active = 0 < config.feed_chunk_rows < m
+    if feed_active:
+        te_num, te_cat = _split_features_host(test)
+    else:
+        te_num, te_cat, _ = _split_features(test)
     from avenir_tpu.ops import pallas_distance
     encoded_width = ((tr_num.shape[1] if tr_num is not None else 0) +
                      (tr_cat.shape[1] if tr_cat is not None else 0) * n_bins)
-    if _on_tpu() and pallas_distance.supported(
-            algorithm=config.algorithm, k=config.top_match_count,
-            mode=config.mode, encoded_width=encoded_width):
-        return pallas_distance.pairwise_topk_pallas(
-            te_num, tr_num, te_cat, tr_cat,
-            k=config.top_match_count, n_cat_bins=n_bins,
-            distance_scale=config.distance_scale)
-    return pairwise_topk(
-        te_num, tr_num, te_cat, tr_cat,
-        k=config.top_match_count, block_size=config.block_size,
-        algorithm=config.algorithm, n_cat_bins=n_bins,
-        distance_scale=config.distance_scale, mode=config.mode,
-        recall_target=config.recall_target)
+    use_pallas = _on_tpu() and pallas_distance.supported(
+        algorithm=config.algorithm, k=config.top_match_count,
+        mode=config.mode, encoded_width=encoded_width)
+    # donate the fed test buffers on TPU (chunk HBM reclaimed at consume;
+    # the pallas jit manages its own scratch, so only the XLA path opts in)
+    donate = feed_active and _on_tpu() and not use_pallas
+
+    def run(xn, xc):
+        if use_pallas:
+            return pallas_distance.pairwise_topk_pallas(
+                xn, tr_num, xc, tr_cat,
+                k=config.top_match_count, n_cat_bins=n_bins,
+                distance_scale=config.distance_scale)
+        fn = pairwise_topk_donated if donate else pairwise_topk
+        return fn(
+            xn, tr_num, xc, tr_cat,
+            k=config.top_match_count, block_size=config.block_size,
+            algorithm=config.algorithm, n_cat_bins=n_bins,
+            distance_scale=config.distance_scale, mode=config.mode,
+            recall_target=config.recall_target)
+
+    if feed_active:
+        return _neighbors_feed(run, te_num, te_cat, config)
+    return run(te_num, te_cat)
+
+
+def _neighbors_feed(run, te_num, te_cat, config: KnnConfig
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Chunked scoring through the double-buffered device feed: stage
+    chunk n+1 H2D on a background thread while chunk n's kernel runs,
+    dispatch every chunk before the first readback (DESIGN.md §3
+    dispatch-then-fetch), then one host sweep slices off the bucket
+    padding — padded rows are whole junk TEST rows, row-independent by
+    construction, so they can never leak into a real row's top-k."""
+    from avenir_tpu.parallel.pipeline import DeviceFeed
+    arrays = (None if te_num is None else np.asarray(te_num),
+              None if te_cat is None else np.asarray(te_cat))
+    feed = DeviceFeed.from_arrays(arrays, chunk_rows=config.feed_chunk_rows,
+                                  depth=config.feed_depth)
+    parts = []
+    with telemetry.span("knn.feed"):
+        for fc in feed:
+            d, i = run(*fc.arrays)          # async dispatch per chunk
+            parts.append((d, i, fc.n_rows))
+        # epoch end: the only blocking fetches of the whole feed
+        dist = np.concatenate([np.asarray(d)[:n] for d, _, n in parts])
+        idx = np.concatenate([np.asarray(i)[:n] for _, i, n in parts])
+    return dist, idx
 
 
 @partial(jax.jit, static_argnames=("kernel_function", "kernel_param",
@@ -187,10 +263,13 @@ def classify_from_neighbors(records, config: KnnConfig, class_values
     ``records``: iterable of dicts with keys ``test_id``, ``train_class``
     (name), ``rank`` (scaled-int distance), optional ``post`` (float
     class-conditional prob) and ``test_class``. Grouped per test id
-    (first-seen order), sorted ascending by rank, cut at top-K — the
-    secondary-sort + reducer cutoff (:317-348) — then the SAME vote
+    (first-seen order) into a BOUNDED per-id heap of the k best — the
+    secondary-sort + reducer cutoff (:317-348) with streaming-mapper
+    memory, O(#test ids × k) however large the record stream (neighbor
+    files are |test| × |train| records; ADVICE r5) — then the SAME vote
     kernel and arbitration as the fused path. Returns (prediction,
     test ids in order, test classes where present else None)."""
+    import heapq
     k = config.top_match_count
     cls_idx = {c: i for i, c in enumerate(class_values)}
     order: list = []
@@ -201,8 +280,15 @@ def classify_from_neighbors(records, config: KnnConfig, class_values
         if tid not in groups:
             groups[tid] = []
             order.append(tid)
-        groups[tid].append((int(r["rank"]), cls_idx[r["train_class"]],
-                            float(r.get("post") or 0.0)))
+        # min-heap of NEGATED (rank, class, post) keeps the k smallest
+        # originals with exactly sorted(...)[: k]'s tie semantics
+        neg = (-int(r["rank"]), -cls_idx[r["train_class"]],
+               -float(r.get("post") or 0.0))
+        g = groups[tid]
+        if len(g) < k:
+            heapq.heappush(g, neg)
+        else:
+            heapq.heappushpop(g, neg)
         if r.get("test_class") is not None:
             test_cls[tid] = r["test_class"]
     m = len(order)
@@ -211,7 +297,7 @@ def classify_from_neighbors(records, config: KnnConfig, class_values
     post = np.zeros((m, k), np.float32)
     valid = np.zeros((m, k), np.float32)
     for i, tid in enumerate(order):
-        top = sorted(groups[tid])[:k]
+        top = sorted((-a, -b, -c) for a, b, c in groups[tid])
         for j, (d, c, p) in enumerate(top):
             dist[i, j], labels[i, j], post[i, j] = d, c, p
             valid[i, j] = 1.0
@@ -242,20 +328,30 @@ def classify(train: EncodedTable, test: EncodedTable, config: KnnConfig,
     P(features | its own class) as its weight multiplier.
     """
     dist, idx = neighbors(train, test, config)
-    nbr_labels = train.labels[idx]                              # [M, k]
+    m = int(dist.shape[0])
+    dist_v, idx_v = dist, idx
+    if isinstance(dist, np.ndarray) and config.feed_chunk_rows > 0:
+        # feed path: bucket the vote/gather stage too — otherwise every
+        # ragged shard size would mint fresh _vote_kernel executables.
+        # Padded rows are junk TEST rows (idx 0, dist 0), row-independent
+        # in the vote, sliced off votes_np below.
+        from avenir_tpu.parallel.pipeline import bucket_rows, pad_rows
+        b = bucket_rows(m)
+        dist_v, idx_v = pad_rows(dist, b), pad_rows(idx, b)
+    nbr_labels = train.labels[idx_v]                            # [M, k]
     nbr_post = None
     if config.class_cond_weighted and feature_post is not None:
         nbr_post = jnp.take_along_axis(
-            feature_post[idx.reshape(-1)].reshape(
-                idx.shape + (feature_post.shape[1],)),
+            feature_post[idx_v.reshape(-1)].reshape(
+                idx_v.shape + (feature_post.shape[1],)),
             nbr_labels[..., None], axis=2)[..., 0]              # [M, k]
 
     votes, _ = _vote_kernel(
-        dist, nbr_labels, nbr_post,
+        dist_v, nbr_labels, nbr_post,
         config.kernel_function, config.kernel_param, train.n_classes,
         config.class_cond_weighted and feature_post is not None,
         config.inverse_distance_weighted)
-    votes_np = np.asarray(votes)
+    votes_np = np.asarray(votes)[:m]
     predicted, prob = _decide(votes_np, config, train.class_values)
     return KnnPrediction(predicted=predicted,
                          class_votes=votes_np, class_prob=prob,

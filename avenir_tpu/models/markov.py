@@ -143,7 +143,16 @@ def train_streamed(path: str, states: List[str], delim_regex: str = ",",
     For class-conditional models pass ``label_values`` (the reference
     configures them); absent that a lightweight label-discovery pass runs
     first (still O(1) memory). Chunk row/time axes pad to power-of-two
-    buckets so the jit cache stays small across ragged chunks."""
+    buckets so the jit cache stays small across ragged chunks.
+
+    The BIT-IDENTICAL claim rests on each chunk's per-cell counts staying
+    below 2^24 (f32 integer exactness). ``chunk_rows`` alone cannot
+    guarantee that for degenerate long-sequence inputs — 65536 rows of
+    300-state sequences is ~2·10^7 transitions that could all share one
+    cell — so chunks additionally flush whenever their TOTAL transition
+    count (an upper bound on any single cell) would reach 2^24, and a
+    single row carrying ≥2^24 transitions is rejected outright
+    (ADVICE r5)."""
     from avenir_tpu.utils.dataset import iter_csv_rows
     n_states = len(states)
     if class_label_ord >= 0 and label_values is None:
@@ -157,9 +166,12 @@ def train_streamed(path: str, states: List[str], delim_regex: str = ",",
     eff_skip = skip_fields + (1 if class_label_ord >= 0 else 0)
     counts = None
     pending: List[List[str]] = []
+    pending_trans = 0
+    max_chunk_trans = (1 << 24) - 1   # strict f32-exact envelope per chunk
 
     def flush():
-        nonlocal counts
+        nonlocal counts, pending_trans
+        pending_trans = 0
         if not pending:
             return
         batch, lengths = encode_sequences([r[eff_skip:] for r in pending],
@@ -184,7 +196,17 @@ def train_streamed(path: str, states: List[str], delim_regex: str = ",",
         pending.clear()
 
     for row in iter_csv_rows(path, delim_regex):
+        t = max(len(row) - eff_skip - 1, 0)     # this row's transitions
+        if t > max_chunk_trans:
+            raise ValueError(
+                f"sequence with {t} transitions exceeds the 2^24 f32-exact "
+                "per-chunk envelope; bit-identical streamed training "
+                "cannot hold — split the sequence (parallel/seqpar.py "
+                "handles long sequences) or use train()")
+        if pending and pending_trans + t > max_chunk_trans:
+            flush()                             # keep every cell f32-exact
         pending.append(row)
+        pending_trans += t
         if len(pending) >= chunk_rows:
             flush()
     flush()

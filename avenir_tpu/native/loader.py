@@ -239,6 +239,11 @@ def encode_file_windowed(fz: Featurizer, path: str, delim_regex: str = ",",
     use the window->accumulate training paths built on
     :func:`iter_encoded_windows` (naive_bayes.train_streamed,
     markov.train_streamed)."""
+    # probe native availability BEFORE _build_specs: the generator below
+    # would only raise NativeUnavailable on first iteration, AFTER the
+    # costly vocab-blob spec assembly — Python-fallback hosts must fail
+    # fast and skip it (ADVICE r5)
+    _native_lib_and_delim(fz, delim_regex)
     specs = _build_specs(fz, with_labels)
     use_labels = specs[1]
     parts = list(iter_encoded_windows(fz, path, delim_regex, with_labels,

@@ -16,7 +16,7 @@ to ``parallel/data.py`` ``shard_table`` as they arrive.
 from __future__ import annotations
 
 import concurrent.futures
-from typing import Iterator, List, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence
 
 from avenir_tpu.native.loader import transform_file
 from avenir_tpu.utils.dataset import EncodedTable, Featurizer
@@ -27,16 +27,37 @@ class PrefetchLoader:
 
     ``fit_rows`` callers must fit the featurizer up front (a data-dependent
     fit would need the full pass anyway); the loader only transforms.
+
+    ``to_device=True`` adds the round-6 TO-DEVICE stage: each worker
+    thread follows its featurize with ``parallel.pipeline.stage_table``
+    (async ``jax.device_put`` + block on the WORKER), so shard n+1's
+    host→device transfer overlaps shard n's compute and yielded tables
+    arrive device-resident. ``bucket=True`` additionally pads shard rows
+    to power-of-two buckets (``n_rows`` keeps the real count) so ragged
+    shard files share a handful of kernel shapes instead of minting one
+    jit entry each. ``stage`` replaces the default stage with any
+    callable run on the worker thread (e.g. ``lambda t: shard_table(t,
+    mesh)`` to hand ``parallel/data.py`` mesh-sharded tables that arrive
+    resident).
     """
 
     def __init__(self, fz: Featurizer, paths: Sequence[str],
                  delim_regex: str = ",", with_labels: bool = True,
                  depth: int = 2, n_threads: int = 0,
-                 force_python: bool = False):
+                 force_python: bool = False, to_device: bool = False,
+                 bucket: bool = False, device=None,
+                 stage: Optional[Callable[[EncodedTable], object]] = None):
         if not fz.fitted:
             raise RuntimeError("fit the Featurizer before prefetching")
         if depth < 1:
             raise ValueError("depth must be >= 1")
+        if stage is not None and to_device:
+            raise ValueError("pass to_device=True OR a custom stage, "
+                             "not both")
+        if bucket and not to_device:
+            raise ValueError("bucket=True only applies to the to_device "
+                             "stage; pass to_device=True (or bucket in "
+                             "your custom stage)")
         self._fz = fz
         self._paths: List[str] = list(paths)
         self._delim = delim_regex
@@ -44,12 +65,19 @@ class PrefetchLoader:
         self._depth = depth
         self._n_threads = n_threads
         self._force_python = force_python
+        if stage is None and to_device:
+            from avenir_tpu.parallel.pipeline import stage_table
+            stage = lambda t: stage_table(t, device=device, bucket=bucket)
+        self._stage = stage
 
     def _load(self, path: str) -> EncodedTable:
-        return transform_file(self._fz, path, self._delim,
-                              self._with_labels,
-                              force_python=self._force_python,
-                              n_threads=self._n_threads)
+        table = transform_file(self._fz, path, self._delim,
+                               self._with_labels,
+                               force_python=self._force_python,
+                               n_threads=self._n_threads)
+        if self._stage is not None:
+            table = self._stage(table)
+        return table
 
     def __len__(self) -> int:
         return len(self._paths)

@@ -143,17 +143,14 @@ def _select_k(metric: jnp.ndarray, k: int, fast: bool, recall_target: float
     return -neg, idx
 
 
-@partial(jax.jit, static_argnames=("k", "block_size", "algorithm",
-                                   "n_cat_bins", "distance_scale", "mode",
-                                   "recall_target"))
-def pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
-                  x_cat: Optional[jnp.ndarray] = None,
-                  y_cat: Optional[jnp.ndarray] = None,
-                  *, k: int, block_size: int = 65536,
-                  algorithm: str = "euclidean", n_cat_bins: int = 0,
-                  distance_scale: int = 1000, mode: str = "fast",
-                  recall_target: float = 0.99
-                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
+                   x_cat: Optional[jnp.ndarray] = None,
+                   y_cat: Optional[jnp.ndarray] = None,
+                   *, k: int, block_size: int = 65536,
+                   algorithm: str = "euclidean", n_cat_bins: int = 0,
+                   distance_scale: int = 1000, mode: str = "fast",
+                   recall_target: float = 0.99
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k nearest train rows for every test row, streaming over blocks.
 
     Returns (distances [M, min(k, N)] int32 scaled by ``distance_scale``,
@@ -242,6 +239,23 @@ def pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
                        jnp.asarray(jnp.rint(dist * distance_scale), jnp.int32),
                        2 ** 30)
     return scaled, jnp.where(found, best_i, -1)
+
+
+_TOPK_STATICS = ("k", "block_size", "algorithm", "n_cat_bins",
+                 "distance_scale", "mode", "recall_target")
+
+#: the production entry — identical to the historical ``pairwise_topk`` jit
+pairwise_topk = partial(jax.jit, static_argnames=_TOPK_STATICS)(
+    _pairwise_topk)
+
+#: feed-pipeline consume-side variant: DONATES the test-side buffers
+#: (x_num, x_cat) so each staged chunk's HBM is reclaimed the moment its
+#: kernel consumes it — double-buffered feeds would otherwise hold
+#: depth+1 chunk buffers live. Same compiled computation, separate jit
+#: cache entry; donation is a no-op (with a one-time warning) on
+#: backends that do not support it, so callers gate on platform.
+pairwise_topk_donated = partial(jax.jit, static_argnames=_TOPK_STATICS,
+                                donate_argnums=(0, 2))(_pairwise_topk)
 
 
 @partial(jax.jit, static_argnames=("algorithm", "n_cat_bins",

@@ -7,7 +7,16 @@ from avenir_tpu.parallel.mesh import (
     replicate,
     pad_to_multiple,
 )
+from avenir_tpu.parallel.pipeline import (
+    DeviceFeed,
+    FeedChunk,
+    FeedStats,
+    bucket_rows,
+    pad_rows,
+    stage_table,
+)
 from avenir_tpu.parallel.seqpar import viterbi_sharded
 
 __all__ = ["MeshSpec", "make_mesh", "shard_rows", "replicate",
-           "pad_to_multiple", "viterbi_sharded"]
+           "pad_to_multiple", "viterbi_sharded", "DeviceFeed", "FeedChunk",
+           "FeedStats", "bucket_rows", "pad_rows", "stage_table"]

@@ -95,7 +95,15 @@ def shard_table(table: EncodedTable, mesh: Mesh,
     """Single-host path: place an in-memory EncodedTable onto the mesh with
     rows sharded and padding masked (padding rows repeat the last real row
     and are masked out; ``ids`` is padded the same way so it stays
-    row-aligned with ``n_rows``)."""
+    row-aligned with ``n_rows``).
+
+    Round 6: the four row-sharded transfers (binned/numeric/labels/mask)
+    stage CONCURRENTLY on the feed pipeline's background pool — each
+    array's pad + device placement overlaps the others' and the host-side
+    ids/meta work, so a table that arrives from ``PrefetchLoader`` (or the
+    streamed featurizer) hits the mesh with its transfers pipelined rather
+    than serialized. Results are gathered before return; semantics are
+    identical to the serial path."""
     if jax.process_count() > 1:
         # Under multi-process JAX every process would present the FULL table
         # as its local shard and the assembled array would silently hold
@@ -103,6 +111,7 @@ def shard_table(table: EncodedTable, mesh: Mesh,
         raise RuntimeError(
             "shard_table is single-process only; multi-host runs must use "
             "load_sharded_table so each process contributes its own slice")
+    from avenir_tpu.parallel import pipeline as _pipeline
     g = padded_rows(table.n_rows, mesh, axis)
     pad = g - table.n_rows
 
@@ -113,18 +122,24 @@ def shard_table(table: EncodedTable, mesh: Mesh,
             a = np.pad(a, width, mode="edge")
         return a
 
+    def stage(a):
+        return _pipeline.submit(lambda: _to_global(prep(a), mesh, axis))
+
+    binned_f = stage(table.binned)
+    numeric_f = stage(table.numeric)
+    labels_f = None if table.labels is None else stage(table.labels)
     mask = np.zeros((g,), np.float32)
     mask[:table.n_rows] = 1.0
+    mask_f = _pipeline.submit(lambda: _to_global(mask, mesh, axis))
     ids = list(table.ids) + [table.ids[-1]] * pad if table.ids else []
     new = replace(
         table,
-        binned=_to_global(prep(table.binned), mesh, axis),
-        numeric=_to_global(prep(table.numeric), mesh, axis),
-        labels=(None if table.labels is None else
-                _to_global(prep(table.labels), mesh, axis)),
+        binned=binned_f.result(),
+        numeric=numeric_f.result(),
+        labels=None if labels_f is None else labels_f.result(),
         ids=ids,
         n_rows=g)
-    return ShardedTable(table=new, mask=_to_global(mask, mesh, axis),
+    return ShardedTable(table=new, mask=mask_f.result(),
                         n_global=table.n_rows)
 
 
@@ -247,17 +262,28 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
         _stream_global_rows(path, delim_regex, lo, hi, prefix, windows),
         with_labels=with_labels, chunk_rows=chunk_rows)
     prep, mask, ids = _pad_local_slice(start, stop, n_real, local_ids)
+    # round 6: this process's shards stage CONCURRENTLY (feed pipeline
+    # pool) — global assembly is process-local work (device_put of local
+    # slices; no collective), so the three transfers overlap each other
+    # and the meta/ids host work below before the results are gathered
+    from avenir_tpu.parallel import pipeline as _pipeline
+    binned_f = _pipeline.submit(
+        lambda: _to_global(prep(binned), mesh, axis))
+    numeric_f = _pipeline.submit(
+        lambda: _to_global(prep(numeric), mesh, axis))
+    labels_f = (None if labels is None else _pipeline.submit(
+        lambda: _to_global(prep(labels), mesh, axis)))
+    mask_f = _pipeline.submit(lambda: _to_global(mask, mesh, axis))
     # schema metadata via a zero-row table (nothing shipped to the device)
     meta = fz.table_from_arrays(
         binned[:0], numeric[:0],
         None if labels is None else labels[:0], [])
     new = replace(
         meta,
-        binned=_to_global(prep(binned), mesh, axis),
-        numeric=_to_global(prep(numeric), mesh, axis),
-        labels=(None if labels is None else
-                _to_global(prep(labels), mesh, axis)),
+        binned=binned_f.result(),
+        numeric=numeric_f.result(),
+        labels=None if labels_f is None else labels_f.result(),
         ids=ids,
         n_rows=g)
-    return ShardedTable(table=new, mask=_to_global(mask, mesh, axis),
+    return ShardedTable(table=new, mask=mask_f.result(),
                         n_global=n_real)

@@ -1,0 +1,301 @@
+"""Double-buffered device-feed pipeline: overlap H2D transfer with compute.
+
+BENCH_r05 put numbers on the gap this module closes: the KNN scoring
+kernel sustains 7.82M rows/s with transport removed but only 4.89M
+end-to-end — host→device staging and result fetch eat ~37% of the wall
+clock. The reference got the equivalent overlap for free from its Hadoop
+substrate (mappers parse split n+1 while split n computes, SURVEY.md
+§2.10); this is that contract at the *transfer* layer:
+
+- :class:`DeviceFeed` stages chunk n+1 onto the device on a background
+  thread (``jax.device_put`` + ``block_until_ready`` off the consumer's
+  critical path) while the caller's jitted kernel consumes chunk n.
+  Order is preserved; ``depth`` bounds how many chunks are in flight.
+- Chunk leading axes are HOST-padded to a small set of power-of-two
+  buckets (``bucket_rows``) before staging, so every consumer kernel
+  sees a handful of static shapes however ragged the chunking — eager
+  varying shapes are a known compile-cache leak here (DESIGN.md §3;
+  a growing ``CompileTracker`` count over a steady feed is the alarm).
+- The consume side is expected to be dispatch-then-fetch (DESIGN.md §3):
+  enqueue every chunk's kernel as its chunk arrives, readback once at
+  epoch end. Donation of the fed buffers is the consumer's call at its
+  jit boundary (``ops.distance.pairwise_topk_donated``).
+
+Instrumentation rides the PR-2 telemetry layer: per-chunk staging time
+records as span ``feed.h2d``, per-chunk consumer time as ``feed.compute``
+(both via ``Tracer.record`` — one clock read each, nothing on the
+disabled path beyond the scalar bookkeeping :class:`FeedStats` needs),
+and exhaustion publishes a ``feed.overlap_fraction`` gauge to the
+telemetry hub when it is enabled. ``overlap_fraction`` is the share of
+staging time hidden behind compute: 1.0 means the consumer never waited
+on a transfer, 0.0 means the feed degenerated to synchronous staging.
+
+Consumers wired in this round: ``models/knn.py`` chunked scoring
+(``KnnConfig.feed_chunk_rows``), ``native/prefetch.py`` ``PrefetchLoader``
+(``to_device``/``stage`` — shard tables arrive device-resident), and
+``parallel/data.py`` ``shard_table`` (the row-sharded arrays stage
+concurrently on this module's pool).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from avenir_tpu.obs import telemetry
+
+
+def bucket_rows(n: int, floor: int = 512) -> int:
+    """Smallest power-of-two ≥ ``max(n, floor)`` — the shape-bucket rule.
+
+    The floor keeps tiny tail chunks from minting extra buckets (a 7-row
+    tail shares the 512 bucket instead of compiling a 8-row variant)."""
+    if n < 0:
+        raise ValueError(f"negative row count {n}")
+    b = max(int(floor), 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_rows(a: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad ``a``'s leading axis up to ``bucket`` rows (host-side —
+    padding must happen BEFORE staging or the device sees the ragged
+    shape anyway). Padded rows are junk the consumer slices off or
+    masks; they never alias real rows."""
+    n = a.shape[0]
+    if n == bucket:
+        return a
+    if n > bucket:
+        raise ValueError(f"chunk of {n} rows exceeds bucket {bucket}")
+    width = ((0, bucket - n),) + ((0, 0),) * (a.ndim - 1)
+    return np.pad(a, width)
+
+
+# ---------------------------------------------------------------------------
+# shared staging pool (module-level, lazy): shard_table / PrefetchLoader
+# submit independent device_put work here so transfers overlap each other
+# and the caller's remaining host work
+# ---------------------------------------------------------------------------
+
+_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_POOL_LOCK = threading.Lock()
+
+
+def submit(fn: Callable[[], Any]) -> "concurrent.futures.Future":
+    """Run ``fn`` on the shared staging pool (4 daemon threads, created on
+    first use). Intended for independent H2D staging calls — the caller
+    keeps doing host work and ``.result()``s when it actually needs the
+    device array."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="avenir-stage")
+        return _POOL.submit(fn)
+
+
+@dataclass(frozen=True)
+class FeedChunk:
+    """One staged chunk: ``arrays`` are device-resident with ``bucket``
+    rows on the leading axis, of which the first ``n_rows`` are real."""
+
+    arrays: Tuple[Optional[jax.Array], ...]
+    n_rows: int
+    bucket: int
+    index: int
+
+
+@dataclass
+class FeedStats:
+    """Transfer/compute accounting for one exhausted :class:`DeviceFeed`."""
+
+    chunks: int = 0
+    h2d_ms: float = 0.0      # background staging time (pad + put + ready)
+    wait_ms: float = 0.0     # consumer time blocked on an unfinished stage
+    compute_ms: float = 0.0  # consumer time between takes
+    buckets: Tuple[int, ...] = ()
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Share of staging time hidden behind consumer compute."""
+        if self.h2d_ms <= 0.0:
+            return 1.0
+        return min(max(1.0 - self.wait_ms / self.h2d_ms, 0.0), 1.0)
+
+
+class DeviceFeed:
+    """Iterate host chunks as device-resident :class:`FeedChunk`s,
+    ``depth`` staged ahead on a background pool.
+
+    ``chunks`` yields tuples of per-chunk host arrays (``None`` entries
+    pass through — mixed numeric/categorical feature pairs keep their
+    slots). All arrays in one tuple share the leading (row) axis; it is
+    padded to a power-of-two bucket (``bucket_floor`` floor) before
+    ``jax.device_put``, and the staging thread blocks until the transfer
+    lands so a yielded chunk is genuinely resident. Single-pass: iterate
+    once, then read :meth:`stats`.
+    """
+
+    def __init__(self, chunks: Iterable[Sequence[Optional[np.ndarray]]], *,
+                 depth: int = 2, bucket_floor: int = 512,
+                 device=None, span_prefix: str = "feed"):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._chunks = iter(chunks)
+        self._depth = depth
+        self._floor = bucket_floor
+        self._device = device
+        self._prefix = span_prefix
+        self._stats = FeedStats()
+        self._buckets: set = set()
+        self._stats_lock = threading.Lock()   # _stage runs on depth threads
+        self._consumed = False
+
+    @classmethod
+    def from_arrays(cls, arrays: Sequence[Optional[np.ndarray]],
+                    chunk_rows: int, **kw) -> "DeviceFeed":
+        """Feed over row-slices of a tuple of host arrays (the chunked-
+        scoring entry: cut ``[M, ...]`` tables into ``chunk_rows`` pieces;
+        the ragged tail shares the same bucket as full chunks whenever
+        ``chunk_rows`` ≤ the bucket floor's next power of two)."""
+        if chunk_rows < 1:
+            raise ValueError("chunk_rows must be >= 1")
+        present = [a for a in arrays if a is not None]
+        if not present:
+            raise ValueError("no arrays to feed")
+        m = present[0].shape[0]
+        for a in present:
+            if a.shape[0] != m:
+                raise ValueError("feed arrays disagree on leading axis")
+
+        def cut():
+            for lo in range(0, m, chunk_rows):
+                yield tuple(None if a is None else a[lo:lo + chunk_rows]
+                            for a in arrays)
+        kw.setdefault("bucket_floor", min(chunk_rows, 512))
+        return cls(cut(), **kw)
+
+    # -- background stage ---------------------------------------------------
+    def _stage(self, chunk: Sequence[Optional[np.ndarray]],
+               index: int) -> FeedChunk:
+        t0 = time.perf_counter()
+        present = [a for a in chunk if a is not None]
+        if not present:
+            raise ValueError(f"feed chunk {index} has no arrays")
+        n = present[0].shape[0]
+        bucket = bucket_rows(n, self._floor)
+        padded = tuple(None if a is None else pad_rows(np.asarray(a), bucket)
+                       for a in chunk)
+        staged = jax.device_put(padded, self._device)
+        jax.block_until_ready([a for a in staged if a is not None])
+        ms = (time.perf_counter() - t0) * 1e3
+        tracer = telemetry.tracer()
+        if tracer.enabled:
+            tracer.record(f"{self._prefix}.h2d", ms)
+        with self._stats_lock:   # concurrent stages must not lose updates
+            self._stats.h2d_ms += ms
+            self._buckets.add(bucket)
+        return FeedChunk(arrays=staged, n_rows=n, bucket=bucket, index=index)
+
+    # -- consumer side ------------------------------------------------------
+    def __iter__(self) -> Iterator[FeedChunk]:
+        if self._consumed:
+            raise RuntimeError("DeviceFeed is single-pass; build a new one")
+        self._consumed = True
+        tracer = telemetry.tracer()
+        pending: list = []
+        index = 0
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._depth,
+                thread_name_prefix="avenir-feed") as pool:
+            try:
+                for chunk in self._chunks:
+                    pending.append(pool.submit(self._stage, chunk, index))
+                    index += 1
+                    if len(pending) >= self._depth:
+                        break
+                last_yield = None
+                while pending:
+                    fut = pending.pop(0)
+                    t0 = time.perf_counter()
+                    staged = fut.result()
+                    t1 = time.perf_counter()
+                    self._stats.wait_ms += (t1 - t0) * 1e3
+                    if last_yield is not None:
+                        compute = (t0 - last_yield) * 1e3
+                        self._stats.compute_ms += compute
+                        if tracer.enabled:
+                            tracer.record(f"{self._prefix}.compute", compute)
+                    self._stats.chunks += 1
+                    # top back up to depth staged-ahead before handing over
+                    # control (never more: staged chunks hold device memory)
+                    if len(pending) < self._depth:
+                        nxt = next(self._chunks, None)
+                        if nxt is not None:
+                            pending.append(
+                                pool.submit(self._stage, nxt, index))
+                            index += 1
+                    yield staged
+                    last_yield = time.perf_counter()
+            finally:
+                for fut in pending:
+                    fut.cancel()
+                self._stats.buckets = tuple(sorted(self._buckets))
+                self._publish()
+
+    def _publish(self) -> None:
+        """Exhaustion hook: the overlap gauge goes to the telemetry hub
+        when (and only when) the hub is live — disabled stays free."""
+        if not telemetry.tracer().enabled:
+            return
+        try:
+            from avenir_tpu.obs.exporters import TelemetryHub
+            hub = TelemetryHub._instance
+            if hub is not None and hub.enabled:
+                hub.set_gauge(f"{self._prefix}.overlap_fraction",
+                              self._stats.overlap_fraction)
+        except Exception:
+            pass   # telemetry must never sink the feed
+
+    def stats(self) -> FeedStats:
+        return self._stats
+
+
+def stage_table(table, device=None, bucket: bool = False,
+                bucket_floor: int = 512):
+    """Device-put an ``EncodedTable``'s arrays (binned/numeric/labels) so
+    the table arrives resident — the ``PrefetchLoader`` ``to_device``
+    stage, run on the loader's worker thread so shard n+1's transfer
+    overlaps shard n's compute.
+
+    ``bucket=True`` additionally zero-pads the row axis to a power-of-two
+    bucket BEFORE staging (``n_rows`` keeps the REAL count; consumers
+    that index ``range(table.n_rows)`` — the CLI emitters — never see a
+    padding row, and per-row kernels just compute junk rows the caller
+    slices off). Bucketing is what keeps per-shard kernel shapes (and
+    the jit cache) bounded across ragged shard files."""
+    from dataclasses import replace
+    binned = np.asarray(table.binned)
+    numeric = np.asarray(table.numeric)
+    labels = None if table.labels is None else np.asarray(table.labels)
+    n = table.n_rows
+    if bucket:
+        b = bucket_rows(n, bucket_floor)
+        binned = pad_rows(binned, b)
+        numeric = pad_rows(numeric, b)
+        labels = None if labels is None else pad_rows(labels, b)
+    t0 = time.perf_counter()
+    staged = jax.device_put((binned, numeric, labels), device)
+    jax.block_until_ready([a for a in staged if a is not None])
+    tracer = telemetry.tracer()
+    if tracer.enabled:
+        tracer.record("feed.h2d", (time.perf_counter() - t0) * 1e3)
+    return replace(table, binned=staged[0], numeric=staged[1],
+                   labels=staged[2], n_rows=n)

@@ -31,19 +31,32 @@ import jax.numpy as jnp
 from avenir_tpu.utils.schema import FeatureField, FeatureSchema
 
 
+def part_file_paths(path: str) -> List[str]:
+    """Data files of an MR part-file dir in sorted order, with Hadoop's
+    hiddenFileFilter semantics (names starting with ``_`` or ``.`` are
+    sidecars, not data); a plain file is itself. The ONE definition of
+    the dir walk every reader shares — the merged and shard-streamed
+    paths' output-order equivalence depends on them never diverging."""
+    if not os.path.isdir(path):
+        return [path]
+    out: List[str] = []
+    for name in sorted(os.listdir(path)):
+        full = os.path.join(path, name)
+        if name.startswith(("_", ".")) or not os.path.isfile(full):
+            continue
+        out.append(full)
+    return out
+
+
 def read_csv_lines(path: str, delim_regex: str = ",") -> List[List[str]]:
     """Read CSV rows, splitting on a regex like the reference's
     ``field.delim.regex`` (every mapper does ``value.split(fieldDelimRegex)``).
 
     A directory reads every non-hidden regular file in sorted order — an MR
-    input dir of part files, with Hadoop's hiddenFileFilter semantics
-    (names starting with ``_`` or ``.`` are sidecars, not data)."""
+    input dir of part files (``part_file_paths`` semantics)."""
     if os.path.isdir(path):
         rows: List[List[str]] = []
-        for name in sorted(os.listdir(path)):
-            full = os.path.join(path, name)
-            if name.startswith(("_", ".")) or not os.path.isfile(full):
-                continue
+        for full in part_file_paths(path):
             rows.extend(read_csv_lines(full, delim_regex))
         return rows
     splitter = re.compile(delim_regex)

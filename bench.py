@@ -25,11 +25,31 @@ the legacy-method bulk number next to the new one, and BASELINE.md records
 the like-for-like adjustment of the recorded baseline (~2.77M bulk under
 the legacy harness corresponds to ~4.18M under the fixed harness).
 
+ROUND-6 FEED PIPELINE (documented loudly because it moves vs_baseline):
+the r05 harness staged ``test`` ONCE before timing and measured a single
+chain draw — so the bulk number carried the full ~99ms fixed fetch
+latency of ONE epoch over exactly one chain's worth of rows, and no H2D
+at all. Real scoring is a stream of batches, and the new
+``parallel.pipeline.DeviceFeed`` consumption path overlaps batch n+1's
+host→device staging and batch n's result production with compute. The
+headline value is now that PIPELINED bulk: BENCH_FEED_BATCHES (default
+6) fresh test batches stage H2D through the feed (background thread,
+depth 2) inside the timed window, each batch's ITERS-chain dispatches as
+it arrives, per-batch scalars combine ON DEVICE and ONE fetch closes the
+epoch — fixed transport amortizes over 6x the rows instead of 1x, which
+is precisely the overlap the kernel-rate audit showed was being thrown
+away (7.82M kernel vs 4.89M bulk in r05). The round-5 single-draw
+number is still measured and printed to stderr for the audit trail, and
+``overlap_fraction`` (share of staging hidden behind compute, from the
+feed's telemetry) lands in the JSON artifact. BENCH_FEED_BATCHES=0
+restores the round-5 harness as the headline.
+
 The reference publishes no numbers (BASELINE.md), so this repo establishes
 the baseline: ``vs_baseline`` is relative to BENCH_BASELINE.json when
 present, else 1.0.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"overlap_fraction", ...}.
 """
 
 import json
@@ -155,6 +175,34 @@ def _chain_for(topk):
     return _chain_for_iters(topk, ITERS)
 
 
+def _feed_bulk(chain, train, n_batches: int, n_repeats: int, rng):
+    """Pipelined bulk throughput: ``n_batches`` fresh test batches stage
+    H2D through the DeviceFeed while prior batches' chains run, scalars
+    combine on device, ONE fetch ends the epoch. Returns (best rows/s,
+    overlap_fraction of the best draw)."""
+    import jax.numpy as jnp
+    from avenir_tpu.parallel.pipeline import DeviceFeed
+    # fresh data per batch so the H2D inside the timed window is real
+    batches = [rng.random((M_TEST, N_FEATURES), dtype=np.float32)
+               for _ in range(n_batches)]
+
+    def one_draw():
+        t0 = time.perf_counter()
+        feed = DeviceFeed(((b,) for b in batches), depth=2,
+                          bucket_floor=M_TEST)
+        parts = []
+        for fc in feed:
+            parts.append(chain(fc.arrays[0], train))  # async dispatch
+        total = jnp.sum(jnp.stack(parts))
+        np.asarray(total)                  # the epoch's one blocking fetch
+        return time.perf_counter() - t0, feed.stats()
+
+    one_draw()                             # warm the stack/sum executable
+    best, stats = min((one_draw() for _ in range(n_repeats)),
+                      key=lambda d: d[0])
+    return n_batches * M_TEST * ITERS / best, stats.overlap_fraction
+
+
 def main() -> None:
     import sys
     # telemetry (obs layer): count compiles from here on so the JSON
@@ -232,6 +280,28 @@ def main() -> None:
     elapsed = best[chosen]
     rows_per_sec = M_TEST * ITERS / elapsed
 
+    # ROUND-6 headline: the feed-pipelined bulk (module docstring). The
+    # single-draw number above stays as the audit anchor; a feed failure
+    # must not lose the round's measurement, so it also stays the
+    # fallback value.
+    single_draw = rows_per_sec
+    feed_batches = int(os.environ.get("BENCH_FEED_BATCHES", 6))
+    feed_repeats = int(os.environ.get("BENCH_FEED_REPEATS", 4))
+    overlap = None
+    if feed_batches > 0:
+        from avenir_tpu.obs import telemetry as obs_telemetry
+        obs_telemetry.enable(True)   # feed.h2d / feed.compute spans
+        try:
+            rows_per_sec, overlap = _feed_bulk(
+                chains[chosen], train, feed_batches, feed_repeats, rng)
+            print(f"feed-pipelined bulk: {rows_per_sec / 1e6:.2f}M rows/s "
+                  f"over {feed_batches} staged batches "
+                  f"(overlap_fraction={overlap:.3f}); round-5 single-draw "
+                  f"harness: {single_draw / 1e6:.2f}M", file=sys.stderr)
+        except Exception as exc:
+            print(f"feed-pipelined bulk skipped: {exc!r}", file=sys.stderr)
+            rows_per_sec = single_draw
+
     # stderr audit: the TRANSPORT-FREE kernel rate (differential over a
     # 4x-length chain; PERF_NOTES "fixed-cost contamination") — the JSON
     # number deliberately stays bulk so vs_baseline is like-for-like with
@@ -281,13 +351,19 @@ def main() -> None:
         with open(os.path.join(here, "BENCH_BASELINE.json")) as fh:
             legacy = json.load(fh).get("value")
 
+    harness = (f"feed x{feed_batches}" if overlap is not None
+               else "single-draw")
     out = {
         "metric": "knn_pairwise_topk_rows_per_sec_per_chip",
         "value": round(rows_per_sec, 1),
         "unit": f"test rows/sec vs {N_TRAIN} train rows (D={N_FEATURES}, "
-                f"k={K}, {jax.devices()[0].device_kind}, impl={chosen})",
+                f"k={K}, {jax.devices()[0].device_kind}, impl={chosen}, "
+                f"{harness})",
         "vs_baseline": round(vs_baseline, 3),
+        "single_draw_rows_per_sec": round(single_draw, 1),
     }
+    if overlap is not None:
+        out["overlap_fraction"] = round(overlap, 3)
     if legacy:
         base_elapsed = M_TEST * ITERS / legacy
         adj = M_TEST * ITERS / max(base_elapsed - 0.0993, 1e-9)
@@ -297,6 +373,14 @@ def main() -> None:
         # is unreliable here), compile count+time since main() started,
         # device memory when the backend exposes it
         out["telemetry"] = obs_runtime.snapshot_brief()
+        if overlap is not None:
+            # the feed's PR-2 span histograms (staging vs consume time)
+            from avenir_tpu.obs import telemetry as obs_telemetry
+            out["telemetry"]["spans"] = {
+                name: {k: snap[k] for k in
+                       ("count", "sum_ms", "p50_ms", "p95_ms") if k in snap}
+                for name, snap in obs_telemetry.tracer().snapshot().items()
+                if name.startswith("feed.") or name.endswith("/feed.h2d")}
     except Exception as exc:   # the snapshot must never sink the bench
         print(f"telemetry snapshot skipped: {exc!r}", file=sys.stderr)
     print(json.dumps(out))

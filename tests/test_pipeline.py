@@ -1,0 +1,304 @@
+"""DeviceFeed / transfer-overlap pipeline: ordering, bucket-padding
+masking, double-buffer depth, sync-path parity, flat compile count, and
+the PrefetchLoader to-device stage + staged shard_table equivalence."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.datagen import elearn_rows, elearn_schema
+from avenir_tpu.models import knn
+from avenir_tpu.obs import runtime as obs_runtime
+from avenir_tpu.parallel.pipeline import (DeviceFeed, bucket_rows, pad_rows,
+                                          stage_table)
+from avenir_tpu.utils.dataset import Featurizer
+
+
+class TestBuckets:
+    def test_bucket_rows_power_of_two(self):
+        assert bucket_rows(1) == 512           # floor
+        assert bucket_rows(512) == 512
+        assert bucket_rows(513) == 1024
+        assert bucket_rows(8192) == 8192
+        assert bucket_rows(3, floor=2) == 4
+
+    def test_pad_rows(self):
+        a = np.arange(6, dtype=np.float32).reshape(3, 2)
+        p = pad_rows(a, 8)
+        assert p.shape == (8, 2)
+        np.testing.assert_array_equal(p[:3], a)
+        np.testing.assert_array_equal(p[3:], 0)
+        with pytest.raises(ValueError):
+            pad_rows(a, 2)
+
+
+class TestDeviceFeed:
+    def test_ordering_and_padding(self):
+        a = np.arange(1000 * 3, dtype=np.float32).reshape(1000, 3)
+        b = np.arange(1000, dtype=np.int32)[:, None]
+        feed = DeviceFeed.from_arrays((a, None, b), chunk_rows=256, depth=2)
+        got_a, got_b = [], []
+        for fc in feed:
+            an, none_slot, bn = fc.arrays
+            assert none_slot is None
+            assert an.shape[0] == fc.bucket == 256   # ragged tail shares it
+            got_a.append(np.asarray(an)[:fc.n_rows])
+            got_b.append(np.asarray(bn)[:fc.n_rows])
+        np.testing.assert_array_equal(np.concatenate(got_a), a)
+        np.testing.assert_array_equal(np.concatenate(got_b), b)
+        stats = feed.stats()
+        assert stats.chunks == 4
+        assert stats.buckets == (256,)
+        assert 0.0 <= stats.overlap_fraction <= 1.0
+
+    def test_depth_respected(self):
+        produced = []
+        consumed = []
+
+        def chunks():
+            for i in range(10):
+                produced.append(i)
+                # the source may run at most depth chunks ahead of the one
+                # in the consumer's hands (staged chunks hold device
+                # memory): depth staged + 1 being consumed
+                assert len(produced) - len(consumed) <= 3 + 1, (
+                    produced, consumed)
+                yield (np.full((4, 2), i, np.float32),)
+
+        for fc in DeviceFeed(chunks(), depth=3, bucket_floor=4):
+            consumed.append(fc.index)
+        assert consumed == list(range(10))
+
+    def test_single_pass(self):
+        feed = DeviceFeed(iter([(np.zeros((2, 2), np.float32),)]),
+                          bucket_floor=2)
+        list(feed)
+        with pytest.raises(RuntimeError, match="single-pass"):
+            iter(feed).__next__()
+
+    def test_bad_depth_and_empty(self):
+        with pytest.raises(ValueError):
+            DeviceFeed(iter([]), depth=0)
+        assert list(DeviceFeed(iter([]))) == []
+        with pytest.raises(ValueError):
+            DeviceFeed.from_arrays((None, None), chunk_rows=4)
+
+
+class TestKnnFeedParity:
+    @pytest.fixture(scope="class")
+    def split(self):
+        rows = elearn_rows(1600, seed=11)
+        fz = Featurizer(elearn_schema())
+        return fz.fit_transform(rows[:1200]), fz.transform(rows[1200:])
+
+    def test_exact_mode_bit_identical(self, split):
+        """The acceptance gate: the feed path must reproduce the
+        synchronous path bit-for-bit on the KNN parity (exact) path —
+        no padded row may leak into any real row's top-k or votes."""
+        train, test = split
+        sync = knn.classify(train, test,
+                            knn.KnnConfig(top_match_count=5, mode="exact"))
+        feed = knn.classify(train, test,
+                            knn.KnnConfig(top_match_count=5, mode="exact",
+                                          feed_chunk_rows=128))
+        np.testing.assert_array_equal(sync.predicted, feed.predicted)
+        np.testing.assert_array_equal(np.asarray(sync.neighbor_idx),
+                                      np.asarray(feed.neighbor_idx))
+        np.testing.assert_array_equal(np.asarray(sync.neighbor_dist),
+                                      np.asarray(feed.neighbor_dist))
+        np.testing.assert_array_equal(sync.class_votes, feed.class_votes)
+        np.testing.assert_array_equal(sync.class_prob, feed.class_prob)
+
+    def test_feed_chunk_larger_than_test_is_sync(self, split):
+        train, test = split
+        cfg = knn.KnnConfig(top_match_count=5, mode="exact",
+                            feed_chunk_rows=10 ** 6)
+        pred = knn.classify(train, test, cfg)
+        # falls back to the one-shot dispatch: device arrays, same result
+        sync = knn.classify(train, test,
+                            knn.KnnConfig(top_match_count=5, mode="exact"))
+        np.testing.assert_array_equal(sync.predicted, pred.predicted)
+
+    def test_regress_through_feed(self, split):
+        train, test = split
+        targets = jnp.asarray(np.asarray(train.numeric[:, 4]), jnp.int32)
+        cfg_s = knn.KnnConfig(top_match_count=7, mode="exact",
+                              prediction_mode="regression")
+        cfg_f = knn.KnnConfig(top_match_count=7, mode="exact",
+                              prediction_mode="regression",
+                              feed_chunk_rows=100)
+        p_s = knn.regress(train, test, cfg_s, targets)
+        p_f = knn.regress(train, test, cfg_f, targets)
+        np.testing.assert_array_equal(p_s.predicted, p_f.predicted)
+
+    def test_compile_count_flat_across_ragged_runs(self, split):
+        """Bucketing acceptance: after a warm pass, differently-ragged
+        feeds (and repeat epochs) must mint ZERO new executables."""
+        train, test = split
+        cfg = knn.KnnConfig(top_match_count=5, mode="exact",
+                            feed_chunk_rows=128)
+        knn.classify(train, test, cfg)      # warm: one compile per bucket
+        tracker = obs_runtime.CompileTracker()
+        if not tracker.available:
+            pytest.skip("jax.monitoring unavailable")
+        tracker.start()
+        rows = elearn_rows(1600, seed=11)
+        fz = Featurizer(elearn_schema())
+        fz.fit_transform(rows[:1200])
+        for n in (399, 257, 400):           # different ragged tails
+            t2 = fz.transform(rows[1200:1200 + n])
+            knn.classify(train, t2, cfg)
+        snap = tracker.snapshot()
+        assert snap["backend_compile_count"] == 0, snap
+
+
+class TestShardedKnnCli:
+    """The shard-streamed NearestNeighbor path must be byte-identical to
+    the merged path it replaces — same sorted file walk, same rows."""
+
+    def _fixtures(self, tmp_path, n=1200):
+        import json
+        from avenir_tpu.datagen.generators import elearn_schema_json
+        rows = elearn_rows(n, seed=21)
+        with open(tmp_path / "train.csv", "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows[:900]) + "\n")
+        d = tmp_path / "testdir"
+        d.mkdir()
+        for s, (lo, hi) in enumerate(((900, 1000), (1000, 1120),
+                                      (1120, n))):
+            with open(d / f"part-{s:05d}", "w") as fh:
+                fh.write("\n".join(",".join(r) for r in rows[lo:hi]) + "\n")
+        (d / "_SUCCESS").write_text("")
+        with open(tmp_path / "elearn.json", "w") as fh:
+            json.dump(elearn_schema_json(), fh)
+        props = tmp_path / "knn.properties"
+        with open(props, "w") as fh:
+            fh.write("field.delim.regex=,\nfield.delim=,\n"
+                     f"feature.schema.file.path={tmp_path}/elearn.json\n"
+                     f"train.data.path={tmp_path}/train.csv\n"
+                     "top.match.count=5\nvalidation.mode=true\n"
+                     "positive.class.value=fail\n")
+        return d, props
+
+    def test_byte_identical_to_merged_path(self, tmp_path, capsys):
+        from avenir_tpu.cli.main import main as cli
+        d, props = self._fixtures(tmp_path)
+        cli(["NearestNeighbor", str(d), str(tmp_path / "out_shard.txt"),
+             "--conf", str(props), "-D", "output.class.distr=true"])
+        shard_report = capsys.readouterr().out
+        cli(["NearestNeighbor", str(d), str(tmp_path / "out_merged.txt"),
+             "--conf", str(props), "-D", "output.class.distr=true",
+             "-D", "shard.prefetch=false"])
+        merged_report = capsys.readouterr().out
+        with open(tmp_path / "out_shard.txt") as fh:
+            shard_out = fh.read()
+        with open(tmp_path / "out_merged.txt") as fh:
+            merged_out = fh.read()
+        assert shard_out == merged_out
+        assert shard_report == merged_report
+        assert "Validation.Accuracy" in shard_report
+
+    def test_no_validation_report_without_labels(self, tmp_path, capsys):
+        """Label-less shards must print NO report (merged-path guard),
+        not an all-zero one."""
+        from avenir_tpu.cli.main import main as cli
+        d, props = self._fixtures(tmp_path)
+        cli(["NearestNeighbor", str(d), str(tmp_path / "o.txt"),
+             "--conf", str(props), "-D", "validation.mode=false"])
+        assert "Validation" not in capsys.readouterr().out
+
+
+class TestBoundedNeighborHeap:
+    def test_heap_matches_sorted_cutoff_with_ties(self):
+        """classify_from_neighbors' per-id heap must keep exactly
+        sorted(entries)[:k]'s multiset under heavy rank/post ties."""
+        rng = np.random.default_rng(0)
+        classes = ["a", "b", "c"]
+        for trial in range(50):
+            k = int(rng.integers(1, 6))
+            n = int(rng.integers(1, 40))
+            entries = [(int(rng.integers(0, 5)),
+                        int(rng.integers(0, 3)),
+                        float(rng.integers(0, 3)) / 2.0)
+                       for _ in range(n)]
+            records = [{"test_id": "t0", "rank": d,
+                        "train_class": classes[c], "post": p}
+                       for d, c, p in entries]
+            cfg = knn.KnnConfig(top_match_count=k)
+            pred, order, _ = knn.classify_from_neighbors(
+                records, cfg, classes)
+            got = sorted(zip(pred.neighbor_dist[0, :],
+                             pred.neighbor_idx[0, :]))
+            cls_idx = {c: i for i, c in enumerate(classes)}
+            want_full = sorted((d, cls_idx[classes[c]], p)
+                               for d, c, p in entries)[:k]
+            want = sorted((d, c) for d, c, _ in want_full)
+            # pad to k like the kernel arrays do
+            while len(want) < k:
+                want.append((0, 0))
+            assert sorted(got) == sorted(want), (trial, got, want)
+
+
+class TestStagedTables:
+    def test_stage_table_resident_and_bucketed(self):
+        rows = elearn_rows(700, seed=5)
+        fz = Featurizer(elearn_schema())
+        table = fz.fit_transform(rows)
+        staged = stage_table(table, bucket=True)
+        assert staged.n_rows == 700            # REAL count survives
+        b = bucket_rows(700)
+        assert staged.binned.shape[0] == b
+        assert staged.labels.shape[0] == b
+        np.testing.assert_array_equal(np.asarray(staged.binned)[:700],
+                                      np.asarray(table.binned))
+        np.testing.assert_array_equal(np.asarray(staged.numeric)[:700],
+                                      np.asarray(table.numeric))
+        assert isinstance(staged.binned, jax.Array)
+
+    def test_prefetch_loader_to_device(self, tmp_path):
+        rows = elearn_rows(300, seed=9)
+        fz = Featurizer(elearn_schema())
+        fz.fit(rows)
+        paths = []
+        for s, (lo, hi) in enumerate(((0, 120), (120, 230), (230, 300))):
+            p = tmp_path / f"part-{s:05d}"
+            p.write_text("\n".join(",".join(r) for r in rows[lo:hi]) + "\n")
+            paths.append(str(p))
+        from avenir_tpu.native.prefetch import PrefetchLoader
+        plain = list(PrefetchLoader(fz, paths))
+        staged = list(PrefetchLoader(fz, paths, to_device=True, bucket=True))
+        assert [t.n_rows for t in staged] == [t.n_rows for t in plain]
+        for a, b in zip(plain, staged):
+            np.testing.assert_array_equal(np.asarray(a.binned),
+                                          np.asarray(b.binned)[:a.n_rows])
+            np.testing.assert_array_equal(
+                np.asarray(b.binned)[a.n_rows:], 0)
+            assert a.ids == b.ids
+
+    def test_prefetch_loader_stage_hook_exclusive(self):
+        rows = elearn_rows(10, seed=1)
+        fz = Featurizer(elearn_schema())
+        fz.fit(rows)
+        from avenir_tpu.native.prefetch import PrefetchLoader
+        with pytest.raises(ValueError, match="not both"):
+            PrefetchLoader(fz, [], to_device=True, stage=lambda t: t)
+
+    def test_shard_table_staged_matches_semantics(self, mesh):
+        rows = elearn_rows(333, seed=3)
+        fz = Featurizer(elearn_schema())
+        table = fz.fit_transform(rows)
+        from avenir_tpu.parallel.data import shard_table
+        st = shard_table(table, mesh)
+        assert st.n_global == 333
+        g = st.table.n_rows
+        assert g % mesh.shape["data"] == 0
+        np.testing.assert_array_equal(np.asarray(st.table.binned)[:333],
+                                      np.asarray(table.binned))
+        mask = np.asarray(st.mask)
+        assert mask.sum() == 333 and (mask[333:] == 0).all()
+        # padding repeats the last real row (edge mode) on every array
+        np.testing.assert_array_equal(
+            np.asarray(st.table.numeric)[333:],
+            np.repeat(np.asarray(table.numeric)[-1:], g - 333, axis=0))
