@@ -97,7 +97,20 @@ def run_bayesian_distribution(conf: JobConfig, in_path: str, out_path: str) -> N
         return
     fz, rows = _load_table(conf, in_path)
     table = fz.transform(rows)
-    model, meta, metrics = nb.train(table)
+    if conf.get_bool("train.sharded", False):
+        # multi-chip: rows shard over the data axis of the mesh.shape
+        # mesh and the count tensors close with a psum — the mapper-emit
+        # + shuffle + reducer-sum of BayesianDistribution as ONE
+        # collective program; counts are integers, so the model file is
+        # byte-identical to the single-chip train
+        from avenir_tpu.parallel import collective
+        from avenir_tpu.parallel.data import shard_table
+        mesh = collective.data_mesh(
+            tuple(conf.get_int_list("mesh.shape") or ()))
+        st = shard_table(table, mesh)
+        model, meta, metrics = nb.train_sharded(st, mesh)
+    else:
+        model, meta, metrics = nb.train(table)
     nb.save_model(model, meta, out_path, delim=conf.get("field.delim", ","))
     print(metrics.to_json())
 
@@ -538,7 +551,15 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
         prediction_mode="regression" if regression else "classification",
         regression_method=conf.get("regression.method", "average"),
         feed_chunk_rows=conf.get_int("feed.chunk.rows", 0),
-        feed_depth=conf.get_int("feed.depth", 2))
+        feed_depth=conf.get_int("feed.depth", 2),
+        # knn.sharded scales scoring over every chip of the mesh declared
+        # by mesh.shape (e.g. "8" or "4,2"; unset = all devices on the
+        # data axis) — distributed top-k merge, exact mode bit-identical;
+        # knn.mode picks the precision path (fast = bf16 + approx top-k,
+        # exact = the bit-stable golden path)
+        sharded=conf.get_bool("knn.sharded", False),
+        mesh_shape=tuple(conf.get_int_list("mesh.shape") or ()),
+        mode=conf.get("knn.mode", "fast"))
     delim = conf.get("field.delim.out", ",")
 
     if not regression:
@@ -1333,7 +1354,19 @@ def run_mutual_information(conf: JobConfig, in_path: str,
     from avenir_tpu.explore import mutual_information as mi
     fz, rows = _load_table(conf, in_path)
     table = fz.transform(rows)
-    scores = mi.compute_scores(mi.compute_distributions(table))
+    if conf.get_bool("train.sharded", False):
+        # multi-chip distribution pass: rows shard over the mesh.shape
+        # mesh, the seven count families close with psums (identical
+        # integer counts -> identical scores)
+        from avenir_tpu.parallel import collective
+        from avenir_tpu.parallel.data import shard_table
+        mesh = collective.data_mesh(
+            tuple(conf.get_int_list("mesh.shape") or ()))
+        st = shard_table(table, mesh)
+        dists = mi.compute_distributions(st.table, mesh=mesh, mask=st.mask)
+    else:
+        dists = mi.compute_distributions(table)
+    scores = mi.compute_scores(dists)
     delim = conf.get("field.delim.out", ",")
     # the reference's key/value names (MutualInformation.java:452-455,
     # resource/hosp.properties) with this build's camelCase names as aliases

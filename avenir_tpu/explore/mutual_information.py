@@ -17,6 +17,7 @@ requirement the reference's distribution counting imposes.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -52,15 +53,54 @@ def _distribution_kernel(oh_bins: jnp.ndarray, oh_cls: jnp.ndarray):
         feature_pair_class
 
 
-def compute_distributions(table: EncodedTable) -> MiDistributions:
+@lru_cache(maxsize=None)
+def _sharded_distribution_fn(n_bins: int, n_classes: int):
+    """shard_map body for the psum-reduced distribution pass: one-hot +
+    einsums over THIS shard's rows, mask-weighted. Masking ``oh_bins``
+    alone covers every family — the 0/1 mask is idempotent under the
+    pair/pair-class products (mask² = mask) — while ``class_counts``
+    weights ``oh_cls`` directly. Cached so collective.psum_reduce reuses
+    one compiled program per (B, C)."""
+    def fn(binned, labels, mask):
+        oh_bins = jax.nn.one_hot(binned, n_bins,
+                                 dtype=jnp.float32) * mask[:, None, None]
+        oh_cls = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
+        out = _distribution_kernel.__wrapped__(oh_bins, oh_cls)
+        cls = jnp.sum(oh_cls * mask[:, None], axis=0)
+        return (cls,) + out[1:]
+    return fn
+
+
+def compute_distributions(table: EncodedTable, mesh=None,
+                          mask=None) -> MiDistributions:
     """One pass over the table -> all seven families (the class-conditional
-    ones are slices of feature_pair_class / feature_class)."""
+    ones are slices of feature_pair_class / feature_class).
+
+    ``mesh``: compute the pass MULTI-CHIP — rows shard over the ``data``
+    axis, each shard runs the same einsums on its rows and a ``psum``
+    closes every family (the MutualInformation reducer's sum, as a
+    collective). ``mask`` weights rows (1.0 real / 0.0 padding; required
+    when the table carries ``ShardedTable`` padding). Counts are exact
+    integers, so the sharded result equals the single-device pass."""
     binned_idx = [i for i, c in enumerate(table.is_continuous) if not c]
     if len(binned_idx) != table.n_features:
         raise ValueError("mutual information needs all features binned "
                          "(categorical or bucketWidth numeric)")
     bins = table.binned
     n_bins = max(table.bins_per_feature)
+    if mesh is not None:
+        from avenir_tpu.parallel import collective
+        if mask is None:
+            mask = jnp.ones((table.n_rows,), jnp.float32)
+        cls, feat, fc, fp, fpc = collective.psum_reduce(
+            _sharded_distribution_fn(n_bins, table.n_classes), mesh,
+            bins, table.labels, mask)
+        return MiDistributions(
+            class_counts=np.asarray(cls), feature=np.asarray(feat),
+            feature_class=np.asarray(fc), feature_pair=np.asarray(fp),
+            feature_pair_class=np.asarray(fpc),
+            feature_ordinals=tuple(f.ordinal for f in table.feature_fields),
+            class_values=tuple(table.class_values))
     oh_bins = jax.nn.one_hot(bins, n_bins, dtype=jnp.float32)
     oh_cls = jax.nn.one_hot(table.labels, table.n_classes, dtype=jnp.float32)
     cls, feat, fc, fp, fpc = _distribution_kernel(oh_bins, oh_cls)

@@ -70,6 +70,14 @@ class KnnConfig:
     # flat across ragged tails.
     feed_chunk_rows: int = 0                 # feed.chunk.rows
     feed_depth: int = 2                      # feed.depth (staged ahead)
+    # knn.sharded: scale scoring out over every chip on the mesh — train
+    # rows shard over the 'data' axis, test rows replicate, per-shard
+    # top-k candidates merge with an all-gather + second top-k
+    # (parallel/collective.py). Exact mode stays bit-identical to the
+    # single-chip path. mesh.shape declares the mesh ((), i.e. unset,
+    # lays every device on the data axis; a second entry adds 'model').
+    sharded: bool = False                    # knn.sharded
+    mesh_shape: Tuple[int, ...] = ()         # mesh.shape
 
 
 def _split_features(table: EncodedTable
@@ -121,7 +129,11 @@ def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
     ``config.feed_chunk_rows`` > 0 streams the test rows through the
     double-buffered DeviceFeed instead of one monolithic dispatch (host
     arrays returned in that case — the chunked path's readback sweep
-    already lands them host-side)."""
+    already lands them host-side). ``config.sharded`` scales the whole
+    computation out over the device mesh (train rows sharded, distributed
+    top-k merge) — see :func:`_neighbors_sharded`."""
+    if config.sharded:
+        return _neighbors_sharded(train, test, config)
     tr_num, tr_cat, n_bins = _split_features(train)
     m = int(test.binned.shape[0])
     feed_active = 0 < config.feed_chunk_rows < m
@@ -158,19 +170,104 @@ def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
     return run(te_num, te_cat)
 
 
-def _neighbors_feed(run, te_num, te_cat, config: KnnConfig
+# one-slot staged-train cache: the CLI part-file loop scores many test
+# shards against ONE train table — re-splitting + re-uploading the train
+# matrix per shard would put the full train set back on the transfer
+# path the sharding exists to cut. Keyed on (table identity, mesh); the
+# strong train ref pins the id against reuse. One slot bounds memory.
+_SHARD_TRAIN_CACHE: dict = {}
+
+
+def _staged_sharded_train(train: EncodedTable, mesh):
+    from avenir_tpu.parallel import collective
+    key = (id(train), mesh)
+    hit = _SHARD_TRAIN_CACHE.get(key)
+    if hit is not None and hit[0] is train:
+        return hit[1]
+    tr_num, tr_cat = _split_features_host(train)
+    staged = collective.shard_train_rows((tr_num, tr_cat), mesh)
+    _SHARD_TRAIN_CACHE.clear()
+    _SHARD_TRAIN_CACHE[key] = (train, staged)
+    return staged
+
+
+def _neighbors_sharded(train: EncodedTable, test: EncodedTable,
+                       config: KnnConfig
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Multi-chip scoring: train rows shard over the mesh's ``data`` axis
+    (edge-padded + masked, so padding can never become a neighbor), test
+    rows replicate, and each chip's local top-k candidates merge with an
+    all-gather + second top-k (``parallel.collective.sharded_topk`` — the
+    reference's shuffle/reduce as one collective; bit-identical to the
+    single-chip path in exact mode). ``feed_chunk_rows`` composes: staged
+    test chunks ``device_put`` DIRECTLY into the replicated sharding, so
+    no post-transfer reshard ever touches the scoring path. Publishes the
+    ``collective.imbalance`` gauge (real rows per shard skew) when
+    telemetry is on."""
+    from avenir_tpu.parallel import collective
+    mesh = collective.data_mesh(config.mesh_shape)
+    n_shards = mesh.shape["data"]
+    cat_idx = [i for i, f in enumerate(train.feature_fields)
+               if f.is_categorical]
+    n_bins = max((train.bins_per_feature[i] for i in cat_idx), default=0)
+    if _on_tpu() and config.mode == "fast":
+        # the sharded path runs the XLA streaming core per shard; the
+        # hand-scheduled Pallas kernel is single-chip only (its own jit/
+        # scratch management does not compose with shard_map). At low
+        # chip counts the per-shard XLA rate can undercut one chip's
+        # Pallas rate — say so instead of silently trading kernels.
+        from avenir_tpu.ops import pallas_distance
+        n_num = sum(1 for i, f in enumerate(train.feature_fields)
+                    if f.is_numeric or train.is_continuous[i])
+        if pallas_distance.supported(
+                algorithm=config.algorithm, k=config.top_match_count,
+                mode=config.mode,
+                encoded_width=n_num + len(cat_idx) * n_bins):
+            from avenir_tpu.utils.profiling import get_logger
+            get_logger("models.knn").warning(
+                "knn.sharded uses the XLA kernel per shard; the Pallas "
+                "single-chip kernel would apply here — compare aggregate "
+                "vs single-chip throughput at %d shards before committing",
+                n_shards)
+    (y_num, y_cat), y_valid, n_real = _staged_sharded_train(train, mesh)
+    if telemetry.tracer().enabled:
+        collective.publish_imbalance(
+            collective.shard_imbalance(y_valid, n_shards))
+
+    def run(xn, xc):
+        return collective.sharded_topk(
+            xn, y_num, xc, y_cat, mesh=mesh, k=config.top_match_count,
+            y_valid=y_valid, n_real=n_real, block_size=config.block_size,
+            algorithm=config.algorithm, n_cat_bins=n_bins,
+            distance_scale=config.distance_scale, mode=config.mode,
+            recall_target=config.recall_target)
+
+    te_num, te_cat = _split_features_host(test)
+    m = int(test.binned.shape[0])
+    if 0 < config.feed_chunk_rows < m:
+        return _neighbors_feed(run, te_num, te_cat, config,
+                               device=collective.replicated(mesh))
+    staged = jax.device_put(
+        (te_num, te_cat), collective.replicated(mesh))
+    d, i = run(*staged)
+    return np.asarray(d), np.asarray(i)
+
+
+def _neighbors_feed(run, te_num, te_cat, config: KnnConfig, device=None
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """Chunked scoring through the double-buffered device feed: stage
     chunk n+1 H2D on a background thread while chunk n's kernel runs,
     dispatch every chunk before the first readback (DESIGN.md §3
     dispatch-then-fetch), then one host sweep slices off the bucket
     padding — padded rows are whole junk TEST rows, row-independent by
-    construction, so they can never leak into a real row's top-k."""
+    construction, so they can never leak into a real row's top-k.
+    ``device`` lets the sharded path stage chunks DIRECTLY into the
+    mesh-replicated sharding (no post-transfer reshard)."""
     from avenir_tpu.parallel.pipeline import DeviceFeed
     arrays = (None if te_num is None else np.asarray(te_num),
               None if te_cat is None else np.asarray(te_cat))
     feed = DeviceFeed.from_arrays(arrays, chunk_rows=config.feed_chunk_rows,
-                                  depth=config.feed_depth)
+                                  depth=config.feed_depth, device=device)
     parts = []
     with telemetry.span("knn.feed"):
         for fc in feed:

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -89,16 +89,22 @@ class BayesModelMeta:
 # train
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("n_classes", "n_bins"))
-def _train_kernel(binned: jnp.ndarray, cont: jnp.ndarray, labels: jnp.ndarray,
+def _train_counts(binned: jnp.ndarray, cont: jnp.ndarray, labels: jnp.ndarray,
                   weights: Optional[jnp.ndarray], n_classes: int, n_bins: int
                   ) -> BayesModel:
+    """Un-jitted count core: the whole BayesianDistribution train job as
+    array math. Shared by the single-device jit and the shard_map body of
+    :func:`train_sharded` (per-shard counts + psum over the data axis)."""
     cls = class_counts(labels, n_classes, weights)
     post = class_feature_bin_counts(binned, labels, n_classes, n_bins, weights)
     prior = feature_bin_counts(binned, n_bins, weights)
     c_cnt, c_sum, c_sq = per_class_moments(cont, labels, n_classes, weights)
     return BayesModel(class_counts=cls, post_counts=post, prior_counts=prior,
                       cont_count=c_cnt, cont_sum=c_sum, cont_sumsq=c_sq)
+
+
+_train_kernel = partial(jax.jit, static_argnames=("n_classes", "n_bins"))(
+    _train_counts)
 
 
 def train(table: EncodedTable, weights: Optional[jnp.ndarray] = None
@@ -113,6 +119,45 @@ def train(table: EncodedTable, weights: Optional[jnp.ndarray] = None
                           table.n_classes, max(meta.n_bins, 1))
     metrics = MetricsRegistry()
     metrics.set("Distribution Data", "Records", table.n_rows)
+    metrics.set("Distribution Data", "Class prior", table.n_classes)
+    metrics.set("Distribution Data", "Feature posterior binned",
+                len(meta.binned_idx) * table.n_classes)
+    metrics.set("Distribution Data", "Feature posterior cont",
+                len(meta.cont_idx) * table.n_classes)
+    return model, meta, metrics
+
+
+@lru_cache(maxsize=None)
+def _counts_fn(n_classes: int, n_bins: int):
+    """Stable per-(C, B) closure for collective.psum_reduce's program
+    cache — a fresh lambda per call would recompile every job."""
+    def fn(binned, cont, labels, weights):
+        return _train_counts(binned, cont, labels, weights, n_classes, n_bins)
+    return fn
+
+
+def train_sharded(st, mesh) -> Tuple[BayesModel, BayesModelMeta,
+                                     MetricsRegistry]:
+    """Multi-chip train: rows live sharded over the mesh's ``data`` axis
+    (a ``parallel.data.ShardedTable``), each shard computes its local
+    count tensors and a ``psum`` closes them — BayesianDistribution's
+    mapper-emit + shuffle + reducer-sum as ONE collective program
+    (``parallel/collective.py``). The shard mask rides in as the weights
+    vector, so the edge-copy padding rows contribute exactly zero; counts
+    are integers well under 2^24, so the result equals :func:`train` on
+    the unsharded table exactly."""
+    from avenir_tpu.parallel import collective
+    table = st.table
+    meta = BayesModelMeta.from_table(table)
+    binned = table.binned[:, list(meta.binned_idx)] if meta.binned_idx else (
+        jnp.zeros((table.n_rows, 0), dtype=jnp.int32))
+    cont = table.numeric[:, list(meta.cont_idx)] if meta.cont_idx else (
+        jnp.zeros((table.n_rows, 0), dtype=jnp.float32))
+    model = collective.psum_reduce(
+        _counts_fn(table.n_classes, max(meta.n_bins, 1)), mesh,
+        binned, cont, table.labels, st.mask)
+    metrics = MetricsRegistry()
+    metrics.set("Distribution Data", "Records", st.n_global)
     metrics.set("Distribution Data", "Class prior", table.n_classes)
     metrics.set("Distribution Data", "Feature posterior binned",
                 len(meta.binned_idx) * table.n_classes)

@@ -143,21 +143,37 @@ def _select_k(metric: jnp.ndarray, k: int, fast: bool, recall_target: float
     return -neg, idx
 
 
-def _pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
-                   x_cat: Optional[jnp.ndarray] = None,
-                   y_cat: Optional[jnp.ndarray] = None,
-                   *, k: int, block_size: int = 65536,
-                   algorithm: str = "euclidean", n_cat_bins: int = 0,
-                   distance_scale: int = 1000, mode: str = "fast",
-                   recall_target: float = 0.99
-                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-k nearest train rows for every test row, streaming over blocks.
+#: sentinel for "no neighbor found" slots in the PRE-finalize metric; the
+#: distributed merge (parallel/collective.py) relies on unfound candidates
+#: sorting strictly after every real distance
+TOPK_BIG = 3.4e38
 
-    Returns (distances [M, min(k, N)] int32 scaled by ``distance_scale``,
-    indices [M, min(k, N)] int32 into the train set). Slots where no valid
-    neighbor was found get distance 2^30 and index -1 (cannot occur for
-    euclidean/manhattan over a non-empty train set; the sentinel protects
-    future metrics that may mask rows out).
+
+def _pairwise_topk_raw(x_num: Optional[jnp.ndarray],
+                       y_num: Optional[jnp.ndarray],
+                       x_cat: Optional[jnp.ndarray] = None,
+                       y_cat: Optional[jnp.ndarray] = None,
+                       *, k: int, block_size: int = 65536,
+                       algorithm: str = "euclidean", n_cat_bins: int = 0,
+                       mode: str = "fast", recall_target: float = 0.99,
+                       y_valid: Optional[jnp.ndarray] = None
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """PRE-finalize streaming top-k: (metric [M, min(k, N)] float32,
+    indices [M, min(k, N)] int32, -1 where nothing was found).
+
+    The returned metric is the block-selection key itself (squared-mean
+    euclidean, or the deferred ``y² − 2x·y`` form in fast mode) — NOT a
+    distance; :func:`_finalize_topk` re-attaches the per-test-row
+    constants, takes the sqrt, and scales to the reference's int. The
+    split exists so the multi-chip path (``parallel/collective.py``) can
+    merge per-shard candidates on the exact f32 selection key the
+    single-chip path sorts by, keeping the distributed merge bit-identical
+    in exact mode.
+
+    ``y_valid`` optionally masks train rows out of candidacy (1.0 real /
+    0.0 padding): masked rows take the ``TOPK_BIG`` sentinel exactly like
+    the internal block padding, so sharded tables padded with edge-row
+    copies can never leak a padded row into any test row's top-k.
     """
     fast = mode == "fast"
     # fast euclidean defers every per-row constant out of the [M, N] slab
@@ -177,7 +193,8 @@ def _pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
 
     y_num_p = pad(y_num, 0.0)
     y_cat_p = pad(y_cat, 0)
-    valid = jnp.pad(jnp.ones((n,), jnp.float32), (0, n_pad))
+    valid = jnp.pad(jnp.ones((n,), jnp.float32) if y_valid is None
+                    else y_valid.astype(jnp.float32), (0, n_pad))
 
     blocks = (
         y_num_p.reshape(n_blocks, block_size, -1) if y_num_p is not None
@@ -188,7 +205,7 @@ def _pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
         jnp.arange(n_blocks, dtype=jnp.int32) * block_size,
     )
 
-    big = jnp.float32(3.4e38)
+    big = jnp.float32(TOPK_BIG)
 
     def body(carry, xs):
         best_d, best_i = carry
@@ -226,6 +243,21 @@ def _pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
             return body(carry, (yb_num, yb_cat, vb, base))
         (best_d, best_i), _ = lax.scan(scan_fn, init, scannable)
 
+    return best_d, best_i
+
+
+def _finalize_topk(best_d: jnp.ndarray, best_i: jnp.ndarray,
+                   x_num: Optional[jnp.ndarray],
+                   x_cat: Optional[jnp.ndarray],
+                   *, algorithm: str, distance_scale: int, mode: str
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pre-finalize (metric, index) pairs -> the reference's scaled-int
+    distances + sentinel handling. Shared by the single-chip path and the
+    distributed merge, so both finalize the SAME f32 values with the SAME
+    ops (bit-identity across chip counts in exact mode)."""
+    fast = mode == "fast"
+    defer = fast and algorithm == "euclidean"
+    big = jnp.float32(TOPK_BIG)
     found = best_d < big
     if defer:
         # re-attach the deferred per-row constants: + x², clamp, /n_attrs
@@ -239,6 +271,30 @@ def _pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
                        jnp.asarray(jnp.rint(dist * distance_scale), jnp.int32),
                        2 ** 30)
     return scaled, jnp.where(found, best_i, -1)
+
+
+def _pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
+                   x_cat: Optional[jnp.ndarray] = None,
+                   y_cat: Optional[jnp.ndarray] = None,
+                   *, k: int, block_size: int = 65536,
+                   algorithm: str = "euclidean", n_cat_bins: int = 0,
+                   distance_scale: int = 1000, mode: str = "fast",
+                   recall_target: float = 0.99
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k nearest train rows for every test row, streaming over blocks.
+
+    Returns (distances [M, min(k, N)] int32 scaled by ``distance_scale``,
+    indices [M, min(k, N)] int32 into the train set). Slots where no valid
+    neighbor was found get distance 2^30 and index -1 (cannot occur for
+    euclidean/manhattan over a non-empty train set; the sentinel protects
+    future metrics that may mask rows out).
+    """
+    best_d, best_i = _pairwise_topk_raw(
+        x_num, y_num, x_cat, y_cat, k=k, block_size=block_size,
+        algorithm=algorithm, n_cat_bins=n_cat_bins, mode=mode,
+        recall_target=recall_target)
+    return _finalize_topk(best_d, best_i, x_num, x_cat, algorithm=algorithm,
+                          distance_scale=distance_scale, mode=mode)
 
 
 _TOPK_STATICS = ("k", "block_size", "algorithm", "n_cat_bins",

@@ -16,7 +16,17 @@ from avenir_tpu.parallel.pipeline import (
     stage_table,
 )
 from avenir_tpu.parallel.seqpar import viterbi_sharded
+from avenir_tpu.parallel.collective import (
+    data_mesh,
+    psum_reduce,
+    replicated,
+    shard_imbalance,
+    shard_train_rows,
+    sharded_topk,
+)
 
 __all__ = ["MeshSpec", "make_mesh", "shard_rows", "replicate",
            "pad_to_multiple", "viterbi_sharded", "DeviceFeed", "FeedChunk",
-           "FeedStats", "bucket_rows", "pad_rows", "stage_table"]
+           "FeedStats", "bucket_rows", "pad_rows", "stage_table",
+           "data_mesh", "psum_reduce", "replicated", "shard_imbalance",
+           "shard_train_rows", "sharded_topk"]

@@ -1,0 +1,355 @@
+"""Multi-chip scale-out of the hot kernels: shard_map + collective merge.
+
+The reference's whole execution model is horizontal scale-out — HDFS
+splits fanned across mapper JVMs with a shuffle/reduce merge (SURVEY.md
+§2.10) — yet until this layer every hot kernel here ran on ONE chip of
+the slice. This module composes the existing substrate (``mesh.py``
+meshes, ``data.py`` row-sharded tables, the PR-3 ``DeviceFeed``) into
+explicitly-collective device programs over the ``data`` axis:
+
+- **Distributed KNN** (:func:`sharded_topk`): train rows shard over the
+  mesh, test rows replicate; each shard runs the unchanged streaming
+  top-k core (``ops.distance._pairwise_topk_raw``) against its rows,
+  then the per-shard ``[M, k]`` candidates all-gather and a second
+  top-k over ``k × n_shards`` candidates closes the merge — the classic
+  distributed-KNN reduce (the reference's secondary-sort shuffle,
+  NearestNeighbor.java:80-81, as one collective). Merging happens on the
+  PRE-finalize f32 selection key, with candidates concatenated in shard
+  order and per-shard candidates already tie-sorted by row id, so exact
+  mode is **bit-identical** to the single-chip path: ties break by
+  global row id on both (``lax.top_k`` is stable, shard order = global
+  row-id order for contiguous row sharding).
+
+  Why all-gather-of-top-k and not all-gather-of-distances: the gather
+  moves ``M × k × n_shards`` candidate pairs (a few KB) over ICI instead
+  of the ``M × N`` distance slab (the whole point of the streaming
+  top-k is that the slab never materializes even in ONE chip's HBM).
+
+- **psum-reduced training** (:func:`psum_reduce`): the reduction-shaped
+  trainers (Naive Bayes count tables, ``ops/histogram.py`` reductions,
+  ``ops/infotheory.py`` mutual-information distributions) run their
+  one-hot contraction per shard and close each output leaf with a
+  ``psum`` over the data axis — the literal combiner/shuffle/reducer
+  collapse the ``mesh.py`` docstring promises. Padding rows carry
+  weight 0 (the ``ShardedTable`` mask), so they contribute exactly
+  nothing to any count.
+
+Telemetry rides the PR-2 obs layer, gated so the disabled hot path
+stays a single fused program: when the tracer is enabled,
+:func:`sharded_topk` runs as three device programs recorded as spans
+``collective.shard_compute`` (per-shard streaming top-k),
+``collective.gather`` (candidate all-gather) and ``collective.merge``
+(second top-k + finalize) — both paths compute identical values.
+:func:`shard_imbalance` + :func:`publish_imbalance` feed the
+``collective.imbalance`` hub gauge ((max − mean)/mean real rows per
+shard; 0.0 = perfectly balanced splits, the straggler signal the
+JobTracker UI used to be).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from avenir_tpu.obs import telemetry
+from avenir_tpu.ops.distance import _finalize_topk, _pairwise_topk_raw
+from avenir_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, MeshSpec,
+                                      make_mesh, shard_map)
+
+
+# ---------------------------------------------------------------------------
+# mesh + sharding helpers
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=16)
+def _cached_mesh(shape: Tuple[int, ...], devices: Tuple) -> Mesh:
+    axes = (DATA_AXIS,) if len(shape) == 1 else (DATA_AXIS, MODEL_AXIS)
+    return make_mesh(MeshSpec(axes, shape), devices=devices)
+
+
+def data_mesh(shape: Sequence[int] = (),
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The CLI's ``mesh.shape`` property as a (cached) mesh: ``()`` or
+    ``(-1,)`` lays every device on the ``data`` axis; a second entry adds
+    the ``model`` axis (e.g. ``4,2``). Caching keeps repeated jobs from
+    re-minting equal-but-distinct Mesh objects (a jit-cache key)."""
+    devs = tuple(devices if devices is not None else jax.devices())
+    return _cached_mesh(tuple(shape) or (-1,), devs)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """The side-file broadcast sharding — pass as ``DeviceFeed(device=...)``
+    so staged test chunks land DIRECTLY replicated across the mesh (no
+    post-transfer reshard on the consume path)."""
+    return NamedSharding(mesh, P())
+
+
+def _row_spec(ndim: int, axis: str = DATA_AXIS) -> P:
+    return P(*((axis,) + (None,) * (ndim - 1)))
+
+
+def shard_train_rows(arrays: Sequence[Optional[np.ndarray]], mesh: Mesh,
+                     *, axis: str = DATA_AXIS
+                     ) -> Tuple[Tuple[Optional[jax.Array], ...],
+                                jax.Array, int]:
+    """Place host train-side arrays row-sharded over ``axis``, padded to a
+    whole number of rows per shard (edge-row copies, exactly like
+    ``data.shard_table``). Returns (staged arrays, validity mask [G]
+    float32 device-sharded, n_real). The mask is what keeps the padded
+    copies out of every top-k candidacy and psum total."""
+    if jax.process_count() > 1:
+        # every process would present the FULL arrays and the placement
+        # would silently hold process_count copies — same contract as
+        # data.shard_table; multi-host runs go through load_sharded_table
+        raise RuntimeError(
+            "shard_train_rows is single-process only; multi-host runs "
+            "must shard via load_sharded_table")
+    present = [a for a in arrays if a is not None]
+    if not present:
+        raise ValueError("no arrays to shard")
+    n = int(present[0].shape[0])
+    for a in present:
+        if a.shape[0] != n:
+            raise ValueError("train arrays disagree on leading axis")
+    from avenir_tpu.parallel import pipeline as _pipeline
+    from avenir_tpu.parallel.data import padded_rows
+    g = padded_rows(n, mesh, axis)
+    pad = g - n
+
+    def prep(a):
+        a = np.asarray(a)
+        if pad:
+            width = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+            a = np.pad(a, width, mode="edge")
+        return jax.device_put(a, NamedSharding(mesh, _row_spec(a.ndim, axis)))
+
+    # transfers overlap each other on the feed pipeline's staging pool
+    # (the shard_table discipline)
+    futs = tuple(None if a is None else _pipeline.submit(lambda a=a: prep(a))
+                 for a in arrays)
+    mask = np.zeros((g,), np.float32)
+    mask[:n] = 1.0
+    mask_f = _pipeline.submit(
+        lambda: jax.device_put(mask, NamedSharding(mesh, P(axis))))
+    staged = tuple(None if f is None else f.result() for f in futs)
+    return staged, mask_f.result(), n
+
+
+def shard_imbalance(mask, n_shards: int) -> float:
+    """(max − mean)/mean real rows per shard — the straggler-risk gauge.
+    0.0 means every shard holds the same number of real rows; 1.0 means
+    the fullest shard carries 2x the average."""
+    m = np.asarray(mask, np.float64).reshape(n_shards, -1).sum(axis=1)
+    mean = float(m.mean())
+    return float((m.max() - mean) / mean) if mean > 0 else 0.0
+
+
+def publish_imbalance(value: float, name: str = "collective.imbalance"
+                      ) -> None:
+    """Hub gauge, telemetry-gated (free when obs is off)."""
+    if not telemetry.tracer().enabled:
+        return
+    try:
+        from avenir_tpu.obs.exporters import TelemetryHub
+        hub = TelemetryHub._instance
+        if hub is not None and hub.enabled:
+            hub.set_gauge(name, value)
+    except Exception:
+        pass  # telemetry must never sink the job
+
+
+# ---------------------------------------------------------------------------
+# distributed KNN: per-shard top-k + all-gather + merge
+# ---------------------------------------------------------------------------
+
+_TOPK_PROGRAMS: Dict[tuple, dict] = {}
+
+
+def _zero_width(a: Optional[jnp.ndarray], m: int, dtype) -> jnp.ndarray:
+    """Absent feature groups become [m, 0] arrays so every mesh/k/mode
+    combination compiles ONE program shape family (the streaming core
+    already treats width-0 exactly like None)."""
+    return jnp.zeros((m, 0), dtype) if a is None else a
+
+
+def _topk_programs(mesh: Mesh, per: int, k_local: int, k_out: int,
+                   block_size: int, algorithm: str, n_cat_bins: int,
+                   distance_scale: int, mode: str, recall_target: float
+                   ) -> dict:
+    """Compiled-callable bundle for one static configuration; cached so
+    repeated calls (chunked feeds!) reuse executables instead of leaking
+    the jit cache."""
+    axis = DATA_AXIS
+    in_specs = (P(None, None), _row_spec(2), P(None, None), _row_spec(2),
+                P(axis))
+
+    def local_shard(xn, yn, xc, yc, yv):
+        d, i = _pairwise_topk_raw(
+            xn, yn, xc, yc, k=k_local, block_size=block_size,
+            algorithm=algorithm, n_cat_bins=n_cat_bins, mode=mode,
+            recall_target=recall_target, y_valid=yv)
+        base = (lax.axis_index(axis) * per).astype(jnp.int32)
+        return d, jnp.where(i >= 0, i + base, -1)
+
+    def merge_core(d_all, i_all):
+        # exact top-k over k_local × n_shards candidates: candidates sit in
+        # shard order and per-shard rank order, so lax.top_k's stable tie
+        # rule reproduces the single-chip "lowest global row id wins"
+        neg, pos = lax.top_k(-d_all, k_out)
+        return -neg, jnp.take_along_axis(i_all, pos, axis=1)
+
+    def finalize(d, i, xn, xc):
+        return _finalize_topk(
+            d, i, xn if xn.shape[1] else None, xc if xc.shape[1] else None,
+            algorithm=algorithm, distance_scale=distance_scale, mode=mode)
+
+    def fused_shard(xn, yn, xc, yc, yv):
+        d, i = local_shard(xn, yn, xc, yc, yv)
+        d_all = lax.all_gather(d, axis, axis=1, tiled=True)
+        i_all = lax.all_gather(i, axis, axis=1, tiled=True)
+        return merge_core(d_all, i_all)
+
+    # check_rep=False: the outputs ARE replicated (all_gather + an
+    # identical merge on every shard) but the checker cannot infer that
+    # through the streaming core's lax.scan
+    fused_sm = shard_map(fused_shard, mesh=mesh, in_specs=in_specs,
+                         out_specs=(P(), P()), check_rep=False)
+
+    @jax.jit
+    def fused(xn, yn, xc, yc, yv):
+        return finalize(*fused_sm(xn, yn, xc, yc, yv), xn, xc)
+
+    # staged (telemetry) decomposition: out_specs stacking the candidate
+    # axis over 'data' leaves the SAME shard-order concatenation the
+    # all_gather produces, just still resident shard-by-shard
+    local_sm = jax.jit(shard_map(
+        local_shard, mesh=mesh, in_specs=in_specs,
+        out_specs=(P(None, axis), P(None, axis))))
+    merge_jit = jax.jit(
+        lambda d_all, i_all, xn, xc: finalize(*merge_core(d_all, i_all),
+                                              xn, xc))
+    return {"fused": fused, "local": local_sm, "merge": merge_jit,
+            "replicated": NamedSharding(mesh, P())}
+
+
+def sharded_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
+                 x_cat: Optional[jnp.ndarray] = None,
+                 y_cat: Optional[jnp.ndarray] = None,
+                 *, mesh: Mesh, k: int,
+                 y_valid: Optional[jax.Array] = None,
+                 n_real: Optional[int] = None,
+                 block_size: int = 65536, algorithm: str = "euclidean",
+                 n_cat_bins: int = 0, distance_scale: int = 1000,
+                 mode: str = "fast", recall_target: float = 0.99,
+                 staged: Optional[bool] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Distributed top-k nearest train rows: train (``y_*``) rows sharded
+    over the mesh's ``data`` axis, test (``x_*``) replicated.
+
+    ``y_*`` arrays hold the PADDED global row count (a multiple of the
+    data-axis size — ``shard_train_rows`` produces them); ``y_valid``
+    masks the padding (required whenever padding exists) and ``n_real``
+    is the real train row count (defaults to the padded count when no
+    mask is given). Returns (scaled int32 distances [M, min(k, n_real)],
+    global train-row indices) — in exact mode bit-identical to
+    ``ops.distance.pairwise_topk`` over the unpadded table on one chip.
+
+    ``staged=None`` auto-selects: a single fused program normally, the
+    three-program span-instrumented pipeline when telemetry is enabled
+    (identical numerics either way — the decomposition only moves the
+    dispatch boundaries).
+    """
+    axis = DATA_AXIS
+    n_shards = mesh.shape[axis]
+    if x_num is None and x_cat is None:
+        raise ValueError("no test features")
+    if y_num is None and y_cat is None:
+        raise ValueError("no train features")
+    m = int((x_num if x_num is not None else x_cat).shape[0])
+    n = int((y_num if y_num is not None else y_cat).shape[0])
+    if n % n_shards:
+        raise ValueError(
+            f"{n} train rows not divisible by the {n_shards}-shard data "
+            "axis; pad with shard_train_rows/shard_table first")
+    if y_valid is None and n_real is not None and n_real != n:
+        raise ValueError("n_real < padded rows needs a y_valid mask")
+    if y_valid is not None and n_real is None:
+        # defaulting n_real to the PADDED count here would silently widen
+        # the output with sentinel columns when k exceeds the real rows
+        raise ValueError("y_valid needs an explicit n_real "
+                         "(shard_train_rows returns both)")
+    n_real = n if n_real is None else n_real
+    per = n // n_shards
+    k_out = max(min(k, n_real), 1)
+    k_local = min(k, per)
+    xn = _zero_width(x_num, m, jnp.float32)
+    xc = _zero_width(x_cat, m, jnp.int32)
+    yn = _zero_width(y_num, n, jnp.float32)
+    yc = _zero_width(y_cat, n, jnp.int32)
+    yv = jnp.ones((n,), jnp.float32) if y_valid is None else y_valid
+
+    key = (mesh, per, k_local, k_out, block_size, algorithm, n_cat_bins,
+           distance_scale, mode, recall_target)
+    progs = _TOPK_PROGRAMS.get(key)
+    if progs is None:
+        progs = _TOPK_PROGRAMS[key] = _topk_programs(
+            mesh, per, k_local, k_out, block_size, algorithm, n_cat_bins,
+            distance_scale, mode, recall_target)
+
+    tracer = telemetry.tracer()
+    if staged is None:
+        staged = tracer.enabled
+    if not staged:
+        return progs["fused"](xn, yn, xc, yc, yv)
+
+    with tracer.span("collective.shard_compute"):
+        cand_d, cand_i = progs["local"](xn, yn, xc, yc, yv)
+        jax.block_until_ready((cand_d, cand_i))
+    with tracer.span("collective.gather"):
+        # the all-gather as an explicit reshard of the [M, S*k_local]
+        # candidate slab to the replicated sharding
+        cand_d, cand_i = jax.device_put((cand_d, cand_i),
+                                        progs["replicated"])
+        jax.block_until_ready((cand_d, cand_i))
+    with tracer.span("collective.merge"):
+        d, i = progs["merge"](cand_d, cand_i, xn, xc)
+        jax.block_until_ready((d, i))
+    return d, i
+
+
+# ---------------------------------------------------------------------------
+# psum-reduced accumulation: the shuffle+reduce analogue for count kernels
+# ---------------------------------------------------------------------------
+
+_PSUM_PROGRAMS: Dict[tuple, object] = {}
+
+
+def psum_reduce(fn, mesh: Mesh, *arrays, axis: str = DATA_AXIS):
+    """Run ``fn`` on each row shard of ``arrays`` and close every output
+    leaf with a ``psum`` over ``axis`` — map-side combine + shuffle +
+    reduce as one collective program.
+
+    ``fn`` must be a STABLE callable (module-level function or cached
+    partial): the compiled program is cached on ``(fn, mesh, axis,
+    ndims)``, so a lambda minted per call would defeat the executable
+    cache and recompile every invocation. Row counts must divide the
+    data-axis size; mask padding rows via a weights argument (the
+    histogram kernels all take one) so they contribute zero."""
+    ndims = tuple(np.ndim(a) for a in arrays)
+    key = (fn, mesh, axis, ndims)
+    prog = _PSUM_PROGRAMS.get(key)
+    if prog is None:
+        in_specs = tuple(_row_spec(nd, axis) for nd in ndims)
+
+        def body(*shards):
+            return jax.tree.map(lambda t: lax.psum(t, axis), fn(*shards))
+
+        prog = _PSUM_PROGRAMS[key] = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=P()))
+    return prog(*arrays)

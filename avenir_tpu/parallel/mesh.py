@@ -18,6 +18,7 @@ input pipeline and checkpoints (the reference's analogue: HDFS I/O).
 
 from __future__ import annotations
 
+import inspect
 from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
@@ -28,6 +29,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+# ---------------------------------------------------------------------------
+# version-adaptive shard_map: one shim for every collective caller
+# (seqpar, collective) — jax moved the symbol (experimental -> top level
+# at 0.5) AND renamed the replication-check kwarg (check_rep -> check_vma
+# at 0.6), so both are probed once here instead of per-module
+# ---------------------------------------------------------------------------
+
+try:                                  # jax >= 0.5 exports it at top level
+    _SHARD_MAP_IMPL = jax.shard_map
+except AttributeError:                # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _SHARD_MAP_IMPL
+
+try:
+    _SM_PARAMS = inspect.signature(_SHARD_MAP_IMPL).parameters
+    _SM_REP_KW = ("check_rep" if "check_rep" in _SM_PARAMS
+                  else "check_vma" if "check_vma" in _SM_PARAMS else None)
+except (ValueError, TypeError):       # unprobeable signature: best effort
+    _SM_REP_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_rep: bool = True):
+    """``jax.shard_map`` with the replication-check flag spelled the way
+    THIS jax spells it (``check_rep`` pre-0.6, ``check_vma`` after)."""
+    kw = {}
+    if not check_rep and _SM_REP_KW is not None:
+        kw[_SM_REP_KW] = False
+    return _SHARD_MAP_IMPL(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)
 
 
 @dataclass(frozen=True)
@@ -57,6 +87,14 @@ class MeshSpec:
             raise ValueError(
                 f"mesh shape {self.shape} needs {fixed} devices, "
                 f"only {n_devices} available")
+        elif fixed < n_devices:
+            # an all-fixed shape smaller than the slice silently strands
+            # chips — legal (a deliberate sub-mesh), but never silent
+            from avenir_tpu.utils.profiling import get_logger
+            get_logger("parallel.mesh").warning(
+                "mesh shape %s uses %d of %d devices; %d device(s) sit "
+                "idle — add a -1 axis to absorb the remainder",
+                self.shape, fixed, n_devices, n_devices - fixed)
         return tuple(shape)
 
 

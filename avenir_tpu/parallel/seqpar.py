@@ -39,6 +39,21 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from avenir_tpu.parallel.mesh import shard_map as _shard_map
+
+try:                                  # varying-rep cast only exists where
+    _pcast = lax.pcast                # shard_map's rep types do; on 0.4.x
+except AttributeError:                # the calls run under check_rep=False
+    def _pcast(x, axis_name, to="varying"):   # and the cast is a no-op
+        return x
+
+
+def _axis_size(axis_name: str) -> int:
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:            # 0.4.x: psum of 1 over the axis is
+        return lax.psum(1, axis_name)  # the same static value
+
 from avenir_tpu.ops.scanops import lseplus, lseplus_eye, maxplus, maxplus_eye
 
 
@@ -78,7 +93,7 @@ def _step_mats(log_init, log_trans, log_emit, obs_local, length, p,
 def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
     """shard_map body: returns (path slice [T_local], best score [])."""
     p = lax.axis_index(axis_name)
-    n_shards = lax.axis_size(axis_name)
+    n_shards = _axis_size(axis_name)
     n_states = log_init.shape[0]
 
     # padded steps backtrack to themselves under the max-plus identity —
@@ -94,7 +109,7 @@ def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
     blocks = lax.all_gather(block, axis_name)            # [P, S, S]
     # scan carries must be marked device-varying to match body outputs that
     # depend on axis_index
-    eye = lax.pcast(ident, axis_name, to="varying")
+    eye = _pcast(ident, axis_name, to="varying")
 
     def prefix_step(carry, qb):
         q, b = qb
@@ -117,7 +132,7 @@ def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
     def bt_step(state_vec, back_row):
         return back_row[state_vec], state_vec
     enter_states, rev = lax.scan(
-        bt_step, lax.pcast(jnp.arange(n_states), axis_name, to="varying"),
+        bt_step, _pcast(jnp.arange(n_states), axis_name, to="varying"),
         backs[::-1])
     states_all = rev[::-1]                                # [T_local, S]
     # enter_states[s_end] = best predecessor in the PREVIOUS shard
@@ -129,7 +144,7 @@ def _local_body(log_init, log_trans, log_emit, obs_local, length, axis_name):
     def fold_step(v, b):
         return jnp.max(v[:, None] + b, axis=0), None
     alpha_T, _ = lax.scan(
-        fold_step, lax.pcast(jnp.zeros((n_states,)), axis_name, to="varying"),
+        fold_step, _pcast(jnp.zeros((n_states,)), axis_name, to="varying"),
         blocks)
     # every device computed the same scalar; pmax proves replication to the
     # shard_map type system (semantically a no-op)
@@ -169,7 +184,7 @@ def _forward_body(log_init, log_trans, log_emit, obs_local, length,
     def fold_step(v, b):
         return jax.nn.logsumexp(v[:, None] + b, axis=0), None
     alpha_t, _ = lax.scan(
-        fold_step, lax.pcast(seed, axis_name, to="varying"), blocks)
+        fold_step, _pcast(seed, axis_name, to="varying"), blocks)
     # every device computed the same scalar; pmax proves replication
     return lax.pmax(jax.nn.logsumexp(alpha_t), axis_name)
 
@@ -194,10 +209,10 @@ def forward_sharded(log_init: jnp.ndarray, log_trans: jnp.ndarray,
             f"{n_shards}-way axis {axis_name!r}; right-pad and pass length=")
     length = jnp.asarray(obs.shape[0] if length is None else length)
     body = partial(_forward_body, axis_name=axis_name)
-    fn = jax.shard_map(
+    fn = _shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(), P(), P(axis_name), P()),
-        out_specs=P())
+        out_specs=P(), check_rep=False)
     obs = jax.device_put(obs, NamedSharding(mesh, P(axis_name)))
     return fn(log_init, log_trans, log_emit, obs, length)
 
@@ -222,9 +237,9 @@ def viterbi_sharded(log_init: jnp.ndarray, log_trans: jnp.ndarray,
             f"{n_shards}-way axis {axis_name!r}; right-pad and pass length=")
     length = jnp.asarray(obs.shape[0] if length is None else length)
     body = partial(_local_body, axis_name=axis_name)
-    fn = jax.shard_map(
+    fn = _shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(), P(), P(axis_name), P()),
-        out_specs=(P(axis_name), P()))
+        out_specs=(P(axis_name), P()), check_rep=False)
     obs = jax.device_put(obs, NamedSharding(mesh, P(axis_name)))
     return fn(log_init, log_trans, log_emit, obs, length)

@@ -203,6 +203,63 @@ def _feed_bulk(chain, train, n_batches: int, n_repeats: int, rng):
     return n_batches * M_TEST * ITERS / best, stats.overlap_fraction
 
 
+def _multichip_bench(per_chip_rate: float, rng) -> dict:
+    """REAL multi-chip metric (round 7): the production sharded-KNN path
+    (train rows sharded over the ``data`` mesh axis, per-shard top-k,
+    all-gather + merge — ``parallel/collective.py``) timed across every
+    available chip, reported as AGGREGATE test rows/s plus scaling
+    efficiency vs the measured 1-chip rate (aggregate / (per_chip × n)).
+    Falls back gracefully on a 1-device backend (the sandbox has no TPU
+    plugin): the section still lands in the JSON with n_devices=1 and
+    efficiency 1.0 so the artifact schema is stable across environments.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    devs = jax.devices()
+    n_dev = len(devs)
+    if n_dev == 1:
+        return {"n_devices": 1,
+                "aggregate_rows_per_sec": round(per_chip_rate, 1),
+                "scaling_efficiency": 1.0,
+                "note": "single-device backend: aggregate == per-chip"}
+    from avenir_tpu.parallel import collective
+    mesh = collective.data_mesh()
+    n_shards = mesh.shape["data"]
+    train = rng.random((N_TRAIN, N_FEATURES), dtype=np.float32)
+    test = rng.random((M_TEST, N_FEATURES), dtype=np.float32)
+    (y,), y_valid, n_real = collective.shard_train_rows((train,), mesh)
+    x = jax.device_put(test, collective.replicated(mesh))
+
+    @jax.jit
+    def chain(test, train_y, yv):
+        def body(t, _):
+            d, i = collective.sharded_topk(
+                t, train_y, mesh=mesh, k=K, y_valid=yv, n_real=n_real,
+                mode="fast", staged=False)
+            eps = (jnp.sum(d) % 7).astype(jnp.float32) * 1e-20
+            return t + eps, (d[0, 0], i[0, 0])
+        _, outs = lax.scan(body, test, None, length=ITERS)
+        return jnp.sum(outs[0].astype(jnp.float32)) + \
+            jnp.sum(outs[1].astype(jnp.float32))
+
+    np.asarray(chain(x, y, y_valid))          # compile + warm
+    reps = max(4, REPEATS // 3)
+    elapsed = min(_timed_multi(chain, x, y, y_valid) for _ in range(reps))
+    aggregate = M_TEST * ITERS / elapsed
+    eff = aggregate / (per_chip_rate * n_shards) if per_chip_rate else 0.0
+    return {"n_devices": n_dev,
+            "mesh_shape": {k: int(v) for k, v in mesh.shape.items()},
+            "aggregate_rows_per_sec": round(aggregate, 1),
+            "per_chip_rows_per_sec": round(per_chip_rate, 1),
+            "scaling_efficiency": round(eff, 3)}
+
+
+def _timed_multi(chain, x, y, yv) -> float:
+    t0 = time.perf_counter()
+    np.asarray(chain(x, y, yv))               # one final host fetch
+    return time.perf_counter() - t0
+
+
 def main() -> None:
     import sys
     # telemetry (obs layer): count compiles from here on so the JSON
@@ -364,6 +421,27 @@ def main() -> None:
     }
     if overlap is not None:
         out["overlap_fraction"] = round(overlap, 3)
+    # ROUND-7 MULTICHIP: aggregate rows/s across the mesh + scaling
+    # efficiency vs 1 chip — the metric that makes MULTICHIP_rN.json a
+    # measurement instead of a dryrun. The per-chip basis is the XLA
+    # fast-mode single-draw (the same kernel the sharded path runs per
+    # shard); a multichip failure must not lose the round's headline.
+    if os.environ.get("BENCH_MULTICHIP", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        try:
+            basis = best.get("xla", float("inf"))
+            if not np.isfinite(basis):
+                basis = elapsed                  # chosen impl as fallback
+            out["multichip"] = _multichip_bench(M_TEST * ITERS / basis, rng)
+            mc = out["multichip"]
+            print(f"multichip: {mc['aggregate_rows_per_sec'] / 1e6:.2f}M "
+                  f"rows/s aggregate over {mc['n_devices']} device(s), "
+                  f"scaling efficiency {mc['scaling_efficiency']:.3f}",
+                  file=sys.stderr)
+        except Exception as exc:   # fallback-safe: record, never sink
+            print(f"multichip bench skipped: {exc!r}", file=sys.stderr)
+            out["multichip"] = {"n_devices": len(jax.devices()),
+                                "error": repr(exc)}
     if legacy:
         base_elapsed = M_TEST * ITERS / legacy
         adj = M_TEST * ITERS / max(base_elapsed - 0.0993, 1e-9)

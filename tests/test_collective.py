@@ -1,0 +1,437 @@
+"""The multi-chip collective layer (parallel/collective.py): distributed
+KNN top-k merge bit-identity across shard counts, adversarial padding
+masks, psum-reduced trainers, telemetry staging, DeviceFeed replicated
+landing, and the CLI wire-through (knn.sharded / mesh.shape /
+train.sharded)."""
+
+import logging
+import subprocess
+import sys
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.datagen.generators import churn_rows, churn_schema
+from avenir_tpu.models import knn
+from avenir_tpu.models import naive_bayes as nb
+from avenir_tpu.ops.distance import pairwise_full, pairwise_topk
+from avenir_tpu.parallel import collective
+from avenir_tpu.parallel.data import shard_table
+from avenir_tpu.parallel.mesh import MeshSpec, make_mesh
+from avenir_tpu.utils.dataset import Featurizer
+from avenir_tpu.utils.schema import FeatureSchema
+
+
+def _sub_mesh(n):
+    return make_mesh(MeshSpec(), devices=jax.devices()[:n])
+
+
+class TestMeshResolveWarning:
+    """Satellite: an all-fixed shape smaller than the slice must warn with
+    the stranded-device count, never silently idle chips."""
+
+    def test_fixed_below_device_count_warns(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="avenir_tpu.parallel.mesh"):
+            shape = MeshSpec(("data", "model"), (2, 2)).resolve(8)
+        assert shape == (2, 2)
+        assert any("4 device(s) sit idle" in r.getMessage()
+                   for r in caplog.records), caplog.records
+
+    def test_wildcard_absorbs_silently(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="avenir_tpu.parallel.mesh"):
+            assert MeshSpec(("data",), (-1,)).resolve(8) == (8,)
+            assert MeshSpec(("data", "model"), (-1, 2)).resolve(8) == (4, 2)
+        assert not caplog.records
+
+    def test_exact_fit_silent(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="avenir_tpu.parallel.mesh"):
+            assert MeshSpec(("data", "model"), (4, 2)).resolve(8) == (4, 2)
+        assert not caplog.records
+
+    def test_oversized_still_raises(self):
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            MeshSpec(("data",), (16,)).resolve(8)
+
+
+class TestShardedTopkBitIdentity:
+    """Tentpole property: sharded exact-mode KNN is bit-identical to the
+    single-chip path at every shard count — same neighbor ids (ties broken
+    by global row id), same scaled distances, distances consistent with
+    the pairwise_full matrix."""
+
+    @pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+    def test_exact_mode_bit_identical(self, devices, n_dev):
+        rng = np.random.default_rng(100 + n_dev)
+        m, n, k = 41, 257, 5                      # prime train row count
+        x_num = rng.random((m, 4), dtype=np.float32)
+        y_num = rng.random((n, 4), dtype=np.float32)
+        # low-cardinality categoricals force DISTANCE TIES, so this also
+        # pins the tie-break rule across the distributed merge
+        x_cat = rng.integers(0, 3, (m, 2)).astype(np.int32)
+        y_cat = rng.integers(0, 3, (n, 2)).astype(np.int32)
+        mesh = _sub_mesh(n_dev)
+        (y_n, y_c), y_valid, n_real = collective.shard_train_rows(
+            (y_num, y_cat), mesh)
+        d_s, i_s = collective.sharded_topk(
+            jnp.asarray(x_num), y_n, jnp.asarray(x_cat), y_c, mesh=mesh,
+            k=k, y_valid=y_valid, n_real=n_real, mode="exact", n_cat_bins=3)
+        d_1, i_1 = pairwise_topk(
+            jnp.asarray(x_num), jnp.asarray(y_num), jnp.asarray(x_cat),
+            jnp.asarray(y_cat), k=k, mode="exact", n_cat_bins=3)
+        np.testing.assert_array_equal(np.asarray(i_s), np.asarray(i_1))
+        np.testing.assert_array_equal(np.asarray(d_s), np.asarray(d_1))
+        # distances must equal the full-matrix entries at the chosen ids
+        full = np.asarray(pairwise_full(
+            jnp.asarray(x_num), jnp.asarray(y_num), jnp.asarray(x_cat),
+            jnp.asarray(y_cat), n_cat_bins=3))
+        np.testing.assert_array_equal(
+            np.take_along_axis(full, np.asarray(i_s), axis=1),
+            np.asarray(d_s))
+
+    def test_categorical_only_table(self, mesh):
+        rng = np.random.default_rng(3)
+        x = rng.integers(0, 4, (17, 3)).astype(np.int32)
+        y = rng.integers(0, 4, (53, 3)).astype(np.int32)
+        (y_c,), y_valid, n_real = collective.shard_train_rows((y,), mesh)
+        d_s, i_s = collective.sharded_topk(
+            None, None, jnp.asarray(x), y_c, mesh=mesh, k=7,
+            y_valid=y_valid, n_real=n_real, mode="exact", n_cat_bins=4)
+        d_1, i_1 = pairwise_topk(None, None, jnp.asarray(x), jnp.asarray(y),
+                                 k=7, mode="exact", n_cat_bins=4)
+        np.testing.assert_array_equal(np.asarray(i_s), np.asarray(i_1))
+        np.testing.assert_array_equal(np.asarray(d_s), np.asarray(d_1))
+
+    def test_fast_mode_recall_sane(self, mesh):
+        """Fast mode is not bit-pinned (per-shard approx_min_k sees a
+        different partition) but the merged result must still hit the
+        recall bound vs exact."""
+        rng = np.random.default_rng(9)
+        x = rng.random((64, 9), dtype=np.float32)
+        y = rng.random((1024, 9), dtype=np.float32)
+        (y_d,), y_valid, n_real = collective.shard_train_rows((y,), mesh)
+        _, i_s = collective.sharded_topk(
+            jnp.asarray(x), y_d, mesh=mesh, k=5, y_valid=y_valid,
+            n_real=n_real, mode="fast")
+        _, i_1 = pairwise_topk(jnp.asarray(x), jnp.asarray(y), k=5,
+                               mode="exact")
+        i_s, i_1 = np.asarray(i_s), np.asarray(i_1)
+        recall = np.mean([len(set(i_s[r]) & set(i_1[r])) / 5
+                          for r in range(i_s.shape[0])])
+        assert recall >= 0.95, recall
+
+
+class TestAdversarialPadding:
+    """Satellite: padded rows must never contribute to top-k candidates or
+    psum totals — n_rows < n_shards, n_rows == 1, prime n_rows on 8
+    shards."""
+
+    @pytest.mark.parametrize("n_rows", [1, 3, 7, 13, 101])
+    def test_padding_never_in_topk(self, mesh, n_rows):
+        rng = np.random.default_rng(n_rows)
+        x = rng.random((19, 5), dtype=np.float32)
+        y = rng.random((n_rows, 5), dtype=np.float32)
+        (y_d,), y_valid, n_real = collective.shard_train_rows((y,), mesh)
+        assert n_real == n_rows
+        d_s, i_s = collective.sharded_topk(
+            jnp.asarray(x), y_d, mesh=mesh, k=5, y_valid=y_valid,
+            n_real=n_real, mode="exact")
+        d_1, i_1 = pairwise_topk(jnp.asarray(x), jnp.asarray(y), k=5,
+                                 mode="exact")
+        # output narrows to min(k, n_real) exactly like the one-chip path
+        assert d_s.shape == d_1.shape == (19, min(5, n_rows))
+        np.testing.assert_array_equal(np.asarray(i_s), np.asarray(i_1))
+        np.testing.assert_array_equal(np.asarray(d_s), np.asarray(d_1))
+        # every id addresses a REAL row: the padded edge-copies (which
+        # duplicate real rows' features, the worst-case bait) never appear
+        assert np.asarray(i_s).min() >= 0
+        assert np.asarray(i_s).max() < n_rows
+
+    @pytest.mark.parametrize("n_rows", [1, 7, 13])
+    def test_padding_never_in_psum(self, mesh, n_rows):
+        rows = churn_rows(n_rows, seed=n_rows)
+        fz = Featurizer(churn_schema()).fit(churn_rows(200, seed=1))
+        table = fz.transform(rows)
+        st = shard_table(table, mesh)
+        assert st.table.n_rows > n_rows     # padding really exists
+        m_sh, _, metrics = nb.train_sharded(st, mesh)
+        m_1, _, _ = nb.train(table)
+        for name in ("class_counts", "post_counts", "prior_counts",
+                     "cont_count"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(m_sh, name)),
+                np.asarray(getattr(m_1, name)), err_msg=name)
+        np.testing.assert_allclose(np.asarray(m_sh.cont_sum),
+                                   np.asarray(m_1.cont_sum), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(m_sh.cont_sumsq),
+                                   np.asarray(m_1.cont_sumsq), rtol=1e-6)
+        # the metrics report counts REAL records, not padded ones
+        assert f'"Distribution Data.Records": {float(n_rows)}' in \
+            metrics.to_json()
+
+
+class TestPsumReducedTrainers:
+    def test_nb_sharded_matches_plain(self, mesh):
+        rows = churn_rows(333, seed=4)
+        fz = Featurizer(churn_schema()).fit(rows)
+        table = fz.transform(rows)
+        st = shard_table(table, mesh)
+        m_sh, meta_sh, _ = nb.train_sharded(st, mesh)
+        m_1, meta_1, _ = nb.train(table)
+        assert meta_sh.class_values == meta_1.class_values
+        for name in ("class_counts", "post_counts", "prior_counts",
+                     "cont_count"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(m_sh, name)),
+                np.asarray(getattr(m_1, name)), err_msg=name)
+
+    def test_nb_sharded_model_file_identical(self, mesh, tmp_path):
+        """The wire artifact — what downstream jobs actually consume —
+        must be byte-identical across chip counts."""
+        rows = churn_rows(207, seed=6)
+        fz = Featurizer(churn_schema()).fit(rows)
+        table = fz.transform(rows)
+        m_1, meta, _ = nb.train(table)
+        nb.save_model(m_1, meta, str(tmp_path / "single.txt"))
+        st = shard_table(table, mesh)
+        m_sh, meta_sh, _ = nb.train_sharded(st, mesh)
+        nb.save_model(m_sh, meta_sh, str(tmp_path / "sharded.txt"))
+        assert (tmp_path / "single.txt").read_bytes() == \
+            (tmp_path / "sharded.txt").read_bytes()
+
+    def test_mi_distributions_sharded(self, mesh):
+        from avenir_tpu.explore import mutual_information as mi
+        schema = FeatureSchema.from_json({
+            "fields": [
+                {"name": "id", "ordinal": 0, "id": True,
+                 "dataType": "string"},
+                {"name": "f1", "ordinal": 1, "dataType": "categorical",
+                 "cardinality": ["a", "b"], "feature": True},
+                {"name": "f2", "ordinal": 2, "dataType": "categorical",
+                 "cardinality": ["x", "y", "z"], "feature": True},
+                {"name": "cls", "ordinal": 3, "dataType": "categorical",
+                 "cardinality": ["0", "1"]},
+            ]})
+        rng = np.random.default_rng(2)
+        rows = [[f"r{i}", "ab"[rng.integers(2)], "xyz"[rng.integers(3)],
+                 "01"[rng.integers(2)]] for i in range(211)]
+        table = Featurizer(schema).fit_transform(rows)
+        plain = mi.compute_distributions(table)
+        st = shard_table(table, mesh)
+        sharded = mi.compute_distributions(st.table, mesh=mesh,
+                                           mask=st.mask)
+        for field in ("class_counts", "feature", "feature_class",
+                      "feature_pair", "feature_pair_class"):
+            np.testing.assert_array_equal(
+                getattr(sharded, field), getattr(plain, field),
+                err_msg=field)
+
+    def test_psum_reduce_histogram(self, mesh):
+        """The generic helper over a raw ops/histogram reduction."""
+        from avenir_tpu.ops.histogram import pair_counts
+        rng = np.random.default_rng(5)
+        a = rng.integers(0, 4, 128).astype(np.int32)
+        b = rng.integers(0, 6, 128).astype(np.int32)
+        w = np.ones(128, np.float32)
+
+        got = collective.psum_reduce(_pair_counts_46, mesh,
+                                     jnp.asarray(a), jnp.asarray(b),
+                                     jnp.asarray(w))
+        want = pair_counts(jnp.asarray(a), jnp.asarray(b), 4, 6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_psum_program_cache_reused(self, mesh):
+        """A stable fn + mesh must hit the cached compiled program, not
+        re-mint one per call (the compile-cache-leak discipline)."""
+        n_before = len(collective._PSUM_PROGRAMS)
+        rng = np.random.default_rng(6)
+        for _ in range(3):
+            a = rng.integers(0, 4, 64).astype(np.int32)
+            b = rng.integers(0, 6, 64).astype(np.int32)
+            collective.psum_reduce(_pair_counts_46, mesh, jnp.asarray(a),
+                                   jnp.asarray(b),
+                                   jnp.asarray(np.ones(64, np.float32)))
+        assert len(collective._PSUM_PROGRAMS) <= n_before + 1
+
+
+def _pair_counts_46(a, b, w):
+    from avenir_tpu.ops.histogram import pair_counts
+    return pair_counts(a, b, 4, 6, w)
+
+
+class TestStagedTelemetryPath:
+    def test_staged_equals_fused_and_records_spans(self, mesh):
+        from avenir_tpu.obs import telemetry
+        rng = np.random.default_rng(11)
+        x = rng.random((23, 6), dtype=np.float32)
+        y = rng.random((90, 6), dtype=np.float32)
+        (y_d,), y_valid, n_real = collective.shard_train_rows((y,), mesh)
+        kw = dict(mesh=mesh, k=4, y_valid=y_valid, n_real=n_real,
+                  mode="exact")
+        d_f, i_f = collective.sharded_topk(jnp.asarray(x), y_d, **kw,
+                                           staged=False)
+        tracer = telemetry.tracer()
+        tracer.reset()
+        was = tracer.enabled
+        telemetry.enable(True)
+        try:
+            # staged=None + enabled tracer auto-selects the staged path
+            d_s, i_s = collective.sharded_topk(jnp.asarray(x), y_d, **kw)
+        finally:
+            telemetry.enable(was)
+        np.testing.assert_array_equal(np.asarray(d_s), np.asarray(d_f))
+        np.testing.assert_array_equal(np.asarray(i_s), np.asarray(i_f))
+        snap = tracer.snapshot()
+        for span in ("collective.shard_compute", "collective.gather",
+                     "collective.merge"):
+            assert span in snap and snap[span]["count"] == 1, snap.keys()
+        tracer.reset()
+
+    def test_imbalance_gauge_published(self, mesh):
+        from avenir_tpu.obs import telemetry
+        from avenir_tpu.obs.exporters import TelemetryHub
+        hub = TelemetryHub.get()
+        hub.reset()
+        hub.enable()
+        try:
+            rows = churn_rows(120, seed=8)
+            fz = Featurizer(churn_schema()).fit(rows)
+            train = fz.transform(rows)
+            test = fz.transform(churn_rows(9, seed=9))
+            knn.classify(train, test,
+                         knn.KnnConfig(mode="exact", sharded=True))
+            assert "collective.imbalance" in hub._gauges
+            # 120 rows over 8 shards: perfectly balanced
+            assert hub._gauges["collective.imbalance"] == 0.0
+        finally:
+            hub.disable()
+            hub.reset()
+            telemetry.tracer().reset()
+
+    def test_imbalance_value(self):
+        # 9 real rows on 8 shards -> padded to 16, shards get 2,2,2,2,1,
+        # 0... -> per-shard real counts [2,2,2,2,1,0,0,0]; mean 9/8
+        mask = np.zeros(16, np.float32)
+        mask[:9] = 1.0
+        imb = collective.shard_imbalance(mask, 8)
+        assert imb == pytest.approx((2 - 9 / 8) / (9 / 8))
+        assert collective.shard_imbalance(np.ones(16, np.float32), 8) == 0.0
+
+
+class TestFeedReplicatedStaging:
+    def test_chunks_land_replicated(self, mesh):
+        """DeviceFeed(device=replicated(mesh)) must yield chunks that are
+        ALREADY mesh-replicated — no consume-side reshard."""
+        from avenir_tpu.parallel.pipeline import DeviceFeed
+        rng = np.random.default_rng(13)
+        arr = rng.random((100, 4), dtype=np.float32)
+        feed = DeviceFeed.from_arrays((arr, None), chunk_rows=32,
+                                      device=collective.replicated(mesh),
+                                      bucket_floor=32)
+        seen = 0
+        for fc in feed:
+            a = fc.arrays[0]
+            assert a.sharding.is_fully_replicated
+            assert len(a.sharding.device_set) == len(mesh.devices.flat)
+            seen += fc.n_rows
+        assert seen == 100
+
+    def test_sharded_feed_classify_matches(self):
+        rows = churn_rows(280, seed=14)
+        fz = Featurizer(churn_schema()).fit(rows)
+        train = fz.transform(rows)
+        test = fz.transform(churn_rows(75, seed=15))
+        base = knn.classify(train, test, knn.KnnConfig(mode="exact"))
+        fed = knn.classify(train, test, knn.KnnConfig(
+            mode="exact", sharded=True, feed_chunk_rows=32))
+        np.testing.assert_array_equal(base.predicted, fed.predicted)
+        np.testing.assert_array_equal(base.neighbor_idx, fed.neighbor_idx)
+        np.testing.assert_array_equal(base.neighbor_dist,
+                                      fed.neighbor_dist)
+
+
+def _write_churn_schema(tmp_path):
+    import json as _json
+    from avenir_tpu.datagen.generators import _CHURN_SCHEMA_JSON
+    schema_path = tmp_path / "churn.json"
+    schema_path.write_text(_json.dumps(_CHURN_SCHEMA_JSON))
+    return schema_path
+
+
+class TestCliWireThrough:
+    def _knn_props(self, tmp_path, extra=""):
+        rows = churn_rows(260, seed=16)
+        test_rows = churn_rows(61, seed=17)
+        with open(tmp_path / "train.csv", "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows) + "\n")
+        with open(tmp_path / "test.csv", "w") as fh:
+            fh.write("\n".join(",".join(r) for r in test_rows) + "\n")
+        schema_path = _write_churn_schema(tmp_path)
+        props = tmp_path / "knn.properties"
+        props.write_text(
+            "field.delim.regex=,\nfield.delim=,\n"
+            f"feature.schema.file.path={schema_path}\n"
+            f"train.data.path={tmp_path}/train.csv\n"
+            "top.match.count=5\nknn.mode=exact\n" + extra)
+        return props
+
+    def test_knn_sharded_output_identical(self, tmp_path):
+        from avenir_tpu.cli.main import main as cli
+        props = self._knn_props(tmp_path)
+        cli(["NearestNeighbor", str(tmp_path / "test.csv"),
+             str(tmp_path / "out_single.txt"), "--conf", str(props)])
+        cli(["NearestNeighbor", str(tmp_path / "test.csv"),
+             str(tmp_path / "out_sharded.txt"), "--conf", str(props),
+             "-D", "knn.sharded=true"])
+        assert (tmp_path / "out_single.txt").read_bytes() == \
+            (tmp_path / "out_sharded.txt").read_bytes()
+
+    def test_knn_sharded_mesh_shape_submesh(self, tmp_path, caplog):
+        """mesh.shape=2 runs a 2-device sub-mesh (and warns about the 6
+        idle devices — the satellite's signal, end to end)."""
+        from avenir_tpu.cli.main import main as cli
+        props = self._knn_props(tmp_path)
+        with caplog.at_level(logging.WARNING,
+                             logger="avenir_tpu.parallel.mesh"):
+            cli(["NearestNeighbor", str(tmp_path / "test.csv"),
+                 str(tmp_path / "out_m2.txt"), "--conf", str(props),
+                 "-D", "knn.sharded=true", "-D", "mesh.shape=2"])
+        cli(["NearestNeighbor", str(tmp_path / "test.csv"),
+             str(tmp_path / "out_single.txt"), "--conf", str(props)])
+        assert (tmp_path / "out_m2.txt").read_bytes() == \
+            (tmp_path / "out_single.txt").read_bytes()
+        assert any("sit idle" in r.getMessage()
+                   for r in caplog.records)
+
+    def test_nb_train_sharded_model_identical(self, tmp_path):
+        from avenir_tpu.cli.main import main as cli
+        rows = churn_rows(220, seed=18)
+        with open(tmp_path / "in.csv", "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows) + "\n")
+        schema_path = _write_churn_schema(tmp_path)
+        props = tmp_path / "nb.properties"
+        props.write_text("field.delim.regex=,\nfield.delim=,\n"
+                         f"feature.schema.file.path={schema_path}\n")
+        cli(["BayesianDistribution", str(tmp_path / "in.csv"),
+             str(tmp_path / "model_single.txt"), "--conf", str(props)])
+        cli(["BayesianDistribution", str(tmp_path / "in.csv"),
+             str(tmp_path / "model_sharded.txt"), "--conf", str(props),
+             "-D", "train.sharded=true"])
+        assert (tmp_path / "model_single.txt").read_bytes() == \
+            (tmp_path / "model_sharded.txt").read_bytes()
+
+
+def test_multichip_smoke_script():
+    """CI hook (satellite): the smoke script runs the sharded KNN + NB
+    paths on the simulated 8-device CPU platform on every tier-1 run."""
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "multichip_smoke.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)        # the script sets its own 8-device flag
+    proc = subprocess.run([sys.executable, script], env=env,
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "multichip_smoke OK" in proc.stdout
