@@ -1300,32 +1300,70 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
     as ``eventID,action[,action...]``; rewards drained from
     ``reward.data.path`` lines ``action,reward`` before each event, like
     the bolt (ReinforcementLearnerBolt.java:93-125). A Redis deployment
-    uses avenir_tpu.stream.RedisQueues instead of files."""
+    uses avenir_tpu.stream.RedisQueues instead of files.
+
+    ``serving.engine=true`` routes through the pipelined ``ServingEngine``
+    (stream/engine.py): identical output for this job's statically
+    pre-filled queues (the bit-parity contract), overlap + bulk-transport
+    throughput for live queue deployments. Engine knobs:
+    ``engine.min.batch`` / ``engine.max.batch`` (adaptive micro-batch
+    bounds) and ``engine.reward.drain.max`` (bounded reward sweep).
+    CAVEAT: bit-parity with the loop holds at the DEFAULT
+    ``engine.max.batch`` (the loop's own 64-event cap); a smaller cap
+    changes the select chunking, and with it the realization stream of
+    stochastic algorithms (one PRNG split per chunk) — same
+    distribution, different draws.
+    The engine owns no checkpoints — its durability story is the broker's
+    ack/replay ledger — so it refuses ``checkpoint.dir``."""
     from avenir_tpu.stream.loop import InProcQueues, OnlineLearnerLoop
     learner_type = conf.get_required("learner.type")
     actions = conf.get_list("action.list")
     if not actions:
         raise ValueError("action.list must name the candidate actions")
+    use_engine = conf.get_bool("serving.engine", False)
+    if use_engine and conf.get("checkpoint.dir"):
+        raise ValueError(
+            "serving.engine=true does not checkpoint (durability is the "
+            "broker ledger's job); unset checkpoint.dir or serving.engine")
     queues = InProcQueues()
-    with OnlineLearnerLoop(
-            learner_type, actions, conf.as_dict(), queues,
-            seed=conf.get_int("random.seed", 0),
-            checkpoint_dir=conf.get("checkpoint.dir"),
-            checkpoint_interval=conf.get_int("checkpoint.interval", 100)
-            ) as loop:
-        # the event file is re-read in full on restart; skip the lines a
-        # restored checkpoint already served (rewards are skipped inside
-        # the loop, which sees the re-drained reward stream itself)
+
+    def fill(resumed_events: int = 0) -> None:
         event_rows = read_csv_lines(in_path,
                                     conf.get("field.delim.regex", ","))
-        for row in event_rows[loop.resumed_events:]:
+        for row in event_rows[resumed_events:]:
             queues.push_event(row[0])
         reward_path = conf.get("reward.data.path")
         if reward_path:
             for row in read_csv_lines(reward_path,
                                       conf.get("field.delim.regex", ",")):
                 queues.push_reward(row[0], float(row[1]))
-        stats = loop.run()
+
+    extra = ""
+    if use_engine:
+        from avenir_tpu.stream.engine import ServingEngine
+        fill()
+        engine = ServingEngine(
+            learner_type, actions, conf.as_dict(), queues,
+            seed=conf.get_int("random.seed", 0),
+            min_batch=conf.get_int("engine.min.batch", 8),
+            max_batch=conf.get_int("engine.max.batch", 0) or None,
+            drain_max=conf.get_int("engine.reward.drain.max", 0) or None)
+        stats = engine.run()
+        extra = (f', "overlap_fraction": '
+                 f'{round(stats.overlap_fraction, 3)}'
+                 f', "batches": {stats.batches}')
+    else:
+        with OnlineLearnerLoop(
+                learner_type, actions, conf.as_dict(), queues,
+                seed=conf.get_int("random.seed", 0),
+                checkpoint_dir=conf.get("checkpoint.dir"),
+                checkpoint_interval=conf.get_int("checkpoint.interval", 100)
+                ) as loop:
+            # the event file is re-read in full on restart; skip the lines
+            # a restored checkpoint already served (rewards are skipped
+            # inside the loop, which sees the re-drained reward stream)
+            fill(loop.resumed_events)
+            stats = loop.run()
     delim_out = conf.get("field.delim", ",")
     with open(out_path, "w") as fh:
         while True:
@@ -1335,7 +1373,7 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
             event_id, selections = entry
             fh.write(delim_out.join([event_id] + selections) + "\n")
     print(f'{{"events": {stats.events}, "rewards": {stats.rewards}, '
-          f'"actions": {stats.actions_written}}}')
+          f'"actions": {stats.actions_written}{extra}}}')
 
 
 # a retried attempt would resume from checkpoint.dir and emit only the

@@ -236,6 +236,14 @@ class TelemetryHub:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def set_gauges(self, values: Dict[str, float]) -> None:
+        """Publish several gauges under one lock acquisition (the serving
+        engine's per-run gauge sweep: overlap fraction, queue depth,
+        reward backlog)."""
+        with self._lock:
+            for name, value in values.items():
+                self._gauges[name] = float(value)
+
     # -- outputs -----------------------------------------------------------
     def counters(self) -> Dict[str, float]:
         merged: Dict[str, float] = {}

@@ -3,6 +3,9 @@
 - ``loop``      — OnlineLearnerLoop (the bolt), GroupedLearner (the
                   multi-context ReinforcementLearnerGroup), in-proc +
                   Redis-wire queue adapters
+- ``engine``    — ServingEngine / GroupedServingEngine: the pipelined
+                  serving path (overlap select dispatch with queue I/O,
+                  bulk Redis transport, adaptive micro-batching)
 - ``miniredis`` — self-contained RESP list broker + client (the Redis
                   wire contract without external infrastructure)
 - ``scaleout``  — N-worker-process serving over one broker with per-group
@@ -10,9 +13,13 @@
                   ReinforcementLearnerTopology.java:64-82)
 """
 
+from avenir_tpu.stream.engine import (
+    EngineStats, GroupedServingEngine, ServingEngine,
+)
 from avenir_tpu.stream.loop import (
     GroupedLearner, InProcQueues, LoopStats, OnlineLearnerLoop, RedisQueues,
 )
 
-__all__ = ["GroupedLearner", "InProcQueues", "LoopStats",
-           "OnlineLearnerLoop", "RedisQueues"]
+__all__ = ["EngineStats", "GroupedLearner", "GroupedServingEngine",
+           "InProcQueues", "LoopStats", "OnlineLearnerLoop", "RedisQueues",
+           "ServingEngine"]

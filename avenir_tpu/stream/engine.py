@@ -1,0 +1,479 @@
+"""Pipelined online-serving engine: overlap bandit select with queue I/O.
+
+``OnlineLearnerLoop.run`` is fully synchronous: drain rewards, select a
+micro-batch, WAIT for the device, write every action to the queue one
+broker round trip at a time, repeat. Two costs serialize there that never
+needed to: (1) the host sits idle while the jitted select runs, then the
+device sits idle while the host talks to Redis — the exact gap the batch
+side closed with ``parallel.pipeline.DeviceFeed`` (DESIGN.md §10); and
+(2) a 64-event batch costs ~130 broker round trips (64 RPOPLPUSH pops,
+64 LPUSH+LREM answer/acks, a LINDEX walk per reward). This module applies
+the standard continuous-batching serving recipe (Clipper-style adaptive
+batching, PAPERS.md) to the always-on path:
+
+- **Dispatch-then-fetch** (`ServingEngine`): batch n+1's select is
+  dispatched (async, no readback) BEFORE batch n's actions are fetched
+  and written, so the device computes while the host does queue I/O and
+  the host only blocks when a result is genuinely late. The learner's
+  state buffers are donated to every step on TPU/GPU
+  (``learners._donate_state_argnums``), so the update never copies state.
+- **Bulk transport**: one pipelined RPOPLPUSH sweep pops the batch, one
+  bounded LRANGE sweep drains rewards, one multi-value LPUSH writes every
+  answer, one pipelined LREM batch acks — ~3 round trips per batch
+  (``stream.loop.RedisQueues`` bulk ops), with the at-least-once
+  pending-ledger semantics and the reference's wire format per entry
+  unchanged.
+- **Adaptive micro-batching**: the event cap grows toward
+  ``Learner._SCAN_BUCKET_MAX`` while pops come back full (throughput
+  under backlog) and shrinks toward ``min_batch`` when the queue runs
+  shallow (latency when idle).
+
+Semantics vs ``run()``: for statically pre-filled queues the engine is
+BIT-EQUIVALENT — same seed, same action sequence, same queue bytes — by
+construction (it calls the identical ``next_action_batch_async`` /
+``set_reward_batch`` state evolution in the identical order; the cap
+starts at ``_SCAN_BUCKET_MAX`` so batch decomposition matches, and the
+drain bound is a multiple of the fused reward chunk so fold boundaries
+match). With a LIVE reward producer the pipeline's one-batch lag means a
+reward arriving while batch n is in flight folds before batch n+2's
+select (``run()`` folds it before n+1's) — one extra batch of staleness,
+the price of the overlap; use ``OnlineLearnerLoop.step`` when strict
+per-event interleaving matters.
+
+``GroupedServingEngine`` is the multi-context variant: events
+``"<group>:<id>"`` route through a host-side group-id->context-index dict
+(no ``list.index``), selects stay DEVICE-RESIDENT across waves (one
+vmapped dispatch advances every context; the wave's actions are fetched
+only after the next wave has been dispatched), and drained rewards
+``"<group>:<action>,<reward>"`` fold through the masked batched
+``reward_masked`` dispatch.
+
+Telemetry (all free while the tracer is disabled): spans
+``engine.select`` (host blocked on readback per batch) and ``engine.io``
+(broker I/O per batch), hub gauges ``engine.overlap_fraction``,
+``engine.queue_depth`` and ``engine.reward_backlog``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from avenir_tpu.models.bandits.learners import Learner
+from avenir_tpu.obs import telemetry
+
+
+@dataclass
+class EngineStats:
+    """Counters + overlap accounting for one engine run (cumulative
+    across repeated ``run`` calls on the same engine)."""
+
+    events: int = 0
+    rewards: int = 0
+    actions_written: int = 0
+    batches: int = 0
+    select_wait_ms: float = 0.0   # host blocked on device readback
+    io_ms: float = 0.0            # broker/queue I/O time
+    dispatch_ms: float = 0.0      # host time enqueueing device work
+    queue_depth: int = 0          # pending events (telemetry-gated poll)
+    reward_backlog: int = 0       # unread rewards after the last drain
+    batch_cap: int = 0            # adaptive cap when run() returned
+    # per-batch adaptive-cap trace, BOUNDED (always-on workers keep one
+    # engine alive for the process lifetime): oldest half drops past cap
+    cap_history: List[int] = field(default_factory=list)
+    _CAP_HISTORY_MAX = 1024
+
+    def note_cap(self, cap: int) -> None:
+        self.cap_history.append(cap)
+        if len(self.cap_history) > self._CAP_HISTORY_MAX:
+            del self.cap_history[:self._CAP_HISTORY_MAX // 2]
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Share of the host's non-compute time spent doing useful queue
+        I/O rather than blocked on the device: ``io / (io + select_wait)``.
+        1.0 means every readback found its result already materialized —
+        the queue I/O fully hid the device work; 0.0 means the engine
+        degenerated to the synchronous loop's wait-then-write."""
+        total = self.io_ms + self.select_wait_ms
+        if total <= 0.0:
+            return 1.0
+        return min(max(self.io_ms / total, 0.0), 1.0)
+
+
+def _pop_events(queues, max_n: int) -> List[str]:
+    bulk = getattr(queues, "pop_events", None)
+    if bulk is not None:
+        return bulk(max_n)
+    out = []
+    while len(out) < max_n:
+        event_id = queues.pop_event()
+        if event_id is None:
+            break
+        out.append(event_id)
+    return out
+
+
+def _drain_rewards(queues, max_items: Optional[int]) -> list:
+    try:
+        if max_items is None:
+            return queues.drain_rewards()
+        return queues.drain_rewards(max_items)
+    except TypeError:              # adapter without the bound parameter
+        return queues.drain_rewards()
+
+
+def _write_actions(queues, entries) -> None:
+    bulk = getattr(queues, "write_actions_bulk", None)
+    if bulk is not None:
+        bulk(entries)
+        return
+    for event_id, actions in entries:
+        queues.write_actions(event_id, actions)
+
+
+def _ack_events(queues, event_ids) -> None:
+    bulk = getattr(queues, "ack_events", None)
+    if bulk is not None:
+        bulk(event_ids)
+        return
+    for event_id in event_ids:
+        queues.ack_event(event_id)
+
+
+def _write_and_ack(queues, entries) -> None:
+    """Answer + ack a batch: one fused round trip when the adapter has
+    it (writes before acks in command order), the two-step
+    write-then-ack otherwise. Either way acks never precede writes."""
+    fused = getattr(queues, "write_and_ack", None)
+    if fused is not None:
+        fused(entries)
+        return
+    _write_actions(queues, entries)
+    _ack_events(queues, [event_id for event_id, _ in entries])
+
+
+def _publish_engine_gauges(stats: "EngineStats",
+                           extra: Optional[Dict[str, float]] = None
+                           ) -> None:
+    """Push the engine gauge set to the telemetry hub when (and only
+    when) it is live — shared by both engines so the set cannot drift.
+    Telemetry must never sink the engine."""
+    if not telemetry.tracer().enabled:
+        return
+    try:
+        from avenir_tpu.obs.exporters import TelemetryHub
+        hub = TelemetryHub._instance
+        if hub is not None and hub.enabled:
+            gauges = {
+                "engine.overlap_fraction": stats.overlap_fraction,
+                "engine.reward_backlog": stats.reward_backlog,
+            }
+            if extra:
+                gauges.update(extra)
+            hub.set_gauges(gauges)
+    except Exception:
+        pass
+
+
+class _AdaptiveCap:
+    """Micro-batch sizing under load: a full pop means backlog — double
+    toward ``hi`` for throughput; an underfilled pop means the queue ran
+    shallow — halve toward what actually arrived (floored at ``lo``) so
+    the next batch ships sooner. Starts wide open at ``hi``: a
+    pre-filled queue's first batch must match ``run()``'s decomposition
+    (the bit-parity contract)."""
+
+    def __init__(self, lo: int, hi: int):
+        self.lo = max(int(lo), 1)
+        self.hi = max(int(hi), self.lo)
+        self.cap = self.hi
+
+    def update(self, n_popped: int) -> int:
+        if n_popped >= self.cap:
+            self.cap = min(self.cap * 2, self.hi)
+        else:
+            # halve, but never below what actually arrived — a queue
+            # trickling 40/visit must not oscillate under a cap of 32
+            self.cap = max(self.lo, n_popped, self.cap // 2)
+        return self.cap
+
+
+class ServingEngine:
+    """The pipelined ReinforcementLearnerBolt: one jitted learner, queue
+    adapters in, dispatch-then-fetch out. See the module docstring for
+    the pipeline shape and the semantics contract vs ``run()``.
+
+    ``on_batch`` (optional) is called with the batch's event count after
+    each batch's answers are written+acked — the scale-out workers hang
+    their broker heartbeats on it.
+    """
+
+    def __init__(self, learner_type: str, actions: Sequence[str],
+                 config: Dict[str, Any], queues, *, seed: int = 0,
+                 min_batch: int = 8, max_batch: Optional[int] = None,
+                 drain_max: Optional[int] = None,
+                 learner: Optional[Learner] = None,
+                 on_batch: Optional[Callable[[int], None]] = None):
+        self.learner = (learner if learner is not None
+                        else Learner(learner_type, actions, config, seed))
+        self.queues = queues
+        self.stats = EngineStats()
+        self._cap = _AdaptiveCap(min_batch,
+                                 max_batch or Learner._SCAN_BUCKET_MAX)
+        self._drain_max = drain_max
+        self._on_batch = on_batch
+        self._tel = telemetry.tracer()
+        self.stats.batch_cap = self._cap.cap
+
+    # -- pipeline stages -----------------------------------------------------
+
+    def _fold_rewards(self) -> Tuple[float, int]:
+        """Bounded bulk drain + async fold dispatch; returns (I/O seconds
+        spent talking to the broker, pairs folded) — the fold dispatch
+        itself is device-bound host work, accounted separately."""
+        t0 = time.perf_counter()
+        pairs = _drain_rewards(self.queues, self._drain_max)
+        io_s = time.perf_counter() - t0
+        if pairs:
+            self.learner.set_reward_batch(pairs)
+            self.stats.rewards += len(pairs)
+        backlog = getattr(self.queues, "reward_backlog", None)
+        if backlog is not None:
+            self.stats.reward_backlog = int(backlog)
+        return io_s, len(pairs)
+
+    def _complete(self, events: List[str], handles, batch_size: int) -> None:
+        """Finish an in-flight batch: the ONLY blocking readback on the
+        path, then the batch's bulk write + bulk ack. Ack strictly after
+        write — a death in between replays the batch (at-least-once via
+        the pending ledger)."""
+        t0 = time.perf_counter()
+        selections = self.learner.resolve_action_batch(handles)
+        t1 = time.perf_counter()
+        entries = [(event_id,
+                    selections[i * batch_size:(i + 1) * batch_size])
+                   for i, event_id in enumerate(events)]
+        _write_and_ack(self.queues, entries)
+        t2 = time.perf_counter()
+        self.stats.select_wait_ms += (t1 - t0) * 1e3
+        self.stats.io_ms += (t2 - t1) * 1e3
+        self.stats.events += len(events)
+        self.stats.actions_written += sum(len(e[1]) for e in entries)
+        self.stats.batches += 1
+        self.stats.note_cap(self._cap.cap)
+        if self._tel.enabled:
+            self._tel.record("engine.select", (t1 - t0) * 1e3)
+            self._tel.record("engine.io", (t2 - t1) * 1e3)
+            depth = (self.queues.depth()
+                     if hasattr(self.queues, "depth") else None)
+            if depth is not None:
+                self.stats.queue_depth = depth
+        if self._on_batch is not None:
+            self._on_batch(len(events))
+
+    def _publish_gauges(self) -> None:
+        _publish_engine_gauges(
+            self.stats,
+            extra={"engine.queue_depth": self.stats.queue_depth})
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self, max_events: Optional[int] = None) -> EngineStats:
+        """Drain the queues to completion (or ``max_events``), pipelined.
+        Per iteration: fold drained rewards, pop the next micro-batch,
+        DISPATCH its select, and only then do batch n-1's readback +
+        queue I/O — which the device hides behind batch n's compute."""
+        learner = self.learner
+        batch_size = learner.cfg.batch_size
+        processed = 0
+        pending: Optional[Tuple[List[str], Any]] = None
+        last_folded = 0
+        while True:
+            io_s, last_folded = self._fold_rewards()
+            t0 = time.perf_counter()
+            cap = self._cap.cap
+            if max_events is not None:
+                cap = min(cap, max_events - processed)
+            events = _pop_events(self.queues, cap)
+            t1 = time.perf_counter()
+            handles = None
+            if events:
+                handles = learner.next_action_batch_async(
+                    len(events) * batch_size)
+            t2 = time.perf_counter()
+            self.stats.io_ms += (io_s + (t1 - t0)) * 1e3
+            self.stats.dispatch_ms += (t2 - t1) * 1e3
+            if self._tel.enabled and (io_s or events):
+                self._tel.record("engine.io", (io_s + (t1 - t0)) * 1e3)
+            if pending is not None:
+                self._complete(pending[0], pending[1], batch_size)
+            if not events:
+                break
+            pending = (events, handles)
+            processed += len(events)
+            if max_events is None or processed < max_events:
+                self._cap.update(len(events))
+        # queue drained: fold any reward backlog the bounded sweeps left
+        # (run()'s exit contract — nothing left to starve). The loop's
+        # final drain already came back empty unless it hit the bound.
+        while last_folded:
+            _, last_folded = self._fold_rewards()
+        self.stats.batch_cap = self._cap.cap
+        self._publish_gauges()
+        return self.stats
+
+
+class GroupedServingEngine:
+    """Multi-context serving over one stacked ``GroupedLearner``.
+
+    Events are ``"<group>:<rest>"``; rewards are payloads
+    ``"<group>:<action>,<reward>"`` (the action_id field carries the
+    group prefix). A micro-batch is organized into WAVES — wave w holds
+    the w-th pending event of each context — and each wave is ONE vmapped
+    ``next_all_async`` dispatch whose [G] actions array stays on device
+    until the next wave is in flight (device-resident dispatch).
+
+    DOCUMENTED DEVIATION from per-context ``OnlineLearnerLoop`` serving:
+    a vmapped step advances EVERY context, so in a wave where context g
+    has no pending event, g's learner still takes its step and the drawn
+    action is discarded (never written, never counted). Contexts with
+    balanced traffic — the GroupedLearner deployment shape — see exactly
+    the per-context sequence they would have seen serving alone.
+    """
+
+    def __init__(self, learner_type: str, groups: Sequence[str],
+                 actions: Sequence[str], config: Dict[str, Any], queues, *,
+                 seed: int = 0, min_batch: int = 8,
+                 max_batch: Optional[int] = None,
+                 drain_max: Optional[int] = None, delim: str = ":",
+                 on_batch: Optional[Callable[[int], None]] = None):
+        from avenir_tpu.stream.loop import GroupedLearner
+        self.groups = list(groups)
+        # the host-side id<->index dicts: group routing and reward
+        # resolution are O(1) lookups, never list.index
+        self._group_index = {g: i for i, g in enumerate(self.groups)}
+        self.gl = GroupedLearner(learner_type, len(self.groups), actions,
+                                 config, seed)
+        self.queues = queues
+        self.stats = EngineStats()
+        self._cap = _AdaptiveCap(min_batch,
+                                 max_batch or Learner._SCAN_BUCKET_MAX)
+        self._drain_max = drain_max
+        self._delim = delim
+        self._on_batch = on_batch
+        self._tel = telemetry.tracer()
+
+    def _split_group(self, payload: str) -> Tuple[int, str]:
+        group, _, rest = payload.partition(self._delim)
+        idx = self._group_index.get(group)
+        if idx is None:
+            raise ValueError(f"unknown group {group!r} in {payload!r}")
+        return idx, rest
+
+    def _fold_rewards(self) -> None:
+        """Drain ``group:action`` rewards and fold them as masked batched
+        dispatches: one ``reward_masked`` per reward-wave (a wave holds at
+        most one reward per context), preserving per-context order."""
+        t0 = time.perf_counter()
+        pairs = _drain_rewards(self.queues, self._drain_max)
+        self.stats.io_ms += (time.perf_counter() - t0) * 1e3
+        if not pairs:
+            return
+        n = len(self.groups)
+        # wave w = the w-th reward of each context, assigned by a
+        # per-context counter (O(pairs); a linear wave scan would be
+        # quadratic when rewards concentrate on one context)
+        waves: List[Dict[int, Tuple[int, float]]] = []
+        depth: Dict[int, int] = {}
+        for action_id, reward in pairs:
+            gidx, action = self._split_group(action_id)
+            aidx = self.gl._resolve_action(action)
+            w = depth.get(gidx, 0)
+            depth[gidx] = w + 1
+            if w == len(waves):
+                waves.append({})
+            waves[w][gidx] = (aidx, reward)
+        for wave in waves:
+            idx = [0] * n
+            rew = [0.0] * n
+            mask = [False] * n
+            for gidx, (aidx, reward) in wave.items():
+                idx[gidx], rew[gidx], mask[gidx] = aidx, reward, True
+            self.gl.reward_masked(idx, rew, mask)
+        self.stats.rewards += len(pairs)
+        backlog = getattr(self.queues, "reward_backlog", None)
+        if backlog is not None:
+            self.stats.reward_backlog = int(backlog)
+
+    def _make_waves(self, events: List[str]) -> List[List[Tuple[str, int]]]:
+        """Wave w = the w-th pending event of each context, in pop order
+        (per-context counters: O(events), not a per-event wave scan)."""
+        waves: List[List[Tuple[str, int]]] = []
+        depth: Dict[int, int] = {}
+        for event_id in events:
+            gidx, _ = self._split_group(event_id)
+            w = depth.get(gidx, 0)
+            depth[gidx] = w + 1
+            if w == len(waves):
+                waves.append([])
+            waves[w].append((event_id, gidx))
+        return waves
+
+    def _complete(self, waves, handles) -> None:
+        import numpy as np
+        t0 = time.perf_counter()
+        resolved = [np.asarray(h) for h in handles]   # the blocking fetch
+        t1 = time.perf_counter()
+        entries = []
+        for wave, actions in zip(waves, resolved):
+            for event_id, gidx in wave:
+                entries.append((event_id, [self.gl.actions[int(
+                    actions[gidx])]]))
+        _write_and_ack(self.queues, entries)
+        t2 = time.perf_counter()
+        n_events = sum(len(w) for w in waves)
+        self.stats.select_wait_ms += (t1 - t0) * 1e3
+        self.stats.io_ms += (t2 - t1) * 1e3
+        self.stats.events += n_events
+        self.stats.actions_written += n_events
+        self.stats.batches += 1
+        self.stats.note_cap(self._cap.cap)
+        if self._tel.enabled:
+            self._tel.record("engine.select", (t1 - t0) * 1e3)
+            self._tel.record("engine.io", (t2 - t1) * 1e3)
+        if self._on_batch is not None:
+            self._on_batch(n_events)
+
+    def run(self, max_events: Optional[int] = None) -> EngineStats:
+        processed = 0
+        pending = None
+        while True:
+            self._fold_rewards()
+            t0 = time.perf_counter()
+            cap = self._cap.cap
+            if max_events is not None:
+                cap = min(cap, max_events - processed)
+            events = _pop_events(self.queues, cap)
+            self.stats.io_ms += (time.perf_counter() - t0) * 1e3
+            waves = self._make_waves(events) if events else []
+            t1 = time.perf_counter()
+            handles = [self.gl.next_all_async() for _ in waves]
+            self.stats.dispatch_ms += (time.perf_counter() - t1) * 1e3
+            if pending is not None:
+                self._complete(pending[0], pending[1])
+            if not events:
+                break
+            pending = (waves, handles)
+            processed += len(events)
+            if max_events is None or processed < max_events:
+                self._cap.update(len(events))
+        while True:
+            before = self.stats.rewards
+            self._fold_rewards()
+            if self.stats.rewards == before:
+                break
+        self.stats.batch_cap = self._cap.cap
+        _publish_engine_gauges(self.stats)
+        return self.stats

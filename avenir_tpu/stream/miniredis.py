@@ -128,6 +128,16 @@ class MiniRedisServer:
                 return b":%d\r\n" % len(q)
             if name == b"RPOP":
                 q = self._lists.get(args[0])
+                if len(args) >= 2:
+                    # Redis 6.2 count form: array of up to count popped
+                    # values (oldest first under lpush producers), null
+                    # array when the key is empty/missing
+                    count = int(args[1])
+                    if not q:
+                        return b"*-1\r\n"
+                    popped = [q.pop() for _ in range(min(count, len(q)))]
+                    return b"*%d\r\n" % len(popped) + b"".join(
+                        _encode_bulk(v) for v in popped)
                 return _encode_bulk(q.pop() if q else None)
             if name == b"RPOPLPUSH":
                 # atomic move (the reliable-queue primitive the ack/replay
@@ -143,6 +153,24 @@ class MiniRedisServer:
                 count, val = int(args[1]), args[2]
                 if not q:
                     return b":0\r\n"
+                if count == 1:
+                    # the ledger-ack hot path (64 per engine batch):
+                    # deque.remove is the same head-first first-match
+                    # semantics at C speed, no list rebuild
+                    try:
+                        q.remove(val)
+                        return b":1\r\n"
+                    except ValueError:
+                        return b":0\r\n"
+                if count == -1:
+                    try:
+                        q.reverse()
+                        q.remove(val)
+                        return b":1\r\n"
+                    except ValueError:
+                        return b":0\r\n"
+                    finally:
+                        q.reverse()
                 # count>0: head-first; count<0: tail-first; 0: all
                 removed, items = 0, list(q)   # index 0 = head (LPUSH side)
                 if count < 0:
@@ -197,26 +225,60 @@ class MiniRedisServer:
 # client (the redis-py subset RedisQueues consumes)
 # --------------------------------------------------------------------------
 
+def _encode_command(parts) -> bytes:
+    return b"*%d\r\n" % len(parts) + b"".join(
+        b"$%d\r\n%s\r\n" % (len(p), p) for p in parts)
+
+
 class MiniRedisClient:
     """Tiny blocking client; method-compatible with redis.StrictRedis for
-    the list commands (returns bytes, like redis-py without decoding)."""
+    the list commands (returns bytes, like redis-py without decoding).
+
+    ``pipeline()`` returns a buffering view with the same command
+    methods: N commands go out in ONE socket write and the N replies are
+    read back together — the transport primitive that collapses the
+    serving loop's per-event round trips. ``calls`` counts broker round
+    trips (a pipeline ``execute`` is one), which the serving bench uses
+    to report round-trips-per-batch."""
 
     def __init__(self, host: str = "localhost", port: int = 6379,
                  timeout: float = 30.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._rfile = self._sock.makefile("rb")
         self._lock = threading.Lock()
+        self.calls = 0
 
     def close(self) -> None:
         self._rfile.close()
         self._sock.close()
 
     def _call(self, *parts: bytes):
-        msg = b"*%d\r\n" % len(parts) + b"".join(
-            b"$%d\r\n%s\r\n" % (len(p), p) for p in parts)
+        msg = _encode_command(parts)
         with self._lock:
+            self.calls += 1
             self._sock.sendall(msg)
             return self._reply()
+
+    def _call_many(self, commands):
+        """One write carrying every buffered command, then the matching
+        replies in order (the pipeline transport). Error replies are
+        collected — never left unread, which would desync the stream —
+        and the first one raises after the batch completes."""
+        msg = b"".join(_encode_command(parts) for parts in commands)
+        with self._lock:
+            self.calls += 1
+            self._sock.sendall(msg)
+            replies, first_err = [], None
+            for _ in commands:
+                try:
+                    replies.append(self._reply())
+                except RuntimeError as exc:   # -ERR reply: stream is intact
+                    replies.append(exc)
+                    if first_err is None:
+                        first_err = exc
+        if first_err is not None:
+            raise first_err
+        return replies
 
     def _reply(self):
         line = _read_line(self._rfile)
@@ -234,7 +296,10 @@ class MiniRedisClient:
                 raise ConnectionError("short bulk reply")
             return body[:-2]
         if kind == b"*":
-            return [self._reply() for _ in range(int(rest))]
+            n = int(rest)
+            if n < 0:                     # null array (RPOP count on empty)
+                return None
+            return [self._reply() for _ in range(n)]
         if kind == b"-":
             raise RuntimeError(rest.decode())
         raise ConnectionError(f"unexpected reply {line!r}")
@@ -243,6 +308,9 @@ class MiniRedisClient:
     def _b(v) -> bytes:
         return v if isinstance(v, bytes) else str(v).encode()
 
+    def pipeline(self) -> "MiniRedisPipeline":
+        return MiniRedisPipeline(self)
+
     def ping(self):
         return self._call(b"PING")
 
@@ -250,7 +318,9 @@ class MiniRedisClient:
         return self._call(b"LPUSH", self._b(key),
                           *[self._b(v) for v in values])
 
-    def rpop(self, key) -> Optional[bytes]:
+    def rpop(self, key, count: Optional[int] = None):
+        if count is not None:
+            return self._call(b"RPOP", self._b(key), self._b(count))
         return self._call(b"RPOP", self._b(key))
 
     def rpoplpush(self, src, dst) -> Optional[bytes]:
@@ -275,6 +345,59 @@ class MiniRedisClient:
 
     def flushall(self):
         return self._call(b"FLUSHALL")
+
+
+class MiniRedisPipeline:
+    """Buffered command batch over one client: the redis-py ``pipeline``
+    subset (transaction-less). Command methods mirror the client's,
+    return ``self`` for chaining, and ``execute()`` ships the batch in
+    one round trip, returning the replies in command order."""
+
+    def __init__(self, client: MiniRedisClient):
+        self._client = client
+        self._commands: List[tuple] = []
+
+    def __len__(self) -> int:
+        return len(self._commands)
+
+    def _queue(self, *parts: bytes) -> "MiniRedisPipeline":
+        self._commands.append(parts)
+        return self
+
+    def lpush(self, key, *values):
+        return self._queue(b"LPUSH", self._client._b(key),
+                           *[self._client._b(v) for v in values])
+
+    def rpop(self, key, count: Optional[int] = None):
+        if count is not None:
+            return self._queue(b"RPOP", self._client._b(key),
+                               self._client._b(count))
+        return self._queue(b"RPOP", self._client._b(key))
+
+    def rpoplpush(self, src, dst):
+        return self._queue(b"RPOPLPUSH", self._client._b(src),
+                           self._client._b(dst))
+
+    def lrem(self, key, count, value):
+        return self._queue(b"LREM", self._client._b(key),
+                           self._client._b(count), self._client._b(value))
+
+    def lrange(self, key, start, stop):
+        return self._queue(b"LRANGE", self._client._b(key),
+                           self._client._b(start), self._client._b(stop))
+
+    def lindex(self, key, index):
+        return self._queue(b"LINDEX", self._client._b(key),
+                           self._client._b(index))
+
+    def llen(self, key):
+        return self._queue(b"LLEN", self._client._b(key))
+
+    def execute(self) -> List:
+        commands, self._commands = self._commands, []
+        if not commands:
+            return []
+        return self._client._call_many(commands)
 
 
 def connect_with_retry(host: str, port: int,

@@ -162,6 +162,21 @@ class _StoppableQueues(RedisQueues):
             return None
         return event
 
+    def pop_events(self, max_n: int) -> List[str]:
+        """Bulk pop with sentinel handling: the driver pushes the
+        sentinel AFTER every event, so within one pipelined sweep it can
+        only appear after the real events — truncate there, ack it, and
+        retire the queue view."""
+        if self.stopped:
+            return []
+        events = super().pop_events(max_n)
+        if STOP_SENTINEL in events:
+            cut = events.index(STOP_SENTINEL)
+            self.ack_event(STOP_SENTINEL)
+            self.stopped = True
+            events = events[:cut]
+        return events
+
 
 def shuffle_worker_main(host: str, port: int, worker_id: int,
                         n_workers: int, groups: Sequence[str],
@@ -235,11 +250,16 @@ def shuffle_worker_main(host: str, port: int, worker_id: int,
     # final drain: rewards the driver pushed between this worker's last
     # in-loop drain and its sentinel must still reach the private
     # learners — the driver pushes all rewards before any sentinel, so
-    # after this pass every worker has seen the full stream
+    # after this pass every worker has seen the full stream (drains are
+    # bounded sweeps now, so loop each queue until empty)
     for g, q in reward_q.items():
-        for action_id, reward in q.drain_rewards():
-            learners[g].set_reward(action_id, reward)
-            rewards += 1
+        while True:
+            batch = q.drain_rewards()
+            if not batch:
+                break
+            for action_id, reward in batch:
+                learners[g].set_reward(action_id, reward)
+                rewards += 1
     push_heartbeat(client, worker_id, events, rewards, "shuffle")  # final
     client.close()
     return {"worker": worker_id, "events": events, "rewards": rewards,
@@ -250,7 +270,8 @@ def shuffle_worker_main(host: str, port: int, worker_id: int,
 def worker_main(host: str, port: int, worker_id: int, n_workers: int,
                 groups: Sequence[str], learner_type: str,
                 actions: Sequence[str], config: Dict, seed: int,
-                replay: bool = False, decision_io_ms: float = 0.0) -> Dict:
+                replay: bool = False, decision_io_ms: float = 0.0,
+                engine: bool = False) -> Dict:
     """One serving process: loops for the owned groups until every group's
     stop sentinel arrives. Returns per-worker stats. ``replay`` implements
     ``replay.failed.message=true``: on startup, un-acked events a dead
@@ -260,13 +281,20 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
     action delivery) — the IO-bound serving regime where worker processes
     OVERLAP waits and scale even on a single core (round 4, VERDICT
     item 8; without it this 1-core session host can only anti-scale, the
-    regime BASELINE.md documents)."""
+    regime BASELINE.md documents). ``engine=True`` swaps each group's
+    per-event ``step()`` loop for the pipelined ``ServingEngine``
+    (bulk transport + dispatch-then-fetch; the ack/replay ledger contract
+    is unchanged, just batch-granular), heartbeats included."""
     client = MiniRedisClient(host, port)
     replayed = 0
     if replay:
         for g in owned_groups(groups, worker_id, n_workers):
             replayed += reclaim_pending(
                 client, f"pendingQueue:{g}", f"eventQueue:{g}")
+    if engine:
+        return _worker_main_engine(client, worker_id, n_workers, groups,
+                                   learner_type, actions, config, seed,
+                                   replayed, decision_io_ms)
     loops = {}
     for g in owned_groups(groups, worker_id, n_workers):
         # per-group seed component: each group's learner must explore
@@ -284,6 +312,16 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
         for g in list(active):
             loop = loops[g]
             if loop.queues.stopped:
+                # reward drains are bounded sweeps now: fold whatever
+                # backlog remains before retiring the group (the driver
+                # pushes all rewards before any sentinel), else a >4096
+                # backlog would be silently dropped at shutdown
+                while True:
+                    pairs = loop._drain_new_rewards()
+                    if not pairs:
+                        break
+                    loop.learner.set_reward_batch(pairs)
+                    loop.stats.rewards += len(pairs)
                 active.discard(g)
                 continue
             # one event per visit keeps groups fair; rewards drain inside
@@ -314,6 +352,67 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
         "rewards": rewards_total,
         "replayed": replayed,
         "groups": sorted(loops),
+    }
+
+
+def _worker_main_engine(client, worker_id: int, n_workers: int,
+                        groups: Sequence[str], learner_type: str,
+                        actions: Sequence[str], config: Dict, seed: int,
+                        replayed: int, decision_io_ms: float) -> Dict:
+    """Engine-mode worker body: one pipelined ``ServingEngine`` per owned
+    group over the same stoppable per-group queues. Each visit drains the
+    group's current backlog in one ``run()`` (pipelined micro-batches);
+    heartbeats ride the engine's per-batch callback so a live driver
+    still sees progress mid-drain."""
+    from avenir_tpu.stream.engine import ServingEngine
+    progress = {"served": 0, "hb_mark": 0}
+    engines: Dict[str, ServingEngine] = {}
+
+    def on_batch(n_events: int) -> None:
+        progress["served"] += n_events
+        if (progress["served"] - progress["hb_mark"]) >= HEARTBEAT_EVERY:
+            progress["hb_mark"] = progress["served"]
+            push_heartbeat(
+                client, worker_id, progress["served"],
+                sum(e.stats.rewards for e in engines.values()))
+        if decision_io_ms > 0:
+            time.sleep(decision_io_ms * n_events / 1e3)
+
+    for g in owned_groups(groups, worker_id, n_workers):
+        engines[g] = ServingEngine(
+            learner_type, actions, dict(config),
+            _StoppableQueues(client, g),
+            seed=seed + 1000 * worker_id + list(groups).index(g),
+            on_batch=on_batch)
+    active = set(engines)
+    idle_sleep = 0.001
+    push_heartbeat(client, worker_id, 0, 0)  # alive, engines constructed
+    while active:
+        progressed = False
+        for g in list(active):
+            eng = engines[g]
+            if eng.queues.stopped:
+                active.discard(g)
+                continue
+            before = eng.stats.events
+            eng.run()          # drains this group's current backlog
+            progressed = eng.stats.events > before or progressed
+        if progressed:
+            idle_sleep = 0.001
+        elif active:
+            time.sleep(idle_sleep)
+            idle_sleep = min(idle_sleep * 2, 0.016)
+    events_total = sum(e.stats.events for e in engines.values())
+    rewards_total = sum(e.stats.rewards for e in engines.values())
+    push_heartbeat(client, worker_id, events_total, rewards_total)  # final
+    client.close()
+    return {
+        "worker": worker_id,
+        "events": events_total,
+        "rewards": rewards_total,
+        "replayed": replayed,
+        "groups": sorted(engines),
+        "engine": True,
     }
 
 
@@ -368,7 +467,8 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
                   groups: Sequence[str], learner_type: str,
                   actions: Sequence[str], config: Dict, seed: int,
                   replay: bool = False, decision_io_ms: float = 0.0,
-                  grouping: str = "fields") -> subprocess.Popen:
+                  grouping: str = "fields",
+                  engine: bool = False) -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
            "--host", host, "--port", str(port),
@@ -380,6 +480,8 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
            "--grouping", grouping]
     if replay:
         cmd.append("--replay")
+    if engine:
+        cmd.append("--engine")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True)
 
@@ -388,10 +490,12 @@ def _spawn_workers(host: str, port: int, n_workers: int,
                    groups: Sequence[str], learner_type: str,
                    actions: Sequence[str], config: Dict, seed: int,
                    decision_io_ms: float = 0.0,
-                   grouping: str = "fields") -> List[subprocess.Popen]:
+                   grouping: str = "fields",
+                   engine: bool = False) -> List[subprocess.Popen]:
     return [_spawn_worker(host, port, w, n_workers, groups, learner_type,
                           actions, config, seed,
-                          decision_io_ms=decision_io_ms, grouping=grouping)
+                          decision_io_ms=decision_io_ms, grouping=grouping,
+                          engine=engine)
             for w in range(n_workers)]
 
 
@@ -461,12 +565,17 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
                  seed: int = 7, host: str = "localhost",
                  server: Optional[MiniRedisServer] = None,
                  decision_io_ms: float = 0.0,
-                 grouping: str = "fields") -> ScaleoutResult:
+                 grouping: str = "fields",
+                 engine: bool = False) -> ScaleoutResult:
     """Measure N serving workers against one broker (started here unless
     passed in). Every event must come back answered exactly once.
     ``grouping="shuffle"`` runs the reference's shuffleGrouping discipline
     (shared event queue, private per-worker learners — see
-    :func:`shuffle_worker_main`) instead of per-group ownership."""
+    :func:`shuffle_worker_main`) instead of per-group ownership.
+    ``engine=True`` runs the workers on the pipelined ``ServingEngine``
+    path (fields grouping only)."""
+    if engine and grouping == "shuffle":
+        raise ValueError("engine workers support fields grouping only")
     import numpy as np
     rng = np.random.default_rng(seed)
     groups = [f"g{i}" for i in range(n_groups)]
@@ -488,7 +597,7 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
         procs = _spawn_workers(broker_host, broker_port, n_workers, groups,
                                learner_type, actions, config, seed,
                                decision_io_ms=decision_io_ms,
-                               grouping=grouping)
+                               grouping=grouping, engine=engine)
         try:
             t_push: Dict[str, float] = {}
             latencies: List[float] = []
@@ -572,14 +681,19 @@ def run_chaos(n_workers: int = 2, *, n_groups: int = 4, n_actions: int = 4,
               n_events: int = 400, kill_after: int = 100,
               learner_type: str = "softMax", seed: int = 13,
               host: str = "localhost", timeout_s: float = 120.0,
-              server: Optional[MiniRedisServer] = None) -> ChaosResult:
+              server: Optional[MiniRedisServer] = None,
+              engine: bool = False) -> ChaosResult:
     """Failure-injection run: SIGKILL one worker mid-stream, respawn it
     with ``replay.failed.message=true`` semantics, and verify NO event is
     lost. The kill window can leave answered-but-unacked events, which the
     replacement serves again — at-least-once delivery, exactly Storm's
     ack/replay guarantee — so the driver deduplicates answers by event id;
     after dedup every one of ``n_events`` events is answered exactly once
-    (asserted by the chaos test)."""
+    (asserted by the chaos test). ``engine=True`` runs the pipelined
+    workers: the answered-but-unacked crash window widens to a full
+    micro-batch (write and ack are batch-granular), so duplicates bound
+    at ~batch size per killed worker instead of ~1 — still at-least-once,
+    still exactly-once after dedup."""
     import numpy as np
     import signal as _signal
     rng = np.random.default_rng(seed)
@@ -593,7 +707,8 @@ def run_chaos(n_workers: int = 2, *, n_groups: int = 4, n_actions: int = 4,
     try:
         with _broker(host, server) as (client, host, broker_port):
             procs = _spawn_workers(host, broker_port, n_workers, groups,
-                                   learner_type, actions, config, seed)
+                                   learner_type, actions, config, seed,
+                                   engine=engine)
             for sent in range(n_events):
                 g = groups[sent % len(groups)]
                 client.lpush(f"eventQueue:{g}", f"{g}:{sent}")
@@ -633,7 +748,7 @@ def run_chaos(n_workers: int = 2, *, n_groups: int = 4, n_actions: int = 4,
                     procs[0] = _spawn_worker(
                         host, broker_port, 0, n_workers, groups,
                         learner_type, actions, config, seed + 999,
-                        replay=True)
+                        replay=True, engine=engine)
 
             for g in groups:
                 client.lpush(f"eventQueue:{g}", STOP_SENTINEL)
@@ -683,9 +798,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="fields = per-group ownership (default, stronger "
                          "semantics); shuffle = the reference's "
                          "shuffleGrouping with private per-worker learners")
+    ap.add_argument("--engine", action="store_true",
+                    help="serve through the pipelined ServingEngine "
+                         "(bulk transport + dispatch-then-fetch) instead "
+                         "of the per-event step loop (fields grouping)")
     args = ap.parse_args(argv)
 
     if args.worker:
+        # stuck-worker debugging: SIGUSR1 dumps every thread's stack to
+        # stderr (the driver captures it), without killing the worker
+        import faulthandler
+        import signal as _sig
+        faulthandler.register(_sig.SIGUSR1, all_threads=True)
         # serving is host-latency-bound (one tiny learner step per event):
         # force the CPU backend even when a sitecustomize pins the session
         # at a remote TPU — a relay round-trip per decision would dominate.
@@ -694,14 +818,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from jax.extend.backend import clear_backends
         clear_backends()
         jax.config.update("jax_platforms", "cpu")
-        fn = (shuffle_worker_main if args.grouping == "shuffle"
-              else worker_main)
-        stats = fn(args.host, args.port, args.worker_id,
-                   args.n_workers, args.groups.split(","),
-                   args.learner_type, args.actions.split(","),
-                   json.loads(args.config), args.seed,
-                   replay=args.replay,
-                   decision_io_ms=args.decision_io_ms)
+        if args.grouping == "shuffle":
+            stats = shuffle_worker_main(
+                args.host, args.port, args.worker_id,
+                args.n_workers, args.groups.split(","),
+                args.learner_type, args.actions.split(","),
+                json.loads(args.config), args.seed,
+                replay=args.replay,
+                decision_io_ms=args.decision_io_ms)
+        else:
+            stats = worker_main(
+                args.host, args.port, args.worker_id,
+                args.n_workers, args.groups.split(","),
+                args.learner_type, args.actions.split(","),
+                json.loads(args.config), args.seed,
+                replay=args.replay,
+                decision_io_ms=args.decision_io_ms,
+                engine=args.engine)
         print(json.dumps(stats), flush=True)
         return 0
 
@@ -709,10 +842,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         r = run_scaleout(n, throughput_events=args.events,
                          learner_type=args.learner_type,
                          decision_io_ms=args.decision_io_ms,
-                         grouping=args.grouping)
+                         grouping=args.grouping,
+                         engine=args.engine)
         print(json.dumps({
             "n_workers": r.n_workers,
             "grouping": args.grouping,
+            "engine": args.engine,
             "decision_io_ms": args.decision_io_ms,
             "decisions_per_sec": round(r.decisions_per_sec, 1),
             "p50_latency_ms": round(r.p50_latency_ms, 2),

@@ -260,6 +260,43 @@ def _timed_multi(chain, x, y, yv) -> float:
     return time.perf_counter() - t0
 
 
+def _online_serving_bench() -> dict:
+    """ISSUE 5: the serving-engine bench — decisions/sec of the pipelined
+    ``stream.engine.ServingEngine`` vs the synchronous ``run()`` loop over
+    the same MiniRedis-backed workload, plus overlap_fraction and
+    round-trips/batch. Runs scripts/serving_smoke.py in a SUBPROCESS
+    pinned to the CPU backend: serving is host-latency-bound (one tiny
+    learner step per decision), so timing it through the TPU relay would
+    measure the relay, not the engine — the same reasoning as the
+    scale-out workers. ``--skip-gates`` because a loaded bench host must
+    record the measured ratio, not fail the run; the 2x gate is enforced
+    by the tier-1 smoke hook instead."""
+    import subprocess
+    import sys as _sys
+    script = os.path.join(os.path.dirname(__file__), "scripts",
+                          "serving_smoke.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)     # no virtual-device carryover
+    events = os.environ.get("BENCH_SERVING_EVENTS", "10000")
+    proc = subprocess.run(
+        [_sys.executable, script, "--events", events, "--skip-gates"],
+        env=env, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"serving_smoke rc={proc.returncode}: {proc.stderr[-500:]}")
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    return {
+        "decisions_per_sec": report["decisions_per_sec"],
+        "sync_decisions_per_sec": report["sync_decisions_per_sec"],
+        "speedup_vs_sync": report["speedup_vs_sync"],
+        "overlap_fraction": report["overlap_fraction"],
+        "round_trips_per_batch": report["round_trips_per_batch"],
+        "sync_round_trips_per_batch": report["sync_round_trips_per_batch"],
+        "bit_identical_to_run_loop": report["bit_identical"],
+        "events": report["events"],
+    }
+
+
 def main() -> None:
     import sys
     # telemetry (obs layer): count compiles from here on so the JSON
@@ -442,6 +479,25 @@ def main() -> None:
             print(f"multichip bench skipped: {exc!r}", file=sys.stderr)
             out["multichip"] = {"n_devices": len(jax.devices()),
                                 "error": repr(exc)}
+    # ISSUE-5 ONLINE SERVING: the always-on path's own headline —
+    # engine-vs-sync decisions/sec on CPU over MiniRedis (subprocess;
+    # fallback-safe: a serving failure must not sink the KNN headline)
+    if os.environ.get("BENCH_SERVING", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        try:
+            out["online_serving"] = _online_serving_bench()
+            osrv = out["online_serving"]
+            print(f"online serving: {osrv['decisions_per_sec']:.0f} "
+                  f"decisions/s pipelined vs "
+                  f"{osrv['sync_decisions_per_sec']:.0f} sync "
+                  f"({osrv['speedup_vs_sync']:.2f}x, overlap "
+                  f"{osrv['overlap_fraction']:.3f}, "
+                  f"{osrv['round_trips_per_batch']:.0f} round trips/batch "
+                  f"vs {osrv['sync_round_trips_per_batch']:.0f})",
+                  file=sys.stderr)
+        except Exception as exc:
+            print(f"online serving bench skipped: {exc!r}", file=sys.stderr)
+            out["online_serving"] = {"error": repr(exc)}
     if legacy:
         base_elapsed = M_TEST * ITERS / legacy
         adj = M_TEST * ITERS / max(base_elapsed - 0.0993, 1e-9)

@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Serving-engine smoke gate (ISSUE 5 CI guard).
+
+Runs the pipelined ``ServingEngine`` and the synchronous
+``OnlineLearnerLoop.run()`` over the SAME MiniRedis-backed workload
+(~10k pre-filled events + a reward backlog, pending ledger armed) on the
+CPU backend and asserts, exiting non-zero on any failure:
+
+1. **Bit-parity**: the engine's action queue is byte-identical to the
+   sync loop's (same seed -> same action sequence -> same wire bytes),
+   both ledgers fully retired.
+2. **Throughput**: engine decisions/sec >= 2x the sync loop — the
+   overlap + bulk-transport win the engine exists for. Round trips per
+   batch are measured from the broker client's call counter and
+   reported.
+3. **Disabled-telemetry overhead <= 5%**: the engine with telemetry off
+   (its default) vs a bare hand-rolled pipelined loop with no
+   stats/span bookkeeping at all, interleaved best-of-N on in-process
+   queues (the obs_smoke methodology).
+
+Prints ONE JSON line consumed by bench.py's ``online_serving`` section.
+
+Usage: python scripts/serving_smoke.py [--events N] [--skip-gates]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# CPU unconditionally (not setdefault): serving is host-latency-bound, a
+# TPU relay round trip per dispatch would measure the relay; and state
+# donation (armed on tpu/gpu backends) would invalidate the warmup's
+# state snapshot. A sitecustomize may have pre-imported jax with another
+# platform, so also repin the already-loaded config below.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu":  # pragma: no cover - TPU-pinned hosts
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+
+ACTIONS = ["a0", "a1", "a2", "a3", "a4", "a5"]
+CONFIG = {"current.decision.round": 1, "batch.size": 2}
+LEARNER = "softMax"
+SEED = 11
+# a multiple of the learner's fused reward chunk (256): every fold chunk
+# then shares one compiled shape, which the warmup below pre-compiles
+N_REWARDS = 1536
+N_OVERHEAD_EVENTS = 6400   # 100 full batches, no tail variant
+OVERHEAD_BOUND = 0.05
+ABS_SLACK_S = 0.001
+OVERHEAD_REPEATS = 5
+SPEEDUP_GATE = 2.0
+
+
+def fail(msg: str) -> None:
+    print(f"serving_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _fill_broker(client, n_events: int) -> None:
+    import numpy as np
+    rng = np.random.default_rng(3)
+    for i in range(n_events):
+        client.lpush("eventQueue", f"e{i:05d}")
+    for _ in range(N_REWARDS):
+        a = ACTIONS[int(rng.integers(len(ACTIONS)))]
+        client.lpush("rewardQueue", f"{a},{float(rng.integers(100))}")
+
+
+def _warmed_learner(seed: int, n_events: int = 0):
+    """Build a learner, warm every jitted variant the run will touch
+    (the full 64-event select, the tail-batch select, the 256-pair fused
+    reward fold), then reset its state to the freshly-initialized pytree
+    — jit caches stay hot, the state evolution restarts from zero, so
+    parity and timing are both clean (a compile inside the timed window
+    would smear both paths and the ratio)."""
+    import jax.numpy as jnp
+    from avenir_tpu.models.bandits.learners import Learner
+    learner = Learner(LEARNER, ACTIONS, dict(CONFIG), seed=seed)
+    # snapshot by COPY: on a donation-armed backend the warmup calls
+    # would donate (invalidate) the original state buffers
+    state0 = jax.tree_util.tree_map(jnp.array, learner.state)
+    bs = CONFIG["batch.size"]
+    learner.next_action_batch(64 * bs)
+    tail = n_events % 64
+    if tail:
+        learner.next_action_batch(tail * bs)
+    learner.set_reward_batch([(ACTIONS[0], 1.0)] * 256)
+    learner.state = state0
+    return learner
+
+
+def _drain_actions(client) -> list:
+    out = []
+    while (raw := client.rpop("actionQueue")) is not None:
+        out.append(raw)
+    return out
+
+
+def run_sync(srv, n_events: int):
+    from avenir_tpu.stream.loop import OnlineLearnerLoop, RedisQueues
+    from avenir_tpu.stream.miniredis import MiniRedisClient
+    client = MiniRedisClient(srv.host, srv.port)
+    client.flushall()
+    _fill_broker(client, n_events)
+    queues = RedisQueues(client=client, pending_queue="pendingQueue")
+    loop = OnlineLearnerLoop(LEARNER, ACTIONS, dict(CONFIG), queues,
+                             seed=SEED)
+    loop.learner = _warmed_learner(SEED, n_events)
+    calls0 = client.calls
+    t0 = time.perf_counter()
+    stats = loop.run()
+    elapsed = time.perf_counter() - t0
+    round_trips = client.calls - calls0
+    if stats.events != n_events:
+        fail(f"sync loop served {stats.events}/{n_events}")
+    if client.llen("pendingQueue") != 0:
+        fail("sync loop left un-acked ledger entries")
+    actions = _drain_actions(client)
+    client.close()
+    return elapsed, stats, actions, round_trips
+
+
+def run_engine(srv, n_events: int):
+    from avenir_tpu.stream.engine import ServingEngine
+    from avenir_tpu.stream.loop import RedisQueues
+    from avenir_tpu.stream.miniredis import MiniRedisClient
+    client = MiniRedisClient(srv.host, srv.port)
+    client.flushall()
+    _fill_broker(client, n_events)
+    queues = RedisQueues(client=client, pending_queue="pendingQueue")
+    engine = ServingEngine(LEARNER, ACTIONS, dict(CONFIG), queues,
+                           seed=SEED, learner=_warmed_learner(SEED, n_events))
+    calls0 = client.calls
+    t0 = time.perf_counter()
+    stats = engine.run()
+    elapsed = time.perf_counter() - t0
+    round_trips = client.calls - calls0
+    if stats.events != n_events:
+        fail(f"engine served {stats.events}/{n_events}")
+    if client.llen("pendingQueue") != 0:
+        fail("engine left un-acked ledger entries")
+    actions = _drain_actions(client)
+    client.close()
+    return elapsed, stats, actions, round_trips
+
+
+def _bare_pipelined_run(learner, queues, batch_size: int,
+                        event_cap: int) -> int:
+    """The engine's pipeline shape with ZERO bookkeeping — no stats, no
+    spans, no adaptive cap, no clocks. The disabled-telemetry engine is
+    held to within 5% of this."""
+    served = 0
+    pending = None
+    while True:
+        pairs = queues.drain_rewards()
+        if pairs:
+            learner.set_reward_batch(pairs)
+        events = queues.pop_events(event_cap)
+        handles = (learner.next_action_batch_async(
+            len(events) * batch_size) if events else None)
+        if pending is not None:
+            prev_events, prev_handles = pending
+            selections = learner.resolve_action_batch(prev_handles)
+            queues.write_actions_bulk(
+                [(eid, selections[i * batch_size:(i + 1) * batch_size])
+                 for i, eid in enumerate(prev_events)])
+            queues.ack_events(prev_events)
+            served += len(prev_events)
+        if not events:
+            break
+        pending = (events, handles)
+    return served
+
+
+def check_disabled_overhead() -> dict:
+    from avenir_tpu.models.bandits.learners import Learner
+    from avenir_tpu.obs import telemetry
+    from avenir_tpu.stream.engine import ServingEngine
+    from avenir_tpu.stream.loop import InProcQueues
+    if telemetry.tracer().enabled:
+        fail("tracer unexpectedly enabled before the overhead gate")
+    cap = Learner._SCAN_BUCKET_MAX
+    batch_size = CONFIG["batch.size"]
+
+    eng_queues = InProcQueues()
+    engine = ServingEngine(LEARNER, ACTIONS, dict(CONFIG), eng_queues,
+                           seed=2, learner=_warmed_learner(2, N_OVERHEAD_EVENTS))
+    bare_queues = InProcQueues()
+    bare_learner = _warmed_learner(2, N_OVERHEAD_EVENTS)
+
+    def fill(queues) -> None:
+        for i in range(N_OVERHEAD_EVENTS):
+            queues.push_event(f"e{i}")
+
+    def timed_engine() -> float:
+        fill(eng_queues)
+        t0 = time.perf_counter()
+        engine.run()
+        return time.perf_counter() - t0
+
+    def timed_bare() -> float:
+        fill(bare_queues)
+        t0 = time.perf_counter()
+        _bare_pipelined_run(bare_learner, bare_queues, batch_size, cap)
+        return time.perf_counter() - t0
+
+    timed_engine()      # both jit caches hot before the timed draws
+    timed_bare()
+    # co-tenant scheduler jitter on this 1-core box swings ~12ms draws
+    # by several ms; the bound stays 5% but a tripped measurement gets
+    # one fresh best-of-N before it can fail the gate
+    for attempt in range(2):
+        t_eng = t_bare = float("inf")
+        for _ in range(OVERHEAD_REPEATS):   # interleaved: same weather
+            t_eng = min(t_eng, timed_engine())
+            t_bare = min(t_bare, timed_bare())
+        overhead = (t_eng - t_bare) / t_bare
+        if t_eng <= t_bare * (1 + OVERHEAD_BOUND) + ABS_SLACK_S:
+            break
+        if attempt == 1:
+            fail(f"disabled-telemetry engine overhead "
+                 f"{overhead * 100:.1f}% exceeds "
+                 f"{OVERHEAD_BOUND * 100:.0f}% twice "
+                 f"(engine={t_eng * 1e3:.2f}ms bare={t_bare * 1e3:.2f}ms)")
+    return {"t_engine_ms": round(t_eng * 1e3, 2),
+            "t_bare_ms": round(t_bare * 1e3, 2),
+            "overhead_pct": round(overhead * 100, 1)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=10000)
+    ap.add_argument("--skip-gates", action="store_true",
+                    help="measure and report without failing the speedup "
+                         "gate (bench mode on a loaded host)")
+    args = ap.parse_args()
+
+    from avenir_tpu.stream.miniredis import MiniRedisServer
+    batch_size = CONFIG["batch.size"]
+    with MiniRedisServer() as srv:
+        # interleaved best-of-2 per path: one slow draw on a shared core
+        # must not decide the ratio
+        t_sync = t_eng = float("inf")
+        sync = eng = None
+        for _ in range(2):
+            s = run_sync(srv, args.events)
+            e = run_engine(srv, args.events)
+            if s[0] < t_sync:
+                t_sync, sync = s[0], s
+            if e[0] < t_eng:
+                t_eng, eng = e[0], e
+        _, sync_stats, sync_actions, sync_rt = sync
+        _, eng_stats, eng_actions, eng_rt = eng
+
+    if sync_actions != eng_actions:
+        for i, (a, b) in enumerate(zip(sync_actions, eng_actions)):
+            if a != b:
+                fail(f"action queues diverge at {i}: sync={a!r} "
+                     f"engine={b!r}")
+        fail(f"action queue lengths diverge: {len(sync_actions)} vs "
+             f"{len(eng_actions)}")
+    if not (sync_stats.rewards == eng_stats.rewards == N_REWARDS):
+        fail(f"reward folds diverge: sync={sync_stats.rewards} "
+             f"engine={eng_stats.rewards} expected={N_REWARDS}")
+
+    decisions_sync = args.events * batch_size / t_sync
+    decisions_eng = args.events * batch_size / t_eng
+    speedup = decisions_eng / decisions_sync
+    batches = max(eng_stats.batches, 1)
+    sync_batches = max(-(-args.events // 64), 1)
+    if speedup < SPEEDUP_GATE and not args.skip_gates:
+        fail(f"engine speedup {speedup:.2f}x below the "
+             f"{SPEEDUP_GATE:.0f}x gate "
+             f"(sync={decisions_sync:.0f}/s engine={decisions_eng:.0f}/s)")
+
+    overhead = check_disabled_overhead()
+
+    print(json.dumps({
+        "serving_smoke": "ok",
+        "events": args.events,
+        "batch_size": batch_size,
+        "decisions_per_sec": round(decisions_eng, 1),
+        "sync_decisions_per_sec": round(decisions_sync, 1),
+        "speedup_vs_sync": round(speedup, 2),
+        "overlap_fraction": round(eng_stats.overlap_fraction, 3),
+        "round_trips_per_batch": round(eng_rt / batches, 1),
+        "sync_round_trips_per_batch": round(sync_rt / sync_batches, 1),
+        "bit_identical": True,
+        "disabled_overhead": overhead,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

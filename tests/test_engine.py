@@ -1,0 +1,566 @@
+"""Pipelined serving engine (ISSUE 5): bit-parity with the synchronous
+``run()`` loop, bulk-transport conformance, crash/replay under
+pipelining, adaptive micro-batching, and the grouped device-resident
+dispatch."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.stream.engine import (
+    GroupedServingEngine, ServingEngine, _AdaptiveCap)
+from avenir_tpu.stream.loop import (
+    GroupedLearner, InProcQueues, OnlineLearnerLoop, RedisQueues,
+    reclaim_pending)
+from avenir_tpu.stream.miniredis import MiniRedisClient, MiniRedisServer
+
+ACTIONS = ["a", "b", "c"]
+
+
+def _prefill_inproc(n_events: int, n_rewards: int) -> InProcQueues:
+    q = InProcQueues()
+    for i in range(n_events):
+        q.push_event(f"e{i:04d}")
+    for j in range(n_rewards):
+        q.push_reward(ACTIONS[j % len(ACTIONS)], 10.0 + j)
+    return q
+
+
+class TestEngineRunParity:
+    """The tentpole contract: for statically pre-filled queues the engine
+    is bit-equivalent to ``OnlineLearnerLoop.run`` — same seed, same
+    action sequence, same final learner state."""
+
+    @pytest.mark.parametrize("learner_type", [
+        "softMax", "upperConfidenceBoundOne", "intervalEstimator",
+        "actionPursuit"])
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_bit_parity_prefilled(self, learner_type, seed):
+        cfg = {"batch.size": 2}
+        q_loop = _prefill_inproc(333, 48)
+        q_eng = _prefill_inproc(333, 48)
+        loop = OnlineLearnerLoop(learner_type, ACTIONS, dict(cfg), q_loop,
+                                 seed=seed)
+        loop_stats = loop.run()
+        eng = ServingEngine(learner_type, ACTIONS, dict(cfg), q_eng,
+                            seed=seed)
+        eng_stats = eng.run()
+        assert list(q_loop.actions) == list(q_eng.actions)
+        assert (loop_stats.events, loop_stats.rewards,
+                loop_stats.actions_written) == (
+            eng_stats.events, eng_stats.rewards, eng_stats.actions_written)
+        np.testing.assert_array_equal(
+            np.asarray(loop.learner.state.trial_counts),
+            np.asarray(eng.learner.state.trial_counts))
+        np.testing.assert_allclose(
+            np.asarray(loop.learner.state.reward_sum),
+            np.asarray(eng.learner.state.reward_sum), rtol=1e-5)
+
+    def test_bit_parity_over_miniredis_with_ledger(self):
+        """Same parity over the real RESP wire with the pending ledger
+        armed: identical action-queue BYTES, both ledgers retired, and
+        the engine's transport uses a small fraction of the sync loop's
+        round trips."""
+        def fill(client):
+            for i in range(300):
+                client.lpush("eventQueue", f"e{i:04d}")
+            for j in range(40):
+                client.lpush("rewardQueue",
+                             f"{ACTIONS[j % 3]},{10.0 + j}")
+
+        with MiniRedisServer() as srv:
+            results = {}
+            for mode in ("sync", "engine"):
+                client = MiniRedisClient(srv.host, srv.port)
+                client.flushall()
+                fill(client)
+                queues = RedisQueues(client=client,
+                                     pending_queue="pendingQueue")
+                calls0 = client.calls
+                if mode == "sync":
+                    stats = OnlineLearnerLoop(
+                        "softMax", ACTIONS, {"batch.size": 2}, queues,
+                        seed=3).run()
+                else:
+                    stats = ServingEngine(
+                        "softMax", ACTIONS, {"batch.size": 2}, queues,
+                        seed=3).run()
+                round_trips = client.calls - calls0   # run-phase only
+                assert client.llen("pendingQueue") == 0
+                raw_actions = []
+                while (raw := client.rpop("actionQueue")) is not None:
+                    raw_actions.append(raw)
+                results[mode] = (stats, raw_actions, round_trips)
+                client.close()
+        sync_stats, sync_actions, sync_rt = results["sync"]
+        eng_stats, eng_actions, eng_rt = results["engine"]
+        assert sync_actions == eng_actions       # byte-identical wire
+        assert sync_stats.events == eng_stats.events == 300
+        assert sync_stats.rewards == eng_stats.rewards == 40
+        # ~130 round trips per 64-event batch collapse to ~3 (the
+        # rpop drain of the action queue above is excluded from neither
+        # side, so compare the raw run-phase counters)
+        assert eng_rt * 10 < sync_rt, (eng_rt, sync_rt)
+
+    def test_max_events_cap(self):
+        q = _prefill_inproc(200, 0)
+        eng = ServingEngine("softMax", ACTIONS, {"batch.size": 1}, q,
+                            seed=1)
+        stats = eng.run(max_events=70)
+        assert stats.events == 70
+        assert len(q.events) == 130       # the rest stay queued
+        stats = eng.run()                 # cumulative across run() calls
+        assert stats.events == 200
+
+
+class TestLiveRewards:
+    """The documented pipeline deviation: a reward arriving while batch n
+    is in flight folds before batch n+2's select (run() folds it before
+    n+1's) — one batch of extra staleness, never loss."""
+
+    class _LiveQueues(InProcQueues):
+        """Queue adapter that produces a reward for every served action
+        (as a live consumer would) — rewards appear only AFTER the
+        engine has written the batch."""
+
+        def __init__(self):
+            super().__init__()
+            self.fold_points = []     # events served when a drain folded
+
+        def write_actions_bulk(self, entries):
+            super().write_actions_bulk(entries)
+            for event_id, actions in entries:
+                self.push_reward(actions[0], 50.0)
+
+        def drain_rewards(self, max_items=None):
+            pairs = super().drain_rewards(max_items)
+            if pairs:
+                self.fold_points.append(len(pairs))
+            return pairs
+
+    def test_live_rewards_fold_next_batch_and_none_lost(self):
+        q = self._LiveQueues()
+        for i in range(300):
+            q.push_event(f"e{i}")
+        eng = ServingEngine("softMax", ACTIONS, {"batch.size": 1}, q,
+                            seed=2)
+        stats = eng.run()
+        assert stats.events == 300
+        # every served event produced one reward, every reward was folded
+        # (the exit drain sweeps what the last batch produced)
+        assert stats.rewards == 300
+        assert q.reward_backlog == 0
+        # folds happened at batch boundaries, not per event: fewer fold
+        # points than batches+2, each covering ~a batch of rewards
+        assert len(q.fold_points) <= stats.batches + 2
+        assert max(q.fold_points) > 1
+
+
+class TestAdaptiveBatching:
+    def test_cap_grows_and_shrinks(self):
+        cap = _AdaptiveCap(8, 64)
+        assert cap.cap == 64              # starts wide open (bit-parity)
+        cap.update(3)                     # shallow: shrink toward arrivals
+        assert cap.cap == 32
+        for _ in range(3):
+            cap.update(2)
+        assert cap.cap == 8               # floored at min_batch
+        cap.update(8)                     # full pop: grow again
+        assert cap.cap == 16
+        cap.update(16)
+        assert cap.cap == 32
+        cap.update(32)
+        assert cap.cap == 64
+        cap.update(64)
+        assert cap.cap == 64              # ceiling
+
+    def test_engine_caps_under_backlog_and_trickle(self):
+        # deep backlog: every batch runs at the full 64 cap
+        q = _prefill_inproc(320, 0)
+        eng = ServingEngine("softMax", ACTIONS, {"batch.size": 1}, q,
+                            seed=1)
+        stats = eng.run()
+        assert stats.cap_history[:4] == [64, 64, 64, 64]
+        # trickle: repeated shallow polls shrink the cap to the floor
+        q2 = InProcQueues()
+        eng2 = ServingEngine("softMax", ACTIONS, {"batch.size": 1}, q2,
+                             seed=1, min_batch=8)
+        for _ in range(5):
+            q2.push_event("e")
+            eng2.run()
+        assert eng2.stats.batch_cap == 8
+
+
+class TestBoundedDrainResume:
+    def test_exit_drain_survives_skip_filtered_sweeps(self):
+        """Checkpoint-resume regression: a restored loop re-drains an
+        append-only reward source with ``_skip_rewards`` armed. A whole
+        bounded sweep consumed by the skip filter returns zero pairs —
+        which must NOT read as queue-empty, or rewards past the skip
+        window are silently dropped."""
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            for j in range(200):
+                c.lpush("rewardQueue", f"{ACTIONS[j % 3]},{float(j)}")
+            q = RedisQueues(client=c)
+            q._DRAIN_MAX = 64          # shrink the sweep for the test
+            loop = OnlineLearnerLoop("softMax", ACTIONS,
+                                     {"batch.size": 1}, q, seed=1)
+            loop._skip_rewards = 128   # "checkpoint already folded 128"
+            stats = loop.run()         # no events: straight to exit drain
+            assert stats.rewards == 200 - 128
+            assert q.drain_rewards() == []       # stream fully consumed
+            c.close()
+
+    def test_lindex_fallback_backlog_gauge_not_stale(self):
+        """Capped lindex-walk sweeps must still report the remaining
+        backlog (the gauge exists to signal exactly this condition)."""
+
+        class NoLrangeClient:
+            """lindex/llen only — forces the fallback walk."""
+
+            def __init__(self, items):
+                self.items = list(items)     # index 0 = head
+
+            def lindex(self, key, idx):
+                pos = idx if idx >= 0 else len(self.items) + idx
+                if 0 <= pos < len(self.items):
+                    return self.items[pos]
+                return None
+
+            def llen(self, key):
+                return len(self.items)
+
+        client = NoLrangeClient([f"a,{j}.0".encode() for j in range(10)])
+        q = RedisQueues(client=client)
+        out = q.drain_rewards(max_items=4)
+        assert len(out) == 4
+        assert q.reward_backlog == 6
+        q.drain_rewards()
+        assert q.reward_backlog == 0
+
+
+class TestMiniRedisBulkOps:
+    """Bulk-op conformance: every bulk command must agree with the
+    single-op replies it replaces."""
+
+    def test_rpop_count(self):
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            c.lpush("q", "a", "b", "c")
+            assert c.rpop("q", 2) == [b"a", b"b"]   # oldest first
+            assert c.rpop("q", 5) == [b"c"]         # clamped to length
+            assert c.rpop("q", 2) is None           # null array when empty
+            assert c.rpop("missing", 1) is None
+            c.close()
+
+    def test_pipeline_matches_single_ops(self):
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            c.lpush("q", "a", "b", "c")
+            p = c.pipeline()
+            p.llen("q").rpoplpush("q", "p").lrange("p", 0, -1).lindex(
+                "q", -1).lrem("p", 1, "a").llen("p")
+            replies = p.execute()
+            assert replies == [3, b"a", [b"a"], b"b", 1, 0]
+            assert p.execute() == []                # buffer consumed
+            # one pipeline = ONE client round trip however many commands
+            calls0 = c.calls
+            p2 = c.pipeline()
+            for _ in range(50):
+                p2.llen("q")
+            assert p2.execute() == [2] * 50
+            assert c.calls - calls0 == 1
+            c.close()
+
+    def test_lrem_fast_paths_match_semantics(self):
+        """count=1 / count=-1 ride deque.remove now — same head-first /
+        tail-first first-match semantics as the generic path."""
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            c.lpush("m", "x", "y", "x", "x")        # head: x x y x :tail
+            assert c.lrem("m", 1, "x") == 1         # head-first
+            assert c.lrange("m", 0, -1) == [b"x", b"y", b"x"]
+            assert c.lrem("m", -1, "x") == 1        # tail-first
+            assert c.lrange("m", 0, -1) == [b"x", b"y"]
+            assert c.lrem("m", 1, "zzz") == 0
+            assert c.lrem("nokey", 1, "x") == 0
+            c.close()
+
+    def test_pop_events_bulk_equals_sequential(self):
+        with MiniRedisServer() as srv:
+            c1 = MiniRedisClient(srv.host, srv.port)
+            for i in range(10):
+                c1.lpush("eventQueue", f"e{i}")
+            q = RedisQueues(client=c1, pending_queue="pendingQueue")
+            got = q.pop_events(6)
+            assert got == [f"e{i}" for i in range(6)]
+            assert c1.llen("pendingQueue") == 6     # ledger armed per pop
+            got += q.pop_events(10)
+            assert got == [f"e{i}" for i in range(10)]
+            q.ack_events(got)
+            assert c1.llen("pendingQueue") == 0
+            c1.close()
+
+    def test_pop_events_tolerates_reply_holes(self):
+        """A concurrent producer can lpush BETWEEN two pipelined
+        RPOPLPUSH commands, so replies may be [nil, X, nil]; every
+        non-nil value was atomically moved into the ledger and must be
+        returned, not dropped (the lost-event race)."""
+
+        class HoleyPipeline:
+            def __init__(self, replies):
+                self._replies = replies
+
+            def rpoplpush(self, src, dst):
+                return self
+
+            def execute(self):
+                return self._replies
+
+        class HoleyClient:
+            def __init__(self, replies):
+                self._replies = replies
+
+            def pipeline(self):
+                return HoleyPipeline(self._replies)
+
+            def lrem(self, *a):
+                return 1
+
+        q = RedisQueues(client=HoleyClient([None, b"e7", None, b"e8"]),
+                        pending_queue="pendingQueue")
+        assert q.pop_events(4) == ["e7", "e8"]
+
+    def test_drain_rewards_lrange_sweep_matches_lindex_walk(self):
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            for j in range(7):
+                c.lpush("rewardQueue", f"{ACTIONS[j % 3]},{j}.0")
+            q = RedisQueues(client=c)
+            assert q.drain_rewards() == [
+                (ACTIONS[j % 3], float(j)) for j in range(7)]
+            assert q.drain_rewards() == []          # cursor advanced
+            c.lpush("rewardQueue", "a,99.0")        # new arrival
+            assert q.drain_rewards() == [("a", 99.0)]
+            assert q.reward_backlog == 0
+            c.close()
+
+    def test_drain_rewards_bounded_sweep_and_backlog_gauge(self):
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            for j in range(10):
+                c.lpush("rewardQueue", f"a,{j}.0")
+            q = RedisQueues(client=c)
+            out = q.drain_rewards(max_items=4)
+            assert [r for _, r in out] == [0.0, 1.0, 2.0, 3.0]
+            assert q.reward_backlog == 6            # the gauge
+            out = q.drain_rewards(max_items=4)
+            assert [r for _, r in out] == [4.0, 5.0, 6.0, 7.0]
+            assert q.reward_backlog == 2
+            assert [r for _, r in q.drain_rewards()] == [8.0, 9.0]
+            assert q.reward_backlog == 0
+            c.close()
+
+    def test_write_actions_bulk_order_and_write_and_ack(self):
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            q = RedisQueues(client=c, pending_queue="pendingQueue")
+            c.lpush("eventQueue", "e1", "e2")
+            events = q.pop_events(2)
+            calls0 = c.calls
+            q.write_and_ack([(e, ["x", "y"]) for e in events])
+            assert c.calls - calls0 == 1            # ONE fused round trip
+            assert c.rpop("actionQueue") == b"e1,x,y"
+            assert c.rpop("actionQueue") == b"e2,x,y"
+            assert c.llen("pendingQueue") == 0
+            c.close()
+
+
+class TestCrashReplayUnderPipelining:
+    def test_unacked_bulk_pop_is_replayable(self):
+        """SIGKILL between write and ack, miniature: a consumer bulk-pops
+        and answers but never acks; the replacement reclaims every entry
+        and serves them again — at-least-once via the ledger."""
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            for i in range(8):
+                c.lpush("eventQueue", f"e{i}")
+            q = RedisQueues(client=c, pending_queue="pendingQueue")
+            events = q.pop_events(8)
+            q.write_actions_bulk([(e, ["x"]) for e in events])
+            # ...death here: no ack. A replacement consumer reclaims:
+            assert reclaim_pending(c, "pendingQueue", "eventQueue") == 8
+            q2 = RedisQueues(client=c, pending_queue="pendingQueue")
+            assert q2.pop_events(8) == events       # served again
+            q2.write_and_ack([(e, ["x"]) for e in events])
+            assert c.llen("pendingQueue") == 0
+            c.close()
+
+    def test_chaos_sigkill_with_engine_workers(self):
+        """The full Storm contract under pipelining: SIGKILL an
+        engine-mode worker mid-stream, respawn with replay, nothing
+        lost. The crash window is batch-granular now, so duplicates
+        bound at ~2 batch caps instead of ~1 event."""
+        from avenir_tpu.stream.scaleout import run_chaos
+        r = run_chaos(2, n_groups=4, n_events=300, kill_after=80, seed=13,
+                      engine=True)
+        assert r.killed_at >= 80
+        assert r.unique_answered == r.n_events      # nothing lost
+        assert r.pending_left == 0                  # ledger fully retired
+        assert r.duplicates <= 2 * 64, r.duplicates
+        assert len(r.worker_stats) == 2
+        assert all(w.get("engine") for w in r.worker_stats)
+
+    def test_scaleout_engine_workers_answer_everything(self):
+        from avenir_tpu.stream.scaleout import run_scaleout
+        r = run_scaleout(2, n_groups=4, throughput_events=150,
+                         paced_events=50, paced_rate=500.0, seed=11,
+                         engine=True)
+        total = sum(w["events"] for w in r.worker_stats)
+        assert total == 16 + 150 + 50               # exactly-once
+        assert all(w.get("engine") for w in r.worker_stats)
+        assert r.heartbeats > 0                     # heartbeat wiring
+
+
+class TestGroupedEngine:
+    def test_wave_parity_with_sequential_next_all(self):
+        """Balanced traffic (one event per context per wave): the
+        grouped engine reproduces exactly the actions of sequential
+        ``next_all`` calls on an identically-seeded GroupedLearner."""
+        groups = [f"g{i}" for i in range(4)]
+        q = InProcQueues()
+        for w in range(3):
+            for g in groups:
+                q.push_event(f"{g}:ev{w}")
+        q.push_reward("g1:b", 5.0)
+        q.push_reward("g2:c", 7.0)
+        eng = GroupedServingEngine("softMax", groups, ACTIONS,
+                                   {"batch.size": 1}, q, seed=5)
+        stats = eng.run()
+        assert stats.events == 12 and stats.rewards == 2
+
+        ref = GroupedLearner("softMax", 4, ACTIONS, {"batch.size": 1},
+                             seed=5)
+        ref.reward_masked([0, 1, 2, 0], [0.0, 5.0, 7.0, 0.0],
+                          [False, True, True, False])
+        expect = {}
+        for w in range(3):
+            for gi, action in enumerate(ref.next_all()):
+                expect[f"g{gi}:ev{w}"] = action
+        got = {}
+        while (entry := q.pop_action()) is not None:
+            got[entry[0]] = entry[1][0]
+        assert got == expect
+
+    def test_unknown_group_or_action_raises(self):
+        q = InProcQueues()
+        q.push_event("nope:e1")
+        eng = GroupedServingEngine("softMax", ["g0"], ACTIONS,
+                                   {"batch.size": 1}, q, seed=1)
+        with pytest.raises(ValueError, match="unknown group"):
+            eng.run()
+        q2 = InProcQueues()
+        q2.push_reward("g0:zzz", 1.0)
+        eng2 = GroupedServingEngine("softMax", ["g0"], ACTIONS,
+                                    {"batch.size": 1}, q2, seed=1)
+        with pytest.raises(ValueError, match="not in list"):
+            eng2.run()
+
+    def test_reward_masked_matches_reward_all_subset(self):
+        """reward_masked(idx, rew, mask) must equal reward_all on the
+        masked contexts and leave the others bit-identical."""
+        import jax
+        gl1 = GroupedLearner("upperConfidenceBoundOne", 4, ACTIONS,
+                             {"batch.size": 1}, seed=9)
+        gl2 = GroupedLearner("upperConfidenceBoundOne", 4, ACTIONS,
+                             {"batch.size": 1}, seed=9)
+        gl1.next_all(), gl2.next_all()
+        gl1.reward_masked([1, 0, 2, 0], [30.0, 0.0, 90.0, 0.0],
+                          [True, False, True, False])
+        # reference: apply the same two rewards via reward_all on ALL
+        # contexts, then splice the unmasked contexts back
+        before = gl2.states
+        gl2.reward_all(["b", "a", "c", "a"], [30.0, 0.0, 90.0, 0.0])
+        mask = np.asarray([True, False, True, False])
+        spliced = jax.tree_util.tree_map(
+            lambda new, old: np.where(
+                mask.reshape((4,) + (1,) * (new.ndim - 1)),
+                np.asarray(new), np.asarray(old)),
+            gl2.states, before)
+        for leaf1, leaf2 in zip(
+                jax.tree_util.tree_leaves(gl1.states),
+                jax.tree_util.tree_leaves(spliced)):
+            np.testing.assert_array_equal(np.asarray(leaf1),
+                                          np.asarray(leaf2))
+
+    def test_action_index_dict_replaces_list_index(self):
+        gl = GroupedLearner("softMax", 2, ACTIONS, {"batch.size": 1},
+                            seed=1)
+        assert gl._action_index == {"a": 0, "b": 1, "c": 2}
+        with pytest.raises(ValueError, match="not in list"):
+            gl.reward_all(["a", "zzz"], [1.0, 2.0])
+
+
+class TestTelemetryAndCallbacks:
+    def test_engine_spans_and_gauges(self):
+        from avenir_tpu.obs import exporters as E
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=10.0)
+        try:
+            q = _prefill_inproc(130, 10)
+            eng = ServingEngine("softMax", ACTIONS, {"batch.size": 1}, q,
+                                seed=4)
+            eng.run()
+            report = hub.report()
+        finally:
+            hub.disable()
+            hub.reset()
+        assert "engine.select" in report["spans"]
+        assert "engine.io" in report["spans"]
+        gauges = report["gauges"]
+        assert 0.0 <= gauges["engine.overlap_fraction"] <= 1.0
+        assert gauges["engine.reward_backlog"] == 0
+
+    def test_on_batch_callback_counts_events(self):
+        seen = []
+        q = _prefill_inproc(130, 0)
+        eng = ServingEngine("softMax", ACTIONS, {"batch.size": 1}, q,
+                            seed=4, on_batch=seen.append)
+        stats = eng.run()
+        assert sum(seen) == stats.events == 130
+        assert len(seen) == stats.batches
+
+
+class TestServingSmokeScript:
+    def test_serving_smoke_script(self):
+        """tier-1 hook (the multichip_smoke pattern): the smoke must
+        gate engine >= 2x sync decisions/sec, bit-parity, and <=5%
+        disabled-telemetry overhead. One retry absorbs a transient
+        co-tenant load spike — the gates themselves are unchanged."""
+        script = os.path.join(os.path.dirname(__file__), os.pardir,
+                              "scripts", "serving_smoke.py")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        last = None
+        for attempt in range(2):
+            proc = subprocess.run(
+                [sys.executable, script, "--events", "10000"],
+                capture_output=True, text=True, timeout=560, env=env)
+            last = proc
+            if proc.returncode == 0:
+                break
+            time.sleep(2)
+        assert last.returncode == 0, (
+            f"serving_smoke failed twice:\nstdout: {last.stdout[-800:]}\n"
+            f"stderr: {last.stderr[-800:]}")
+        import json
+        report = json.loads(last.stdout.strip().splitlines()[-1])
+        assert report["bit_identical"] is True
+        assert report["speedup_vs_sync"] >= 2.0
+        assert report["round_trips_per_batch"] <= 5.0

@@ -80,6 +80,26 @@ class TestNaiveBayesStreamed:
         np.testing.assert_array_equal(np.asarray(mem_model.post_counts),
                                       np.asarray(st_model.post_counts))
 
+    def test_windowed_encode_fails_fast_before_spec_build(
+            self, tmp_path, monkeypatch):
+        """ADVICE r5 regression guard: on a Python-fallback host
+        ``encode_file_windowed`` must raise NativeUnavailable from its
+        availability probe BEFORE paying ``_build_specs`` (the vocab-blob
+        assembly is non-trivial for wide vocabularies)."""
+        from avenir_tpu.native import loader
+        fz, _ = self._setup(tmp_path, n=20)
+
+        def unavailable(*a, **k):
+            raise loader.NativeUnavailable("forced by test")
+
+        def spec_build_must_not_run(*a, **k):
+            raise AssertionError(
+                "_build_specs ran before the availability probe")
+        monkeypatch.setattr(loader, "_native_lib_and_delim", unavailable)
+        monkeypatch.setattr(loader, "_build_specs", spec_build_must_not_run)
+        with pytest.raises(loader.NativeUnavailable):
+            loader.encode_file_windowed(fz, str(tmp_path / "train.csv"))
+
     def test_cli_streaming_flag_same_model_file(self, tmp_path, capsys):
         from avenir_tpu.cli.main import main as cli
         rows = G.churn_rows(1200, seed=3)
