@@ -1325,6 +1325,10 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
         raise ValueError(
             "serving.engine=true does not checkpoint (durability is the "
             "broker ledger's job); unset checkpoint.dir or serving.engine")
+    # opt-in ``id|ts`` event lines: queue wait from the stamped enqueue
+    # time lands in the engine.queue_wait histogram (requires telemetry,
+    # i.e. --metrics-out, to be visible); actions keep the bare id
+    event_ts = conf.get_bool("event.timestamps", False)
     queues = InProcQueues()
 
     def fill(resumed_events: int = 0) -> None:
@@ -1347,7 +1351,8 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
             seed=conf.get_int("random.seed", 0),
             min_batch=conf.get_int("engine.min.batch", 8),
             max_batch=conf.get_int("engine.max.batch", 0) or None,
-            drain_max=conf.get_int("engine.reward.drain.max", 0) or None)
+            drain_max=conf.get_int("engine.reward.drain.max", 0) or None,
+            event_timestamps=event_ts)
         stats = engine.run()
         extra = (f', "overlap_fraction": '
                  f'{round(stats.overlap_fraction, 3)}'
@@ -1357,8 +1362,8 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
                 learner_type, actions, conf.as_dict(), queues,
                 seed=conf.get_int("random.seed", 0),
                 checkpoint_dir=conf.get("checkpoint.dir"),
-                checkpoint_interval=conf.get_int("checkpoint.interval", 100)
-                ) as loop:
+                checkpoint_interval=conf.get_int("checkpoint.interval", 100),
+                event_timestamps=event_ts) as loop:
             # the event file is re-read in full on restart; skip the lines
             # a restored checkpoint already served (rewards are skipped
             # inside the loop, which sees the re-drained reward stream)
@@ -1660,6 +1665,12 @@ def main(argv: List[str] = None) -> int:
                              "(spans, compile counts, RSS, counters) after "
                              "the job: JSONL events at PATH, Prometheus "
                              "text exposition at PATH.prom")
+    parser.add_argument("--profile-dir", metavar="PATH", default=None,
+                        help="profile the job through jax.profiler into "
+                             "PATH (an XLA trace viewable in TensorBoard/"
+                             "Perfetto) — the flag form of the "
+                             "profile.trace.dir config key, mirroring "
+                             "--metrics-out")
     args = parser.parse_args(argv)
 
     conf = JobConfig.from_file(args.conf)
@@ -1677,7 +1688,9 @@ def main(argv: List[str] = None) -> int:
     logger = profiling.get_logger("cli", debug_on)
     logger.debug("verb=%s input=%s output=%s conf=%s",
                  args.verb, args.input, args.output, args.conf)
-    trace_dir = conf.get("profile.trace.dir")
+    # the flag wins over the config key (an operator profiling one run
+    # should not have to edit the job's properties file)
+    trace_dir = args.profile_dir or conf.get("profile.trace.dir")
     timer = profiling.StepTimer(args.verb)
     ctx = (profiling.trace(trace_dir) if trace_dir
            else contextlib.nullcontext())

@@ -14,21 +14,27 @@ The measurement substrate every job and loop reports into (ISSUE 2):
 One switch: ``obs.hub().enable()`` (the CLI's ``--metrics-out`` flag).
 """
 
-from avenir_tpu.obs.exporters import (TelemetryHub, hub, prometheus_text,
-                                      read_jsonl, report_to_events,
-                                      events_to_report, write_jsonl)
+from avenir_tpu.obs.exporters import (TelemetryHub, hub, merge_reports,
+                                      prometheus_text, read_jsonl,
+                                      report_to_events, events_to_report,
+                                      source_label, write_jsonl,
+                                      write_report)
 from avenir_tpu.obs.runtime import (CompileTracker, RuntimeSampler,
                                     device_memory_stats,
                                     install_compile_listener,
                                     read_proc_status, snapshot_brief)
 from avenir_tpu.obs.telemetry import (BUCKET_BOUNDS_MS, LatencyHistogram,
-                                      Tracer, enable, percentiles, span,
-                                      tracer)
+                                      Tracer, enable, percentiles,
+                                      percentiles_weighted,
+                                      snapshot_slot_counts, span, tracer)
 
 __all__ = [
     "BUCKET_BOUNDS_MS", "CompileTracker", "LatencyHistogram",
     "RuntimeSampler", "TelemetryHub", "Tracer", "device_memory_stats",
     "enable", "events_to_report", "hub", "install_compile_listener",
-    "percentiles", "prometheus_text", "read_jsonl", "read_proc_status",
-    "report_to_events", "snapshot_brief", "span", "tracer", "write_jsonl",
+    "merge_reports", "percentiles", "percentiles_weighted",
+    "prometheus_text", "read_jsonl",
+    "read_proc_status", "report_to_events", "snapshot_brief",
+    "snapshot_slot_counts", "source_label", "span", "tracer",
+    "write_jsonl", "write_report",
 ]

@@ -26,10 +26,12 @@ Everything is disabled by default; ``hub().enable()`` is the one switch
 from __future__ import annotations
 
 import json
+import os
 import re
+import socket
 import threading
 import time
-from typing import Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
 from avenir_tpu.obs import runtime as _runtime
 from avenir_tpu.obs import telemetry as _telemetry
@@ -64,10 +66,29 @@ def report_to_events(report: Dict) -> List[Dict]:
     return events
 
 
+def _atomic_write(path: str, emit: Callable) -> None:
+    """Write through a same-directory temp file + ``os.replace``: a crash
+    (or serialization error) mid-report leaves the previous file intact
+    instead of a truncated JSONL/.prom for a coordinator to mis-parse.
+    Same-filesystem rename is atomic on POSIX."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            emit(fh)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+
+
 def write_jsonl(events: Iterable[Dict], path: str) -> None:
-    with open(path, "w") as fh:
+    def emit(fh):
         for event in events:
             fh.write(json.dumps(event, sort_keys=True) + "\n")
+    _atomic_write(path, emit)
 
 
 def read_jsonl(path: str) -> List[Dict]:
@@ -108,7 +129,14 @@ def prometheus_text(report: Dict, prefix: str = "avenir") -> str:
         emit(metric, "counter", [f"{metric} {value}"])
     for name, value in sorted(report.get("gauges", {}).items()):
         metric = f"{prefix}_{_prom_name(name)}"
-        emit(metric, "gauge", [f"{metric} {value}"])
+        if isinstance(value, dict):
+            # merged fleet report: per-source values keep their origin as
+            # a label instead of collapsing to one meaningless number
+            emit(metric, "gauge",
+                 [f'{metric}{{source="{_prom_label(str(src))}"}} {v}'
+                  for src, v in sorted(value.items())])
+        else:
+            emit(metric, "gauge", [f"{metric} {value}"])
 
     runtime = report.get("runtime", {})
     for key in ("rss_kb_last", "rss_kb_max", "vm_hwm_kb", "samples"):
@@ -141,6 +169,115 @@ def prometheus_text(report: Dict, prefix: str = "avenir") -> str:
     return "\n".join(lines) + "\n"
 
 
+def write_report(report: Dict, path: str) -> Dict[str, str]:
+    """Dump any report dict (a hub's or a merged fleet one): JSONL events
+    at ``path``, Prometheus text at ``path + ".prom"`` — both written
+    atomically (temp file + rename). Returns the paths written."""
+    write_jsonl(report_to_events(report), path)
+    prom_path = path + ".prom"
+    text = prometheus_text(report)
+    _atomic_write(prom_path, lambda fh: fh.write(text))
+    return {"jsonl": path, "prom": prom_path}
+
+
+def source_label(meta: Dict, index: int = 0) -> str:
+    """Stable per-report origin label for the merged report's gauges:
+    worker id when the report carries one, host:pid otherwise, a running
+    index as the last resort."""
+    if meta.get("worker_id") is not None:
+        return f"w{meta['worker_id']}"
+    if meta.get("host") and meta.get("pid"):
+        return f"{meta['host']}:{meta['pid']}"
+    return f"r{index}"
+
+
+# runtime fields that take the MAX across sources (memory envelopes: the
+# fleet's peak is the binding constraint) vs the ones that SUM (activity)
+_RUNTIME_MAX = ("rss_kb_last", "rss_kb_max", "vm_hwm_kb")
+_RUNTIME_SUM = ("samples",)
+
+
+def merge_reports(reports: List[Dict]) -> Dict:
+    """Merge per-process telemetry reports into ONE fleet report.
+
+    The algebra, per section:
+
+    - **spans** merge bucket-for-bucket via
+      :meth:`~avenir_tpu.obs.telemetry.LatencyHistogram.merge` (sound
+      because bucket bounds are fixed forever); percentile estimates are
+      recomputed from the merged buckets, never averaged.
+    - **counters** sum — they are totals of disjoint work.
+    - **gauges** keep per-source values under a ``source`` key (a gauge is
+      a point-in-time reading; averaging two workers' queue depths would
+      manufacture a number nobody observed).
+    - **runtime** maxes the RSS envelope fields, sums sample/compile
+      activity.
+    - **meta** records every source's meta under ``sources`` (host/pid/
+      worker_id — the attribution trail) plus the merge arity.
+
+    Empty/None reports are identity elements; the merge of one report is
+    that report's data unchanged (modulo recomputed percentiles). The
+    merge is CLOSED: an already-merged report feeds back in cleanly
+    (its per-source gauge dicts splice instead of nesting, its sources
+    flatten into the combined attribution list), so folding pairwise,
+    in arrival order, or across runs' JSONL files all agree."""
+    reports = [r for r in reports if r]
+    merged: Dict = {"spans": {}, "counters": {}, "gauges": {},
+                    "runtime": {"compile": {}}}
+    hists: Dict[str, _telemetry.LatencyHistogram] = {}
+    sources: List[Dict] = []
+    generated_at = 0.0
+    for i, report in enumerate(reports):
+        meta = report.get("meta", {})
+        if "sources" in meta:          # already-merged input: flatten
+            sources.extend(dict(s) for s in meta["sources"])
+        else:
+            sources.append(dict(meta))
+        generated_at = max(generated_at, meta.get("generated_at") or 0.0)
+        label = source_label(meta, i)
+        for name, snap in report.get("spans", {}).items():
+            hist = hists.get(name)
+            if hist is None:
+                hist = hists[name] = _telemetry.LatencyHistogram()
+            hist.merge(snap)
+        for name, value in report.get("counters", {}).items():
+            merged["counters"][name] = (
+                merged["counters"].get(name, 0.0) + value)
+        for name, value in report.get("gauges", {}).items():
+            slot = merged["gauges"].setdefault(name, {})
+            if isinstance(value, dict):
+                # already per-source (a merged report): splice the
+                # entries under their OWN labels — nesting them under
+                # this report's label would corrupt the exposition
+                slot.update(value)
+            else:
+                slot[label] = value
+        runtime = report.get("runtime", {})
+        for key in _RUNTIME_MAX:
+            if key in runtime:
+                merged["runtime"][key] = max(
+                    merged["runtime"].get(key, 0), runtime[key])
+        for key in _RUNTIME_SUM:
+            if key in runtime:
+                merged["runtime"][key] = (
+                    merged["runtime"].get(key, 0) + runtime[key])
+        for key, value in runtime.get("compile", {}).items():
+            if key == "available":
+                merged["runtime"]["compile"]["available"] = (
+                    merged["runtime"]["compile"].get("available", False)
+                    or bool(value))
+            else:
+                merged["runtime"]["compile"][key] = round(
+                    merged["runtime"]["compile"].get(key, 0) + value, 6)
+    merged["spans"] = {name: h.snapshot()
+                       for name, h in sorted(hists.items())}
+    merged["meta"] = {"format": "avenir-telemetry-v1",
+                      "generated_at": generated_at or time.time(),
+                      "merged_sources": len(reports),
+                      "sources": sources}
+    return merged
+
+
 class TelemetryHub:
     """Process-wide merge point: spans + runtime + counters -> one report.
 
@@ -161,6 +298,10 @@ class TelemetryHub:
         self._lock = threading.Lock()
         self._enabled = False
         self._enabled_at: Optional[float] = None
+        # extra meta (e.g. worker_id) merged into every report's meta so
+        # fleet-merged reports stay attributable; survives reset() — the
+        # process's identity does not change between jobs
+        self._meta: Dict = {}
 
     @classmethod
     def get(cls) -> "TelemetryHub":
@@ -244,6 +385,13 @@ class TelemetryHub:
             for name, value in values.items():
                 self._gauges[name] = float(value)
 
+    def set_meta(self, **kw) -> None:
+        """Attach identity fields (``worker_id=3``) to every future
+        report's meta — the attribution the fleet merge keys its
+        per-source gauges on."""
+        with self._lock:
+            self._meta.update(kw)
+
     # -- outputs -----------------------------------------------------------
     def counters(self) -> Dict[str, float]:
         merged: Dict[str, float] = {}
@@ -255,12 +403,21 @@ class TelemetryHub:
     def report(self) -> Dict:
         runtime = self.sampler.snapshot()
         runtime["compile"] = self.compile_tracker.snapshot()
+        now = time.time()
         with self._lock:
             gauges = dict(self._gauges)
+            extra_meta = dict(self._meta)
         return {
-            "meta": {"generated_at": time.time(),
+            "meta": {"generated_at": now,
                      "enabled_at": self._enabled_at,
-                     "format": "avenir-telemetry-v1"},
+                     # how long telemetry has been collecting — the
+                     # denominator a rate dashboard divides counters by
+                     "duration_s": (round(now - self._enabled_at, 6)
+                                    if self._enabled_at else None),
+                     "host": socket.gethostname(),
+                     "pid": os.getpid(),
+                     "format": "avenir-telemetry-v1",
+                     **extra_meta},
             "spans": self.tracer.snapshot(),
             "counters": self.counters(),
             "gauges": gauges,
@@ -269,13 +426,9 @@ class TelemetryHub:
 
     def write(self, path: str) -> Dict[str, str]:
         """Dump the merged report: JSONL events at ``path``, Prometheus
-        text at ``path + ".prom"``. Returns the paths written."""
-        report = self.report()
-        write_jsonl(report_to_events(report), path)
-        prom_path = path + ".prom"
-        with open(prom_path, "w") as fh:
-            fh.write(prometheus_text(report))
-        return {"jsonl": path, "prom": prom_path}
+        text at ``path + ".prom"``, both atomically (temp + rename).
+        Returns the paths written."""
+        return write_report(self.report(), path)
 
 
 def hub() -> TelemetryHub:

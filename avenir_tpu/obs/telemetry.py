@@ -39,6 +39,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 BUCKET_BOUNDS_MS: Tuple[float, ...] = tuple(
     0.001 * 2.0 ** i for i in range(28))
 
+# snapshot bucket keys are repr(bound); the merge path maps them back
+_BOUND_INDEX = {repr(b): i for i, b in enumerate(BUCKET_BOUNDS_MS)}
+
 _PCTS = (50, 95, 99)
 
 
@@ -58,6 +61,55 @@ def percentiles(values: Sequence[float],
         rank = max(1, math.ceil(q / 100.0 * n))
         out[q] = float(ordered[min(rank, n) - 1])
     return out
+
+
+def percentiles_weighted(pairs: Sequence[Tuple[float, int]],
+                         qs: Sequence[int] = _PCTS) -> Dict[int, float]:
+    """Nearest-rank percentiles of a WEIGHTED multiset: ``(value, n)``
+    entries stand for ``n`` repeats of ``value`` — identical result to
+    :func:`percentiles` over the expanded samples, at one entry per
+    batch. The serving loop's per-event ring records this shape so the
+    enabled hot path pays one append per batch; the rank rule
+    (``max(1, ceil(q/100 * total))``) lives HERE, beside its unweighted
+    sibling, so the convention cannot drift between the two."""
+    out = {q: 0.0 for q in qs}
+    total = sum(n for _, n in pairs)
+    if total <= 0:
+        return out
+    ordered = sorted(pairs)
+    for q in qs:
+        rank = max(1, math.ceil(q / 100.0 * total))
+        cum = 0
+        for value, n in ordered:
+            cum += n
+            if cum >= rank:
+                out[q] = float(value)
+                break
+    return out
+
+
+def snapshot_slot_counts(snap: Dict) -> List[int]:
+    """Per-slot (NON-cumulative) counts of a :meth:`LatencyHistogram.
+    snapshot` dict: one int per finite bucket bound plus the overflow
+    terminal. The inverse of the snapshot's cumulative ``le`` encoding —
+    what the merge folds, and what tests sum bucket-for-bucket across
+    worker reports (a cumulative value at an ABSENT key equals the last
+    present one, so cumulative dicts cannot be summed key-wise)."""
+    slots = [0] * (len(BUCKET_BOUNDS_MS) + 1)
+    count = int(snap.get("count", 0))
+    if count == 0:
+        return slots
+    prev = 0
+    for key, cum in sorted(snap.get("buckets", {}).items(),
+                           key=lambda kv: _BOUND_INDEX.get(kv[0],
+                                                           len(slots))):
+        idx = _BOUND_INDEX.get(key)
+        if idx is None:          # the "+Inf" terminal sorts last; skip it
+            continue
+        slots[idx] = int(cum) - prev
+        prev = int(cum)
+    slots[-1] = count - prev     # overflow = total minus last finite cum
+    return slots
 
 
 class LatencyHistogram:
@@ -81,16 +133,49 @@ class LatencyHistogram:
         self.max_ms = 0.0
         self._lock = threading.Lock()
 
-    def record(self, ms: float) -> None:
+    def record(self, ms: float, n: int = 1) -> None:
+        """Record ``n`` observations of the same latency in one bisect +
+        one lock acquisition — how batch loops amortize one clock read
+        over every event of a batch without N record calls."""
+        if n <= 0:
+            return
         idx = bisect.bisect_left(BUCKET_BOUNDS_MS, ms)
         with self._lock:
-            self._counts[idx] += 1
-            self.count += 1
-            self.sum_ms += ms
+            self._counts[idx] += n
+            self.count += n
+            self.sum_ms += ms * n
             if ms < self.min_ms:
                 self.min_ms = ms
             if ms > self.max_ms:
                 self.max_ms = ms
+
+    def merge(self, snap: Dict) -> None:
+        """Fold another histogram's :meth:`snapshot` dict into this one
+        bucket-for-bucket — the fleet-merge primitive. Sound because the
+        bucket bounds are FIXED (module header): every process's slot i
+        covers the same range, so per-slot counts simply add. Count/sum
+        add, min/max envelope; the merge is associative and
+        order-independent (integer bucket counts; float sums to rounding).
+        An empty snapshot is the identity."""
+        count = int(snap.get("count", 0))
+        if count == 0:
+            return
+        slots = snapshot_slot_counts(snap)
+        with self._lock:
+            for i, c in enumerate(slots):
+                self._counts[i] += c
+            self.count += count
+            self.sum_ms += float(snap.get("sum_ms", 0.0))
+            if snap.get("min_ms", float("inf")) < self.min_ms:
+                self.min_ms = float(snap["min_ms"])
+            if snap.get("max_ms", 0.0) > self.max_ms:
+                self.max_ms = float(snap["max_ms"])
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict) -> "LatencyHistogram":
+        h = cls()
+        h.merge(snap)
+        return h
 
     def percentile_ms(self, q: float) -> float:
         """Bucket-edge estimate of the q-th percentile (q in [0, 100])."""
@@ -193,16 +278,16 @@ class Tracer:
             return _NULL_SPAN
         return _Span(self, name)
 
-    def record(self, name: str, ms: float) -> None:
+    def record(self, name: str, ms: float, n: int = 1) -> None:
         """Record a latency directly (batch loops that amortize one clock
-        read over N events use this instead of N spans)."""
+        read over N events use this with ``n`` instead of N spans)."""
         if not self.enabled:
             return
         hist = self._hists.get(name)
         if hist is None:
             with self._lock:
                 hist = self._hists.setdefault(name, LatencyHistogram())
-        hist.record(ms)
+        hist.record(ms, n)
 
     def histogram(self, name: str) -> Optional[LatencyHistogram]:
         return self._hists.get(name)

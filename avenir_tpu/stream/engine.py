@@ -49,9 +49,14 @@ only after the next wave has been dispatched), and drained rewards
 ``reward_masked`` dispatch.
 
 Telemetry (all free while the tracer is disabled): spans
-``engine.select`` (host blocked on readback per batch) and ``engine.io``
-(broker I/O per batch), hub gauges ``engine.overlap_fraction``,
-``engine.queue_depth`` and ``engine.reward_backlog``.
+``engine.select`` (host blocked on readback per batch), ``engine.io``
+(broker I/O per batch) and ``engine.decision_latency`` (pop→action-
+written per EVENT, one amortized record per batch — the fleet SLO
+signal, ISSUE 6), hub gauges ``engine.overlap_fraction``,
+``engine.queue_depth`` and ``engine.reward_backlog``. With
+``event_timestamps=True`` (harness-controlled producers stamping
+``id|enqueue_ts``) the enqueue→pop gap additionally lands in
+``engine.queue_wait``.
 """
 
 from __future__ import annotations
@@ -215,7 +220,8 @@ class ServingEngine:
                  min_batch: int = 8, max_batch: Optional[int] = None,
                  drain_max: Optional[int] = None,
                  learner: Optional[Learner] = None,
-                 on_batch: Optional[Callable[[int], None]] = None):
+                 on_batch: Optional[Callable[[int], None]] = None,
+                 event_timestamps: bool = False):
         self.learner = (learner if learner is not None
                         else Learner(learner_type, actions, config, seed))
         self.queues = queues
@@ -225,6 +231,10 @@ class ServingEngine:
         self._drain_max = drain_max
         self._on_batch = on_batch
         self._tel = telemetry.tracer()
+        # opt-in ``id|ts`` payloads (stream.loop.split_event_timestamp):
+        # queue wait measured end-to-end, actions written under the bare
+        # id, acks by raw payload; wire format untouched when off
+        self._event_ts = bool(event_timestamps)
         self.stats.batch_cap = self._cap.cap
 
     # -- pipeline stages -----------------------------------------------------
@@ -244,18 +254,29 @@ class ServingEngine:
             self.stats.reward_backlog = int(backlog)
         return io_s, len(pairs)
 
-    def _complete(self, events: List[str], handles, batch_size: int) -> None:
+    def _complete(self, events: List[str], acks: List[str], handles,
+                  t_pop: float, batch_size: int) -> None:
         """Finish an in-flight batch: the ONLY blocking readback on the
         path, then the batch's bulk write + bulk ack. Ack strictly after
         write — a death in between replays the batch (at-least-once via
-        the pending ledger)."""
+        the pending ledger). ``t_pop`` is the clock read taken before the
+        batch's pop: write-done minus it is the pop→action-written
+        decision latency every event of the batch observed, recorded once
+        per batch with count ``len(events)`` (ISSUE 6)."""
         t0 = time.perf_counter()
         selections = self.learner.resolve_action_batch(handles)
         t1 = time.perf_counter()
         entries = [(event_id,
                     selections[i * batch_size:(i + 1) * batch_size])
                    for i, event_id in enumerate(events)]
-        _write_and_ack(self.queues, entries)
+        if not self._event_ts:
+            _write_and_ack(self.queues, entries)
+        else:
+            # timestamps mode: write ids differ from the raw ledger
+            # payloads, so the fused single-round-trip path (which acks
+            # the write ids) cannot be used — write, then ack the raws
+            _write_actions(self.queues, entries)
+            _ack_events(self.queues, acks)
         t2 = time.perf_counter()
         self.stats.select_wait_ms += (t1 - t0) * 1e3
         self.stats.io_ms += (t2 - t1) * 1e3
@@ -266,6 +287,8 @@ class ServingEngine:
         if self._tel.enabled:
             self._tel.record("engine.select", (t1 - t0) * 1e3)
             self._tel.record("engine.io", (t2 - t1) * 1e3)
+            self._tel.record("engine.decision_latency",
+                             (t2 - t_pop) * 1e3, len(events))
             depth = (self.queues.depth()
                      if hasattr(self.queues, "depth") else None)
             if depth is not None:
@@ -288,7 +311,7 @@ class ServingEngine:
         learner = self.learner
         batch_size = learner.cfg.batch_size
         processed = 0
-        pending: Optional[Tuple[List[str], Any]] = None
+        pending: Optional[Tuple] = None
         last_folded = 0
         while True:
             io_s, last_folded = self._fold_rewards()
@@ -298,6 +321,10 @@ class ServingEngine:
                 cap = min(cap, max_events - processed)
             events = _pop_events(self.queues, cap)
             t1 = time.perf_counter()
+            acks = events
+            if events and self._event_ts:
+                from avenir_tpu.stream.loop import strip_event_timestamps
+                events = strip_event_timestamps(acks, self._tel)
             handles = None
             if events:
                 handles = learner.next_action_batch_async(
@@ -308,10 +335,12 @@ class ServingEngine:
             if self._tel.enabled and (io_s or events):
                 self._tel.record("engine.io", (io_s + (t1 - t0)) * 1e3)
             if pending is not None:
-                self._complete(pending[0], pending[1], batch_size)
+                self._complete(*pending, batch_size)
             if not events:
                 break
-            pending = (events, handles)
+            # t0 (pre-pop clock read) rides along as the batch's
+            # decision-latency anchor
+            pending = (events, acks, handles, t0)
             processed += len(events)
             if max_events is None or processed < max_events:
                 self._cap.update(len(events))
@@ -348,7 +377,8 @@ class GroupedServingEngine:
                  seed: int = 0, min_batch: int = 8,
                  max_batch: Optional[int] = None,
                  drain_max: Optional[int] = None, delim: str = ":",
-                 on_batch: Optional[Callable[[int], None]] = None):
+                 on_batch: Optional[Callable[[int], None]] = None,
+                 event_timestamps: bool = False):
         from avenir_tpu.stream.loop import GroupedLearner
         self.groups = list(groups)
         # the host-side id<->index dicts: group routing and reward
@@ -364,6 +394,7 @@ class GroupedServingEngine:
         self._delim = delim
         self._on_batch = on_batch
         self._tel = telemetry.tracer()
+        self._event_ts = bool(event_timestamps)
 
     def _split_group(self, payload: str) -> Tuple[int, str]:
         group, _, rest = payload.partition(self._delim)
@@ -407,31 +438,45 @@ class GroupedServingEngine:
         if backlog is not None:
             self.stats.reward_backlog = int(backlog)
 
-    def _make_waves(self, events: List[str]) -> List[List[Tuple[str, int]]]:
+    def _make_waves(self, events: List[str]
+                    ) -> List[List[Tuple[str, int, str]]]:
         """Wave w = the w-th pending event of each context, in pop order
-        (per-context counters: O(events), not a per-event wave scan)."""
-        waves: List[List[Tuple[str, int]]] = []
+        (per-context counters: O(events), not a per-event wave scan).
+        Entries are ``(write_id, group_index, raw_payload)`` — write id
+        and raw differ only in timestamps mode, where the enqueue stamp
+        is peeled into ``engine.queue_wait``."""
+        ids = events
+        if self._event_ts:
+            from avenir_tpu.stream.loop import strip_event_timestamps
+            ids = strip_event_timestamps(events, self._tel)
+        waves: List[List[Tuple[str, int, str]]] = []
         depth: Dict[int, int] = {}
-        for event_id in events:
+        for event_id, raw in zip(ids, events):
             gidx, _ = self._split_group(event_id)
             w = depth.get(gidx, 0)
             depth[gidx] = w + 1
             if w == len(waves):
                 waves.append([])
-            waves[w].append((event_id, gidx))
+            waves[w].append((event_id, gidx, raw))
         return waves
 
-    def _complete(self, waves, handles) -> None:
+    def _complete(self, waves, handles, t_pop: float) -> None:
         import numpy as np
         t0 = time.perf_counter()
         resolved = [np.asarray(h) for h in handles]   # the blocking fetch
         t1 = time.perf_counter()
         entries = []
+        acks = []
         for wave, actions in zip(waves, resolved):
-            for event_id, gidx in wave:
+            for event_id, gidx, raw in wave:
                 entries.append((event_id, [self.gl.actions[int(
                     actions[gidx])]]))
-        _write_and_ack(self.queues, entries)
+                acks.append(raw)
+        if not self._event_ts:
+            _write_and_ack(self.queues, entries)
+        else:
+            _write_actions(self.queues, entries)
+            _ack_events(self.queues, acks)
         t2 = time.perf_counter()
         n_events = sum(len(w) for w in waves)
         self.stats.select_wait_ms += (t1 - t0) * 1e3
@@ -443,6 +488,8 @@ class GroupedServingEngine:
         if self._tel.enabled:
             self._tel.record("engine.select", (t1 - t0) * 1e3)
             self._tel.record("engine.io", (t2 - t1) * 1e3)
+            self._tel.record("engine.decision_latency",
+                             (t2 - t_pop) * 1e3, n_events)
         if self._on_batch is not None:
             self._on_batch(n_events)
 
@@ -462,10 +509,10 @@ class GroupedServingEngine:
             handles = [self.gl.next_all_async() for _ in waves]
             self.stats.dispatch_ms += (time.perf_counter() - t1) * 1e3
             if pending is not None:
-                self._complete(pending[0], pending[1])
+                self._complete(*pending)
             if not events:
                 break
-            pending = (waves, handles)
+            pending = (waves, handles, t0)
             processed += len(events)
             if max_events is None or processed < max_events:
                 self._cap.update(len(events))

@@ -38,6 +38,39 @@ from avenir_tpu.models.bandits.learners import (
 from avenir_tpu.obs import telemetry
 
 
+def split_event_timestamp(payload: str) -> Tuple[str, Optional[float]]:
+    """Split an opt-in ``id|enqueue_ts`` event payload (ISSUE 6: true
+    end-to-end queue wait). Returns ``(event_id, ts)``; a payload without
+    a parseable trailing timestamp comes back unchanged with ``ts=None``,
+    so a producer that never stamps is handled bit-identically — the wire
+    format only changes when the harness opts in on BOTH ends."""
+    event_id, sep, ts = payload.rpartition("|")
+    if not sep:
+        return payload, None
+    try:
+        return event_id, float(ts)
+    except ValueError:
+        return payload, None
+
+
+def strip_event_timestamps(raws: Sequence[str], tel) -> List[str]:
+    """Peel enqueue timestamps off a popped batch: returns the bare ids
+    (for action writes; callers keep ``raws`` for acks — the ledger
+    stores the verbatim popped bytes) and records each stamped payload's
+    enqueue→pop gap into the ``engine.queue_wait`` histogram. ONE
+    wall-clock read for the whole batch; each event still gets its own
+    record because enqueue times differ per event. The single home for
+    this logic — the loop's both paths and both engines call it."""
+    now = time.time()
+    ids = []
+    for raw in raws:
+        event_id, ts = split_event_timestamp(raw)
+        ids.append(event_id)
+        if ts is not None and tel.enabled:
+            tel.record("engine.queue_wait", max(now - ts, 0.0) * 1e3)
+    return ids
+
+
 # --------------------------------------------------------------------------
 # queue adapters
 # --------------------------------------------------------------------------
@@ -422,14 +455,27 @@ class OnlineLearnerLoop:
     def __init__(self, learner_type: str, actions: Sequence[str],
                  config: Dict[str, Any], queues, seed: int = 0,
                  checkpoint_dir: Optional[str] = None,
-                 checkpoint_interval: int = 100):
+                 checkpoint_interval: int = 100,
+                 event_timestamps: bool = False):
         self.learner = Learner(learner_type, actions, config, seed)
         self.queues = queues
         self.stats = LoopStats()
         # process-wide tracer: free no-ops while telemetry is disabled
         # (the default), span histograms + gauges when obs.hub() enables it
         self._tel = telemetry.tracer()
-        # per-event serving latencies (ms), bounded ring -> p50/p95/p99
+        # opt-in ``id|ts`` event payloads: actions are written under the
+        # bare id (downstream wire format unchanged), the enqueue->pop gap
+        # lands in the engine.queue_wait histogram, and acks use the RAW
+        # payload (the ledger stores the verbatim popped bytes)
+        self._event_ts = bool(event_timestamps)
+        # per-event serving latencies -> p50/p95/p99: WEIGHTED ring of
+        # (per_event_ms, n_events) pairs, ONE append per batch — the
+        # enabled hot path must not pay one float per event (ISSUE 6
+        # amortization). maxlen 2048 keeps step()-mode history exactly
+        # where the old per-event float ring had it (2048 events, n=1
+        # entries) while run()'s batch entries now cover up to 2048
+        # BATCHES; the refresh sort happens once per run() exit, never
+        # in the hot loop.
         self._event_ms: deque = deque(maxlen=2048)
         self._ckpt = None
         self._ckpt_mod = None
@@ -496,29 +542,40 @@ class OnlineLearnerLoop:
         """Fold the recorded per-event latencies into the LoopStats
         percentile gauges. Called on ``run`` exit and ``close`` (not per
         event: nearest-rank percentiles sort the ring, which would be
-        measurable in the hot loop)."""
+        measurable in the hot loop). The ring holds WEIGHTED
+        ``(per_event_ms, n)`` batch entries; ``percentiles_weighted``
+        gives nearest-rank over the expanded multiset — the same result
+        the old per-event ring produced, at one entry per batch."""
         if not self._event_ms:
             return
-        pct = telemetry.percentiles(list(self._event_ms))
+        pct = telemetry.percentiles_weighted(list(self._event_ms))
         self.stats.event_p50_ms = pct[50]
         self.stats.event_p95_ms = pct[95]
         self.stats.event_p99_ms = pct[99]
 
-    def _observe_event(self, n_events: int, elapsed_ms: float) -> None:
+    def _observe_event(self, n_events: int, elapsed_ms: float,
+                       decision_ms: Optional[float] = None) -> None:
         """Per-event latency + queue-depth/reward-lag gauges after serving
         ``n_events`` in ``elapsed_ms``. The reward-lag counter always
         updates (two int ops); everything else — latency ring, span
-        histogram, broker-RTT depth poll — runs only while telemetry is
+        histograms, broker-RTT depth poll — runs only while telemetry is
         enabled, keeping the default path inside the smoke script's 5%
-        bound (scripts/obs_smoke.py)."""
+        bound (scripts/obs_smoke.py). ``decision_ms`` is the batch's
+        pop→action-written wall time: the decision latency every event of
+        the batch actually observed (an event waits for its whole batch),
+        recorded ``n_events`` times into the fleet-wide
+        ``engine.decision_latency`` histogram via ONE amortized record —
+        the SLO-gate signal (ISSUE 6)."""
         self.stats.reward_lag = max(
             0, self.stats.events - self.stats.rewards)
         if not self._tel.enabled:
             return
         per_event = elapsed_ms / max(n_events, 1)
-        self._event_ms.extend([per_event] * n_events)
-        for _ in range(n_events):
-            self._tel.record("loop.event", per_event)
+        self._event_ms.append((per_event, n_events))
+        self._tel.record("loop.event", per_event, n_events)
+        if decision_ms is not None:
+            self._tel.record("engine.decision_latency", decision_ms,
+                             n_events)
         depth = self.queues.depth() if hasattr(
             self.queues, "depth") else None
         if depth is not None:
@@ -543,20 +600,32 @@ class OnlineLearnerLoop:
         for action_id, reward in self._drain_new_rewards():
             self.learner.set_reward(action_id, reward)
             self.stats.rewards += 1
-        event_id = self.queues.pop_event()
-        if event_id is None:
+        # decision latency is pop→action-written, so the clock restarts
+        # here (t0 includes the reward fold); gated so the disabled hot
+        # path keeps its single clock read
+        tel = self._tel.enabled
+        t_pop = time.perf_counter() if tel else t0
+        raw_event = self.queues.pop_event()
+        if raw_event is None:
             # empty polls are not serving latency: no histogram record
             self.stats.reward_lag = max(
                 0, self.stats.events - self.stats.rewards)
             return False
+        event_id = raw_event
+        if self._event_ts:
+            event_id = strip_event_timestamps([raw_event], self._tel)[0]
         selections = self.learner.next_actions()
         self.queues.write_actions(event_id, selections)
         # ack AFTER the answer is on the wire: a death in between replays
-        # the event (at-least-once) rather than losing it
-        self.queues.ack_event(event_id)
+        # the event (at-least-once) rather than losing it. Ack by the RAW
+        # payload — the ledger holds the verbatim popped bytes.
+        self.queues.ack_event(raw_event)
         self.stats.events += 1
         self.stats.actions_written += len(selections)
-        self._observe_event(1, (time.perf_counter() - t0) * 1e3)
+        now = time.perf_counter()
+        self._observe_event(
+            1, (now - t0) * 1e3,
+            decision_ms=(now - t_pop) * 1e3 if tel else None)
         self._maybe_checkpoint()
         return True
 
@@ -579,6 +648,8 @@ class OnlineLearnerLoop:
                 with self._tel.span("loop.reward_fold"):
                     self.learner.set_reward_batch(pairs)
                 self.stats.rewards += len(pairs)
+            tel = self._tel.enabled
+            t_pop = time.perf_counter() if tel else t_batch
             events: List[str] = []
             while (len(events) < event_cap
                    and (max_events is None
@@ -606,6 +677,9 @@ class OnlineLearnerLoop:
                 self.stats.reward_lag = max(
                     0, self.stats.events - self.stats.rewards)
                 break
+            raws = events
+            if self._event_ts:
+                events = strip_event_timestamps(raws, self._tel)
             with self._tel.span("loop.select"):
                 selections = self.learner.next_action_batch(
                     len(events) * batch_size)
@@ -613,14 +687,16 @@ class OnlineLearnerLoop:
             for i, event_id in enumerate(events):
                 sel = selections[i * batch_size:(i + 1) * batch_size]
                 self.queues.write_actions(event_id, sel)
-                self.queues.ack_event(event_id)
+                self.queues.ack_event(raws[i])
                 self.stats.events += 1
                 self.stats.actions_written += len(sel)
             processed += len(events)
+            now = time.perf_counter()
             # batch wall time amortized per event: the micro-batched
             # serving latency each event actually observed
             self._observe_event(
-                len(events), (time.perf_counter() - t_batch) * 1e3)
+                len(events), (now - t_batch) * 1e3,
+                decision_ms=(now - t_pop) * 1e3 if tel else None)
             self._maybe_checkpoint(events_before)
         self.refresh_latency_stats()
         return self.stats

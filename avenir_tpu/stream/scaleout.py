@@ -67,6 +67,12 @@ STOP_SENTINEL = "__STOP__"
 HEARTBEAT_QUEUE = "heartbeatQueue"
 HEARTBEAT_EVERY = 25  # events between heartbeats (plus start + exit)
 
+# fleet telemetry (ISSUE 6): telemetry-armed workers serialize their FULL
+# obs report through the broker on the heartbeat cadence; the coordinator
+# drains the list, keeps each worker's latest, and merges them into ONE
+# fleet report (obs.exporters.merge_reports) written by --metrics-out
+TELEMETRY_QUEUE = "telemetryQueue"
+
 
 def owned_groups(groups: Sequence[str], worker_id: int,
                  n_workers: int) -> List[str]:
@@ -74,11 +80,60 @@ def owned_groups(groups: Sequence[str], worker_id: int,
     return [g for i, g in enumerate(groups) if i % n_workers == worker_id]
 
 
+# the last report payload this PROCESS pushed, per worker id: each new
+# push retires the previous one (LREM by value), so the telemetry queue
+# holds ~one full report per live worker instead of growing by one
+# multi-KB snapshot per heartbeat for the whole run. A SIGKILLed
+# worker's final entry survives untrimmed — bounded at one per crash,
+# and the driver keeps the latest per worker anyway.
+_LAST_REPORT_PAYLOAD: Dict[int, str] = {}
+
+
+def push_worker_report(client, worker_id: int) -> None:
+    """Ship this worker's merged telemetry report through the broker —
+    a no-op unless the process's TelemetryHub is live, so the default
+    (untelemetered) worker pays nothing. Rides the heartbeat cadence:
+    the caller is :func:`push_heartbeat`. Supersedes (removes) the
+    report this process pushed last time, keeping the queue bounded."""
+    try:
+        from avenir_tpu.obs.exporters import TelemetryHub
+        hub = TelemetryHub._instance
+        if hub is None or not hub.enabled:
+            return
+        report = hub.report()
+    except Exception:
+        # telemetry must never sink a serving worker
+        return
+    payload = json.dumps({"worker": worker_id, "report": report})
+    previous = _LAST_REPORT_PAYLOAD.get(worker_id)
+    if previous is not None:
+        try:
+            client.lrem(TELEMETRY_QUEUE, 1, previous)
+        except Exception:
+            pass                  # a client without lrem just accumulates
+    client.lpush(TELEMETRY_QUEUE, payload)
+    _LAST_REPORT_PAYLOAD[worker_id] = payload
+
+
+def read_worker_reports(client) -> Dict[int, Dict]:
+    """Drain the telemetry queue (driver side): the LATEST report per
+    worker wins — interim cadence pushes are superseded snapshots of the
+    same monotone histograms, not increments to sum."""
+    out: Dict[int, Dict] = {}
+    while True:
+        raw = client.rpop(TELEMETRY_QUEUE)
+        if raw is None:
+            return out
+        entry = json.loads(raw.decode())
+        out[int(entry["worker"])] = entry["report"]
+
+
 def push_heartbeat(client, worker_id: int, events: int, rewards: int,
                    grouping: str = "fields") -> None:
     client.lpush(HEARTBEAT_QUEUE, json.dumps(
         {"worker": worker_id, "events": events, "rewards": rewards,
          "ts": time.time(), "grouping": grouping}))
+    push_worker_report(client, worker_id)
 
 
 def read_heartbeats(client) -> List[Dict]:
@@ -110,30 +165,60 @@ def worker_throughput(heartbeats: Sequence[Dict]) -> Dict[int, float]:
 def detect_stragglers(heartbeats: Sequence[Dict],
                       min_events_fraction: float = 0.5,
                       stale_after_s: Optional[float] = None,
-                      now: Optional[float] = None) -> List[int]:
+                      now: Optional[float] = None,
+                      latency_p99: Optional[Dict[int, float]] = None,
+                      latency_factor: float = 3.0) -> List[int]:
     """Straggler = a worker whose LATEST heartbeat reports under
     ``min_events_fraction`` of the median worker's served events, or (with
     ``stale_after_s``) one whose last heartbeat is older than that — the
-    dead-worker signal during a live run. Returns sorted worker ids."""
+    dead-worker signal during a live run — or (with ``latency_p99``, the
+    per-worker ``engine.decision_latency`` p99 from the shipped fleet
+    reports) one whose p99 is >= ``latency_factor`` x the fleet median:
+    the latency-percentile signal ISSUE 6 upgrades throughput-only
+    detection with, which catches a worker that keeps up on COUNT while
+    serving every event slowly (e.g. a degraded core — invisible to the
+    event-fraction test until it finally falls behind). Returns sorted
+    worker ids."""
     latest: Dict[int, Dict] = {}
     for hb in heartbeats:
         worker = int(hb["worker"])
         cur = latest.get(worker)
         if cur is None or hb["ts"] >= cur["ts"]:
             latest[worker] = hb
-    if not latest:
-        return []
-    counts = sorted(h["events"] for h in latest.values())
-    median = counts[len(counts) // 2]
     flagged = set()
-    for worker, hb in latest.items():
-        if hb["events"] < min_events_fraction * median:
-            flagged.add(worker)
-        if stale_after_s is not None:
-            t_now = time.time() if now is None else now
-            if t_now - hb["ts"] > stale_after_s:
+    if latest:
+        counts = sorted(h["events"] for h in latest.values())
+        median = counts[len(counts) // 2]
+        for worker, hb in latest.items():
+            if hb["events"] < min_events_fraction * median:
                 flagged.add(worker)
+            if stale_after_s is not None:
+                t_now = time.time() if now is None else now
+                if t_now - hb["ts"] > stale_after_s:
+                    flagged.add(worker)
+    if latency_p99:
+        p99s = sorted(latency_p99.values())
+        # LOWER median: the straggler sits ABOVE the threshold, so for
+        # even fleets the upper-middle element would be the slow
+        # worker's own p99 and `p99 >= k * itself` could never fire —
+        # a 2-worker fleet (the most common deploy) would be blind
+        median_p99 = p99s[(len(p99s) - 1) // 2]
+        if median_p99 > 0:
+            for worker, p99 in latency_p99.items():
+                if p99 >= latency_factor * median_p99:
+                    flagged.add(worker)
     return sorted(flagged)
+
+
+def worker_latency_p99(worker_reports: Dict[int, Dict]) -> Dict[int, float]:
+    """Per-worker ``engine.decision_latency`` p99 out of shipped fleet
+    reports — the :func:`detect_stragglers` ``latency_p99`` input."""
+    out: Dict[int, float] = {}
+    for worker, report in worker_reports.items():
+        snap = report.get("spans", {}).get("engine.decision_latency")
+        if snap and snap.get("count"):
+            out[worker] = float(snap.get("p99_ms", 0.0))
+    return out
 
 
 class _StoppableQueues(RedisQueues):
@@ -271,7 +356,8 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
                 groups: Sequence[str], learner_type: str,
                 actions: Sequence[str], config: Dict, seed: int,
                 replay: bool = False, decision_io_ms: float = 0.0,
-                engine: bool = False) -> Dict:
+                engine: bool = False,
+                event_timestamps: bool = False) -> Dict:
     """One serving process: loops for the owned groups until every group's
     stop sentinel arrives. Returns per-worker stats. ``replay`` implements
     ``replay.failed.message=true``: on startup, un-acked events a dead
@@ -294,7 +380,8 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
     if engine:
         return _worker_main_engine(client, worker_id, n_workers, groups,
                                    learner_type, actions, config, seed,
-                                   replayed, decision_io_ms)
+                                   replayed, decision_io_ms,
+                                   event_timestamps)
     loops = {}
     for g in owned_groups(groups, worker_id, n_workers):
         # per-group seed component: each group's learner must explore
@@ -302,7 +389,8 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
         loops[g] = OnlineLearnerLoop(
             learner_type, actions, dict(config),
             _StoppableQueues(client, g),
-            seed=seed + 1000 * worker_id + list(groups).index(g))
+            seed=seed + 1000 * worker_id + list(groups).index(g),
+            event_timestamps=event_timestamps)
     active = set(loops)
     idle_sleep = 0.001
     served_total = 0
@@ -358,7 +446,8 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
 def _worker_main_engine(client, worker_id: int, n_workers: int,
                         groups: Sequence[str], learner_type: str,
                         actions: Sequence[str], config: Dict, seed: int,
-                        replayed: int, decision_io_ms: float) -> Dict:
+                        replayed: int, decision_io_ms: float,
+                        event_timestamps: bool = False) -> Dict:
     """Engine-mode worker body: one pipelined ``ServingEngine`` per owned
     group over the same stoppable per-group queues. Each visit drains the
     group's current backlog in one ``run()`` (pipelined micro-batches);
@@ -383,7 +472,7 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
             learner_type, actions, dict(config),
             _StoppableQueues(client, g),
             seed=seed + 1000 * worker_id + list(groups).index(g),
-            on_batch=on_batch)
+            on_batch=on_batch, event_timestamps=event_timestamps)
     active = set(engines)
     idle_sleep = 0.001
     push_heartbeat(client, worker_id, 0, 0)  # alive, engines constructed
@@ -432,6 +521,11 @@ class ScaleoutResult:
     worker_throughput: Dict[int, float] = field(default_factory=dict)
     stragglers: List[int] = field(default_factory=list)
     heartbeats: int = 0
+    # fleet telemetry (ISSUE 6): per-worker latest reports shipped over
+    # the broker, and their merge_reports fold — the thing --metrics-out
+    # writes. Both empty unless the run was telemetry-armed.
+    worker_reports: Dict[int, Dict] = field(default_factory=dict)
+    fleet_report: Optional[Dict] = None
 
 
 @contextlib.contextmanager
@@ -468,7 +562,8 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
                   actions: Sequence[str], config: Dict, seed: int,
                   replay: bool = False, decision_io_ms: float = 0.0,
                   grouping: str = "fields",
-                  engine: bool = False) -> subprocess.Popen:
+                  engine: bool = False, telemetry: bool = False,
+                  event_timestamps: bool = False) -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
            "--host", host, "--port", str(port),
@@ -482,6 +577,10 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
         cmd.append("--replay")
     if engine:
         cmd.append("--engine")
+    if telemetry:
+        cmd.append("--telemetry")
+    if event_timestamps:
+        cmd.append("--event-timestamps")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True)
 
@@ -491,11 +590,13 @@ def _spawn_workers(host: str, port: int, n_workers: int,
                    actions: Sequence[str], config: Dict, seed: int,
                    decision_io_ms: float = 0.0,
                    grouping: str = "fields",
-                   engine: bool = False) -> List[subprocess.Popen]:
+                   engine: bool = False, telemetry: bool = False,
+                   event_timestamps: bool = False) -> List[subprocess.Popen]:
     return [_spawn_worker(host, port, w, n_workers, groups, learner_type,
                           actions, config, seed,
                           decision_io_ms=decision_io_ms, grouping=grouping,
-                          engine=engine)
+                          engine=engine, telemetry=telemetry,
+                          event_timestamps=event_timestamps)
             for w in range(n_workers)]
 
 
@@ -521,19 +622,24 @@ def _drive(client: MiniRedisClient, groups: Sequence[str],
            ctr: Dict[str, Dict[str, float]], n_events: int,
            rate: Optional[float], rng, t_push: Dict[str, float],
            latencies: List[float], picks: List[Tuple[str, str]],
-           shuffle: bool = False) -> None:
+           shuffle: bool = False, stamp: bool = False) -> None:
     """Throughput mode (``rate=None``): BURST all events up-front so every
     group carries backlog and worker parallelism — not this driver's serial
     reward loop — sets the drain time. Paced mode: inject at ``rate``/s and
     consume as answers arrive, measuring per-event serving latency.
     ``shuffle`` pushes every event onto the single shared ``eventQueue``
-    (the shuffleGrouping spout) instead of the per-group queues."""
+    (the shuffleGrouping spout) instead of the per-group queues. ``stamp``
+    appends an enqueue timestamp (``id|ts``, the event.timestamps contract)
+    so telemetry-armed workers measure true queue wait; workers write
+    actions under the bare id, so ``t_push``/answer bookkeeping is
+    unchanged."""
     def push(sent):
         g = groups[sent % len(groups)]
         event_id = f"{g}:{sent}"
         t_push[event_id] = time.perf_counter()
+        payload = f"{event_id}|{time.time()}" if stamp else event_id
         client.lpush("eventQueue" if shuffle else f"eventQueue:{g}",
-                     event_id)
+                     payload)
     if rate is None:
         for sent in range(n_events):
             push(sent)
@@ -566,16 +672,29 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
                  server: Optional[MiniRedisServer] = None,
                  decision_io_ms: float = 0.0,
                  grouping: str = "fields",
-                 engine: bool = False) -> ScaleoutResult:
+                 engine: bool = False,
+                 metrics_out: Optional[str] = None,
+                 event_timestamps: bool = False) -> ScaleoutResult:
     """Measure N serving workers against one broker (started here unless
     passed in). Every event must come back answered exactly once.
     ``grouping="shuffle"`` runs the reference's shuffleGrouping discipline
     (shared event queue, private per-worker learners — see
     :func:`shuffle_worker_main`) instead of per-group ownership.
     ``engine=True`` runs the workers on the pipelined ``ServingEngine``
-    path (fields grouping only)."""
+    path (fields grouping only). ``metrics_out`` arms worker telemetry:
+    every worker ships its obs report over the broker on the heartbeat
+    cadence and the merged FLEET report (one file, attributable per
+    source) lands at that path as JSONL + ``.prom`` — plus in
+    ``ScaleoutResult.fleet_report``/``worker_reports``. Straggler
+    detection then also uses per-worker decision-latency p99.
+    ``event_timestamps`` stamps every driven event ``id|ts`` so workers
+    measure true enqueue→pop queue wait (fields grouping only)."""
     if engine and grouping == "shuffle":
         raise ValueError("engine workers support fields grouping only")
+    if event_timestamps and grouping == "shuffle":
+        raise ValueError(
+            "event.timestamps is wired through the fields-grouping "
+            "loops/engines; shuffle workers do not parse stamped payloads")
     import numpy as np
     rng = np.random.default_rng(seed)
     groups = [f"g{i}" for i in range(n_groups)]
@@ -597,24 +716,29 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
         procs = _spawn_workers(broker_host, broker_port, n_workers, groups,
                                learner_type, actions, config, seed,
                                decision_io_ms=decision_io_ms,
-                               grouping=grouping, engine=engine)
+                               grouping=grouping, engine=engine,
+                               telemetry=metrics_out is not None,
+                               event_timestamps=event_timestamps)
         try:
             t_push: Dict[str, float] = {}
             latencies: List[float] = []
             picks: List[Tuple[str, str]] = []
             # warmup: first dispatch per worker pays jit compile; excluded
             _drive(client, groups, ctr, 4 * n_groups, None, rng,
-                   t_push, [], [], shuffle=shuffle)
+                   t_push, [], [], shuffle=shuffle,
+                   stamp=event_timestamps)
             t_push.clear()
 
             t0 = time.perf_counter()
             _drive(client, groups, ctr, throughput_events, None, rng,
-                   t_push, [], picks, shuffle=shuffle)
+                   t_push, [], picks, shuffle=shuffle,
+                   stamp=event_timestamps)
             throughput_s = time.perf_counter() - t0
 
             t_push.clear()
             _drive(client, groups, ctr, paced_events, paced_rate, rng,
-                   t_push, latencies, picks, shuffle=shuffle)
+                   t_push, latencies, picks, shuffle=shuffle,
+                   stamp=event_timestamps)
 
             if shuffle:
                 # one sentinel per worker on the shared queue
@@ -649,6 +773,18 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
 
         heartbeats = read_heartbeats(client)
 
+        # fleet telemetry: each worker's LATEST shipped report, merged
+        # into one attributable fleet report and written atomically
+        worker_reports = read_worker_reports(client)
+        fleet_report = None
+        if worker_reports:
+            from avenir_tpu.obs import exporters as obs_exporters
+            fleet_report = obs_exporters.merge_reports(
+                [worker_reports[w] for w in sorted(worker_reports)])
+            if metrics_out:
+                obs_exporters.write_report(fleet_report, metrics_out)
+        latency_p99 = worker_latency_p99(worker_reports)
+
         tail = picks[-int(0.3 * len(picks)):]
         best_frac = sum(ctr[g][a] > 0.5 for g, a in tail) / max(len(tail), 1)
         lat = sorted(latencies)
@@ -662,8 +798,11 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
             best_action_fraction=best_frac,
             worker_stats=worker_stats,
             worker_throughput=worker_throughput(heartbeats),
-            stragglers=detect_stragglers(heartbeats),
-            heartbeats=len(heartbeats))
+            stragglers=detect_stragglers(heartbeats,
+                                         latency_p99=latency_p99 or None),
+            heartbeats=len(heartbeats),
+            worker_reports=worker_reports,
+            fleet_report=fleet_report)
 
 
 @dataclass
@@ -802,6 +941,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="serve through the pipelined ServingEngine "
                          "(bulk transport + dispatch-then-fetch) instead "
                          "of the per-event step loop (fields grouping)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="worker mode: arm the obs TelemetryHub and ship "
+                         "this worker's report over the broker on the "
+                         "heartbeat cadence (the fleet-merge input)")
+    ap.add_argument("--event-timestamps", action="store_true",
+                    help="events carry id|enqueue_ts payloads: measure "
+                         "true queue wait into engine.queue_wait "
+                         "(fields grouping)")
+    ap.add_argument("--metrics-out", default=None, metavar="PATH",
+                    help="driver mode: arm worker telemetry and write the "
+                         "merged FLEET report (JSONL + .prom) here")
     args = ap.parse_args(argv)
 
     if args.worker:
@@ -818,6 +968,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from jax.extend.backend import clear_backends
         clear_backends()
         jax.config.update("jax_platforms", "cpu")
+        if args.telemetry:
+            # arm the full obs layer BEFORE the loops are built so every
+            # span/gauge of this worker's lifetime lands in the shipped
+            # report; worker_id in meta keeps the fleet merge attributable
+            from avenir_tpu.obs import exporters as obs_exporters
+            obs_exporters.hub().enable().set_meta(worker_id=args.worker_id)
         if args.grouping == "shuffle":
             stats = shuffle_worker_main(
                 args.host, args.port, args.worker_id,
@@ -834,7 +990,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 json.loads(args.config), args.seed,
                 replay=args.replay,
                 decision_io_ms=args.decision_io_ms,
-                engine=args.engine)
+                engine=args.engine,
+                event_timestamps=args.event_timestamps)
         print(json.dumps(stats), flush=True)
         return 0
 
@@ -843,8 +1000,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          learner_type=args.learner_type,
                          decision_io_ms=args.decision_io_ms,
                          grouping=args.grouping,
-                         engine=args.engine)
-        print(json.dumps({
+                         engine=args.engine,
+                         metrics_out=args.metrics_out,
+                         event_timestamps=args.event_timestamps)
+        out = {
             "n_workers": r.n_workers,
             "grouping": args.grouping,
             "engine": args.engine,
@@ -856,7 +1015,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "worker_throughput": {str(w): round(t, 1) for w, t
                                   in sorted(r.worker_throughput.items())},
             "stragglers": r.stragglers,
-        }))
+        }
+        if r.fleet_report is not None:
+            dl = r.fleet_report["spans"].get("engine.decision_latency", {})
+            out["fleet_decision_latency"] = {
+                "count": dl.get("count", 0),
+                "p50_ms": round(dl.get("p50_ms", 0.0), 3),
+                "p99_ms": round(dl.get("p99_ms", 0.0), 3)}
+            if args.metrics_out:
+                out["metrics_out"] = args.metrics_out
+        print(json.dumps(out))
     return 0
 
 

@@ -285,7 +285,7 @@ def _online_serving_bench() -> dict:
         raise RuntimeError(
             f"serving_smoke rc={proc.returncode}: {proc.stderr[-500:]}")
     report = json.loads(proc.stdout.strip().splitlines()[-1])
-    return {
+    out = {
         "decisions_per_sec": report["decisions_per_sec"],
         "sync_decisions_per_sec": report["sync_decisions_per_sec"],
         "speedup_vs_sync": report["speedup_vs_sync"],
@@ -295,6 +295,11 @@ def _online_serving_bench() -> dict:
         "bit_identical_to_run_loop": report["bit_identical"],
         "events": report["events"],
     }
+    # ISSUE 6: per-event decision-latency distribution (p50/p95/p99 +
+    # the fixed-bucket dump) — the SLO the serving tier is gated on
+    if "decision_latency" in report:
+        out["decision_latency"] = report["decision_latency"]
+    return out
 
 
 def main() -> None:
@@ -487,13 +492,17 @@ def main() -> None:
         try:
             out["online_serving"] = _online_serving_bench()
             osrv = out["online_serving"]
+            lat = osrv.get("decision_latency", {})
+            lat_note = (f", p99 decision latency {lat['p99_ms']:.2f}ms"
+                        if lat else "")
             print(f"online serving: {osrv['decisions_per_sec']:.0f} "
                   f"decisions/s pipelined vs "
                   f"{osrv['sync_decisions_per_sec']:.0f} sync "
                   f"({osrv['speedup_vs_sync']:.2f}x, overlap "
                   f"{osrv['overlap_fraction']:.3f}, "
                   f"{osrv['round_trips_per_batch']:.0f} round trips/batch "
-                  f"vs {osrv['sync_round_trips_per_batch']:.0f})",
+                  f"vs {osrv['sync_round_trips_per_batch']:.0f}"
+                  f"{lat_note})",
                   file=sys.stderr)
         except Exception as exc:
             print(f"online serving bench skipped: {exc!r}", file=sys.stderr)

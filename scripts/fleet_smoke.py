@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Fleet-telemetry smoke gate (ISSUE 6 CI guard).
+
+Runs a REAL 2-worker ``stream.scaleout`` deployment (broker subprocess,
+worker subprocesses, telemetry armed, ``event.timestamps`` stamped
+payloads) with ``--metrics-out`` and asserts the acceptance contract:
+
+1. ONE merged fleet report lands at the path (JSONL + parseable ``.prom``
+   sibling), with per-source meta (host/pid/worker_id) for both workers.
+2. ``engine.decision_latency`` count in the MERGED report equals the
+   total events processed across the fleet — every served event recorded
+   exactly once, end to end through the broker shipping.
+3. Every merged span histogram equals the BUCKET-WISE SUM of the
+   per-worker reports (slot-count equality via ``snapshot_slot_counts``
+   — cumulative dicts cannot be compared key-wise), and its count the sum
+   of worker counts.
+4. ``engine.queue_wait`` (the ``id|ts`` enqueue→pop measurement) also
+   carries one observation per event — true queue wait is measured, not
+   just in-process serving time.
+5. Straggler detection ran with the latency-p99 signal available for
+   every worker.
+
+No timing gate here (the latency SLO lives in serving_smoke, where the
+workload is controlled); this guards the MERGE algebra and the broker
+shipping path, so it is count-exact and cannot flake on a loaded host.
+
+Usage: JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_GROUPS = 4
+THROUGHPUT_EVENTS = 120
+PACED_EVENTS = 30
+
+
+def fail(msg: str) -> None:
+    print(f"fleet_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    from avenir_tpu.obs import exporters as E
+    from avenir_tpu.obs import telemetry as T
+    from avenir_tpu.stream.scaleout import run_scaleout, worker_latency_p99
+
+    expected = 4 * N_GROUPS + THROUGHPUT_EVENTS + PACED_EVENTS
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "fleet.jsonl")
+        r = run_scaleout(2, n_groups=N_GROUPS, n_actions=3,
+                         throughput_events=THROUGHPUT_EVENTS,
+                         paced_events=PACED_EVENTS, paced_rate=400.0,
+                         seed=11, metrics_out=out, event_timestamps=True)
+        total = sum(w["events"] for w in r.worker_stats)
+        if total != expected:
+            fail(f"fleet served {total}/{expected} events")
+        if sorted(r.worker_reports) != [0, 1]:
+            fail(f"expected reports from workers [0, 1], got "
+                 f"{sorted(r.worker_reports)}")
+
+        # 1. one merged report on disk, both exposition formats
+        report = E.events_to_report(E.read_jsonl(out))
+        if not os.path.exists(out + ".prom"):
+            fail("prometheus sibling missing")
+        if "avenir_span_latency_ms" not in open(out + ".prom").read():
+            fail("prometheus sibling carries no span histograms")
+        meta = report.get("meta", {})
+        sources = meta.get("sources", [])
+        if len(sources) != 2 or sorted(
+                s.get("worker_id") for s in sources) != [0, 1]:
+            fail(f"merged meta not attributable: {meta}")
+        if not all(s.get("host") and s.get("pid") for s in sources):
+            fail(f"merged meta sources missing host/pid: {sources}")
+
+        # 2. decision-latency count == fleet-total events
+        dl = report.get("spans", {}).get("engine.decision_latency", {})
+        if dl.get("count") != expected:
+            fail(f"merged decision_latency count {dl.get('count')} != "
+                 f"total events {expected}")
+        if not (0 < dl["p50_ms"] <= dl["p95_ms"] <= dl["p99_ms"]):
+            fail(f"merged decision-latency percentiles unordered: {dl}")
+
+        # 3. merged spans == bucket-wise sum of per-worker reports
+        for name, snap in report["spans"].items():
+            parts = [w["spans"][name] for w in r.worker_reports.values()
+                     if name in w.get("spans", {})]
+            if snap["count"] != sum(p["count"] for p in parts):
+                fail(f"span {name}: merged count {snap['count']} != "
+                     f"sum of worker counts")
+            merged_slots = T.snapshot_slot_counts(snap)
+            summed = [sum(col) for col in zip(
+                *(T.snapshot_slot_counts(p) for p in parts))]
+            if merged_slots != summed:
+                fail(f"span {name}: merged buckets are not the "
+                     f"bucket-wise sum of the worker reports")
+
+        # 4. true queue wait measured end to end
+        qw = report["spans"].get("engine.queue_wait", {})
+        if qw.get("count") != expected:
+            fail(f"queue_wait count {qw.get('count')} != {expected}")
+
+        # 5. latency signal reached the straggler detector
+        lat = worker_latency_p99(r.worker_reports)
+        if sorted(lat) != [0, 1]:
+            fail(f"latency p99 missing for some workers: {lat}")
+
+    print("fleet_smoke OK", file=sys.stderr)
+    print(json.dumps({
+        "fleet_smoke": "ok",
+        "events": expected,
+        "decision_latency_count": dl["count"],
+        "decision_p50_ms": round(dl["p50_ms"], 3),
+        "decision_p99_ms": round(dl["p99_ms"], 3),
+        "queue_wait_p99_ms": round(qw["p99_ms"], 3),
+        "merged_spans": len(report["spans"]),
+        "stragglers": r.stragglers,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,16 @@ Three checks, exit 0 only if all pass:
    best-of-N; fails when the instrumented-but-disabled path costs >5%
    over bare (plus 1ms absolute slack so scheduler noise on a fast
    machine cannot flake the gate).
+4. **Enabled per-event latency bound** (ISSUE 6): the pipelined
+   ``ServingEngine`` with the span tracer ENABLED vs the SAME engine
+   with it disabled, at the 6400-event scale PR 5's gate runs — the
+   pure cost of the live per-event ``engine.decision_latency`` records
+   (amortized to one histogram touch per batch), attributed cleanly:
+   both sides carry identical engine bookkeeping, so the diff is the
+   record path and nothing else. PR 5's own gate (serving_smoke)
+   continues to bound the DISABLED engine vs the bare loop, so the
+   chain bare -> disabled engine -> enabled engine is covered end to
+   end, each link ≤5%.
 
 Usage: JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 """
@@ -119,8 +129,21 @@ def check_streaming_loop(tmp: str) -> dict:
         fail(f"loop.event histogram wrong: {spans.get('loop.event')}")
     if "loop.select" not in spans:
         fail(f"loop.select span missing; spans={sorted(spans)}")
+    # ISSUE 6: per-event decision latency — exactly one observation per
+    # served event, with ordered percentile estimates
+    dl = spans.get("engine.decision_latency", {})
+    if dl.get("count") != N_LOOP_EVENTS:
+        fail(f"engine.decision_latency histogram wrong: {dl}")
+    if not (0 < dl["p50_ms"] <= dl["p95_ms"] <= dl["p99_ms"]):
+        fail(f"decision-latency percentiles unordered: {dl}")
+    # merge-ready meta: host/pid/duration for fleet attribution
+    meta = report.get("meta", {})
+    if not (meta.get("host") and meta.get("pid")
+            and meta.get("duration_s", 0) > 0):
+        fail(f"report meta missing host/pid/duration_s: {meta}")
     hub.reset()
-    return {"event_p50_ms": round(stats.event_p50_ms, 3)}
+    return {"event_p50_ms": round(stats.event_p50_ms, 3),
+            "decision_p99_ms": round(dl["p99_ms"], 3)}
 
 
 def _bare_run(learner, queues, batch_size: int, event_cap: int) -> list:
@@ -146,6 +169,33 @@ def _bare_run(learner, queues, batch_size: int, event_cap: int) -> list:
             counters[0] += 1
             counters[2] += len(sel)
     return counters
+
+
+def _overhead_gate(timed_a, timed_b, label: str) -> dict:
+    """Shared timing methodology for every overhead gate: warm both
+    paths, interleaved best-of-N (both see the same scheduler weather;
+    min-over-draws estimates each path's true cost), retried twice
+    (serving_smoke pattern — a sustained co-tenant burst on this shared
+    1-core box can poison a whole attempt's minima, so one retry is not
+    always enough), 5% + absolute-slack bound."""
+    attempts = 3
+    timed_a()             # warm both jit caches before timing
+    timed_b()
+    for attempt in range(attempts):
+        t_a = t_b = float("inf")
+        for _ in range(REPEATS):
+            t_a = min(t_a, timed_a())
+            t_b = min(t_b, timed_b())
+        overhead = (t_a - t_b) / t_b
+        if t_a <= t_b * (1 + OVERHEAD_BOUND) + ABS_SLACK_S:
+            break
+        if attempt == attempts - 1:
+            fail(f"{label} overhead {overhead * 100:.1f}% exceeds "
+                 f"{OVERHEAD_BOUND * 100:.0f}% {attempts} times "
+                 f"(instrumented={t_a * 1e3:.2f}ms bare={t_b * 1e3:.2f}ms)")
+    return {"t_loop_ms": round(t_a * 1e3, 2),
+            "t_bare_ms": round(t_b * 1e3, 2),
+            "overhead_pct": round(overhead * 100, 1)}
 
 
 def check_disabled_overhead() -> dict:
@@ -175,22 +225,56 @@ def check_disabled_overhead() -> dict:
         _bare_run(bare_learner, bare_queues, batch_size, event_cap)
         return time.perf_counter() - t0
 
-    timed_loop()          # warm both jit caches before timing
-    timed_bare()
-    # interleaved best-of-N: both paths see the same scheduler weather,
-    # and min-over-draws estimates each path's true cost
-    t_loop = t_bare = float("inf")
-    for _ in range(REPEATS):
-        t_loop = min(t_loop, timed_loop())
-        t_bare = min(t_bare, timed_bare())
-    overhead = (t_loop - t_bare) / t_bare
-    if t_loop > t_bare * (1 + OVERHEAD_BOUND) + ABS_SLACK_S:
-        fail(f"disabled-telemetry loop overhead {overhead * 100:.1f}% "
-             f"exceeds {OVERHEAD_BOUND * 100:.0f}% "
-             f"(loop={t_loop * 1e3:.2f}ms bare={t_bare * 1e3:.2f}ms)")
-    return {"t_loop_ms": round(t_loop * 1e3, 2),
-            "t_bare_ms": round(t_bare * 1e3, 2),
-            "overhead_pct": round(overhead * 100, 1)}
+    return _overhead_gate(timed_loop, timed_bare,
+                          "disabled-telemetry loop")
+
+
+# the enabled-latency gate runs at PR 5's gate scale: 100 full 64-event
+# batches — per-batch record cost amortizes over real batch work
+N_ENABLED_EVENTS = 6400
+
+
+def check_enabled_latency_overhead() -> dict:
+    """ISSUE 6 gate: the pipelined ServingEngine with the span tracer
+    ENABLED vs the SAME engine with it disabled — per-event
+    decision-latency records live, amortized to one histogram touch per
+    batch. Toggling the tracer around one engine object keeps every
+    other cost (stats, adaptive cap, clocks) identical on both sides,
+    so the measured diff is the record path and nothing else; the
+    engine-vs-bare link of the chain stays gated by serving_smoke
+    (PR 5's gate). Only the tracer is armed (no hub => no sampler
+    thread): this measures the record path, not a background poller."""
+    from avenir_tpu.obs import telemetry
+    from avenir_tpu.stream.engine import ServingEngine
+    from avenir_tpu.stream.loop import InProcQueues
+    if telemetry.tracer().enabled:
+        fail("tracer unexpectedly enabled before the enabled-latency gate")
+
+    queues = InProcQueues()
+    engine = ServingEngine("softMax", ACTIONS, dict(LEARNER_CFG),
+                           queues, seed=3)
+
+    def timed(enabled: bool) -> float:
+        _fill(queues, N_ENABLED_EVENTS)
+        telemetry.enable(enabled)
+        t0 = time.perf_counter()
+        engine.run()
+        elapsed = time.perf_counter() - t0
+        telemetry.enable(False)
+        return elapsed
+
+    try:
+        out = _overhead_gate(lambda: timed(True), lambda: timed(False),
+                             "ENABLED per-event latency engine")
+        # the amortized records really happened: one per event served,
+        # despite one histogram touch per batch
+        snap = telemetry.tracer().snapshot().get("engine.decision_latency")
+    finally:
+        telemetry.enable(False)
+        telemetry.tracer().reset()
+    if not snap or snap["count"] < N_ENABLED_EVENTS:
+        fail(f"enabled engine recorded no per-event latency: {snap}")
+    return out
 
 
 def main() -> int:
@@ -199,6 +283,7 @@ def main() -> int:
         summary["batch"] = check_batch_job(tmp)
         summary["loop"] = check_streaming_loop(tmp)
     summary["overhead"] = check_disabled_overhead()
+    summary["enabled_overhead"] = check_enabled_latency_overhead()
     print(json.dumps({"obs_smoke": "ok", **summary}))
     return 0
 

@@ -17,10 +17,17 @@ CPU backend and asserts, exiting non-zero on any failure:
    (its default) vs a bare hand-rolled pipelined loop with no
    stats/span bookkeeping at all, interleaved best-of-N on in-process
    queues (the obs_smoke methodology).
+4. **p99 decision-latency SLO** (ISSUE 6): a telemetry-enabled engine
+   pass over the same workload must record exactly one
+   ``engine.decision_latency`` observation per event (pop→action-written)
+   and its p99 must stay under ``--p99-ms`` — the latency gate that rides
+   next to the throughput/parity gates; the full histogram (p50/p95/p99 +
+   bucket dump) lands in the JSON as ``decision_latency``.
 
 Prints ONE JSON line consumed by bench.py's ``online_serving`` section.
 
-Usage: python scripts/serving_smoke.py [--events N] [--skip-gates]
+Usage: python scripts/serving_smoke.py [--events N] [--p99-ms MS]
+       [--skip-gates]
 """
 
 import argparse
@@ -57,6 +64,11 @@ OVERHEAD_BOUND = 0.05
 ABS_SLACK_S = 0.001
 OVERHEAD_REPEATS = 5
 SPEEDUP_GATE = 2.0
+# p99 decision-latency SLO default: a 64-event micro-batch on this CPU
+# path completes in single-digit ms; 500ms absorbs co-tenant scheduler
+# stalls on a shared 1-core box without letting a real regression (e.g.
+# a blocking readback re-serialized into every batch) sneak through
+P99_BOUND_MS = 500.0
 
 
 def fail(msg: str) -> None:
@@ -152,6 +164,27 @@ def run_engine(srv, n_events: int):
     return elapsed, stats, actions, round_trips
 
 
+def measure_decision_latency(srv, n_events: int) -> dict:
+    """The SLO-gate pass: one telemetry-enabled engine run over the same
+    workload, returning the ``engine.decision_latency`` histogram
+    snapshot. Enabled AFTER (and disabled before) every timed gate so the
+    latency pass can never contaminate the throughput/overhead numbers;
+    exactly one observation per event is itself asserted here."""
+    from avenir_tpu.obs import telemetry
+    telemetry.enable(True)
+    try:
+        _, stats, _, _ = run_engine(srv, n_events)
+    finally:
+        telemetry.enable(False)
+    snap = telemetry.tracer().snapshot().get("engine.decision_latency")
+    telemetry.tracer().reset()
+    if not snap:
+        fail("telemetry-enabled engine recorded no decision latency")
+    if snap["count"] != n_events:
+        fail(f"decision_latency count {snap['count']} != events {n_events}")
+    return snap
+
+
 def _bare_pipelined_run(learner, queues, batch_size: int,
                         event_cap: int) -> int:
     """The engine's pipeline shape with ZERO bookkeeping — no stats, no
@@ -238,9 +271,11 @@ def check_disabled_overhead() -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=10000)
+    ap.add_argument("--p99-ms", type=float, default=P99_BOUND_MS,
+                    help="p99 decision-latency SLO bound (ISSUE 6)")
     ap.add_argument("--skip-gates", action="store_true",
                     help="measure and report without failing the speedup "
-                         "gate (bench mode on a loaded host)")
+                         "and latency gates (bench mode on a loaded host)")
     args = ap.parse_args()
 
     from avenir_tpu.stream.miniredis import MiniRedisServer
@@ -259,6 +294,16 @@ def main() -> int:
                 t_eng, eng = e[0], e
         _, sync_stats, sync_actions, sync_rt = sync
         _, eng_stats, eng_actions, eng_rt = eng
+        # the SLO pass runs LAST inside the broker scope: tracer off again
+        # before the overhead gate below asserts it. Retried once like
+        # every other timing gate here: a co-tenant load spike during the
+        # single pass inflates p99 ~10x and must not fail CI — the better
+        # of two passes is still a real measured distribution.
+        latency = measure_decision_latency(srv, args.events)
+        if latency["p99_ms"] > args.p99_ms and not args.skip_gates:
+            retry = measure_decision_latency(srv, args.events)
+            if retry["p99_ms"] < latency["p99_ms"]:
+                latency = retry
 
     if sync_actions != eng_actions:
         for i, (a, b) in enumerate(zip(sync_actions, eng_actions)):
@@ -281,6 +326,13 @@ def main() -> int:
              f"{SPEEDUP_GATE:.0f}x gate "
              f"(sync={decisions_sync:.0f}/s engine={decisions_eng:.0f}/s)")
 
+    # the p99 SLO gate (ISSUE 6), next to throughput/parity like the
+    # ROADMAP item asks: per-event pop→action-written latency
+    if latency["p99_ms"] > args.p99_ms and not args.skip_gates:
+        fail(f"p99 decision latency {latency['p99_ms']:.2f}ms exceeds "
+             f"the {args.p99_ms:.0f}ms SLO bound "
+             f"(p50={latency['p50_ms']:.2f}ms count={latency['count']})")
+
     overhead = check_disabled_overhead()
 
     print(json.dumps({
@@ -295,6 +347,14 @@ def main() -> int:
         "sync_round_trips_per_batch": round(sync_rt / sync_batches, 1),
         "bit_identical": True,
         "disabled_overhead": overhead,
+        "decision_latency": {
+            "count": latency["count"],
+            "p50_ms": round(latency["p50_ms"], 3),
+            "p95_ms": round(latency["p95_ms"], 3),
+            "p99_ms": round(latency["p99_ms"], 3),
+            "p99_bound_ms": args.p99_ms,
+            "buckets": latency.get("buckets", {}),
+        },
     }))
     return 0
 

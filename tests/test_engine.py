@@ -536,6 +536,79 @@ class TestTelemetryAndCallbacks:
         assert sum(seen) == stats.events == 130
         assert len(seen) == stats.batches
 
+    def test_decision_latency_one_observation_per_event(self):
+        """ISSUE 6: pop→action-written latency lands in the fleet-wide
+        engine.decision_latency histogram, count == events served, with
+        one amortized record per batch (batches < events)."""
+        from avenir_tpu.obs import telemetry as T
+        T.enable(True)
+        try:
+            q = _prefill_inproc(130, 0)
+            eng = ServingEngine("softMax", ACTIONS, {"batch.size": 1}, q,
+                                seed=4)
+            stats = eng.run()
+            snap = T.tracer().snapshot()["engine.decision_latency"]
+        finally:
+            T.enable(False)
+            T.tracer().reset()
+        assert snap["count"] == stats.events == 130
+        assert stats.batches < 130            # amortization was real
+        assert 0 < snap["p50_ms"] <= snap["p99_ms"]
+
+    def test_grouped_decision_latency_counts_events(self):
+        from avenir_tpu.obs import telemetry as T
+        from avenir_tpu.stream.engine import GroupedServingEngine
+        T.enable(True)
+        try:
+            q = InProcQueues()
+            for i in range(40):
+                q.push_event(f"g{i % 4}:{i}")
+            ge = GroupedServingEngine(
+                "softMax", [f"g{i}" for i in range(4)], ACTIONS,
+                {"batch.size": 1}, q, seed=4)
+            stats = ge.run()
+            snap = T.tracer().snapshot()["engine.decision_latency"]
+        finally:
+            T.enable(False)
+            T.tracer().reset()
+        assert snap["count"] == stats.events == 40
+
+    def test_event_timestamps_queue_wait_and_ledger(self):
+        """id|ts mode over the Redis adapter with the ledger armed: queue
+        wait recorded per event, actions written under the bare id, and
+        every raw ledger entry retired (acks resolve the RAW payload)."""
+        import time as _time
+        from avenir_tpu.obs import telemetry as T
+        from avenir_tpu.stream.loop import RedisQueues
+        from avenir_tpu.stream.miniredis import (MiniRedisClient,
+                                                 MiniRedisServer)
+        with MiniRedisServer() as srv:
+            client = MiniRedisClient(srv.host, srv.port)
+            t0 = _time.time() - 0.25
+            for i in range(20):
+                client.lpush("eventQueue", f"e{i:02d}|{t0}")
+            queues = RedisQueues(client=client,
+                                 pending_queue="pendingQueue")
+            T.enable(True)
+            try:
+                eng = ServingEngine("softMax", ACTIONS, {"batch.size": 1},
+                                    queues, seed=4, event_timestamps=True)
+                stats = eng.run()
+                snap = T.tracer().snapshot()
+            finally:
+                T.enable(False)
+                T.tracer().reset()
+            assert stats.events == 20
+            qw = snap["engine.queue_wait"]
+            assert qw["count"] == 20
+            assert qw["min_ms"] >= 250.0
+            assert client.llen("pendingQueue") == 0   # all acks landed
+            actions = []
+            while (raw := client.rpop("actionQueue")) is not None:
+                actions.append(raw.decode().split(",")[0])
+            assert actions == [f"e{i:02d}" for i in range(20)]
+            client.close()
+
 
 class TestServingSmokeScript:
     def test_serving_smoke_script(self):

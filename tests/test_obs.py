@@ -1,8 +1,13 @@
 """Unified telemetry layer (ISSUE 2): spans + histograms, runtime
-collectors, exporters, CLI --metrics-out, loop gauges, heartbeats."""
+collectors, exporters, CLI --metrics-out, loop gauges, heartbeats.
+Fleet half (ISSUE 6): merge algebra, cross-worker shipping, per-event
+decision latency, latency-based straggler detection."""
 
 import json
+import os
 import re
+import subprocess
+import sys
 import threading
 import time
 
@@ -341,8 +346,235 @@ class TestLoopTelemetry:
         spans = report["spans"]
         assert "loop.select" in spans
         assert spans["loop.event"]["count"] == 12
+        # ISSUE 6: pop→action-written latency, one observation per event
+        assert spans["engine.decision_latency"]["count"] == 12
+        dl = spans["engine.decision_latency"]
+        assert 0 < dl["p50_ms"] <= dl["p95_ms"] <= dl["p99_ms"]
         assert report["runtime"]["samples"] >= 0
         hub.reset()
+
+    def test_event_timestamps_measure_queue_wait(self):
+        """Opt-in id|ts payloads: queue wait recorded per event, actions
+        written under the bare id (wire format preserved downstream),
+        step() and run() paths both."""
+        from avenir_tpu.stream.loop import InProcQueues, OnlineLearnerLoop
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=0.05)
+        try:
+            queues = InProcQueues()
+            t0 = time.time() - 0.5            # enqueued 500ms ago
+            for i in range(8):
+                queues.push_event(f"e{i}|{t0}")
+            loop = OnlineLearnerLoop(
+                "softMax", ["x", "y"],
+                {"current.decision.round": 1, "batch.size": 2}, queues,
+                seed=0, event_timestamps=True)
+            assert loop.step()                # per-event path
+            loop.run()                        # batch path
+            report = hub.report()
+        finally:
+            hub.disable()
+        qw = report["spans"]["engine.queue_wait"]
+        assert qw["count"] == 8
+        assert qw["min_ms"] >= 500.0          # the planted wait is seen
+        ids = []
+        while (entry := queues.pop_action()) is not None:
+            ids.append(entry[0])
+        assert ids == [f"e{i}" for i in range(8)]
+        hub.reset()
+
+    def test_unstamped_payloads_unchanged_when_mode_off(self):
+        """With event_timestamps off (the default), a payload containing
+        '|' passes through verbatim — the wire format only changes when
+        the harness opts in on both ends."""
+        from avenir_tpu.stream.loop import InProcQueues, OnlineLearnerLoop
+        queues = InProcQueues()
+        queues.push_event("weird|7.5")
+        loop = OnlineLearnerLoop(
+            "softMax", ["x", "y"],
+            {"current.decision.round": 1, "batch.size": 2}, queues, seed=0)
+        loop.run()
+        assert queues.pop_action()[0] == "weird|7.5"
+
+
+def _merge_snaps(snaps):
+    h = T.LatencyHistogram()
+    for s in snaps:
+        h.merge(s)
+    return h.snapshot()
+
+
+class TestMergeAlgebra:
+    """ISSUE 6 merge contract: fixed buckets make histograms from
+    different processes add bucket-for-bucket; the merge must be
+    order-independent, associative, and identity on empty."""
+
+    def _hist(self, values):
+        h = T.LatencyHistogram()
+        for v in values:
+            h.record(v)
+        return h
+
+    # binary-exact values: float sums then associate exactly, so the
+    # snapshot dicts compare with == rather than approx
+    _A = [0.5, 1.0, 2.0, 300.0]
+    _B = [0.25, 0.25, 1e9]          # includes an overflow-bucket value
+    _C = [4.0, 8.0]
+
+    def test_merge_equals_direct_recording(self):
+        merged = _merge_snaps([self._hist(v).snapshot()
+                               for v in (self._A, self._B, self._C)])
+        direct = self._hist(self._A + self._B + self._C).snapshot()
+        assert merged == direct
+
+    def test_merge_order_independent_and_associative(self):
+        sa, sb, sc = (self._hist(v).snapshot()
+                      for v in (self._A, self._B, self._C))
+        m1 = _merge_snaps([sa, sb, sc])
+        m2 = _merge_snaps([sc, sa, sb])
+        m3 = _merge_snaps([_merge_snaps([sa, sb]), sc])     # (a+b)+c
+        m4 = _merge_snaps([sa, _merge_snaps([sb, sc])])     # a+(b+c)
+        assert m1 == m2 == m3 == m4
+
+    def test_empty_merge_is_identity(self):
+        sa = self._hist(self._A).snapshot()
+        empty = T.LatencyHistogram().snapshot()
+        assert _merge_snaps([sa, empty]) == _merge_snaps([sa])
+        assert _merge_snaps([empty]) == empty
+
+    def test_record_n_amortized(self):
+        """record(ms, n) — the one-clock-read-per-batch path — equals n
+        individual records."""
+        a = T.LatencyHistogram()
+        a.record(3.0, 64)
+        b = T.LatencyHistogram()
+        for _ in range(64):
+            b.record(3.0)
+        assert a.snapshot() == b.snapshot()
+
+    def test_slot_counts_invert_cumulative_encoding(self):
+        h = self._hist(self._A + self._B)
+        slots = T.snapshot_slot_counts(h.snapshot())
+        assert len(slots) == len(T.BUCKET_BOUNDS_MS) + 1
+        assert sum(slots) == h.count
+        assert slots[-1] == 1          # the 1e9 overflow observation
+
+    def test_jsonl_round_trip_merge_matches_in_process(self, tmp_path):
+        """Reports written to JSONL, read back, and merged must equal the
+        in-process merge bucket-for-bucket (the coordinator's path)."""
+        sa, sb = (self._hist(v).snapshot() for v in (self._A, self._B))
+        reports = [{"meta": {"worker_id": i}, "spans": {"x": s},
+                    "counters": {}, "gauges": {}}
+                   for i, s in enumerate((sa, sb))]
+        round_tripped = []
+        for i, report in enumerate(reports):
+            path = str(tmp_path / f"w{i}.jsonl")
+            E.write_jsonl(E.report_to_events(report), path)
+            round_tripped.append(E.events_to_report(E.read_jsonl(path)))
+        merged_rt = E.merge_reports(round_tripped)
+        merged_in_proc = E.merge_reports(reports)
+        assert merged_rt["spans"] == merged_in_proc["spans"]
+        assert (merged_rt["spans"]["x"] == _merge_snaps([sa, sb]))
+
+    def _report(self, worker, span_values, counters, gauges, rss):
+        return {
+            "meta": {"worker_id": worker, "host": "h", "pid": 100 + worker,
+                     "generated_at": float(worker)},
+            "spans": {"loop.event": self._hist(span_values).snapshot()},
+            "counters": dict(counters),
+            "gauges": dict(gauges),
+            "runtime": {"rss_kb_last": rss, "rss_kb_max": rss + 10,
+                        "samples": 2,
+                        "compile": {"backend_compile_count": 1,
+                                    "available": True}},
+        }
+
+    def test_merge_reports_sections(self):
+        r0 = self._report(0, self._A, {"n": 2.0}, {"depth": 1.0}, 100)
+        r1 = self._report(1, self._C, {"n": 3.0, "m": 1.0},
+                          {"depth": 9.0}, 300)
+        m = E.merge_reports([r0, r1])
+        # counters sum
+        assert m["counters"] == {"n": 5.0, "m": 1.0}
+        # gauges keep per-source values under a source key
+        assert m["gauges"]["depth"] == {"w0": 1.0, "w1": 9.0}
+        # runtime maxes RSS, sums activity
+        assert m["runtime"]["rss_kb_last"] == 300
+        assert m["runtime"]["rss_kb_max"] == 310
+        assert m["runtime"]["samples"] == 4
+        assert m["runtime"]["compile"]["backend_compile_count"] == 2
+        # meta stays attributable
+        assert m["meta"]["merged_sources"] == 2
+        assert [s["worker_id"] for s in m["meta"]["sources"]] == [0, 1]
+        # spans merged bucket-wise
+        assert m["spans"]["loop.event"] == _merge_snaps(
+            [r0["spans"]["loop.event"], r1["spans"]["loop.event"]])
+        # empty-report identity on the data sections
+        m_id = E.merge_reports([r0, r1, {"spans": {}, "counters": {},
+                                         "gauges": {}}])
+        assert m_id["spans"] == m["spans"]
+        assert m_id["counters"] == m["counters"]
+        assert m_id["gauges"] == m["gauges"]
+
+    def test_merge_reports_closed_under_merging(self):
+        """Feeding an already-merged report back in must equal the flat
+        merge: per-source gauge dicts splice (never nest), sources
+        flatten — the pairwise-fold recipe DESIGN.md §13 documents."""
+        r0 = self._report(0, self._A, {"n": 2.0}, {"depth": 1.0}, 100)
+        r1 = self._report(1, self._B, {"n": 3.0}, {"depth": 9.0}, 200)
+        r2 = self._report(2, self._C, {"n": 1.0}, {"depth": 5.0}, 300)
+        flat = E.merge_reports([r0, r1, r2])
+        nested = E.merge_reports([E.merge_reports([r0, r1]), r2])
+        assert nested["spans"] == flat["spans"]
+        assert nested["counters"] == flat["counters"]
+        assert nested["gauges"] == flat["gauges"]
+        assert nested["runtime"] == flat["runtime"]
+        assert [s["worker_id"] for s in nested["meta"]["sources"]] == \
+            [0, 1, 2]
+        # prometheus exposition of the nested merge stays parseable
+        for line in E.prometheus_text(nested).splitlines():
+            if line.startswith("avenir_depth"):
+                assert line.split(" ", 1)[1].replace(".", "").isdigit()
+
+    def test_percentiles_weighted_matches_expanded(self):
+        pairs = [(3.0, 5), (1.0, 90), (7.0, 5)]
+        expanded = [v for v, n in pairs for _ in range(n)]
+        assert T.percentiles_weighted(pairs) == T.percentiles(expanded)
+        assert T.percentiles_weighted([]) == {50: 0.0, 95: 0.0, 99: 0.0}
+
+    def test_merged_gauges_render_with_source_labels(self):
+        m = E.merge_reports([
+            self._report(0, self._A, {}, {"depth": 1.0}, 100),
+            self._report(1, self._C, {}, {"depth": 2.0}, 100)])
+        text = E.prometheus_text(m)
+        assert 'avenir_depth{source="w0"} 1.0' in text
+        assert 'avenir_depth{source="w1"} 2.0' in text
+
+    def test_hub_report_meta_attribution(self):
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=0.05)
+        try:
+            hub.set_meta(worker_id=7)
+            time.sleep(0.01)
+            meta = hub.report()["meta"]
+        finally:
+            hub.disable()
+        assert meta["worker_id"] == 7
+        assert meta["host"] and meta["pid"] == os.getpid()
+        assert meta["duration_s"] > 0
+        hub.reset()
+
+    def test_atomic_write_preserves_previous_file(self, tmp_path):
+        """A failed serialization mid-write must leave the previous
+        report intact and no temp litter (the crash-truncation guard)."""
+        path = str(tmp_path / "m.jsonl")
+        E.write_jsonl([{"type": "meta", "ok": 1}], path)
+        with pytest.raises(TypeError):
+            E.write_jsonl([{"type": "meta"}, {"bad": object()}], path)
+        assert E.read_jsonl(path) == [{"type": "meta", "ok": 1}]
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
 
 
 class TestHeartbeats:
@@ -377,6 +609,37 @@ class TestHeartbeats:
         tp = worker_throughput(beats)
         assert tp[0] == pytest.approx(10.0)
         assert tp[1] == 40.0                   # single beat: raw count
+
+    def test_straggler_by_latency_percentile(self):
+        """ISSUE 6 upgrade: a worker that keeps up on COUNT but serves
+        every event slowly is flagged by its decision-latency p99 vs the
+        fleet median — invisible to the event-fraction test."""
+        from avenir_tpu.stream.scaleout import detect_stragglers
+        beats = [self._hb(0, 100, 10.0), self._hb(1, 98, 10.0),
+                 self._hb(2, 97, 10.0)]
+        lat = {0: 4.0, 1: 5.0, 2: 40.0}       # w2: 8x the median p99
+        assert detect_stragglers(beats) == []
+        assert detect_stragglers(beats, latency_p99=lat) == [2]
+        assert detect_stragglers(beats, latency_p99=lat,
+                                 latency_factor=20.0) == []
+        # latency-only input (no heartbeats) still works
+        assert detect_stragglers([], latency_p99=lat) == [2]
+        # EVEN fleet sizes must not be blind: the median is the LOWER
+        # middle, else a 2-worker fleet's slow half IS the median and
+        # can never exceed k x itself
+        assert detect_stragglers([], latency_p99={0: 4.0, 1: 40.0}) == [1]
+        assert detect_stragglers([], latency_p99={0: 4.0, 1: 5.0}) == []
+
+    def test_worker_latency_p99_extraction(self):
+        from avenir_tpu.stream.scaleout import worker_latency_p99
+        h = T.LatencyHistogram()
+        h.record(2.0, 10)
+        reports = {0: {"spans": {"engine.decision_latency": h.snapshot()}},
+                   1: {"spans": {}},            # no latency: skipped
+                   2: {"spans": {"engine.decision_latency":
+                                 T.LatencyHistogram().snapshot()}}}
+        lat = worker_latency_p99(reports)
+        assert list(lat) == [0] and lat[0] > 0
 
     def test_two_worker_scaleout_reports_heartbeats(self):
         """End-to-end: 2 workers, broker subprocess, heartbeats flow back
@@ -454,3 +717,43 @@ class TestCliMetricsOut:
         assert (tmp_path / "model.txt").exists()   # the job itself ran
         assert not E.hub().enabled
         E.hub().reset()
+
+    def test_profile_dir_produces_trace(self, tmp_path):
+        """ISSUE 6 satellite: --profile-dir on a CLI verb emits a jax
+        profiler trace directory on CPU (mirrors --metrics-out)."""
+        from avenir_tpu.cli.main import main as cli
+        from avenir_tpu.datagen import generators as G
+        rows = G.churn_rows(60, seed=6)
+        (tmp_path / "data.csv").write_text(
+            "\n".join(",".join(r) for r in rows))
+        with open(tmp_path / "churn.json", "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        (tmp_path / "p.properties").write_text(
+            f"feature.schema.file.path={tmp_path}/churn.json\n")
+        prof = tmp_path / "trace"
+        rc = cli(["BayesianDistribution", str(tmp_path / "data.csv"),
+                  str(tmp_path / "model.txt"),
+                  "--conf", str(tmp_path / "p.properties"),
+                  "--profile-dir", str(prof)])
+        assert rc == 0
+        produced = [f for _, _, fs in os.walk(prof) for f in fs]
+        assert produced, "profiler produced no trace files"
+
+
+def test_fleet_smoke_script():
+    """CI hook (ISSUE 6): the fleet-merge smoke — a real 2-worker
+    scaleout run whose --metrics-out fleet report is count-exact
+    (decision-latency count == total events, merged spans == bucket-wise
+    sum of per-worker reports) — runs on every tier-1 pass, like
+    test_collective.py::test_multichip_smoke_script."""
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "fleet_smoke.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)     # workers pin their own CPU backend
+    proc = subprocess.run([sys.executable, script], env=env,
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "fleet_smoke OK" in proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["fleet_smoke"] == "ok"
+    assert report["decision_latency_count"] == report["events"]
