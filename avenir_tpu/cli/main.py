@@ -1313,8 +1313,15 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
     changes the select chunking, and with it the realization stream of
     stochastic algorithms (one PRNG split per chunk) — same
     distribution, different draws.
-    The engine owns no checkpoints — its durability story is the broker's
-    ack/replay ledger — so it refuses ``checkpoint.dir``."""
+
+    The engine owns no orbax checkpoints — its in-run durability is the
+    broker's ack/replay ledger — but it CAN anchor its state in the
+    lifecycle snapshot registry (ISSUE 7): ``lifecycle.dir`` restores
+    the registry head into the learner before serving and publishes the
+    post-run state as a new version (``lifecycle.max.keep`` prunes), the
+    same registry a RetrainDaemon or a scale-out fleet subscribes to.
+    ``checkpoint.dir`` with the engine now errors with a pointer at
+    ``lifecycle.dir`` instead of a bare refusal."""
     from avenir_tpu.stream.loop import InProcQueues, OnlineLearnerLoop
     learner_type = conf.get_required("learner.type")
     actions = conf.get_list("action.list")
@@ -1323,8 +1330,16 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
     use_engine = conf.get_bool("serving.engine", False)
     if use_engine and conf.get("checkpoint.dir"):
         raise ValueError(
-            "serving.engine=true does not checkpoint (durability is the "
-            "broker ledger's job); unset checkpoint.dir or serving.engine")
+            "serving.engine=true does not use checkpoint.dir (in-run "
+            "durability is the broker ledger's job); point the engine at "
+            "the snapshot registry instead — set lifecycle.dir to restore "
+            "the registry head on start and publish the post-run learner "
+            "state as a new version (lifecycle/registry.py)")
+    lifecycle_dir = conf.get("lifecycle.dir")
+    if lifecycle_dir and not use_engine:
+        raise ValueError(
+            "lifecycle.dir is the engine's durability anchor; the loop "
+            "path keeps checkpoint.dir (set serving.engine=true)")
     # opt-in ``id|ts`` event lines: queue wait from the stamped enqueue
     # time lands in the engine.queue_wait histogram (requires telemetry,
     # i.e. --metrics-out, to be visible); actions keep the bare id
@@ -1353,10 +1368,45 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
             max_batch=conf.get_int("engine.max.batch", 0) or None,
             drain_max=conf.get_int("engine.reward.drain.max", 0) or None,
             event_timestamps=event_ts)
+        registry = None
+        if lifecycle_dir:
+            from avenir_tpu.lifecycle.registry import (
+                SnapshotRegistry, state_schema_hash)
+            registry = SnapshotRegistry(
+                lifecycle_dir,
+                max_to_keep=conf.get_int("lifecycle.max.keep", 0) or None)
+            head = registry.latest()
+            if head is not None:
+                if not head.has_payload:
+                    raise ValueError(
+                        f"registry head v{head.version} at {lifecycle_dir} "
+                        f"is a file artifact "
+                        f"(kind={head.manifest.get('kind')!r}), not a "
+                        f"learner-state pytree; the engine restores only "
+                        f"learner-state snapshots — point lifecycle.dir "
+                        f"at a learner-state registry or publish batch "
+                        f"model files to a separate one")
+                if (head.schema_hash is not None and head.schema_hash
+                        != state_schema_hash(engine.learner.state)):
+                    raise ValueError(
+                        f"registry head v{head.version} at {lifecycle_dir} "
+                        f"was published for a different learner shape "
+                        f"(schema {head.schema_hash}); clear the registry "
+                        f"or match learner.type/action.list/config")
+                engine.swap_state(
+                    head.restore(like=engine.learner.state),
+                    version=head.version)
         stats = engine.run()
-        extra = (f', "overlap_fraction": '
-                 f'{round(stats.overlap_fraction, 3)}'
-                 f', "batches": {stats.batches}')
+        if registry is not None:
+            snap = registry.publish(
+                engine.learner.state, kind="learner-state",
+                train_rows=stats.rewards,
+                extra={"learner_type": learner_type,
+                       "events": stats.events})
+            extra += f', "lifecycle_version": {snap.version}'
+        extra += (f', "overlap_fraction": '
+                  f'{round(stats.overlap_fraction, 3)}'
+                  f', "batches": {stats.batches}')
     else:
         with OnlineLearnerLoop(
                 learner_type, actions, conf.as_dict(), queues,
@@ -1386,6 +1436,91 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
 # loop owns its durability (checkpoint + event replay), so the job-level
 # retry budget must not re-run it
 run_reinforcement_learner.retry_safe = False
+
+
+def run_lifecycle(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Snapshot-registry operations (ISSUE 7) — the ``lifecycle`` verb.
+
+    ``lifecycle.dir`` names the registry; ``lifecycle.command`` picks:
+
+    - ``list``: every committed version's manifest, one JSON line each,
+      to ``out_path`` (``in_path`` ignored).
+    - ``show``: the head version's manifest to ``out_path``.
+    - ``publish``: commit ``in_path`` verbatim as a file artifact (the
+      reference's "copy the model file" step, made atomic + versioned) —
+      e.g. a BayesianDistribution/Markov model a batch verb just wrote.
+    - ``retrain``: one bandit refit wave — rebuild a fresh learner
+      (``learner.type`` / ``action.list`` / the usual learner config)
+      from the reward ledger at ``in_path`` (lines ``action,reward``)
+      and publish its state pytree; the manifest lands at ``out_path``.
+      This is the out-of-core batch-retrain leg a RetrainDaemon runs
+      continuously, invokable as a job.
+    - ``prune``: drop all but ``lifecycle.max.keep`` newest versions.
+
+    Each command prints a one-line JSON summary like the other verbs."""
+    import json as _json
+    from avenir_tpu.lifecycle.registry import SnapshotRegistry
+    lifecycle_dir = conf.get_required("lifecycle.dir")
+    registry = SnapshotRegistry(
+        lifecycle_dir,
+        max_to_keep=conf.get_int("lifecycle.max.keep", 0) or None)
+    command = conf.get("lifecycle.command", "list")
+    if command == "list":
+        versions = registry.versions()
+        with open(out_path, "w") as fh:
+            for v in versions:
+                fh.write(_json.dumps(registry.get(v).manifest,
+                                     sort_keys=True) + "\n")
+        print(_json.dumps({"lifecycle.versions": len(versions),
+                           "lifecycle.head": registry.latest_version()}))
+    elif command == "show":
+        head = registry.latest()
+        if head is None:
+            raise ValueError(f"registry at {lifecycle_dir} is empty")
+        with open(out_path, "w") as fh:
+            _json.dump(head.manifest, fh, sort_keys=True)
+        print(_json.dumps({"lifecycle.head": head.version}))
+    elif command == "publish":
+        snap = registry.publish(
+            file_path=in_path,
+            kind=conf.get("lifecycle.kind", "model"),
+            extra={"published_by": "cli"})
+        print(_json.dumps({"lifecycle.published": snap.version}))
+    elif command == "retrain":
+        from avenir_tpu.lifecycle.retrain import (
+            RetrainDaemon, bandit_refit_train_fn)
+        learner_type = conf.get_required("learner.type")
+        actions = conf.get_list("action.list")
+        if not actions:
+            raise ValueError("action.list must name the candidate actions")
+        delim = conf.get("field.delim.regex", ",")
+
+        def rewards():
+            return [(r[0], float(r[1]))
+                    for r in read_csv_lines(in_path, delim)]
+        daemon = RetrainDaemon(registry, bandit_refit_train_fn(
+            learner_type, actions, conf.as_dict(), rewards,
+            seed=conf.get_int("random.seed", 0)))
+        snap = daemon.run_once()
+        if snap is None:
+            raise RuntimeError(
+                f"retrain wave failed: {daemon.last_error!r}")
+        with open(out_path, "w") as fh:
+            _json.dump(snap.manifest, fh, sort_keys=True)
+        print(_json.dumps({"lifecycle.published": snap.version,
+                           "lifecycle.train_rows":
+                               snap.manifest["train_rows"]}))
+    elif command == "prune":
+        keep = conf.get_int("lifecycle.max.keep")
+        if keep is None:
+            raise ValueError("prune needs lifecycle.max.keep")
+        removed = registry.prune(keep)
+        print(_json.dumps({"lifecycle.pruned": removed,
+                           "lifecycle.head": registry.latest_version()}))
+    else:
+        raise ValueError(
+            f"invalid lifecycle.command {command!r} (list, show, publish, "
+            "retrain, prune)")
 
 
 def run_mutual_information(conf: JobConfig, in_path: str,
@@ -1638,6 +1773,7 @@ VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "RandomFirstGreedyBandit": lambda c, i, o: _run_batch_bandit(
         "RandomFirstGreedyBandit", c, i, o),
     "ReinforcementLearnerTopology": run_reinforcement_learner,
+    "Lifecycle": run_lifecycle,
     "MutualInformation": run_mutual_information,
     "CramerCorrelation": lambda c, i, o: run_correlation(
         c, i, o, "cramerIndex"),

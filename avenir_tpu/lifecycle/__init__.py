@@ -1,0 +1,32 @@
+"""Online model lifecycle — continuous retrain, versioned snapshots,
+zero-drop hot-swap, drift-triggered refresh (ROADMAP item 4).
+
+The reference splits batch (MapReduce) from online (Storm) and bridges
+them by hand through files: "state between jobs is exchanged exclusively
+through files" (PAPER.md §1), and the operational loop is literally
+"retrain offline, copy the model file, restart the topology". This
+package fuses the two halves into one always-on service:
+
+- :mod:`~avenir_tpu.lifecycle.registry` — a versioned, file-backed
+  snapshot store (monotonic version ids, manifest JSON, atomic publish,
+  ``latest()``/``get()``/``subscribe()``) that generalizes the
+  Checkpointer into a publish/subscribe artifact store shared by batch
+  verbs and the serving tier.
+- :mod:`~avenir_tpu.lifecycle.retrain` — a ``RetrainDaemon`` running
+  out-of-core batch retrains beside a live engine, publishing each wave
+  to the registry with telemetry spans.
+- :mod:`~avenir_tpu.lifecycle.swap` — the hot-swap seam: engines/loops
+  install a published snapshot at a batch boundary without dropping
+  events (parity contract: identical to stop/restore/resume).
+- :mod:`~avenir_tpu.lifecycle.drift` — Page–Hinkley / windowed-mean
+  detectors over the reward stream that trigger a retrain or alarm.
+"""
+
+from avenir_tpu.lifecycle.registry import (     # noqa: F401
+    RegistryWatcher, Snapshot, SnapshotRegistry, state_schema_hash)
+from avenir_tpu.lifecycle.retrain import (      # noqa: F401
+    RetrainDaemon, bandit_refit_train_fn)
+from avenir_tpu.lifecycle.swap import (         # noqa: F401
+    LifecycleClient, install_state)
+from avenir_tpu.lifecycle.drift import (        # noqa: F401
+    DriftMonitor, PageHinkley, WindowedMeanDetector)

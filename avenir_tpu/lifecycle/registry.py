@@ -1,0 +1,343 @@
+"""Versioned, file-backed model/learner snapshot registry.
+
+The reference hands state between its batch (MR) and online (Storm)
+halves through bare files with an out-of-band "copy the model, restart
+the topology" protocol (PAPER.md §1). This module is that bridge made
+first-class: a directory of immutable, monotonically versioned snapshot
+dirs plus an atomically-updated ``LATEST`` pointer, so a publisher
+(:class:`~avenir_tpu.lifecycle.retrain.RetrainDaemon`, a batch verb)
+and any number of subscribers (serving engines, scale-out workers) share
+artifacts without ever observing a half-written one.
+
+Layout under the registry directory::
+
+    v0000001/
+        manifest.json    version, created_at, schema_hash, train_rows,
+                         parent_version, kind, extra metadata
+        payload.npz      flattened pytree leaves (leaf_000..leaf_N), or
+        artifact         a verbatim published file (file snapshots)
+    LATEST               {"version": N} — the committed head
+
+Atomicity is the ``write_report`` pattern (obs/exporters.py): every
+snapshot is assembled in a same-filesystem temp dir and ``os.replace``d
+into place, and ``LATEST`` is rewritten through a temp file — a SIGKILL
+mid-publish leaves the previous head intact, never a truncated snapshot
+(an orphaned ``.tmp-*`` dir is garbage-collected by the next publish).
+
+Pytrees restore with ``like=`` (the Checkpointer contract): leaves come
+back as jnp arrays with the reference pytree's structure and dtypes —
+freshly allocated buffers, so installing a restored snapshot into a
+donation-armed learner can never alias the registry's (or another
+subscriber's) arrays. ``schema_hash`` fingerprints the pytree structure
++ leaf shapes/dtypes, letting a subscriber reject a snapshot that no
+longer matches its live state instead of crashing mid-swap.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_VERSION_RE = re.compile(r"^v(\d{7,})$")
+_TMP_RE = re.compile(r"^\.tmp-(\d+)-")
+_LATEST = "LATEST"
+_MANIFEST = "manifest.json"
+_PAYLOAD = "payload.npz"
+_ARTIFACT = "artifact"
+
+# a publish assembles one snapshot — seconds, not hours. Past this age a
+# temp dir is an orphan no matter what its embedded pid says (the pid
+# check below is same-host only; a publisher on ANOTHER host sharing the
+# filesystem can collide pid-wise with a live local process)
+_TMP_STALE_S = 3600.0
+
+
+def _leaves(pytree) -> List[np.ndarray]:
+    import jax
+    return [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(pytree)]
+
+
+def state_schema_hash(pytree) -> str:
+    """Fingerprint of a pytree's STRUCTURE + leaf shapes/dtypes (not its
+    values): two states swap-compatibly iff their hashes match. The
+    treedef string pins the container layout, so a dict state and a
+    flax-struct state with identical arrays still hash differently."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(pytree)
+    desc = [str(treedef)] + [
+        f"{tuple(np.shape(l))}:{np.asarray(l).dtype.str}" for l in leaves]
+    return hashlib.sha256("|".join(desc).encode()).hexdigest()[:16]
+
+
+@dataclass
+class Snapshot:
+    """One resolved registry version: manifest + lazy payload access."""
+
+    version: int
+    path: str
+    manifest: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def schema_hash(self) -> Optional[str]:
+        return self.manifest.get("schema_hash")
+
+    @property
+    def has_payload(self) -> bool:
+        """True when this snapshot carries a pytree payload (restore()
+        works); False for verbatim file artifacts (artifact_path())."""
+        return os.path.isfile(os.path.join(self.path, _PAYLOAD))
+
+    def restore(self, like: Any = None):
+        """Load the pytree payload. With ``like``, leaves come back as
+        jnp arrays in ``like``'s structure and dtypes (fresh buffers —
+        donation-safe); without it, a list of numpy arrays in flatten
+        order."""
+        payload = os.path.join(self.path, _PAYLOAD)
+        with np.load(payload) as zf:
+            leaves = [zf[f"leaf_{i:03d}"] for i in range(len(zf.files))]
+        if like is None:
+            return leaves
+        import jax
+        import jax.numpy as jnp
+        ref_leaves, treedef = jax.tree_util.tree_flatten(like)
+        if len(ref_leaves) != len(leaves):
+            raise ValueError(
+                f"snapshot v{self.version} has {len(leaves)} leaves, "
+                f"like= has {len(ref_leaves)}")
+        out = [jnp.asarray(leaf, dtype=np.asarray(ref).dtype)
+               for leaf, ref in zip(leaves, ref_leaves)]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def artifact_path(self) -> str:
+        """Path of a file snapshot's verbatim artifact."""
+        path = os.path.join(self.path, _ARTIFACT)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"snapshot v{self.version} carries no file artifact")
+        return path
+
+
+class SnapshotRegistry:
+    """Publish/subscribe artifact store over one directory.
+
+    Safe for one publisher + many subscriber processes on a shared
+    filesystem (the scale-out deployment shape): publishing is
+    rename-atomic and subscribers only ever read committed versions
+    through the ``LATEST`` pointer. Concurrent publishers are tolerated
+    (version allocation retries on collision) but ordering between them
+    is last-writer-wins on ``LATEST`` — the single-RetrainDaemon model
+    is the intended topology.
+    """
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+
+    # -- read side ---------------------------------------------------------
+
+    def _scan_versions(self) -> List[int]:
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            m = _VERSION_RE.match(name)
+            if m and os.path.isfile(os.path.join(self.directory, name,
+                                                 _MANIFEST)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def versions(self) -> List[int]:
+        """Committed versions, ascending."""
+        return self._scan_versions()
+
+    def latest_version(self) -> Optional[int]:
+        """The committed head: the LATEST pointer when present and valid,
+        else the newest complete snapshot dir (pointer lost/corrupt —
+        e.g. a crash between the snapshot rename and the pointer write;
+        the snapshot itself is complete, so serving it is correct)."""
+        try:
+            with open(os.path.join(self.directory, _LATEST)) as fh:
+                v = int(json.load(fh)["version"])
+            if os.path.isfile(self._vdir(v) + "/" + _MANIFEST):
+                return v
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            pass
+        scanned = self._scan_versions()
+        return scanned[-1] if scanned else None
+
+    def _vdir(self, version: int) -> str:
+        return os.path.join(self.directory, f"v{version:07d}")
+
+    def get(self, version: int) -> Snapshot:
+        path = self._vdir(version)
+        with open(os.path.join(path, _MANIFEST)) as fh:
+            manifest = json.load(fh)
+        return Snapshot(version=version, path=path, manifest=manifest)
+
+    def latest(self) -> Optional[Snapshot]:
+        v = self.latest_version()
+        return self.get(v) if v is not None else None
+
+    def subscribe(self,
+                  from_version: Optional[int] = None) -> "RegistryWatcher":
+        """A poll-based watcher: ``poll()`` returns each NEW head exactly
+        once. ``from_version=None`` starts at the current head (only
+        future publishes fire); ``from_version=0`` replays the current
+        head on the first poll."""
+        if from_version is None:
+            from_version = self.latest_version() or 0
+        return RegistryWatcher(self, from_version)
+
+    # -- write side --------------------------------------------------------
+
+    def publish(self, pytree: Any = None, *, file_path: Optional[str] = None,
+                kind: str = "model", train_rows: int = 0,
+                extra: Optional[Dict[str, Any]] = None) -> Snapshot:
+        """Commit a new version: exactly one of ``pytree`` (arrays) or
+        ``file_path`` (verbatim artifact copy). Returns the committed
+        :class:`Snapshot`. The rename is the commit point; everything
+        before it happens in a temp dir invisible to readers."""
+        if (pytree is None) == (file_path is None):
+            raise ValueError("publish takes exactly one of pytree= or "
+                             "file_path=")
+        parent = self.latest_version()
+        manifest = {
+            "format": "avenir-lifecycle-v1",
+            "created_at": time.time(),
+            "kind": kind,
+            "train_rows": int(train_rows),
+            "parent_version": parent,
+            "extra": dict(extra or {}),
+        }
+        tmp = tempfile.mkdtemp(prefix=f".tmp-{os.getpid()}-",
+                               dir=self.directory)
+        try:
+            if pytree is not None:
+                manifest["schema_hash"] = state_schema_hash(pytree)
+                leaves = _leaves(pytree)
+                manifest["n_leaves"] = len(leaves)
+                np.savez(os.path.join(tmp, _PAYLOAD),
+                         **{f"leaf_{i:03d}": leaf
+                            for i, leaf in enumerate(leaves)})
+            else:
+                shutil.copyfile(file_path, os.path.join(tmp, _ARTIFACT))
+                manifest["source_file"] = os.path.abspath(file_path)
+            version = (parent or 0)
+            while True:
+                version += 1
+                manifest["version"] = version
+                with open(os.path.join(tmp, _MANIFEST), "w") as fh:
+                    json.dump(manifest, fh, sort_keys=True)
+                try:
+                    os.replace(tmp, self._vdir(version))
+                    break
+                except OSError:
+                    # target exists: a concurrent publisher won this
+                    # version id — retry with the next one
+                    if not os.path.isdir(self._vdir(version)):
+                        raise
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._commit_latest(version)
+        self._gc()
+        return self.get(version)
+
+    def _commit_latest(self, version: int) -> None:
+        """write_report's temp + ``os.replace`` pattern: the pointer is
+        either the old head or the new one, never truncated JSON."""
+        path = os.path.join(self.directory, _LATEST)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump({"version": version}, fh)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _tmp_is_orphan(self, name: str, path: str) -> bool:
+        """A temp dir is swept only when its publisher is provably gone:
+        its embedded pid is dead on this host, or the dir has outlived
+        any plausible publish (cross-host publishers — same filesystem,
+        different pid namespace — age out instead). Sweeping every
+        ``.tmp-*`` unconditionally would delete a CONCURRENT publisher's
+        in-flight assembly and silently fail its wave."""
+        try:
+            age = time.time() - os.stat(path).st_mtime
+        except OSError:
+            return False                # raced away already
+        if age > _TMP_STALE_S:
+            return True
+        m = _TMP_RE.match(name)
+        if m:
+            try:
+                os.kill(int(m.group(1)), 0)
+            except ProcessLookupError:
+                return True             # same-host publisher died
+            except OSError:
+                pass                    # EPERM etc.: alive, not ours
+        return False
+
+    def _gc(self) -> None:
+        """Prune past ``max_to_keep`` (head always survives) and sweep
+        orphaned temp dirs a killed publisher left behind. Best-effort:
+        a subscriber may hold an old version open; deletion failures are
+        ignored and retried on the next publish."""
+        for name in os.listdir(self.directory):
+            if name.startswith(".tmp-"):
+                path = os.path.join(self.directory, name)
+                if self._tmp_is_orphan(name, path):
+                    shutil.rmtree(path, ignore_errors=True)
+        if not self.max_to_keep:
+            return
+        versions = self._scan_versions()
+        for v in versions[:-max(int(self.max_to_keep), 1)]:
+            shutil.rmtree(self._vdir(v), ignore_errors=True)
+
+    def prune(self, max_to_keep: int) -> List[int]:
+        """Explicit prune (the CLI verb); returns the versions removed."""
+        versions = self._scan_versions()
+        doomed = versions[:-max(int(max_to_keep), 1)]
+        for v in doomed:
+            shutil.rmtree(self._vdir(v), ignore_errors=True)
+        return doomed
+
+
+class RegistryWatcher:
+    """Poll-based subscription: each committed head is surfaced once.
+
+    File polling (not inotify) on purpose — subscribers poll on their
+    heartbeat cadence, the same discipline the scale-out workers already
+    use for liveness, and it works over any shared filesystem."""
+
+    def __init__(self, registry: SnapshotRegistry, last_seen: int):
+        self.registry = registry
+        self.last_seen = int(last_seen)
+
+    def poll(self) -> Optional[Snapshot]:
+        """The new head if it advanced past ``last_seen``, else None.
+        Intermediate versions published between polls are skipped — a
+        subscriber always converges on the newest model, it does not
+        replay history."""
+        head = self.registry.latest_version()
+        if head is None or head <= self.last_seen:
+            return None
+        snap = self.registry.get(head)
+        self.last_seen = head
+        return snap

@@ -1,0 +1,169 @@
+"""Continuous retraining beside a live serving engine.
+
+The reference's retrain story is operational: run the MR trainer again,
+copy the model file, restart the Storm topology (PAPER.md §1). Here the
+same wave — an out-of-core batch retrain over the accumulated data —
+runs in a background thread NEXT TO the engine, publishes its result to
+the :class:`~avenir_tpu.lifecycle.registry.SnapshotRegistry`, and the
+engine hot-swaps at the next batch boundary (swap.py) with zero dropped
+events and no restart.
+
+``RetrainDaemon`` is deliberately generic over WHAT retrains: it owns
+the cadence (interval and/or explicit :meth:`request`, e.g. from a
+drift detector), the telemetry spans (``lifecycle.retrain`` around the
+train function, ``lifecycle.publish`` around the registry commit), the
+``lifecycle.model_version`` hub gauge, and the never-sink-serving error
+policy; the ``train_fn`` supplies the wave. Three wave shapes ship:
+
+- :func:`bandit_refit_train_fn` — rebuild a bandit learner's state from
+  the reward ledger (the online path's own out-of-core retrain: the
+  ledger is the accumulated training set).
+- ``train_streamed``-style batch retrains (NB / Markov): wrap the
+  existing streaming trainer + ``save_model`` in a closure that returns
+  ``{"file_path": path}`` — the registry stores the verbatim model
+  artifact, exactly the file the batch verbs already read and write.
+- Anything returning ``{"pytree": ...}`` or ``{"file_path": ...}`` plus
+  optional ``train_rows``/``extra``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from avenir_tpu.lifecycle.registry import Snapshot, SnapshotRegistry
+from avenir_tpu.obs import telemetry
+from avenir_tpu.obs.exporters import set_hub_gauges_if_live as _set_hub_gauges
+
+
+def bandit_refit_train_fn(learner_type: str, actions, config: Dict[str, Any],
+                          reward_source: Callable[[], list],
+                          seed: int = 0) -> Callable[[], Dict[str, Any]]:
+    """A retrain wave for the online path itself: build a FRESH learner
+    and refit it from the reward ledger (``reward_source()`` returns the
+    accumulated ``(action_id, reward)`` pairs — a file reader, a broker
+    LRANGE sweep, an in-memory ledger). The published snapshot is the
+    learner-state pytree a serving engine hot-swaps in; folding through
+    ``set_reward_batch`` keeps the refit on the same fused device path
+    as live serving."""
+    from avenir_tpu.models.bandits.learners import Learner
+
+    def train() -> Dict[str, Any]:
+        learner = Learner(learner_type, list(actions), dict(config),
+                          seed=seed)
+        pairs = list(reward_source())
+        if pairs:
+            learner.set_reward_batch(pairs)
+        return {"pytree": learner.state, "train_rows": len(pairs),
+                "kind": "learner-state",
+                "extra": {"learner_type": learner_type}}
+    return train
+
+
+class RetrainDaemon:
+    """Background retrain waves publishing to a registry.
+
+    ``start()`` spawns the worker thread; waves run every ``interval_s``
+    seconds and/or whenever :meth:`request` fires (drift detectors call
+    it). A wave that raises is counted (``errors``) and logged — it must
+    never take the serving process down. :meth:`run_once` runs one wave
+    synchronously on the caller's thread (CLI verb, tests, smoke)."""
+
+    def __init__(self, registry: SnapshotRegistry,
+                 train_fn: Callable[[], Dict[str, Any]],
+                 interval_s: Optional[float] = None,
+                 kind: str = "model"):
+        self.registry = registry
+        self.train_fn = train_fn
+        self.interval_s = interval_s
+        self.kind = kind
+        self.waves = 0
+        self.errors = 0
+        self.last_version: Optional[int] = None
+        self.last_error: Optional[BaseException] = None
+        self._tel = telemetry.tracer()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- wave --------------------------------------------------------------
+
+    def run_once(self) -> Optional[Snapshot]:
+        """One retrain-and-publish wave. Returns the committed snapshot,
+        or None when the wave failed (error counted, serving unharmed)."""
+        try:
+            with self._tel.span("lifecycle.retrain"):
+                result = self.train_fn()
+            pytree = result.get("pytree")
+            file_path = result.get("file_path")
+            with self._tel.span("lifecycle.publish"):
+                snap = self.registry.publish(
+                    pytree, file_path=file_path,
+                    kind=result.get("kind", self.kind),
+                    train_rows=result.get("train_rows", 0),
+                    extra=result.get("extra"))
+        except Exception as exc:
+            self.errors += 1
+            self.last_error = exc
+            _set_hub_gauges({"lifecycle.retrain_errors": self.errors})
+            return None
+        with self._lock:
+            self.waves += 1
+            self.last_version = snap.version
+        _set_hub_gauges({"lifecycle.model_version": snap.version,
+                         "lifecycle.retrain_waves": self.waves})
+        return snap
+
+    def request(self) -> None:
+        """Ask for a wave now (drift detectors, operators). Coalescing:
+        requests landing while a wave runs fold into one follow-up wave."""
+        self._wake.set()
+
+    # -- thread ------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            fired = self._wake.wait(timeout=self.interval_s)
+            if self._stop.is_set():
+                return
+            if fired:
+                self._wake.clear()
+            elif self.interval_s is None:
+                continue
+            self.run_once()
+
+    def start(self) -> "RetrainDaemon":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lifecycle-retrain")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=timeout)
+        self._thread = None
+
+    def wait_for_waves(self, n: int, timeout: float = 60.0) -> bool:
+        """Block until ``n`` waves have completed (tests/smoke): True on
+        success, False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.waves >= n:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def __enter__(self) -> "RetrainDaemon":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

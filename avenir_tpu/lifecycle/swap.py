@@ -1,0 +1,231 @@
+"""Zero-drop hot-swap: install a published snapshot at a batch boundary.
+
+The reference swaps models by restarting the Storm topology — every
+in-flight tuple is dropped or replayed. Here the serving engine and the
+online loop gain a ``swap_state(pytree, version)`` seam with a strict
+parity contract: a swap at a batch boundary is IDENTICAL to stopping the
+loop, restoring the snapshot, and resuming — in-flight dispatched
+batches resolve against the old state (their selects were already
+dispatched; the handles are independent device arrays), the next
+dispatch uses the new one, and not a single event is dropped or served
+twice (tested the way PR 5 tested checkpoint-resume, algorithms × seeds,
+including a swap landing while a dispatched batch is in flight).
+
+Donated-buffer safety: on TPU/GPU the learner's state pytree is DONATED
+to every jitted step (``learners._donate_state_argnums``) — whatever is
+installed will have its buffers invalidated on the next dispatch. So
+:func:`install_state` always installs a FRESH COPY of the snapshot
+(``jnp.array`` per leaf, cast to the live state's dtypes): the registry
+payload, a test's reference snapshot, or a second engine sharing the
+same snapshot can never be corrupted by this engine's dispatches.
+
+:class:`LifecycleClient` is the subscriber half the scale-out workers
+ride: it polls a :class:`~avenir_tpu.lifecycle.registry.RegistryWatcher`
+on the heartbeat cadence and swaps every registered target whose state
+schema matches the new snapshot (mismatches alarm instead of crash —
+a publisher rolling a new learner shape must not take the fleet down).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from avenir_tpu.obs import telemetry
+from avenir_tpu.obs.exporters import set_hub_gauges_if_live as _hub_gauges
+
+
+def install_state(learner, pytree: Any) -> None:
+    """Replace ``learner.state`` with a donation-safe copy of ``pytree``.
+
+    Leaves are validated shape-for-shape against the live state (a
+    mismatched snapshot must fail loudly HERE, not as a shape error
+    inside the next jitted dispatch) and copied into fresh buffers cast
+    to the live dtypes — ``jnp.array`` copies even jax-array leaves, so
+    the source snapshot survives any number of donated dispatches."""
+    import jax
+    import jax.numpy as jnp
+    ref_leaves, ref_def = jax.tree_util.tree_flatten(learner.state)
+    new_leaves, new_def = jax.tree_util.tree_flatten(pytree)
+    if ref_def != new_def:
+        raise ValueError(
+            f"snapshot structure {new_def} does not match live state "
+            f"{ref_def}")
+    copied = []
+    for i, (ref, new) in enumerate(zip(ref_leaves, new_leaves)):
+        if tuple(jnp.shape(new)) != tuple(jnp.shape(ref)):
+            raise ValueError(
+                f"snapshot leaf {i} shape {tuple(jnp.shape(new))} != live "
+                f"state shape {tuple(jnp.shape(ref))}")
+        copied.append(jnp.array(new, dtype=ref.dtype))
+    learner.state = jax.tree_util.tree_unflatten(ref_def, copied)
+
+
+def record_swap(tel, t0: float, version: Optional[int],
+                swap_count: int) -> float:
+    """Shared swap telemetry tail: the ``lifecycle.swap`` latency span,
+    the ``lifecycle.swap_total`` / ``lifecycle.model_version`` hub
+    gauges (per-source attributable under ``merge_reports`` — the fleet
+    report shows WHICH worker runs WHICH version). Returns elapsed ms."""
+    ms = (time.perf_counter() - t0) * 1e3
+    if tel.enabled:
+        tel.record("lifecycle.swap", ms)
+    gauges: Dict[str, float] = {"lifecycle.swap_total": swap_count}
+    if version is not None:
+        gauges["lifecycle.model_version"] = version
+    _hub_gauges(gauges)
+    return ms
+
+
+class BoundaryStopQueues:
+    """Queue adapter modeling a STOP at an exact popped-event budget —
+    the replay half of the swap parity contract (driven by the parity
+    tests and ``scripts/lifecycle_smoke.py``).
+
+    A live hot-swap at batch boundary b runs swap-THEN-fold: rewards
+    still queued at the boundary fold into the NEW state. A naive replay
+    via ``run(max_events=...)`` folds that backlog into the about-to-be-
+    replaced state on its way out (``run()``'s exit-drain contract), so
+    the rewards' signal is lost and byte parity false-fails the moment
+    rewards sit queued at a swap boundary. This wrapper models the stop
+    faithfully: once ``budget`` events have been popped, pops AND reward
+    drains come back empty — a stopped process folds nothing — so
+    boundary-pending rewards survive for the restored engine's first
+    fold, exactly the live order. ``set_budget(None)`` reopens the gate
+    for the final resume leg.
+
+    Budgets must land on batch boundaries (multiples of the engine's pop
+    cap) so the pop cadence — and with it the PRNG chunking — matches
+    the live run's."""
+
+    def __init__(self, queues):
+        self.queues = queues
+        self._budget: Optional[int] = None
+        self._popped = 0
+
+    def set_budget(self, budget: Optional[int]) -> None:
+        self._budget = budget
+        self._popped = 0
+
+    @property
+    def _gate_open(self) -> bool:
+        return self._budget is None or self._popped < self._budget
+
+    def pop_events(self, max_n: int) -> list:
+        if not self._gate_open:
+            return []
+        if self._budget is not None:
+            max_n = min(max_n, self._budget - self._popped)
+        bulk = getattr(self.queues, "pop_events", None)
+        if bulk is not None:
+            out = bulk(max_n)
+        else:
+            out = []
+            while len(out) < max_n:
+                event_id = self.queues.pop_event()
+                if event_id is None:
+                    break
+                out.append(event_id)
+        self._popped += len(out)
+        return out
+
+    def pop_event(self):
+        if not self._gate_open:
+            return None
+        event_id = self.queues.pop_event()
+        if event_id is not None:
+            self._popped += 1
+        return event_id
+
+    def drain_rewards(self, max_items: Optional[int] = None) -> list:
+        if not self._gate_open:
+            return []
+        try:
+            if max_items is None:
+                return self.queues.drain_rewards()
+            return self.queues.drain_rewards(max_items)
+        except TypeError:        # adapter without the bound parameter
+            return self.queues.drain_rewards()
+
+    def __getattr__(self, name):
+        return getattr(self.queues, name)
+
+
+class LifecycleClient:
+    """Registry subscription + swap fan-out for a serving process.
+
+    ``targets`` maps a name (the scale-out group id, or anything) to an
+    object with ``swap_state(pytree, version=)`` — a ``ServingEngine``,
+    an ``OnlineLearnerLoop`` — plus a live ``learner.state`` to restore
+    against. :meth:`poll_and_swap` is called on the heartbeat cadence:
+    one registry stat per call, zero work when the head hasn't moved.
+
+    A snapshot naming a ``group`` in its manifest extra swaps only that
+    target; otherwise every target swaps (the scale-out fleet runs one
+    algorithm/config across groups, so one published learner state is
+    every group's new baseline)."""
+
+    def __init__(self, registry_or_dir, from_version: Optional[int] = None,
+                 min_poll_interval_s: float = 0.0):
+        from avenir_tpu.lifecycle.registry import SnapshotRegistry
+        self.registry = (registry_or_dir
+                         if isinstance(registry_or_dir, SnapshotRegistry)
+                         else SnapshotRegistry(str(registry_or_dir)))
+        self.watcher = self.registry.subscribe(from_version)
+        self.targets: Dict[str, Any] = {}
+        self.swaps = 0
+        self.rejected = 0
+        self.last_version: Optional[int] = None
+        # poll throttle: an idle worker's outer loop spins at ms cadence,
+        # and each poll is a registry stat — cap it at the heartbeat-ish
+        # interval the caller picks (0 = every call, the test default)
+        self.min_poll_interval_s = float(min_poll_interval_s)
+        self._last_poll = 0.0
+        self._tel = telemetry.tracer()
+
+    def register(self, name: str, target: Any) -> None:
+        self.targets[name] = target
+
+    def poll_and_swap(self) -> Optional[int]:
+        """Check the registry head; swap matching targets on a new
+        version. Returns the version swapped in, else None. Never
+        raises — a bad snapshot alarms (``lifecycle.swap_rejected``)
+        and serving continues on the current model."""
+        if self.min_poll_interval_s > 0.0:
+            now = time.monotonic()
+            if now - self._last_poll < self.min_poll_interval_s:
+                return None
+            self._last_poll = now
+        try:
+            snap = self.watcher.poll()
+        except Exception:
+            return None
+        if snap is None or not self.targets:
+            return None
+        group = (snap.manifest.get("extra") or {}).get("group")
+        swapped = None
+        for name, target in self.targets.items():
+            if group is not None and name != group:
+                continue
+            try:
+                like = target.learner.state
+                from avenir_tpu.lifecycle.registry import state_schema_hash
+                if not snap.has_payload:
+                    raise ValueError(
+                        f"v{snap.version} is a file artifact "
+                        f"(kind={snap.manifest.get('kind')!r}), not a "
+                        f"swappable learner-state pytree")
+                if (snap.schema_hash is not None
+                        and snap.schema_hash != state_schema_hash(like)):
+                    raise ValueError(
+                        f"schema hash {snap.schema_hash} != live state")
+                target.swap_state(snap.restore(like=like),
+                                  version=snap.version)
+                swapped = snap.version
+            except Exception:
+                self.rejected += 1
+                _hub_gauges({"lifecycle.swap_rejected": self.rejected})
+        if swapped is not None:
+            self.swaps += 1
+            self.last_version = swapped
+        return swapped

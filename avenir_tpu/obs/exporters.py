@@ -433,3 +433,16 @@ class TelemetryHub:
 
 def hub() -> TelemetryHub:
     return TelemetryHub.get()
+
+
+def set_hub_gauges_if_live(values: Dict[str, float]) -> None:
+    """Publish gauges iff the singleton hub exists AND is enabled; never
+    raises. The shared discipline of every instrumented hot path (the
+    serving engines, lifecycle swap/retrain/drift): telemetry must never
+    sink serving — a disabled or absent hub costs one attribute read."""
+    try:
+        h = TelemetryHub._instance
+        if h is not None and h.enabled:
+            h.set_gauges(values)
+    except Exception:
+        pass

@@ -78,6 +78,9 @@ class EngineStats:
     rewards: int = 0
     actions_written: int = 0
     batches: int = 0
+    # lifecycle (ISSUE 7): hot-swaps installed + the version serving now
+    swaps: int = 0
+    model_version: Optional[int] = None
     select_wait_ms: float = 0.0   # host blocked on device readback
     io_ms: float = 0.0            # broker/queue I/O time
     dispatch_ms: float = 0.0      # host time enqueueing device work
@@ -167,19 +170,14 @@ def _publish_engine_gauges(stats: "EngineStats",
     Telemetry must never sink the engine."""
     if not telemetry.tracer().enabled:
         return
-    try:
-        from avenir_tpu.obs.exporters import TelemetryHub
-        hub = TelemetryHub._instance
-        if hub is not None and hub.enabled:
-            gauges = {
-                "engine.overlap_fraction": stats.overlap_fraction,
-                "engine.reward_backlog": stats.reward_backlog,
-            }
-            if extra:
-                gauges.update(extra)
-            hub.set_gauges(gauges)
-    except Exception:
-        pass
+    from avenir_tpu.obs.exporters import set_hub_gauges_if_live
+    gauges = {
+        "engine.overlap_fraction": stats.overlap_fraction,
+        "engine.reward_backlog": stats.reward_backlog,
+    }
+    if extra:
+        gauges.update(extra)
+    set_hub_gauges_if_live(gauges)
 
 
 class _AdaptiveCap:
@@ -221,7 +219,9 @@ class ServingEngine:
                  drain_max: Optional[int] = None,
                  learner: Optional[Learner] = None,
                  on_batch: Optional[Callable[[int], None]] = None,
-                 event_timestamps: bool = False):
+                 event_timestamps: bool = False,
+                 swap_source: Optional[Callable[[], Optional[Tuple]]] = None,
+                 drift_monitor=None):
         self.learner = (learner if learner is not None
                         else Learner(learner_type, actions, config, seed))
         self.queues = queues
@@ -231,11 +231,49 @@ class ServingEngine:
         self._drain_max = drain_max
         self._on_batch = on_batch
         self._tel = telemetry.tracer()
+        # lifecycle seam (ISSUE 7): polled once per batch boundary;
+        # returns (version, state_pytree) to hot-swap, None to keep going
+        self._swap_source = swap_source
+        # drift detectors fed from the drained reward stream
+        self._drift = drift_monitor
         # opt-in ``id|ts`` payloads (stream.loop.split_event_timestamp):
         # queue wait measured end-to-end, actions written under the bare
         # id, acks by raw payload; wire format untouched when off
         self._event_ts = bool(event_timestamps)
         self.stats.batch_cap = self._cap.cap
+
+    # -- lifecycle seam ------------------------------------------------------
+
+    def swap_state(self, pytree, version=None) -> float:
+        """Install a model/learner snapshot at a batch boundary (ISSUE 7).
+
+        The parity contract: calling this between batches is IDENTICAL
+        to stopping the engine, restoring the snapshot, and resuming —
+        any in-flight dispatched batch already holds its device handles
+        (computed from the old state at dispatch), so it resolves
+        unchanged; the next dispatch reads the new state. The install is
+        a donation-safe COPY (lifecycle.swap.install_state): on
+        donation-armed backends the engine's next dispatch invalidates
+        its state buffers, which must never be the caller's snapshot.
+        Returns the swap latency in ms (the ``lifecycle.swap`` span)."""
+        from avenir_tpu.lifecycle.swap import install_state, record_swap
+        t0 = time.perf_counter()
+        install_state(self.learner, pytree)
+        self.stats.swaps += 1
+        if version is not None:
+            self.stats.model_version = version
+        return record_swap(self._tel, t0, version, self.stats.swaps)
+
+    def _maybe_swap(self) -> None:
+        """Poll the swap source at the top of a batch iteration — before
+        the batch's reward drain, the exact point a stop/restore/resume
+        re-enters — and install whatever it hands back."""
+        if self._swap_source is None:
+            return
+        pending = self._swap_source()
+        if pending is not None:
+            version, pytree = pending
+            self.swap_state(pytree, version=version)
 
     # -- pipeline stages -----------------------------------------------------
 
@@ -249,6 +287,8 @@ class ServingEngine:
         if pairs:
             self.learner.set_reward_batch(pairs)
             self.stats.rewards += len(pairs)
+            if self._drift is not None:
+                self._drift.observe_rewards(r for _, r in pairs)
         backlog = getattr(self.queues, "reward_backlog", None)
         if backlog is not None:
             self.stats.reward_backlog = int(backlog)
@@ -314,6 +354,7 @@ class ServingEngine:
         pending: Optional[Tuple] = None
         last_folded = 0
         while True:
+            self._maybe_swap()
             io_s, last_folded = self._fold_rewards()
             t0 = time.perf_counter()
             cap = self._cap.cap

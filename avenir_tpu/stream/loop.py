@@ -441,6 +441,11 @@ class LoopStats:
     event_p50_ms: float = 0.0   # per-event serving latency percentiles
     event_p95_ms: float = 0.0   # (batch mode: batch wall time / batch size)
     event_p99_ms: float = 0.0
+    # lifecycle (ISSUE 7): hot-swaps installed + the version serving now
+    # (gauges, not checkpointed — a fresh process re-learns its version
+    # from the registry)
+    swaps: int = 0
+    model_version: Optional[int] = None
 
 
 class OnlineLearnerLoop:
@@ -456,7 +461,8 @@ class OnlineLearnerLoop:
                  config: Dict[str, Any], queues, seed: int = 0,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_interval: int = 100,
-                 event_timestamps: bool = False):
+                 event_timestamps: bool = False,
+                 swap_source: Optional[Callable[[], Optional[Tuple]]] = None):
         self.learner = Learner(learner_type, actions, config, seed)
         self.queues = queues
         self.stats = LoopStats()
@@ -477,6 +483,9 @@ class OnlineLearnerLoop:
         # BATCHES; the refresh sort happens once per run() exit, never
         # in the hot loop.
         self._event_ms: deque = deque(maxlen=2048)
+        # lifecycle seam (ISSUE 7): polled once per step/batch boundary;
+        # returns (version, state_pytree) to hot-swap, None otherwise
+        self._swap_source = swap_source
         self._ckpt = None
         self._ckpt_mod = None
         self._ckpt_interval = max(int(checkpoint_interval), 1)
@@ -499,6 +508,32 @@ class OnlineLearnerLoop:
                 self.stats = LoopStats(**stats)
                 self._skip_rewards = self.stats.rewards
                 self.resumed_events = self.stats.events
+
+    def swap_state(self, pytree, version=None) -> float:
+        """Install a learner-state snapshot at a step/batch boundary
+        (ISSUE 7). Identical to stopping the loop, restoring the
+        snapshot, and resuming: the whole state is replaced with a
+        donation-safe copy, so everything after is determined by
+        (snapshot, remaining queues) exactly as a restart would be.
+        Returns the swap latency in ms (the ``lifecycle.swap`` span)."""
+        from avenir_tpu.lifecycle.swap import install_state, record_swap
+        t0 = time.perf_counter()
+        install_state(self.learner, pytree)
+        self.stats.swaps += 1
+        if version is not None:
+            self.stats.model_version = version
+        return record_swap(self._tel, t0, version, self.stats.swaps)
+
+    def _maybe_swap(self) -> None:
+        """Poll the swap source at the top of a step/batch — the exact
+        point a stop/restore/resume re-enters (before the reward
+        drain)."""
+        if self._swap_source is None:
+            return
+        pending = self._swap_source()
+        if pending is not None:
+            version, pytree = pending
+            self.swap_state(pytree, version=version)
 
     def _drain_new_rewards_counted(self) -> Tuple[List[Tuple[str, float]],
                                                   int]:
@@ -596,6 +631,7 @@ class OnlineLearnerLoop:
     def step(self) -> bool:
         """Process one event (rewards drained first, like the bolt
         :96-99). Returns False when the event queue is empty."""
+        self._maybe_swap()
         t0 = time.perf_counter()
         for action_id, reward in self._drain_new_rewards():
             self.learner.set_reward(action_id, reward)
@@ -642,6 +678,7 @@ class OnlineLearnerLoop:
         batch_size = self.learner.cfg.batch_size
         event_cap = Learner._SCAN_BUCKET_MAX
         while max_events is None or processed < max_events:
+            self._maybe_swap()
             t_batch = time.perf_counter()
             pairs = self._drain_new_rewards()
             if pairs:

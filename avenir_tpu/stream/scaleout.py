@@ -352,12 +352,26 @@ def shuffle_worker_main(host: str, port: int, worker_id: int,
             "grouping": "shuffle"}
 
 
+def _lifecycle_client(lifecycle_dir: Optional[str]):
+    """Registry subscription for a worker process (ISSUE 7): polled on
+    the heartbeat-ish cadence, swapping every owned group's learner when
+    a retrain wave publishes a new head. None when lifecycle is off."""
+    if not lifecycle_dir:
+        return None
+    from avenir_tpu.lifecycle.swap import LifecycleClient
+    # from_version=0 replays the current head on the first poll, so a
+    # worker joining after a publish starts on the published model
+    return LifecycleClient(lifecycle_dir, from_version=0,
+                           min_poll_interval_s=0.25)
+
+
 def worker_main(host: str, port: int, worker_id: int, n_workers: int,
                 groups: Sequence[str], learner_type: str,
                 actions: Sequence[str], config: Dict, seed: int,
                 replay: bool = False, decision_io_ms: float = 0.0,
                 engine: bool = False,
-                event_timestamps: bool = False) -> Dict:
+                event_timestamps: bool = False,
+                lifecycle_dir: Optional[str] = None) -> Dict:
     """One serving process: loops for the owned groups until every group's
     stop sentinel arrives. Returns per-worker stats. ``replay`` implements
     ``replay.failed.message=true``: on startup, un-acked events a dead
@@ -370,18 +384,24 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
     regime BASELINE.md documents). ``engine=True`` swaps each group's
     per-event ``step()`` loop for the pipelined ``ServingEngine``
     (bulk transport + dispatch-then-fetch; the ack/replay ledger contract
-    is unchanged, just batch-granular), heartbeats included."""
+    is unchanged, just batch-granular), heartbeats included.
+    ``lifecycle_dir`` subscribes the worker to a snapshot registry
+    (ISSUE 7): polled on the heartbeat-ish cadence, a newly published
+    learner-state snapshot hot-swaps into every owned group's learner at
+    its next step/batch boundary — the fleet re-models without a single
+    dropped event or restart."""
     client = MiniRedisClient(host, port)
     replayed = 0
     if replay:
         for g in owned_groups(groups, worker_id, n_workers):
             replayed += reclaim_pending(
                 client, f"pendingQueue:{g}", f"eventQueue:{g}")
+    lc = _lifecycle_client(lifecycle_dir)
     if engine:
         return _worker_main_engine(client, worker_id, n_workers, groups,
                                    learner_type, actions, config, seed,
                                    replayed, decision_io_ms,
-                                   event_timestamps)
+                                   event_timestamps, lc)
     loops = {}
     for g in owned_groups(groups, worker_id, n_workers):
         # per-group seed component: each group's learner must explore
@@ -391,11 +411,17 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
             _StoppableQueues(client, g),
             seed=seed + 1000 * worker_id + list(groups).index(g),
             event_timestamps=event_timestamps)
+    if lc is not None:
+        for g, loop in loops.items():
+            lc.register(g, loop)
+        lc.poll_and_swap()      # join on the published head, if any
     active = set(loops)
     idle_sleep = 0.001
     served_total = 0
     push_heartbeat(client, worker_id, 0, 0)  # alive, loops constructed
     while active:
+        if lc is not None:
+            lc.poll_and_swap()   # throttled to the heartbeat-ish cadence
         progressed = False
         for g in list(active):
             loop = loops[g]
@@ -447,7 +473,8 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
                         groups: Sequence[str], learner_type: str,
                         actions: Sequence[str], config: Dict, seed: int,
                         replayed: int, decision_io_ms: float,
-                        event_timestamps: bool = False) -> Dict:
+                        event_timestamps: bool = False,
+                        lc=None) -> Dict:
     """Engine-mode worker body: one pipelined ``ServingEngine`` per owned
     group over the same stoppable per-group queues. Each visit drains the
     group's current backlog in one ``run()`` (pipelined micro-batches);
@@ -473,10 +500,18 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
             _StoppableQueues(client, g),
             seed=seed + 1000 * worker_id + list(groups).index(g),
             on_batch=on_batch, event_timestamps=event_timestamps)
+    if lc is not None:
+        for g, eng in engines.items():
+            lc.register(g, eng)
+        lc.poll_and_swap()      # join on the published head, if any
     active = set(engines)
     idle_sleep = 0.001
     push_heartbeat(client, worker_id, 0, 0)  # alive, engines constructed
     while active:
+        if lc is not None:
+            # between run() calls every engine is at a batch boundary;
+            # the client throttles itself to the heartbeat-ish cadence
+            lc.poll_and_swap()
         progressed = False
         for g in list(active):
             eng = engines[g]
@@ -563,7 +598,8 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
                   replay: bool = False, decision_io_ms: float = 0.0,
                   grouping: str = "fields",
                   engine: bool = False, telemetry: bool = False,
-                  event_timestamps: bool = False) -> subprocess.Popen:
+                  event_timestamps: bool = False,
+                  lifecycle_dir: Optional[str] = None) -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
            "--host", host, "--port", str(port),
@@ -581,6 +617,8 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
         cmd.append("--telemetry")
     if event_timestamps:
         cmd.append("--event-timestamps")
+    if lifecycle_dir:
+        cmd += ["--lifecycle-dir", lifecycle_dir]
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True)
 
@@ -591,12 +629,15 @@ def _spawn_workers(host: str, port: int, n_workers: int,
                    decision_io_ms: float = 0.0,
                    grouping: str = "fields",
                    engine: bool = False, telemetry: bool = False,
-                   event_timestamps: bool = False) -> List[subprocess.Popen]:
+                   event_timestamps: bool = False,
+                   lifecycle_dir: Optional[str] = None
+                   ) -> List[subprocess.Popen]:
     return [_spawn_worker(host, port, w, n_workers, groups, learner_type,
                           actions, config, seed,
                           decision_io_ms=decision_io_ms, grouping=grouping,
                           engine=engine, telemetry=telemetry,
-                          event_timestamps=event_timestamps)
+                          event_timestamps=event_timestamps,
+                          lifecycle_dir=lifecycle_dir)
             for w in range(n_workers)]
 
 
@@ -674,7 +715,8 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
                  grouping: str = "fields",
                  engine: bool = False,
                  metrics_out: Optional[str] = None,
-                 event_timestamps: bool = False) -> ScaleoutResult:
+                 event_timestamps: bool = False,
+                 lifecycle_dir: Optional[str] = None) -> ScaleoutResult:
     """Measure N serving workers against one broker (started here unless
     passed in). Every event must come back answered exactly once.
     ``grouping="shuffle"`` runs the reference's shuffleGrouping discipline
@@ -718,7 +760,8 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
                                decision_io_ms=decision_io_ms,
                                grouping=grouping, engine=engine,
                                telemetry=metrics_out is not None,
-                               event_timestamps=event_timestamps)
+                               event_timestamps=event_timestamps,
+                               lifecycle_dir=lifecycle_dir)
         try:
             t_push: Dict[str, float] = {}
             latencies: List[float] = []
@@ -949,6 +992,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="events carry id|enqueue_ts payloads: measure "
                          "true queue wait into engine.queue_wait "
                          "(fields grouping)")
+    ap.add_argument("--lifecycle-dir", default=None, metavar="PATH",
+                    help="subscribe to the snapshot registry at PATH "
+                         "(lifecycle, ISSUE 7): workers hot-swap newly "
+                         "published learner-state snapshots at batch "
+                         "boundaries, polled on the heartbeat cadence "
+                         "(fields grouping)")
     ap.add_argument("--metrics-out", default=None, metavar="PATH",
                     help="driver mode: arm worker telemetry and write the "
                          "merged FLEET report (JSONL + .prom) here")
@@ -991,7 +1040,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 replay=args.replay,
                 decision_io_ms=args.decision_io_ms,
                 engine=args.engine,
-                event_timestamps=args.event_timestamps)
+                event_timestamps=args.event_timestamps,
+                lifecycle_dir=args.lifecycle_dir)
         print(json.dumps(stats), flush=True)
         return 0
 
@@ -1002,7 +1052,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          grouping=args.grouping,
                          engine=args.engine,
                          metrics_out=args.metrics_out,
-                         event_timestamps=args.event_timestamps)
+                         event_timestamps=args.event_timestamps,
+                         lifecycle_dir=args.lifecycle_dir)
         out = {
             "n_workers": r.n_workers,
             "grouping": args.grouping,

@@ -302,6 +302,44 @@ def _online_serving_bench() -> dict:
     return out
 
 
+def _lifecycle_bench() -> dict:
+    """ISSUE 7: the lifecycle bench — serve-while-retrain throughput and
+    hot-swap latency. Runs scripts/lifecycle_smoke.py in a CPU-pinned
+    subprocess (the serving-bench reasoning: the swap is host work, the
+    relay would dominate): a ServingEngine drains ~10k events over
+    MiniRedis while a RetrainDaemon publishes waves the engine hot-swaps
+    mid-run, with zero dropped events and stop/restore/resume parity.
+    ``--skip-gates`` on a loaded bench host records the measured swap
+    latency instead of failing; the 250ms p99 gate is enforced by the
+    tier-1 smoke hook."""
+    import subprocess
+    import sys as _sys
+    script = os.path.join(os.path.dirname(__file__), "scripts",
+                          "lifecycle_smoke.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)     # no virtual-device carryover
+    events = os.environ.get("BENCH_LIFECYCLE_EVENTS", "10000")
+    proc = subprocess.run(
+        [_sys.executable, script, "--events", events, "--skip-gates"],
+        env=env, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"lifecycle_smoke rc={proc.returncode}: {proc.stderr[-500:]}")
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    return {
+        "decisions_per_sec_during_retrain":
+            report["decisions_per_sec_during_retrain"],
+        "swaps": report["swaps"],
+        "versions_published": report["versions_published"],
+        "swap_p50_ms": report["swap_p50_ms"],
+        "swap_p99_ms": report["swap_p99_ms"],
+        "zero_dropped_events": report["zero_dropped_events"],
+        "bit_parity_vs_stop_restore_resume":
+            report["bit_parity_vs_stop_restore_resume"],
+        "events": report["events"],
+    }
+
+
 def main() -> None:
     import sys
     # telemetry (obs layer): count compiles from here on so the JSON
@@ -507,6 +545,22 @@ def main() -> None:
         except Exception as exc:
             print(f"online serving bench skipped: {exc!r}", file=sys.stderr)
             out["online_serving"] = {"error": repr(exc)}
+    # ISSUE-7 LIFECYCLE: serve-while-retrain throughput + hot-swap
+    # latency (subprocess; fallback-safe like its siblings)
+    if os.environ.get("BENCH_LIFECYCLE", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        try:
+            out["lifecycle"] = _lifecycle_bench()
+            lcb = out["lifecycle"]
+            print(f"lifecycle: "
+                  f"{lcb['decisions_per_sec_during_retrain']:.0f} "
+                  f"decisions/s while {lcb['versions_published']} retrain "
+                  f"waves published, {lcb['swaps']} hot-swaps "
+                  f"(p99 {lcb['swap_p99_ms']:.2f}ms, zero drops, "
+                  f"stop/restore/resume parity)", file=sys.stderr)
+        except Exception as exc:
+            print(f"lifecycle bench skipped: {exc!r}", file=sys.stderr)
+            out["lifecycle"] = {"error": repr(exc)}
     if legacy:
         base_elapsed = M_TEST * ITERS / legacy
         adj = M_TEST * ITERS / max(base_elapsed - 0.0993, 1e-9)

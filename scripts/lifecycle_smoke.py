@@ -1,0 +1,341 @@
+#!/usr/bin/env python
+"""Lifecycle smoke gate (ISSUE 7 CI guard).
+
+Serves ~10k events through the pipelined ``ServingEngine`` over a real
+MiniRedis broker WHILE a ``RetrainDaemon`` runs retrain waves that
+publish learner-state snapshots to a ``SnapshotRegistry``, and the
+engine hot-swaps each published version at a batch boundary mid-run.
+Asserts, exiting non-zero on any failure:
+
+1. **Zero dropped events**: every pushed event answered, the pending
+   ledger fully retired, engine event count exact.
+2. **Action-count exactness**: actions written == events x batch.size —
+   a swap can neither eat nor duplicate a batch.
+3. **Swap happened under load**: >= 1 hot-swap landed while the engine
+   was mid-drain (a dispatched batch in flight), and the engine ends on
+   the registry head version.
+4. **Swap bit-parity**: the swapped run's action bytes are IDENTICAL to
+   stop-at-the-same-boundary / restore-the-same-snapshot / resume — the
+   ISSUE 7 parity contract, checked on real broker bytes.
+5. **Swap latency SLO**: p99 of the ``lifecycle.swap`` span <= 250ms
+   (the state is a fixed-shape pytree copy; anything slower means the
+   swap path grew a blocking readback or compile).
+6. **Version-gauge visibility**: the merged fleet report
+   (``merge_reports`` over this process's hub report) carries
+   ``lifecycle.model_version`` / ``lifecycle.swap_total`` attributed
+   per source, and the ``.prom`` exposition renders them with a
+   ``source`` label.
+
+Prints ONE JSON line consumed by bench.py's ``lifecycle`` section.
+
+Usage: python scripts/lifecycle_smoke.py [--events N] [--swap-p99-ms MS]
+       [--skip-gates]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu":  # pragma: no cover - TPU-pinned hosts
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+
+ACTIONS = ["a0", "a1", "a2", "a3", "a4", "a5"]
+CONFIG = {"current.decision.round": 1, "batch.size": 2}
+LEARNER = "softMax"
+SEED = 11
+N_REWARDS = 1024
+SWAP_P99_BOUND_MS = 250.0
+N_WAVES = 3                     # retrain waves published mid-run
+
+
+def fail(msg: str) -> None:
+    print(f"lifecycle_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _fill_broker(client, n_events: int) -> None:
+    import numpy as np
+    rng = np.random.default_rng(3)
+    for i in range(n_events):
+        client.lpush("eventQueue", f"e{i:05d}")
+    for _ in range(N_REWARDS):
+        a = ACTIONS[int(rng.integers(len(ACTIONS)))]
+        client.lpush("rewardQueue", f"{a},{float(rng.integers(100))}")
+
+
+def _drain_actions(client) -> list:
+    out = []
+    while (raw := client.rpop("actionQueue")) is not None:
+        out.append(raw)
+    return out
+
+
+def _registry_with_waves(tmp, n_waves: int):
+    """Pre-compute ``n_waves`` retrain waves' snapshots so the live run's
+    swaps are deterministic inputs for the parity replay: each wave
+    refits a fresh learner from a different reward slice (the
+    'accumulated ledger grew' story)."""
+    from avenir_tpu.lifecycle.registry import SnapshotRegistry
+    from avenir_tpu.lifecycle.retrain import (
+        RetrainDaemon, bandit_refit_train_fn)
+    import numpy as np
+    rng = np.random.default_rng(17)
+    registry = SnapshotRegistry(os.path.join(tmp, "registry"),
+                                max_to_keep=8)
+    ledger = [(ACTIONS[int(rng.integers(len(ACTIONS)))],
+               float(rng.integers(100))) for _ in range(4096)]
+    daemons = []
+    for w in range(n_waves):
+        take = (w + 1) * len(ledger) // n_waves
+        daemons.append(RetrainDaemon(registry, bandit_refit_train_fn(
+            LEARNER, ACTIONS, dict(CONFIG),
+            lambda take=take: ledger[:take], seed=SEED + 100 + w)))
+    return registry, daemons
+
+
+def run_with_swaps(srv, registry, daemons, n_events: int):
+    """The live arm: the engine drains the broker while a daemon thread
+    runs the retrain waves beside it; the engine's swap source polls the
+    registry at every batch boundary. Waves are TRIGGERED off serve
+    progress (batches completed), not wall time, so at least the first
+    publish deterministically lands while the engine is mid-drain with a
+    dispatched batch in flight. Returns (stats, actions, swap trace,
+    elapsed seconds)."""
+    from avenir_tpu.stream.engine import ServingEngine
+    from avenir_tpu.stream.loop import RedisQueues
+    from avenir_tpu.stream.miniredis import MiniRedisClient
+
+    client = MiniRedisClient(srv.host, srv.port)
+    client.flushall()
+    _fill_broker(client, n_events)
+    queues = RedisQueues(client=client, pending_queue="pendingQueue")
+
+    watcher_box = {}
+    swap_trace = []               # (batch_boundary_index, version)
+    boundary = {"n": 0}
+
+    def swap_source():
+        boundary["n"] += 1
+        snap = watcher_box["watcher"].poll()
+        if snap is None:
+            return None
+        swap_trace.append((boundary["n"], snap.version))
+        return snap.version, snap.restore(like=engine.learner.state)
+
+    # wave w fires after trigger_batches[w] batches have completed —
+    # early enough that the publish lands with thousands of events still
+    # queued, spread enough that successive swaps hit different regimes
+    trigger_batches = [2, 30, 70][:len(daemons)]
+    triggers = [threading.Event() for _ in daemons]
+    progress = {"batches": 0}
+
+    def on_batch(n: int) -> None:
+        progress["batches"] += 1
+        for i, at in enumerate(trigger_batches):
+            if progress["batches"] >= at:
+                triggers[i].set()
+
+    engine = ServingEngine(LEARNER, ACTIONS, dict(CONFIG), queues,
+                           seed=SEED, swap_source=swap_source,
+                           on_batch=on_batch)
+    watcher_box["watcher"] = registry.subscribe()
+
+    def retrain_thread():
+        for trigger, daemon in zip(triggers, daemons):
+            trigger.wait(timeout=120)
+            if daemon.run_once() is None:
+                raise RuntimeError(f"wave failed: {daemon.last_error!r}")
+
+    t = threading.Thread(target=retrain_thread, daemon=True)
+    t0 = time.perf_counter()
+    t.start()
+    stats = engine.run()
+    elapsed = time.perf_counter() - t0
+    # late triggers (engine already drained) release instantly; the join
+    # just waits out the remaining publishes
+    for trigger in triggers:
+        trigger.set()
+    t.join(timeout=120)
+    if t.is_alive():
+        fail("retrain thread did not finish")
+    if stats.events != n_events:
+        fail(f"engine served {stats.events}/{n_events}")
+    if client.llen("pendingQueue") != 0:
+        fail("un-acked ledger entries left behind")
+    actions = _drain_actions(client)
+    client.close()
+    return stats, actions, swap_trace, elapsed
+
+
+def run_split_replay(srv, registry, swap_trace, n_events: int):
+    """The parity arm: REPLAY the live run as stop/restore/resume — run
+    to each recorded swap boundary, stop, install the same snapshot,
+    resume. Byte-identical action queues is the ISSUE 7 contract.
+
+    The stop is modeled through ``BoundaryStopQueues``, NOT
+    ``run(max_events=...)``: the latter's exit drain would fold rewards
+    queued at the boundary into the about-to-be-replaced state (the
+    live swap folds them into the NEW state — swap-then-fold order),
+    breaking parity whenever rewards sit queued at a swap boundary."""
+    from avenir_tpu.lifecycle.swap import BoundaryStopQueues
+    from avenir_tpu.stream.engine import ServingEngine
+    from avenir_tpu.stream.loop import RedisQueues
+    from avenir_tpu.stream.miniredis import MiniRedisClient
+
+    client = MiniRedisClient(srv.host, srv.port)
+    client.flushall()
+    _fill_broker(client, n_events)
+    queues = BoundaryStopQueues(
+        RedisQueues(client=client, pending_queue="pendingQueue"))
+    engine = ServingEngine(LEARNER, ACTIONS, dict(CONFIG), queues,
+                           seed=SEED)
+    # boundary b is polled at the top of batch iteration b (1-indexed);
+    # iteration i pops events [64*(i-1), 64*i) — so a swap at boundary b
+    # equals stopping after 64*(b-1) popped events
+    served = 0
+    for boundary_n, version in swap_trace:
+        target = min(64 * (boundary_n - 1), n_events)
+        if target > served:
+            queues.set_budget(target - served)
+            engine.run()
+            served = target
+        snap = registry.get(version)
+        engine.swap_state(snap.restore(like=engine.learner.state),
+                          version=version)
+    queues.set_budget(None)
+    engine.run()
+    stats = engine.stats               # cumulative across the run() calls
+    if stats.events != n_events:
+        fail(f"replay served {stats.events}/{n_events}")
+    if client.llen("pendingQueue") != 0:
+        fail("replay left un-acked ledger entries")
+    actions = _drain_actions(client)
+    client.close()
+    return actions
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=10000)
+    ap.add_argument("--swap-p99-ms", type=float, default=SWAP_P99_BOUND_MS)
+    ap.add_argument("--skip-gates", action="store_true",
+                    help="measure and report without failing the latency "
+                         "gate (bench mode on a loaded host)")
+    args = ap.parse_args()
+
+    from avenir_tpu.obs import exporters as E
+    from avenir_tpu.obs import telemetry as T
+    from avenir_tpu.stream.miniredis import MiniRedisServer
+
+    # telemetry armed for the WHOLE run: swap latency spans + version
+    # gauges must land in the merged report (gate 6)
+    hub = E.hub().enable()
+    hub.set_meta(worker_id=0)
+    with tempfile.TemporaryDirectory() as tmp:
+        registry, daemons = _registry_with_waves(tmp, N_WAVES)
+        # warm the install path on a SCRATCH learner: the first
+        # install_state pays the per-shape convert/copy dispatch compiles
+        # process-wide; the timed swaps must measure the swap, not jit
+        from avenir_tpu.lifecycle.swap import install_state
+        from avenir_tpu.models.bandits.learners import Learner
+        scratch = Learner(LEARNER, ACTIONS, dict(CONFIG), seed=1)
+        donor = Learner(LEARNER, ACTIONS, dict(CONFIG), seed=2)
+        install_state(scratch, donor.state)
+        with MiniRedisServer() as srv:
+            stats, live_actions, swap_trace, elapsed = run_with_swaps(
+                srv, registry, daemons, args.events)
+            replay_actions = run_split_replay(
+                srv, registry, swap_trace, args.events)
+        report = hub.report()
+        fleet = E.merge_reports([report])
+        out_path = os.path.join(tmp, "lifecycle.jsonl")
+        paths = E.write_report(fleet, out_path)
+        prom_text = open(paths["prom"]).read()
+        versions_published = registry.latest_version()
+    hub.disable()
+
+    batch_size = CONFIG["batch.size"]
+
+    # 1-2. zero drops + action-count exactness
+    if stats.events != args.events:
+        fail(f"served {stats.events}/{args.events}")
+    if stats.actions_written != args.events * batch_size:
+        fail(f"actions written {stats.actions_written} != "
+             f"{args.events * batch_size}")
+    if len(live_actions) != args.events:
+        fail(f"action queue holds {len(live_actions)}/{args.events}")
+
+    # 3. swaps landed mid-run, engine ends on the head
+    if stats.swaps < 1:
+        fail("no hot-swap landed during the serve window")
+    mid_run = [b for b, _ in swap_trace if 1 < b <= args.events // 64]
+    if not mid_run:
+        fail(f"no swap landed while batches were in flight: {swap_trace}")
+    if stats.model_version != swap_trace[-1][1]:
+        fail(f"engine version {stats.model_version} != last swapped "
+             f"{swap_trace[-1][1]}")
+
+    # 4. bit-parity vs stop/restore/resume
+    if live_actions != replay_actions:
+        for i, (a, b) in enumerate(zip(live_actions, replay_actions)):
+            if a != b:
+                fail(f"swap parity diverges at {i}: live={a!r} "
+                     f"replay={b!r} (swaps at {swap_trace})")
+        fail(f"action counts diverge: {len(live_actions)} vs "
+             f"{len(replay_actions)}")
+
+    # 5. swap latency SLO
+    swap_snap = (report.get("spans") or {}).get("lifecycle.swap")
+    if not swap_snap or swap_snap["count"] < stats.swaps:
+        fail(f"lifecycle.swap span missing/short: {swap_snap}")
+    if swap_snap["p99_ms"] > args.swap_p99_ms and not args.skip_gates:
+        fail(f"swap p99 {swap_snap['p99_ms']:.2f}ms exceeds "
+             f"{args.swap_p99_ms:.0f}ms")
+
+    # 6. version gauges attributed per source in the merged fleet report
+    for gauge in ("lifecycle.model_version", "lifecycle.swap_total"):
+        slot = fleet["gauges"].get(gauge)
+        if not isinstance(slot, dict) or "w0" not in slot:
+            fail(f"{gauge} not per-source in the fleet report: {slot}")
+        if f'avenir_{gauge.replace(".", "_")}{{source="w0"}}' not in \
+                prom_text:
+            fail(f"{gauge} missing source label in .prom exposition")
+    if int(fleet["gauges"]["lifecycle.model_version"]["w0"]) != \
+            stats.model_version:
+        fail("fleet-report version gauge != engine version")
+    if int(fleet["gauges"]["lifecycle.swap_total"]["w0"]) != stats.swaps:
+        fail("fleet-report swap_total gauge != engine swaps")
+
+    print("lifecycle_smoke OK", file=sys.stderr)
+    print(json.dumps({
+        "lifecycle_smoke": "ok",
+        "events": args.events,
+        "actions_written": stats.actions_written,
+        "decisions_per_sec_during_retrain": round(
+            args.events * batch_size / elapsed, 1),
+        "versions_published": versions_published,
+        "swaps": stats.swaps,
+        "model_version": stats.model_version,
+        "swap_p50_ms": round(swap_snap["p50_ms"], 3),
+        "swap_p99_ms": round(swap_snap["p99_ms"], 3),
+        "swap_p99_bound_ms": args.swap_p99_ms,
+        "bit_parity_vs_stop_restore_resume": True,
+        "zero_dropped_events": True,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,52 @@ class TestCheckpointer:
             ckpt.restore()
         ckpt.close()
 
+    def test_sigkill_mid_save_never_truncates_latest(self, tmp_path):
+        """Atomicity regression (ISSUE 7 satellite): a process killed
+        mid-checkpoint leaves step data on disk WITHOUT the commit
+        marker (the marker lands via temp + os.replace strictly after
+        the save completes) — so a restart's ``latest_step``/``restore``
+        must keep serving the last COMMITTED step, never the torn one.
+        Fault injection: clone the good step to a higher step number and
+        corrupt its payload, mimicking the on-disk state of a SIGKILL
+        between orbax's data writes and our commit."""
+        import os
+        import shutil
+        ckdir = str(tmp_path / "ck")
+        tree = {"w": jnp.arange(6.0).reshape(2, 3),
+                "n": jnp.asarray(7, jnp.int32)}
+        ckpt = Checkpointer(ckdir)
+        ckpt.save(1, tree)
+        ckpt.close()
+        # the torn step: full directory layout, corrupted contents, and
+        # crucially NO commit-marker update
+        shutil.copytree(os.path.join(ckdir, "1"), os.path.join(ckdir, "2"))
+        for root, _, files in os.walk(os.path.join(ckdir, "2")):
+            for name in files:
+                with open(os.path.join(root, name), "w") as fh:
+                    fh.write("torn")
+        ckpt2 = Checkpointer(ckdir)
+        assert ckpt2.latest_step() == 1      # torn step 2 is invisible
+        out = ckpt2.restore(like=tree)       # argument-less path = step 1
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(tree["w"]))
+        assert int(out["n"]) == 7
+        ckpt2.close()
+
+    def test_marker_commits_lazily_for_async_saves(self, tmp_path):
+        """Async mode: the marker lands once the in-flight save is known
+        durable (next save / restore / latest_step / close all wait
+        first), so a reader never sees a step ahead of its data."""
+        ckpt = Checkpointer(str(tmp_path / "ck"), use_async=True)
+        tree = {"x": jnp.zeros(3)}
+        for step in (1, 2, 3):
+            ckpt.save(step, tree)
+        assert ckpt.latest_step() == 3       # waits, then commits
+        ckpt.close()
+        ckpt2 = Checkpointer(str(tmp_path / "ck"), use_async=True)
+        assert ckpt2.latest_step() == 3
+        ckpt2.close()
+
 
 def _seed_queues(n_events, rewards=()):
     q = InProcQueues()

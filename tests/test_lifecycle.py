@@ -1,0 +1,855 @@
+"""Online model lifecycle (ISSUE 7): versioned snapshot registry,
+retrain daemon, zero-drop hot-swap parity, drift detectors, CLI wiring,
+and the fleet-report attribution of the lifecycle gauges."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.lifecycle.drift import (
+    DriftMonitor, PageHinkley, WindowedMeanDetector)
+from avenir_tpu.lifecycle.registry import (
+    RegistryWatcher, SnapshotRegistry, state_schema_hash)
+from avenir_tpu.lifecycle.retrain import (
+    RetrainDaemon, bandit_refit_train_fn)
+from avenir_tpu.lifecycle.swap import LifecycleClient, install_state
+from avenir_tpu.stream.engine import ServingEngine
+from avenir_tpu.stream.loop import InProcQueues, OnlineLearnerLoop
+
+ACTIONS = ["a", "b", "c"]
+CONFIG = {"batch.size": 2}
+
+
+def _prefill(n_events: int, n_rewards: int = 40) -> InProcQueues:
+    q = InProcQueues()
+    for i in range(n_events):
+        q.push_event(f"e{i:04d}")
+    for j in range(n_rewards):
+        q.push_reward(ACTIONS[j % len(ACTIONS)], 10.0 + j)
+    return q
+
+
+def _learner_state(seed: int = 5, rewards=()):
+    from avenir_tpu.models.bandits.learners import Learner
+    learner = Learner("softMax", ACTIONS, dict(CONFIG), seed=seed)
+    if rewards:
+        learner.set_reward_batch(list(rewards))
+    return learner.state
+
+
+# ==========================================================================
+# registry
+# ==========================================================================
+
+class TestSnapshotRegistry:
+    def test_publish_restore_roundtrip(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        state = _learner_state(rewards=[("a", 5.0), ("b", 7.0)])
+        snap = reg.publish(state, kind="learner-state", train_rows=2,
+                           extra={"learner_type": "softMax"})
+        assert snap.version == 1
+        assert snap.manifest["train_rows"] == 2
+        assert snap.manifest["parent_version"] is None
+        assert snap.schema_hash == state_schema_hash(state)
+        back = reg.get(1).restore(like=state)
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_monotonic_versions_and_parent_chain(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        state = _learner_state()
+        versions = [reg.publish(state).version for _ in range(3)]
+        assert versions == [1, 2, 3]
+        assert reg.latest_version() == 3
+        assert reg.get(3).manifest["parent_version"] == 2
+        assert reg.versions() == [1, 2, 3]
+
+    def test_max_to_keep_prunes_and_head_survives(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"), max_to_keep=2)
+        state = _learner_state()
+        for _ in range(5):
+            reg.publish(state)
+        assert reg.versions() == [4, 5]
+        assert reg.latest_version() == 5
+
+    def test_torn_latest_pointer_falls_back_to_scan(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        reg.publish(_learner_state())
+        reg.publish(_learner_state())
+        # simulate a crash that corrupted the pointer (truncated JSON)
+        with open(os.path.join(reg.directory, "LATEST"), "w") as fh:
+            fh.write('{"vers')
+        assert reg.latest_version() == 2
+        assert reg.latest().version == 2
+
+    def test_orphan_tmp_dir_is_invisible_and_collected(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        reg.publish(_learner_state())
+        # a publisher SIGKILLed mid-assembly leaves a temp dir behind;
+        # a real spawned-and-reaped pid makes the liveness probe say
+        # "publisher gone" deterministically
+        proc = subprocess.Popen([sys.executable, "-c", ""])
+        proc.wait()
+        orphan = os.path.join(reg.directory, f".tmp-{proc.pid}-dead")
+        os.makedirs(orphan)
+        with open(os.path.join(orphan, "payload.npz"), "w") as fh:
+            fh.write("torn")
+        assert reg.versions() == [1]           # never served as a version
+        reg.publish(_learner_state())          # next publish sweeps it
+        assert not os.path.exists(orphan)
+
+    def test_live_publishers_tmp_dir_survives_concurrent_gc(self,
+                                                            tmp_path):
+        """A CONCURRENT publisher's in-flight temp dir must not be
+        swept by another publish — deleting it would fail that
+        publisher's wave mid-assembly (silently, inside a
+        RetrainDaemon). Liveness = the embedded pid; this process IS
+        the live publisher here."""
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        in_flight = os.path.join(reg.directory,
+                                 f".tmp-{os.getpid()}-building")
+        os.makedirs(in_flight)
+        with open(os.path.join(in_flight, "payload.npz"), "w") as fh:
+            fh.write("half-written")
+        reg.publish(_learner_state())
+        assert os.path.isdir(in_flight)        # still assembling
+        # but an ANCIENT dir with a live pid is an orphan regardless
+        # (cross-host publishers age out; no publish takes an hour)
+        old = time.time() - 7200
+        os.utime(in_flight, (old, old))
+        reg.publish(_learner_state())
+        assert not os.path.exists(in_flight)
+
+    def test_partial_version_dir_without_manifest_ignored(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        reg.publish(_learner_state())
+        os.makedirs(os.path.join(reg.directory, "v0000002"))
+        assert reg.versions() == [1]
+        assert reg.latest_version() == 1
+
+    def test_file_artifact_publish(self, tmp_path):
+        src = tmp_path / "model.txt"
+        src.write_text("class,prior\nyes,0.5\n")
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        snap = reg.publish(file_path=str(src), kind="nb-model")
+        assert snap.manifest["kind"] == "nb-model"
+        with open(reg.get(snap.version).artifact_path()) as fh:
+            assert fh.read() == "class,prior\nyes,0.5\n"
+        with pytest.raises(ValueError):
+            reg.publish(_learner_state(), file_path=str(src))
+
+    def test_watcher_surfaces_each_head_once_and_skips_to_newest(
+            self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        state = _learner_state()
+        watcher = reg.subscribe()              # starts at current head
+        assert watcher.poll() is None
+        reg.publish(state)
+        assert watcher.poll().version == 1
+        assert watcher.poll() is None          # surfaced once
+        reg.publish(state)
+        reg.publish(state)                     # two publishes, one poll:
+        assert watcher.poll().version == 3     # converge on the newest
+        replay = reg.subscribe(from_version=0)
+        assert replay.poll().version == 3      # from 0: current head fires
+
+
+# ==========================================================================
+# retrain daemon
+# ==========================================================================
+
+class TestRetrainDaemon:
+    def test_run_once_publishes_with_spans_and_gauge(self, tmp_path):
+        from avenir_tpu.obs import exporters as E
+        from avenir_tpu.obs import telemetry as T
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        ledger = [("a", 80.0)] * 300 + [("b", 5.0)] * 300
+        daemon = RetrainDaemon(reg, bandit_refit_train_fn(
+            "softMax", ACTIONS, dict(CONFIG), lambda: ledger, seed=3))
+        hub = E.hub()
+        hub.reset()
+        hub.enable()
+        try:
+            snap = daemon.run_once()
+        finally:
+            hub.disable()
+        assert snap is not None and snap.version == 1
+        assert snap.manifest["train_rows"] == 600
+        assert snap.manifest["kind"] == "learner-state"
+        report = hub.report()
+        assert report["gauges"]["lifecycle.model_version"] == 1
+        assert report["spans"]["lifecycle.retrain"]["count"] == 1
+        assert report["spans"]["lifecycle.publish"]["count"] == 1
+        hub.reset()
+        T.tracer().reset()
+        # the refit folded the ledger: arm a clearly dominates
+        state = snap.restore(like=_learner_state())
+        avg = (np.asarray(state.reward_sum)
+               / np.maximum(np.asarray(state.reward_count), 1.0))
+        assert avg[0] > avg[1]
+
+    def test_request_triggered_wave_in_background(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        daemon = RetrainDaemon(reg, bandit_refit_train_fn(
+            "softMax", ACTIONS, dict(CONFIG), lambda: [("a", 1.0)]))
+        with daemon:
+            daemon.request()
+            assert daemon.wait_for_waves(1, timeout=60)
+        assert reg.latest_version() == 1
+        assert daemon.last_version == 1
+        assert daemon.errors == 0
+
+    def test_failed_wave_counts_error_and_never_raises(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+
+        def boom():
+            raise RuntimeError("train data gone")
+        daemon = RetrainDaemon(reg, boom)
+        assert daemon.run_once() is None
+        assert daemon.errors == 1
+        assert isinstance(daemon.last_error, RuntimeError)
+        assert reg.latest_version() is None
+
+
+# ==========================================================================
+# drift detection
+# ==========================================================================
+
+class TestDrift:
+    def test_page_hinkley_fires_on_shift_not_on_stationary(self):
+        rng = np.random.default_rng(0)
+        ph = PageHinkley(delta=0.05, threshold=10.0, min_samples=30)
+        stationary = [ph.update(float(v))
+                      for v in rng.normal(1.0, 0.1, 400)]
+        assert not any(stationary)
+        shifted = [ph.update(float(v)) for v in rng.normal(3.0, 0.1, 200)]
+        assert any(shifted)
+
+    def test_page_hinkley_down_direction(self):
+        ph = PageHinkley(delta=0.01, threshold=5.0, min_samples=10,
+                         direction="down")
+        for _ in range(50):
+            ph.update(10.0)
+        fired = [ph.update(1.0) for _ in range(50)]
+        assert any(fired)
+
+    def test_windowed_mean_freezes_reference_and_detects_level_shift(self):
+        wm = WindowedMeanDetector(window=32, threshold=0.5)
+        assert not any(wm.update(1.0) for _ in range(64))
+        fired = [wm.update(2.0) for _ in range(64)]
+        assert any(fired)
+        # post-drift reset: the new level is the new normal
+        assert not any(wm.update(2.0) for _ in range(96))
+
+    def test_monitor_requests_retrain_with_cooldown(self):
+        calls = []
+        mon = DriftMonitor(
+            {"reward": PageHinkley(delta=0.01, threshold=3.0,
+                                   min_samples=10)},
+            on_drift=lambda: calls.append(time.monotonic()),
+            cooldown_s=1000.0)
+        for _ in range(30):
+            mon.observe("reward", 1.0)
+        for _ in range(200):
+            mon.observe("reward", 9.0)
+        # multiple alarms possible (detector resets + refires), but the
+        # cooldown collapses them into ONE retrain request
+        assert mon.alarms >= 1
+        assert mon.alarms_by_signal["reward"] == mon.alarms
+        assert len(calls) == 1
+        assert mon.observe("unknown", 1.0) is False
+
+    def test_engine_feeds_reward_stream_into_monitor(self):
+        mon = DriftMonitor({"reward": PageHinkley(
+            delta=0.01, threshold=5.0, min_samples=10)})
+        q = _prefill(64, n_rewards=0)
+        for _ in range(100):
+            q.push_reward("a", 1.0)
+        for _ in range(100):
+            q.push_reward("a", 50.0)
+        eng = ServingEngine("softMax", ACTIONS, dict(CONFIG), q, seed=1,
+                            drift_monitor=mon)
+        eng.run()
+        assert mon.alarms >= 1
+
+
+# ==========================================================================
+# hot-swap: install safety + the stop/restore/resume parity contract
+# ==========================================================================
+
+class TestInstallState:
+    def test_install_copies_leaves(self):
+        from avenir_tpu.models.bandits.learners import Learner
+        learner = Learner("softMax", ACTIONS, dict(CONFIG), seed=0)
+        snapshot = _learner_state(seed=9, rewards=[("a", 3.0)])
+        install_state(learner, snapshot)
+        import jax
+        for installed, src in zip(
+                jax.tree_util.tree_leaves(learner.state),
+                jax.tree_util.tree_leaves(snapshot)):
+            np.testing.assert_array_equal(np.asarray(installed),
+                                          np.asarray(src))
+            # fresh buffers: a donated dispatch on the installed state
+            # must never invalidate the snapshot's own arrays
+            assert installed is not src
+
+    def test_shape_mismatch_raises_before_any_mutation(self):
+        from avenir_tpu.models.bandits.learners import Learner
+        learner = Learner("softMax", ACTIONS, dict(CONFIG), seed=0)
+        before = learner.state
+        bad = _learner_state(seed=0)
+        wrong = Learner("softMax", ACTIONS + ["d"], dict(CONFIG), seed=0)
+        with pytest.raises(ValueError, match="shape"):
+            install_state(learner, wrong.state)
+        assert learner.state is before
+
+    def test_structure_mismatch_raises(self):
+        from avenir_tpu.models.bandits.learners import Learner
+        learner = Learner("softMax", ACTIONS, dict(CONFIG), seed=0)
+        with pytest.raises(ValueError, match="structure"):
+            install_state(learner, {"not": np.zeros(3)})
+
+
+def _swap_at_poll(n: int, snapshot_fn):
+    """swap_source firing at the n-th batch-boundary poll (1-indexed)."""
+    polls = {"n": 0}
+
+    def source():
+        polls["n"] += 1
+        if polls["n"] == n:
+            return 1000 + n, snapshot_fn()
+        return None
+    return source
+
+
+class TestSwapParity:
+    """The ISSUE 7 contract, tested the way PR 5 tested engine parity:
+    a hot-swap mid-run is bit-identical to stopping at the same batch
+    boundary, restoring the same snapshot, and resuming — across
+    algorithms x seeds, on both run() and the pipelined ServingEngine,
+    including a swap landing while a dispatched batch is in flight."""
+
+    N_EVENTS = 333               # full batches + a ragged tail
+    SWAP_POLL = 3                # boundary of batch 3: events 128.. onward
+
+    @pytest.mark.parametrize("learner_type", [
+        "softMax", "upperConfidenceBoundOne", "intervalEstimator",
+        "actionPursuit"])
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_engine_swap_equals_stop_restore_resume(self, learner_type,
+                                                    seed):
+        from avenir_tpu.models.bandits.learners import Learner
+        snapshot = Learner(learner_type, ACTIONS, dict(CONFIG),
+                           seed=seed + 50)
+        snapshot.set_reward_batch([(ACTIONS[i % 3], float(i))
+                                   for i in range(16)])
+
+        q_live = _prefill(self.N_EVENTS)
+        live = ServingEngine(
+            learner_type, ACTIONS, dict(CONFIG), q_live, seed=seed,
+            swap_source=_swap_at_poll(self.SWAP_POLL,
+                                      lambda: snapshot.state))
+        live_stats = live.run()
+        assert live_stats.swaps == 1
+        assert live_stats.model_version == 1000 + self.SWAP_POLL
+
+        # the swap landed while batch 2's dispatch was in flight: at
+        # poll 3 the engine holds pending batch 2 (dispatched, not yet
+        # completed) — in-flight work must resolve against the OLD state
+        q_split = _prefill(self.N_EVENTS)
+        split = ServingEngine(learner_type, ACTIONS, dict(CONFIG),
+                              q_split, seed=seed)
+        split.run(max_events=64 * (self.SWAP_POLL - 1))
+        split.swap_state(snapshot.state, version=1000 + self.SWAP_POLL)
+        split.run()
+
+        assert list(q_live.actions) == list(q_split.actions)
+        assert live_stats.events == split.stats.events == self.N_EVENTS
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(live.learner.state),
+                        jax.tree_util.tree_leaves(split.learner.state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("learner_type", ["softMax", "actionPursuit"])
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_loop_swap_equals_stop_restore_resume(self, learner_type,
+                                                  seed):
+        snapshot_state = _learner_state(seed=seed + 50,
+                                        rewards=[("b", 9.0)] * 8)
+        q_live = _prefill(self.N_EVENTS)
+        live = OnlineLearnerLoop(
+            learner_type, ACTIONS, dict(CONFIG), q_live, seed=seed,
+            swap_source=_swap_at_poll(self.SWAP_POLL,
+                                      lambda: snapshot_state))
+        live.run()
+        assert live.stats.swaps == 1
+
+        q_split = _prefill(self.N_EVENTS)
+        split = OnlineLearnerLoop(learner_type, ACTIONS, dict(CONFIG),
+                                  q_split, seed=seed)
+        split.run(max_events=64 * (self.SWAP_POLL - 1))
+        split.swap_state(snapshot_state)
+        split.run()
+        assert list(q_live.actions) == list(q_split.actions)
+        assert live.stats.events == split.stats.events == self.N_EVENTS
+
+    def test_step_mode_swap_boundary(self):
+        """step() polls the seam per event: a swap between steps equals
+        replacing the state by hand at the same point."""
+        snapshot_state = _learner_state(seed=77, rewards=[("c", 4.0)] * 4)
+        q_live = _prefill(20)
+        live = OnlineLearnerLoop(
+            "softMax", ACTIONS, dict(CONFIG), q_live, seed=2,
+            swap_source=_swap_at_poll(6, lambda: snapshot_state))
+        while live.step():
+            pass
+        q_ref = _prefill(20)
+        ref = OnlineLearnerLoop("softMax", ACTIONS, dict(CONFIG), q_ref,
+                                seed=2)
+        for _ in range(5):
+            ref.step()
+        ref.swap_state(snapshot_state)
+        while ref.step():
+            pass
+        assert list(q_live.actions) == list(q_ref.actions)
+
+    def test_boundary_pending_rewards_fold_into_new_state(self):
+        """Rewards QUEUED at the swap boundary fold into the NEW state
+        (live order: swap, then fold). The replay arm must model the
+        stop with ``BoundaryStopQueues`` — ``run(max_events)``'s exit
+        drain would fold that backlog into the discarded old state,
+        losing the rewards and false-failing byte parity (the
+        lifecycle_smoke replay-arm regression)."""
+        import jax
+        from avenir_tpu.lifecycle.swap import BoundaryStopQueues
+        from avenir_tpu.models.bandits.learners import Learner
+        learner_type, seed = "softMax", 3
+        snapshot = Learner(learner_type, ACTIONS, dict(CONFIG), seed=53)
+        snapshot.set_reward_batch([(ACTIONS[i % 3], 1.0 + i)
+                                   for i in range(12)])
+        boundary = 64 * (self.SWAP_POLL - 1)
+
+        def boundary_rewards(q):
+            # on_batch(1) fires inside iteration 2's completion, AFTER
+            # iteration 2's fold — so these sit queued at boundary 3,
+            # the exact window where live folds into the NEW state
+            fired = {"done": False}
+
+            def on_batch(n):
+                if not fired["done"]:
+                    fired["done"] = True
+                    for i in range(8):
+                        q.push_reward(ACTIONS[i % 3], 5.0 + i)
+            return on_batch
+
+        q_live = _prefill(self.N_EVENTS)
+        live = ServingEngine(
+            learner_type, ACTIONS, dict(CONFIG), q_live, seed=seed,
+            on_batch=boundary_rewards(q_live),
+            swap_source=_swap_at_poll(self.SWAP_POLL,
+                                      lambda: snapshot.state))
+        live.run()
+        assert live.stats.swaps == 1
+
+        q_split = _prefill(self.N_EVENTS)
+        gated = BoundaryStopQueues(q_split)
+        split = ServingEngine(learner_type, ACTIONS, dict(CONFIG), gated,
+                              seed=seed, on_batch=boundary_rewards(q_split))
+        gated.set_budget(boundary)
+        split.run()
+        split.swap_state(snapshot.state)
+        gated.set_budget(None)
+        split.run()
+        assert list(q_live.actions) == list(q_split.actions)
+        assert live.stats.events == split.stats.events == self.N_EVENTS
+        assert live.stats.rewards == split.stats.rewards
+        for a, b in zip(jax.tree_util.tree_leaves(live.learner.state),
+                        jax.tree_util.tree_leaves(split.learner.state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # the naive max_events replay consumes the boundary rewards into
+        # the discarded state — its final learner never saw them
+        q_naive = _prefill(self.N_EVENTS)
+        naive = ServingEngine(learner_type, ACTIONS, dict(CONFIG),
+                              q_naive, seed=seed,
+                              on_batch=boundary_rewards(q_naive))
+        naive.run(max_events=boundary)
+        naive.swap_state(snapshot.state)
+        naive.run()
+        assert any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree_util.tree_leaves(live.learner.state),
+                            jax.tree_util.tree_leaves(naive.learner.state)))
+
+    def test_swap_records_span_and_fleet_attributable_gauges(self):
+        """The observability satellite: lifecycle.model_version /
+        lifecycle.swap_total land as hub gauges, and merge_reports
+        attributes them per source with a ``source`` label in the
+        Prometheus exposition."""
+        from avenir_tpu.obs import exporters as E
+        from avenir_tpu.obs import telemetry as T
+        hub = E.hub()
+        hub.reset()
+        hub.enable()
+        hub.set_meta(worker_id=3)
+        try:
+            q = _prefill(130)
+            eng = ServingEngine(
+                "softMax", ACTIONS, dict(CONFIG), q, seed=1,
+                swap_source=_swap_at_poll(2, lambda: _learner_state()))
+            eng.run()
+            report = hub.report()
+        finally:
+            hub.disable()
+            hub.reset()
+            T.tracer().reset()
+        assert report["spans"]["lifecycle.swap"]["count"] == 1
+        assert report["gauges"]["lifecycle.model_version"] == 1002
+        assert report["gauges"]["lifecycle.swap_total"] == 1
+        other = {"meta": {"worker_id": 4},
+                 "gauges": {"lifecycle.model_version": 7,
+                            "lifecycle.swap_total": 2}}
+        fleet = E.merge_reports([report, other])
+        assert fleet["gauges"]["lifecycle.model_version"] == {
+            "w3": 1002, "w4": 7}
+        assert fleet["gauges"]["lifecycle.swap_total"] == {"w3": 1, "w4": 2}
+        prom = E.prometheus_text(fleet)
+        assert 'avenir_lifecycle_model_version{source="w3"} 1002' in prom
+        assert 'avenir_lifecycle_model_version{source="w4"} 7' in prom
+        assert 'avenir_lifecycle_swap_total{source="w3"} 1' in prom
+
+
+# ==========================================================================
+# LifecycleClient: the scale-out worker's subscription
+# ==========================================================================
+
+class TestLifecycleClient:
+    def test_poll_swaps_registered_targets(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        reg.publish(_learner_state(seed=9, rewards=[("a", 6.0)]),
+                    kind="learner-state")
+        loop = OnlineLearnerLoop("softMax", ACTIONS, dict(CONFIG),
+                                 _prefill(4), seed=1)
+        eng = ServingEngine("softMax", ACTIONS, dict(CONFIG),
+                            _prefill(4), seed=2)
+        lc = LifecycleClient(reg, from_version=0)
+        lc.register("g0", loop)
+        lc.register("g1", eng)
+        assert lc.poll_and_swap() == 1
+        assert loop.stats.swaps == 1 and eng.stats.swaps == 1
+        assert loop.stats.model_version == eng.stats.model_version == 1
+        assert lc.poll_and_swap() is None      # head unchanged
+        assert lc.swaps == 1
+
+    def test_group_targeted_snapshot_swaps_only_that_group(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        reg.publish(_learner_state(), kind="learner-state",
+                    extra={"group": "g1"})
+        loops = {g: OnlineLearnerLoop("softMax", ACTIONS, dict(CONFIG),
+                                      _prefill(2), seed=i)
+                 for i, g in enumerate(["g0", "g1"])}
+        lc = LifecycleClient(reg, from_version=0)
+        for g, loop in loops.items():
+            lc.register(g, loop)
+        assert lc.poll_and_swap() == 1
+        assert loops["g0"].stats.swaps == 0
+        assert loops["g1"].stats.swaps == 1
+
+    def test_schema_mismatch_rejected_not_crashed(self, tmp_path):
+        from avenir_tpu.models.bandits.learners import Learner
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        wrong = Learner("softMax", ACTIONS + ["d"], dict(CONFIG), seed=0)
+        reg.publish(wrong.state, kind="learner-state")
+        loop = OnlineLearnerLoop("softMax", ACTIONS, dict(CONFIG),
+                                 _prefill(2), seed=1)
+        lc = LifecycleClient(reg, from_version=0)
+        lc.register("g0", loop)
+        assert lc.poll_and_swap() is None
+        assert lc.rejected == 1
+        assert loop.stats.swaps == 0
+        loop.run()                             # serving continues fine
+        assert loop.stats.events == 2
+
+    def test_file_artifact_snapshot_rejected_not_crashed(self, tmp_path):
+        """A batch-model FILE artifact published into a registry workers
+        subscribe to alarms (swap_rejected) instead of crashing the
+        fleet on a missing payload.npz."""
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        model = tmp_path / "model.txt"
+        model.write_text("markov,model,bytes\n")
+        reg.publish(file_path=str(model), kind="markov-model")
+        loop = OnlineLearnerLoop("softMax", ACTIONS, dict(CONFIG),
+                                 _prefill(2), seed=1)
+        lc = LifecycleClient(reg, from_version=0)
+        lc.register("g0", loop)
+        assert lc.poll_and_swap() is None
+        assert lc.rejected == 1
+        assert loop.stats.swaps == 0
+        loop.run()                             # serving continues fine
+        assert loop.stats.events == 2
+
+    def test_min_poll_interval_throttles(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        lc = LifecycleClient(reg, from_version=0,
+                             min_poll_interval_s=3600.0)
+        loop = OnlineLearnerLoop("softMax", ACTIONS, dict(CONFIG),
+                                 _prefill(2), seed=1)
+        lc.register("g0", loop)
+        lc.poll_and_swap()                     # consumes the interval
+        reg.publish(_learner_state(), kind="learner-state")
+        assert lc.poll_and_swap() is None      # throttled, not swapped
+        assert loop.stats.swaps == 0
+
+
+class TestScaleoutLifecycle:
+    def test_workers_subscribe_and_fleet_report_attributes_versions(
+            self, tmp_path):
+        """End-to-end over real worker subprocesses: a registry head
+        published before the run is swapped in by every worker (the
+        ``from_version=0`` join semantics), and the merged fleet report
+        attributes ``lifecycle.model_version`` / ``lifecycle.swap_total``
+        per worker — the ISSUE 7 observability satellite on the wire."""
+        from avenir_tpu.models.bandits.learners import Learner
+        from avenir_tpu.stream.scaleout import run_scaleout
+        reg_dir = str(tmp_path / "reg")
+        seed_learner = Learner("softMax", [f"a{i}" for i in range(3)],
+                               {"current.decision.round": 1,
+                                "batch.size": 8}, seed=123)
+        SnapshotRegistry(reg_dir).publish(seed_learner.state,
+                                          kind="learner-state")
+        out = str(tmp_path / "fleet.jsonl")
+        r = run_scaleout(2, n_groups=4, n_actions=3,
+                         throughput_events=120, paced_events=30,
+                         paced_rate=400.0, seed=11, metrics_out=out,
+                         lifecycle_dir=reg_dir)
+        total = sum(w["events"] for w in r.worker_stats)
+        assert total == 4 * 4 + 120 + 30       # zero drops with swaps on
+        assert r.fleet_report is not None
+        versions = r.fleet_report["gauges"].get("lifecycle.model_version")
+        swaps = r.fleet_report["gauges"].get("lifecycle.swap_total")
+        assert versions == {"w0": 1.0, "w1": 1.0}
+        assert set(swaps) == {"w0", "w1"}
+        assert all(v >= 1 for v in swaps.values())
+
+
+# ==========================================================================
+# CLI wiring
+# ==========================================================================
+
+def _write_props(path, **kw):
+    with open(path, "w") as fh:
+        for key, value in kw.items():
+            fh.write(f"{key}={value}\n")
+
+
+class TestCliLifecycle:
+    def _events_rewards(self, tmp_path, n_events=96):
+        with open(tmp_path / "events.txt", "w") as fh:
+            for i in range(n_events):
+                fh.write(f"E{i:04d}\n")
+        with open(tmp_path / "rewards.txt", "w") as fh:
+            for j in range(30):
+                fh.write(f"{ACTIONS[j % 3]},{float(j)}\n")
+
+    def test_engine_with_checkpoint_dir_steers_to_lifecycle_dir(
+            self, tmp_path):
+        from avenir_tpu.cli.main import main as cli
+        self._events_rewards(tmp_path)
+        props = tmp_path / "p.properties"
+        _write_props(props, **{"learner.type": "softMax",
+                               "action.list": "a,b,c",
+                               "serving.engine": "true",
+                               "checkpoint.dir": str(tmp_path / "ck")})
+        with pytest.raises(ValueError, match="lifecycle.dir"):
+            cli(["ReinforcementLearnerTopology",
+                 str(tmp_path / "events.txt"),
+                 str(tmp_path / "actions.txt"), "--conf", str(props)])
+
+    def test_lifecycle_dir_without_engine_refused(self, tmp_path):
+        from avenir_tpu.cli.main import main as cli
+        self._events_rewards(tmp_path)
+        props = tmp_path / "p.properties"
+        _write_props(props, **{"learner.type": "softMax",
+                               "action.list": "a,b,c",
+                               "lifecycle.dir": str(tmp_path / "reg")})
+        with pytest.raises(ValueError, match="serving.engine"):
+            cli(["ReinforcementLearnerTopology",
+                 str(tmp_path / "events.txt"),
+                 str(tmp_path / "actions.txt"), "--conf", str(props)])
+
+    def test_engine_restores_and_publishes_through_registry(
+            self, tmp_path, capsys):
+        """Two engine runs against one registry: run 1 publishes v1,
+        run 2 restores it (continuing the learner's life across
+        processes — the durability checkpoint.dir used to provide, now
+        through the same registry a RetrainDaemon feeds) and publishes
+        v2 with the v1 lineage."""
+        from avenir_tpu.cli.main import main as cli
+        self._events_rewards(tmp_path)
+        props = tmp_path / "p.properties"
+        _write_props(props, **{
+            "learner.type": "softMax", "action.list": "a,b,c",
+            "reward.data.path": str(tmp_path / "rewards.txt"),
+            "serving.engine": "true",
+            "lifecycle.dir": str(tmp_path / "reg"),
+            "lifecycle.max.keep": "4"})
+        cli(["ReinforcementLearnerTopology", str(tmp_path / "events.txt"),
+             str(tmp_path / "a1.txt"), "--conf", str(props)])
+        out1 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out1["lifecycle_version"] == 1
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        v1 = reg.get(1)
+        assert v1.manifest["kind"] == "learner-state"
+        assert v1.manifest["extra"]["events"] == 96
+
+        cli(["ReinforcementLearnerTopology", str(tmp_path / "events.txt"),
+             str(tmp_path / "a2.txt"), "--conf", str(props)])
+        out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out2["lifecycle_version"] == 2
+        v2 = reg.get(2)
+        assert v2.manifest["parent_version"] == 1
+        # run 2 restored v1: its learner carried run 1's trial history
+        # (96 events x default batch.size 1 per run), so v2's state
+        # covers both runs' selections — the cross-process continuity
+        # checkpoint.dir used to provide
+        v1_state = v1.restore(like=_learner_state())
+        assert int(np.asarray(v1_state.total_trials)) == 96
+        state = v2.restore(like=_learner_state())
+        assert int(np.asarray(state.total_trials)) == 2 * 96
+
+    def test_engine_refuses_mismatched_registry_head(self, tmp_path):
+        from avenir_tpu.cli.main import main as cli
+        from avenir_tpu.models.bandits.learners import Learner
+        self._events_rewards(tmp_path)
+        wrong = Learner("softMax", ACTIONS + ["d"], dict(CONFIG), seed=0)
+        SnapshotRegistry(str(tmp_path / "reg")).publish(
+            wrong.state, kind="learner-state")
+        props = tmp_path / "p.properties"
+        _write_props(props, **{
+            "learner.type": "softMax", "action.list": "a,b,c",
+            "serving.engine": "true",
+            "lifecycle.dir": str(tmp_path / "reg")})
+        with pytest.raises(ValueError, match="different learner shape"):
+            cli(["ReinforcementLearnerTopology",
+                 str(tmp_path / "events.txt"),
+                 str(tmp_path / "actions.txt"), "--conf", str(props)])
+
+    def test_engine_refuses_file_artifact_registry_head(self, tmp_path):
+        """A registry whose head is a batch-model FILE artifact (the
+        Lifecycle publish verb) cannot anchor an engine run: the clear
+        refusal, not a FileNotFoundError from a missing payload.npz."""
+        from avenir_tpu.cli.main import main as cli
+        model = tmp_path / "model.txt"
+        model.write_text("markov,model,bytes\n")
+        SnapshotRegistry(str(tmp_path / "reg")).publish(
+            file_path=str(model), kind="markov-model")
+        self._events_rewards(tmp_path)
+        props = tmp_path / "p.properties"
+        _write_props(props, **{
+            "learner.type": "softMax", "action.list": "a,b,c",
+            "serving.engine": "true",
+            "lifecycle.dir": str(tmp_path / "reg")})
+        with pytest.raises(ValueError, match="file artifact"):
+            cli(["ReinforcementLearnerTopology",
+                 str(tmp_path / "events.txt"),
+                 str(tmp_path / "actions.txt"), "--conf", str(props)])
+
+    def test_lifecycle_verb_publish_list_show_prune(self, tmp_path,
+                                                    capsys):
+        from avenir_tpu.cli.main import main as cli
+        model = tmp_path / "model.txt"
+        model.write_text("markov,model,bytes\n")
+        props = tmp_path / "l.properties"
+        _write_props(props, **{"lifecycle.dir": str(tmp_path / "reg"),
+                               "lifecycle.command": "publish",
+                               "lifecycle.kind": "markov-model"})
+        for _ in range(3):
+            cli(["Lifecycle", str(model), str(tmp_path / "out.txt"),
+                 "--conf", str(props)])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["lifecycle.published"] == 3
+
+        cli(["Lifecycle", str(model), str(tmp_path / "list.jsonl"),
+             "--conf", str(props), "-D", "lifecycle.command=list"])
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "list.jsonl").read().splitlines()]
+        assert [l["version"] for l in lines] == [1, 2, 3]
+        assert all(l["kind"] == "markov-model" for l in lines)
+
+        cli(["Lifecycle", str(model), str(tmp_path / "head.json"),
+             "--conf", str(props), "-D", "lifecycle.command=show"])
+        head = json.loads(open(tmp_path / "head.json").read())
+        assert head["version"] == 3
+
+        cli(["Lifecycle", str(model), str(tmp_path / "out.txt"),
+             "--conf", str(props), "-D", "lifecycle.command=prune",
+             "-D", "lifecycle.max.keep=1"])
+        assert SnapshotRegistry(str(tmp_path / "reg")).versions() == [3]
+
+    def test_lifecycle_verb_retrain_wave(self, tmp_path, capsys):
+        from avenir_tpu.cli.main import main as cli
+        with open(tmp_path / "ledger.txt", "w") as fh:
+            for j in range(64):
+                fh.write(f"{ACTIONS[j % 3]},{float(j % 10)}\n")
+        props = tmp_path / "r.properties"
+        _write_props(props, **{"lifecycle.dir": str(tmp_path / "reg"),
+                               "lifecycle.command": "retrain",
+                               "learner.type": "softMax",
+                               "action.list": "a,b,c",
+                               "batch.size": "2"})
+        cli(["Lifecycle", str(tmp_path / "ledger.txt"),
+             str(tmp_path / "manifest.json"), "--conf", str(props)])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["lifecycle.published"] == 1
+        assert out["lifecycle.train_rows"] == 64
+        manifest = json.loads(open(tmp_path / "manifest.json").read())
+        assert manifest["extra"]["learner_type"] == "softMax"
+        # the published state restores into a serving engine
+        reg = SnapshotRegistry(str(tmp_path / "reg"))
+        eng = ServingEngine("softMax", ACTIONS, dict(CONFIG),
+                            _prefill(8), seed=0)
+        eng.swap_state(reg.latest().restore(like=eng.learner.state),
+                       version=reg.latest_version())
+        eng.run()
+        assert eng.stats.events == 8
+
+
+# ==========================================================================
+# the tier-1 smoke hook (the fleet_smoke pattern)
+# ==========================================================================
+
+def test_lifecycle_smoke_script():
+    """CI hook (ISSUE 7): serve ~10k events over MiniRedis while retrain
+    waves publish and hot-swap mid-run — zero dropped events, action
+    count exact, swap p99 <= 250ms, stop/restore/resume bit-parity, and
+    the version gauge visible per-source in the merged fleet report. One
+    retry absorbs a transient co-tenant load spike (the serving_smoke
+    discipline); the gates themselves are unchanged."""
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "lifecycle_smoke.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    last = None
+    for attempt in range(2):
+        proc = subprocess.run([sys.executable, script], env=env,
+                              capture_output=True, text=True, timeout=560)
+        last = proc
+        if proc.returncode == 0:
+            break
+        time.sleep(2)
+    assert last.returncode == 0, (
+        f"lifecycle_smoke failed twice:\nstdout: {last.stdout[-800:]}\n"
+        f"stderr: {last.stderr[-800:]}")
+    report = json.loads(last.stdout.strip().splitlines()[-1])
+    assert report["zero_dropped_events"] is True
+    assert report["bit_parity_vs_stop_restore_resume"] is True
+    assert report["swaps"] >= 1
+    assert report["swap_p99_ms"] <= report["swap_p99_bound_ms"]
+    assert report["actions_written"] == report["events"] * 2
