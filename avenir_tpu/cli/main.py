@@ -1307,7 +1307,13 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
     pre-filled queues (the bit-parity contract), overlap + bulk-transport
     throughput for live queue deployments. Engine knobs:
     ``engine.min.batch`` / ``engine.max.batch`` (adaptive micro-batch
-    bounds) and ``engine.reward.drain.max`` (bounded reward sweep).
+    bounds), ``engine.reward.drain.max`` (bounded reward sweep), and
+    ``engine.admission.high`` / ``engine.admission.low`` /
+    ``engine.shed.policy`` (``reject-new`` | ``drop-oldest``) /
+    ``engine.shed.chunk`` — the ISSUE 8 bounded-depth admission gate:
+    past the high-water mark the engine retires excess events un-served
+    with exact accounting (``shed_total`` in the job JSON; admitted +
+    shed == produced) and recovers automatically below the low mark.
     CAVEAT: bit-parity with the loop holds at the DEFAULT
     ``engine.max.batch`` (the loop's own 64-event cap); a smaller cap
     changes the select chunking, and with it the realization stream of
@@ -1359,15 +1365,28 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
 
     extra = ""
     if use_engine:
-        from avenir_tpu.stream.engine import ServingEngine
+        from avenir_tpu.stream.engine import AdmissionControl, ServingEngine
         fill()
+        # admission control (ISSUE 8): engine.admission.high arms the
+        # bounded-depth gate — past it the engine sheds per
+        # engine.shed.policy with exact accounting, recovering below
+        # engine.admission.low (default high/4)
+        admission = None
+        high_water = conf.get_int("engine.admission.high", 0)
+        if high_water:
+            admission = AdmissionControl(
+                high_water=high_water,
+                low_water=conf.get_int("engine.admission.low", 0) or None,
+                policy=conf.get("engine.shed.policy", "reject-new"),
+                shed_chunk=conf.get_int("engine.shed.chunk", 256))
         engine = ServingEngine(
             learner_type, actions, conf.as_dict(), queues,
             seed=conf.get_int("random.seed", 0),
             min_batch=conf.get_int("engine.min.batch", 8),
             max_batch=conf.get_int("engine.max.batch", 0) or None,
             drain_max=conf.get_int("engine.reward.drain.max", 0) or None,
-            event_timestamps=event_ts)
+            event_timestamps=event_ts,
+            admission=admission)
         registry = None
         if lifecycle_dir:
             from avenir_tpu.lifecycle.registry import (
@@ -1407,6 +1426,8 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
         extra += (f', "overlap_fraction": '
                   f'{round(stats.overlap_fraction, 3)}'
                   f', "batches": {stats.batches}')
+        if admission is not None:
+            extra += f', "shed_total": {stats.shed_total}'
     else:
         with OnlineLearnerLoop(
                 learner_type, actions, conf.as_dict(), queues,

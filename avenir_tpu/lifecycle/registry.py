@@ -190,6 +190,26 @@ class SnapshotRegistry:
         v = self.latest_version()
         return self.get(v) if v is not None else None
 
+    def latest_where(self, kind: Optional[str] = None,
+                     **extra_match) -> Optional[Snapshot]:
+        """Newest committed snapshot whose manifest matches ``kind`` and
+        every ``extra_match`` key inside ``extra`` — the restore-on-
+        acquire lookup the ownership rebalancer uses (e.g.
+        ``latest_where(kind="learner-handoff", group="g3")``). Scans
+        newest-first, so the common hit (a handoff published moments
+        ago) reads one or two manifests, not the whole registry."""
+        for version in reversed(self._scan_versions()):
+            try:
+                snap = self.get(version)
+            except (OSError, json.JSONDecodeError):
+                continue            # pruned/raced away mid-scan
+            if kind is not None and snap.manifest.get("kind") != kind:
+                continue
+            extra = snap.manifest.get("extra") or {}
+            if all(extra.get(k) == v for k, v in extra_match.items()):
+                return snap
+        return None
+
     def subscribe(self,
                   from_version: Optional[int] = None) -> "RegistryWatcher":
         """A poll-based watcher: ``poll()`` returns each NEW head exactly

@@ -81,6 +81,11 @@ class EngineStats:
     # lifecycle (ISSUE 7): hot-swaps installed + the version serving now
     swaps: int = 0
     model_version: Optional[int] = None
+    # admission control (ISSUE 8): events popped-and-retired WITHOUT being
+    # served while the engine was past its high-water mark. The exact-
+    # accounting contract: admitted (``events``) + ``shed_total`` equals
+    # every event the engine popped — nothing vanishes uncounted.
+    shed_total: int = 0
     select_wait_ms: float = 0.0   # host blocked on device readback
     io_ms: float = 0.0            # broker/queue I/O time
     dispatch_ms: float = 0.0      # host time enqueueing device work
@@ -88,14 +93,19 @@ class EngineStats:
     reward_backlog: int = 0       # unread rewards after the last drain
     batch_cap: int = 0            # adaptive cap when run() returned
     # per-batch adaptive-cap trace, BOUNDED (always-on workers keep one
-    # engine alive for the process lifetime): oldest half drops past cap
+    # engine alive for the process lifetime): oldest half drops past cap,
+    # counted in ``history_dropped`` so the loss is visible in the fleet
+    # report instead of silent (ISSUE 8 satellite)
     cap_history: List[int] = field(default_factory=list)
+    history_dropped: int = 0
     _CAP_HISTORY_MAX = 1024
 
     def note_cap(self, cap: int) -> None:
         self.cap_history.append(cap)
         if len(self.cap_history) > self._CAP_HISTORY_MAX:
-            del self.cap_history[:self._CAP_HISTORY_MAX // 2]
+            drop = self._CAP_HISTORY_MAX // 2
+            del self.cap_history[:drop]
+            self.history_dropped += drop
 
     @property
     def overlap_fraction(self) -> float:
@@ -174,10 +184,117 @@ def _publish_engine_gauges(stats: "EngineStats",
     gauges = {
         "engine.overlap_fraction": stats.overlap_fraction,
         "engine.reward_backlog": stats.reward_backlog,
+        # exact-accounting visibility (ISSUE 8): shed work and bounded-
+        # history drops surface in the fleet report, never silently
+        "engine.shed_total": stats.shed_total,
+        "engine.history_dropped": stats.history_dropped,
     }
     if extra:
         gauges.update(extra)
     set_hub_gauges_if_live(gauges)
+
+
+def warm_serving_paths(learner: Learner, rewards: bool = True) -> None:
+    """Pre-compile every jitted program a live serving run can reach on
+    ``learner`` — compile caches are PER-INSTANCE (each Learner owns its
+    jitted closures), so this must run on the learner that will serve,
+    not a scratch twin. Mirrors the chunking facts in learners.py:
+    fused select/reward chunks jit per exact power-of-two size; any
+    non-pow2 remainder runs the masked-scan path, jit per bucket shape;
+    a ``64 + k`` decomposition reaches masked bucket ``bucket(k)``. A
+    compile landing inside a live batch stretches that batch's decision
+    latency by ~0.5s on a loaded host — an SLO miss that has nothing to
+    do with serving. MUTATES learner state (selects advance the PRNG;
+    rewards update counts): callers snapshot and restore state around
+    it, or warm before real traffic exists."""
+    cap = max(Learner._SCAN_BUCKET_MAX * learner.cfg.batch_size, 1)
+    r = 1
+    while r <= min(cap, learner._FUSED_CHUNK_MAX):
+        learner.resolve_action_batch(learner.next_action_batch_async(r))
+        r *= 2
+    # 64+k hits the masked path: take=64, then take=k -> bucket(k)
+    for extra in (1, 2, 3, 5, 9, 17, 33):
+        learner.resolve_action_batch(
+            learner.next_action_batch_async(
+                Learner._SCAN_BUCKET_MAX + extra))
+    if not rewards:
+        return
+    action = learner.actions[0]
+    r = 1
+    while r <= learner._FUSED_CHUNK_MAX:
+        learner.set_reward_batch([(action, 0.0)] * r)
+        r *= 2
+    for extra in (1, 2, 3, 5, 9, 17, 33):
+        learner.set_reward_batch(
+            [(action, 0.0)] * (Learner._SCAN_BUCKET_MAX + extra))
+
+
+class AdmissionControl:
+    """Bounded-depth gate for the serving engine (ISSUE 8): graceful
+    degradation instead of an unbounded ``engine.queue_depth``.
+
+    Hysteresis latch: shedding starts when the event-queue depth exceeds
+    ``high_water`` and stops once it falls to ``low_water`` (default
+    ``high_water // 4``) — the engine recovers to shed-free operation
+    automatically when load drops. While shedding, each engine iteration
+    retires up to ``shed_chunk`` events un-served before its serve batch
+    — one bulk ``shed_events`` broker command on adapters that have it,
+    else an over-popped sweep whose excess is acked through the ledger
+    (:meth:`split`). Either way the accounting is exact:
+    ``EngineStats.shed_total`` counts every retired event, so
+    admitted + shed equals everything popped — nothing is silently
+    dropped.
+
+    ``policy`` picks who is shed:
+
+    - ``"reject-new"``: shed the NEWEST arrivals, serve the oldest in
+      arrival order — the classic bounded-queue admission gate.
+    - ``"drop-oldest"``: shed the OLDEST — bounds decision STALENESS
+      under backlog (a stale decision for a live event beats a fresh
+      decision for an expired one).
+    """
+
+    POLICIES = ("reject-new", "drop-oldest")
+
+    def __init__(self, high_water: int, low_water: Optional[int] = None,
+                 policy: str = "reject-new", shed_chunk: int = 256):
+        if policy not in self.POLICIES:
+            raise ValueError(f"shed policy {policy!r} not in "
+                             f"{self.POLICIES}")
+        self.high_water = int(high_water)
+        self.low_water = (max(self.high_water // 4, 1)
+                          if low_water is None else int(low_water))
+        if not 0 < self.low_water <= self.high_water:
+            raise ValueError(
+                f"need 0 < low_water ({self.low_water}) <= high_water "
+                f"({self.high_water})")
+        self.policy = policy
+        self.shed_chunk = max(int(shed_chunk), 1)
+        self.shedding = False
+
+    def update(self, depth: Optional[int]) -> bool:
+        """Advance the latch with the current queue depth; returns
+        whether the engine should shed this iteration. An unknown depth
+        (adapter without ``depth()``) never sheds."""
+        if depth is None:
+            self.shedding = False
+        elif self.shedding:
+            if depth <= self.low_water:
+                self.shedding = False
+        elif depth > self.high_water:
+            self.shedding = True
+        return self.shedding
+
+    def split(self, popped: List[str], admit_n: int
+              ) -> Tuple[List[str], List[str]]:
+        """(admitted, shed) out of an over-full sweep, per policy."""
+        admit_n = max(admit_n, 0)
+        if len(popped) <= admit_n:
+            return popped, []
+        if self.policy == "drop-oldest":
+            return popped[len(popped) - admit_n:], \
+                popped[:len(popped) - admit_n]
+        return popped[:admit_n], popped[admit_n:]
 
 
 class _AdaptiveCap:
@@ -221,7 +338,8 @@ class ServingEngine:
                  on_batch: Optional[Callable[[int], None]] = None,
                  event_timestamps: bool = False,
                  swap_source: Optional[Callable[[], Optional[Tuple]]] = None,
-                 drift_monitor=None):
+                 drift_monitor=None,
+                 admission: Optional[AdmissionControl] = None):
         self.learner = (learner if learner is not None
                         else Learner(learner_type, actions, config, seed))
         self.queues = queues
@@ -231,6 +349,10 @@ class ServingEngine:
         self._drain_max = drain_max
         self._on_batch = on_batch
         self._tel = telemetry.tracer()
+        # admission control (ISSUE 8): None (default) keeps the engine
+        # bit-identical to its pre-admission behavior — no depth polls,
+        # no shedding, no extra broker traffic
+        self._admission = admission
         # lifecycle seam (ISSUE 7): polled once per batch boundary;
         # returns (version, state_pytree) to hot-swap, None to keep going
         self._swap_source = swap_source
@@ -336,10 +458,46 @@ class ServingEngine:
         if self._on_batch is not None:
             self._on_batch(len(events))
 
+    def _note_shed(self, n: int, elapsed_s: float) -> None:
+        # no io_ms here: both shed paths run inside the iteration's
+        # t0..t1 window, which run() already folds into io_ms — adding
+        # it again would double-count exactly when the overload gauges
+        # matter most
+        self.stats.shed_total += n
+        if self._tel.enabled:
+            self._tel.record("engine.shed", elapsed_s * 1e3, n)
+
+    def _shed_direct(self) -> None:
+        """Preferred shed path: one bulk pop off the adapter
+        (``shed_events`` — RPOP/LPOP count on the Redis adapter),
+        bypassing the ledger entirely. Shed work is discarded by design,
+        so it needs no crash replay — and must not cost one
+        RPOPLPUSH + LREM round trip per discarded event."""
+        t0 = time.perf_counter()
+        shed = self.queues.shed_events(
+            self._admission.shed_chunk,
+            newest=self._admission.policy == "reject-new")
+        if shed:
+            self._note_shed(len(shed), time.perf_counter() - t0)
+
+    def _shed(self, popped: List[str], admit_n: int) -> List[str]:
+        """Fallback shed for adapters without ``shed_events``: the sweep
+        over-popped through the ledger, so every shed event is retired
+        by an ack (raw payload) exactly as an answered one would be.
+        Returns the admitted payloads in their original relative
+        order."""
+        admitted, shed = self._admission.split(popped, admit_n)
+        if shed:
+            t0 = time.perf_counter()
+            _ack_events(self.queues, shed)
+            self._note_shed(len(shed), time.perf_counter() - t0)
+        return admitted
+
     def _publish_gauges(self) -> None:
-        _publish_engine_gauges(
-            self.stats,
-            extra={"engine.queue_depth": self.stats.queue_depth})
+        extra = {"engine.queue_depth": self.stats.queue_depth}
+        if self._admission is not None:
+            extra["engine.shedding"] = float(self._admission.shedding)
+        _publish_engine_gauges(self.stats, extra=extra)
 
     # -- the loop ------------------------------------------------------------
 
@@ -360,7 +518,30 @@ class ServingEngine:
             cap = self._cap.cap
             if max_events is not None:
                 cap = min(cap, max_events - processed)
-            events = _pop_events(self.queues, cap)
+            pop_n = cap
+            if self._admission is not None:
+                # one depth poll per iteration drives the hysteresis
+                # latch; while shedding, excess work is retired un-served
+                # BEFORE the serve batch pops (one bulk command), or by
+                # over-popping + ack on adapters without shed_events
+                depth = (self.queues.depth()
+                         if hasattr(self.queues, "depth") else None)
+                if depth is not None:
+                    self.stats.queue_depth = depth
+                if self._admission.update(depth):
+                    if hasattr(self.queues, "shed_events"):
+                        self._shed_direct()
+                    else:
+                        pop_n = cap + self._admission.shed_chunk
+            # the decision-latency anchor excludes the admission work
+            # above: shed/depth I/O is not part of any ADMITTED event's
+            # pop→action-written path (t0 keeps covering it for the io
+            # accounting); without admission the two clocks coincide
+            t_anchor = (time.perf_counter() if self._admission is not None
+                        else t0)
+            events = _pop_events(self.queues, pop_n)
+            if pop_n > cap and len(events) > cap:
+                events = self._shed(events, cap)
             t1 = time.perf_counter()
             acks = events
             if events and self._event_ts:
@@ -379,9 +560,9 @@ class ServingEngine:
                 self._complete(*pending, batch_size)
             if not events:
                 break
-            # t0 (pre-pop clock read) rides along as the batch's
+            # the pre-pop clock read rides along as the batch's
             # decision-latency anchor
-            pending = (events, acks, handles, t0)
+            pending = (events, acks, handles, t_anchor)
             processed += len(events)
             if max_events is None or processed < max_events:
                 self._cap.update(len(events))

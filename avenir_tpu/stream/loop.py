@@ -24,7 +24,7 @@ the ``redis`` package being importable.
 
 from __future__ import annotations
 
-from collections import deque
+from collections import Counter, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -107,6 +107,16 @@ class InProcQueues:
     def ack_events(self, event_ids: Sequence[str]) -> None:
         pass
 
+    def shed_events(self, max_n: int, newest: bool = False) -> List[str]:
+        """Admission-control shed (ISSUE 8): remove up to ``max_n``
+        events without serving them. ``newest=True`` takes the most
+        recent arrivals (reject-new), else the oldest (drop-oldest)."""
+        out = []
+        while self.events and len(out) < max_n:
+            out.append(self.events.popleft() if newest
+                       else self.events.pop())
+        return out
+
     def push_reward(self, action_id: str, reward: float) -> None:
         self.rewards.appendleft((action_id, reward))
 
@@ -181,6 +191,13 @@ class RedisQueues:
         # a future multi-field event format — remember id→raw so ack always
         # LREMs the verbatim ledger bytes (ADVICE round 3)
         self._pending_raw: dict = {}
+        # raw payload -> count of ledger entries THIS consumer knows it
+        # popped and has not yet acked. The reconciliation key for broker
+        # failover (ISSUE 8): ledger entries beyond these counts are pops
+        # whose replies a dead connection swallowed — invisible to the
+        # consumer, so they must go back to the event queue or they would
+        # hang un-answered forever. See recover_in_flight().
+        self._in_flight: Counter = Counter()
 
     # one drain_rewards call sweeps at most this many entries: a giant
     # reward backlog must not starve event serving for a whole drain
@@ -200,17 +217,62 @@ class RedisQueues:
         self._pending_raw.setdefault(decoded, []).append(raw)
         self._pending_raw.setdefault(
             decoded.partition(self.delim)[0], []).append(raw)
+        self._in_flight[raw] += 1
+
+    def _reconnects(self) -> Optional[int]:
+        """The client's reconnect counter, None for clients without the
+        failover transport (plain MiniRedisClient, redis-py, fakes)."""
+        return getattr(self._r, "reconnects", None)
+
+    def recover_in_flight(self) -> int:
+        """Reconcile the broker-side pending ledger with this consumer's
+        bookkeeping after a broker failover (ISSUE 8 broker fault
+        tolerance). A reconnect mid-``pop_events`` means the resent sweep
+        popped FRESH events while the original sweep's pops — executed
+        broker-side, replies lost — sit in the ledger under ids this
+        consumer never saw. Every ledger entry beyond the locally-known
+        in-flight counts is such an orphan: push it back onto the event
+        queue for a re-pop (at-least-once; the action consumer's dedup
+        completes exactly-once, the same contract as a worker crash).
+        Returns the number of entries replayed. Safe only because each
+        pending ledger has exactly one consumer (the ownership
+        discipline)."""
+        if self.pending_queue is None:
+            return 0
+        raws = self._r.lrange(self.pending_queue, 0, -1)
+        have = Counter(raws)
+        n = 0
+        for raw, count in have.items():
+            for _ in range(count - self._in_flight.get(raw, 0)):
+                # requeue BEFORE retiring the ledger copy: a crash (or a
+                # second broker death) between the two commands then
+                # leaves the event in BOTH lists — served once from the
+                # queue, replayed once more from the ledger, and dedup
+                # absorbs the copy. The reverse order has a window where
+                # the event is in NEITHER list: silent loss, the one
+                # outcome this whole layer exists to prevent.
+                self._r.lpush(self.event_queue, raw)
+                self._r.lrem(self.pending_queue, 1, raw)
+                n += 1
+        return n
 
     def pop_event(self) -> Optional[str]:
+        marker = self._reconnects()
         if self.pending_queue is not None:
             raw = self._r.rpoplpush(self.event_queue, self.pending_queue)
         else:
             raw = self._r.rpop(self.event_queue)
-        if raw is None:
-            return None
-        decoded = raw.decode()
-        if self.pending_queue is not None:
-            self._note_pending(decoded, raw)
+        if raw is not None:
+            decoded = raw.decode()
+            if self.pending_queue is not None:
+                self._note_pending(decoded, raw)
+        else:
+            decoded = None
+        if marker is not None and self._reconnects() != marker:
+            # reconcile only AFTER noting this pop in the local
+            # bookkeeping — reconciling first would misread the resent
+            # pop's own ledger entry as an orphan and replay it
+            self.recover_in_flight()
         return decoded
 
     def pop_events(self, max_n: int) -> List[str]:
@@ -222,6 +284,7 @@ class RedisQueues:
         results."""
         if max_n <= 0:
             return []
+        marker = self._reconnects()
         if self.pending_queue is not None:
             pipe = getattr(self._r, "pipeline", None)
             if pipe is not None:
@@ -255,7 +318,40 @@ class RedisQueues:
             if self.pending_queue is not None:
                 self._note_pending(decoded, raw)
             out.append(decoded)
+        if marker is not None and self._reconnects() != marker:
+            # a failover resent the sweep: reclaim the ORIGINAL sweep's
+            # orphaned ledger entries (replies lost, events popped) back
+            # onto the event queue. Strictly after the _note_pending
+            # loop above — reconciling before it would misread the
+            # resent sweep's own ledger entries as orphans and replay
+            # the whole batch (one guaranteed duplicate per event).
+            self.recover_in_flight()
         return out
+
+    def shed_events(self, max_n: int, newest: bool = False) -> List[str]:
+        """Admission-control shed (ISSUE 8): up to ``max_n`` events off
+        in ONE broker command — RPOP count (oldest; drop-oldest policy)
+        or LPOP count (newest arrivals; reject-new). Deliberately
+        BYPASSES the pending ledger: shed work is discarded by design,
+        so it needs no crash replay, and routing it through the ledger
+        would cost one RPOPLPUSH + one LREM per shed event (the
+        admission gate exists to cut load, not double it). The returned
+        payloads are the caller's exact-accounting record; the one
+        un-accounted window is a broker crash between this command and
+        the reply, which loses only already-doomed work."""
+        if max_n <= 0:
+            return []
+        cmd = self._r.lpop if newest else self._r.rpop
+        try:
+            raws = cmd(self.event_queue, max_n)
+        except TypeError:          # client without the count form
+            raws = []
+            for _ in range(max_n):
+                raw = cmd(self.event_queue)
+                if raw is None:
+                    break
+                raws.append(raw)
+        return [raw.decode() for raw in (raws or [])]
 
     def _ack_raw(self, event_id: str):
         """Resolve an ack to the verbatim raw ledger bytes and drop the
@@ -272,6 +368,10 @@ class RedisQueues:
                     entries.remove(raw)
                 if entries == []:
                     del self._pending_raw[key]
+            if self._in_flight[raw] > 1:
+                self._in_flight[raw] -= 1
+            else:
+                self._in_flight.pop(raw, None)
         return raw
 
     def ack_event(self, event_id: str) -> None:

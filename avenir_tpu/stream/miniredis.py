@@ -10,17 +10,42 @@ runs and is testable with zero external infrastructure. A real Redis server
 is a drop-in replacement: ``MiniRedisClient`` mirrors the redis-py subset
 ``stream.loop.RedisQueues`` consumes (bytes in, bytes out).
 
+Fault tolerance (ISSUE 8): the client carries a default socket timeout and
+surfaces :class:`BrokerUnavailable` instead of hanging on a dead broker;
+``reconnect=True`` arms transparent reconnection with capped exponential
+backoff + jitter and at-least-once command resend (the ack/replay ledger
+plus downstream dedup complete the exactly-once effect — see
+``RedisQueues.recover_in_flight``). The server side gains an append-only
+command log (``aof_path``): every mutating command is logged after it
+executes, and a restarted broker replays the log back to its pre-crash
+state — a SIGKILLed broker loses at most the single command whose log
+write the kill interrupted, which the same at-least-once contract absorbs.
+``SET``/``GET`` round out the subset with the single-key atomic record the
+ownership rebalancer swaps assignments through (stream/rebalance.py).
+
 Single-process uses need none of this — ``InProcQueues`` stays the default.
 """
 
 from __future__ import annotations
 
+import os
+import random
 import socket
 import socketserver
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+
+# blocking socket ops (connect, send, reply read) give up after this long
+# by default: a dead broker must surface as BrokerUnavailable, never as an
+# indefinite hang in a worker's recv path (ISSUE 8 satellite)
+DEFAULT_TIMEOUT = 10.0
+
+
+class BrokerUnavailable(ConnectionError):
+    """The broker cannot be reached: connect/send/reply timed out or was
+    refused, and reconnection (when armed) exhausted its deadline."""
 
 
 # --------------------------------------------------------------------------
@@ -75,7 +100,13 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             if cmd is None:
                 return
-            self.wfile.write(srv.execute(cmd))
+            try:
+                reply = srv.execute(cmd)
+            except ConnectionError:
+                # simulated crash (crash_after): drop the connection with
+                # no reply, exactly what a SIGKILLed broker looks like
+                return
+            self.wfile.write(reply)
             self.wfile.flush()
 
 
@@ -84,17 +115,67 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-class MiniRedisServer:
-    """Threaded in-memory list store speaking the RESP list subset."""
+# the commands the AOF must log: everything that changes store state.
+# Reads (LRANGE/LINDEX/LLEN/GET/PING) replay to the same answer for free.
+_MUTATING = frozenset((b"LPUSH", b"RPOP", b"LPOP", b"RPOPLPUSH", b"LREM",
+                       b"DEL", b"FLUSHALL", b"SET"))
 
-    def __init__(self, host: str = "localhost", port: int = 0):
+
+class MiniRedisServer:
+    """Threaded in-memory list store speaking the RESP list subset.
+
+    ``aof_path`` arms crash durability: each mutating command is appended
+    (RESP-encoded) to the log after it executes, and a server constructed
+    over an existing log replays it before serving — so a broker SIGKILL
+    + restart resumes from the pre-crash store (a torn final record from
+    the kill is truncated away on replay). The log is flushed per command
+    but not fsynced: it protects against broker-process death, the chaos
+    scenario the harness injects, not host power loss.
+
+    ``crash_after=N`` (tests only) simulates that SIGKILL
+    deterministically: after N executed commands the server answers
+    nothing and drops every connection — in-flight pipelines lose their
+    replies mid-batch exactly as a real kill loses them."""
+
+    def __init__(self, host: str = "localhost", port: int = 0,
+                 aof_path: Optional[str] = None,
+                 crash_after: Optional[int] = None):
         self._lists: Dict[bytes, deque] = {}
+        self._strings: Dict[bytes, bytes] = {}
         self._lock = threading.Lock()
+        self._aof = None
+        self._aof_path = aof_path
+        self._executed = 0
+        self._crash_after = crash_after
+        if aof_path:
+            self._replay_aof(aof_path)
+            self._aof = open(aof_path, "ab")
         self._tcp = _TCPServer((host, port), _Handler)
         self._tcp.owner = self  # type: ignore[attr-defined]
         self.host, self.port = self._tcp.server_address[:2]
         self._thread = threading.Thread(target=self._tcp.serve_forever,
                                         daemon=True)
+
+    def _replay_aof(self, path: str) -> None:
+        """Rebuild the store from the command log. A partial tail record
+        (the command a SIGKILL interrupted mid-write) is discarded AND
+        truncated away, so appending resumes on a record boundary."""
+        if not os.path.exists(path):
+            return
+        good = 0
+        with open(path, "rb") as fh:
+            while True:
+                try:
+                    cmd = _read_command(fh)
+                except (ConnectionError, ValueError):
+                    break                       # torn tail: stop here
+                if cmd is None:
+                    break
+                self._apply(cmd[0].upper(), cmd[1:])
+                good = fh.tell()
+        if good < os.path.getsize(path):
+            with open(path, "r+b") as fh:
+                fh.truncate(good)
 
     def start(self) -> "MiniRedisServer":
         self._thread.start()
@@ -106,6 +187,9 @@ class MiniRedisServer:
         if self._thread.is_alive():
             self._tcp.shutdown()
         self._tcp.server_close()
+        if self._aof is not None:
+            self._aof.close()
+            self._aof = None
 
     def __enter__(self) -> "MiniRedisServer":
         return self.start()
@@ -117,108 +201,144 @@ class MiniRedisServer:
 
     def execute(self, cmd: List[bytes]) -> bytes:
         name = cmd[0].upper()
-        args = cmd[1:]
         with self._lock:
-            if name == b"PING":
-                return b"+PONG\r\n"
-            if name == b"LPUSH":
-                q = self._lists.setdefault(args[0], deque())
-                for val in args[1:]:
-                    q.appendleft(val)
-                return b":%d\r\n" % len(q)
-            if name == b"RPOP":
-                q = self._lists.get(args[0])
-                if len(args) >= 2:
-                    # Redis 6.2 count form: array of up to count popped
-                    # values (oldest first under lpush producers), null
-                    # array when the key is empty/missing
-                    count = int(args[1])
-                    if not q:
-                        return b"*-1\r\n"
-                    popped = [q.pop() for _ in range(min(count, len(q)))]
-                    return b"*%d\r\n" % len(popped) + b"".join(
-                        _encode_bulk(v) for v in popped)
-                return _encode_bulk(q.pop() if q else None)
-            if name == b"RPOPLPUSH":
-                # atomic move (the reliable-queue primitive the ack/replay
-                # ledger rides): nothing is ever in neither list
-                q = self._lists.get(args[0])
+            if (self._crash_after is not None
+                    and self._executed >= self._crash_after):
+                raise ConnectionError("simulated broker crash")
+            self._executed += 1
+            reply = self._apply(name, cmd[1:])
+            if self._aof is not None and name in _MUTATING:
+                # logged AFTER the apply: a kill between the two loses
+                # exactly that one mutation, which the client's
+                # at-least-once resend re-issues after reconnect
+                self._aof.write(_encode_command(cmd))
+                self._aof.flush()
+            return reply
+
+    def _apply(self, name: bytes, args: List[bytes]) -> bytes:
+        if name == b"PING":
+            return b"+PONG\r\n"
+        if name == b"SET":
+            # the single-key atomic record (ownership assignments ride
+            # this: one epoch-numbered JSON blob swapped in one command)
+            self._strings[args[0]] = args[1]
+            return b"+OK\r\n"
+        if name == b"GET":
+            return _encode_bulk(self._strings.get(args[0]))
+        if name == b"LPUSH":
+            q = self._lists.setdefault(args[0], deque())
+            for val in args[1:]:
+                q.appendleft(val)
+            return b":%d\r\n" % len(q)
+        if name == b"RPOP":
+            q = self._lists.get(args[0])
+            if len(args) >= 2:
+                # Redis 6.2 count form: array of up to count popped
+                # values (oldest first under lpush producers), null
+                # array when the key is empty/missing
+                count = int(args[1])
                 if not q:
-                    return _encode_bulk(None)
-                val = q.pop()
-                self._lists.setdefault(args[1], deque()).appendleft(val)
-                return _encode_bulk(val)
-            if name == b"LREM":
-                q = self._lists.get(args[0])
-                count, val = int(args[1]), args[2]
+                    return b"*-1\r\n"
+                popped = [q.pop() for _ in range(min(count, len(q)))]
+                return b"*%d\r\n" % len(popped) + b"".join(
+                    _encode_bulk(v) for v in popped)
+            return _encode_bulk(q.pop() if q else None)
+        if name == b"LPOP":
+            # head-side pop (newest under lpush producers) — the
+            # reject-new admission shed takes arrivals off the head in
+            # one command instead of per-event round trips
+            q = self._lists.get(args[0])
+            if len(args) >= 2:
+                count = int(args[1])
                 if not q:
-                    return b":0\r\n"
-                if count == 1:
-                    # the ledger-ack hot path (64 per engine batch):
-                    # deque.remove is the same head-first first-match
-                    # semantics at C speed, no list rebuild
-                    try:
-                        q.remove(val)
-                        return b":1\r\n"
-                    except ValueError:
-                        return b":0\r\n"
-                if count == -1:
-                    try:
-                        q.reverse()
-                        q.remove(val)
-                        return b":1\r\n"
-                    except ValueError:
-                        return b":0\r\n"
-                    finally:
-                        q.reverse()
-                # count>0: head-first; count<0: tail-first; 0: all
-                removed, items = 0, list(q)   # index 0 = head (LPUSH side)
-                if count < 0:
-                    items.reverse()
-                limit = abs(count) if count != 0 else len(items)
-                kept = []
-                for item in items:
-                    if item == val and removed < limit:
-                        removed += 1
-                    else:
-                        kept.append(item)
-                if count < 0:
-                    kept.reverse()
-                self._lists[args[0]] = deque(kept)
-                return b":%d\r\n" % removed
-            if name == b"LRANGE":
-                q = self._lists.get(args[0])
-                lo, hi = int(args[1]), int(args[2])
-                items = list(q) if q else []
-                n = len(items)
-                lo = max(lo + n if lo < 0 else lo, 0)
-                hi = hi + n if hi < 0 else hi
-                # a stop still negative after conversion is out of range:
-                # real Redis replies with an empty array, not a slice
-                sel = items[lo:hi + 1] if 0 <= hi and lo <= hi else []
-                return b"*%d\r\n" % len(sel) + b"".join(
-                    _encode_bulk(v) for v in sel)
-            if name == b"LINDEX":
-                q = self._lists.get(args[0])
-                idx = int(args[1])
-                if q is None:
-                    return _encode_bulk(None)
-                pos = idx if idx >= 0 else len(q) + idx
-                if 0 <= pos < len(q):
-                    return _encode_bulk(q[pos])
+                    return b"*-1\r\n"
+                popped = [q.popleft()
+                          for _ in range(min(count, len(q)))]
+                return b"*%d\r\n" % len(popped) + b"".join(
+                    _encode_bulk(v) for v in popped)
+            return _encode_bulk(q.popleft() if q else None)
+        if name == b"RPOPLPUSH":
+            # atomic move (the reliable-queue primitive the ack/replay
+            # ledger rides): nothing is ever in neither list
+            q = self._lists.get(args[0])
+            if not q:
                 return _encode_bulk(None)
-            if name == b"LLEN":
-                q = self._lists.get(args[0])
-                return b":%d\r\n" % (len(q) if q else 0)
-            if name == b"DEL":
-                n = 0
-                for key in args:
-                    n += 1 if self._lists.pop(key, None) is not None else 0
-                return b":%d\r\n" % n
-            if name == b"FLUSHALL":
-                self._lists.clear()
-                return b"+OK\r\n"
-            return b"-ERR unknown command '%s'\r\n" % name
+            val = q.pop()
+            self._lists.setdefault(args[1], deque()).appendleft(val)
+            return _encode_bulk(val)
+        if name == b"LREM":
+            q = self._lists.get(args[0])
+            count, val = int(args[1]), args[2]
+            if not q:
+                return b":0\r\n"
+            if count == 1:
+                # the ledger-ack hot path (64 per engine batch):
+                # deque.remove is the same head-first first-match
+                # semantics at C speed, no list rebuild
+                try:
+                    q.remove(val)
+                    return b":1\r\n"
+                except ValueError:
+                    return b":0\r\n"
+            if count == -1:
+                try:
+                    q.reverse()
+                    q.remove(val)
+                    return b":1\r\n"
+                except ValueError:
+                    return b":0\r\n"
+                finally:
+                    q.reverse()
+            # count>0: head-first; count<0: tail-first; 0: all
+            removed, items = 0, list(q)   # index 0 = head (LPUSH side)
+            if count < 0:
+                items.reverse()
+            limit = abs(count) if count != 0 else len(items)
+            kept = []
+            for item in items:
+                if item == val and removed < limit:
+                    removed += 1
+                else:
+                    kept.append(item)
+            if count < 0:
+                kept.reverse()
+            self._lists[args[0]] = deque(kept)
+            return b":%d\r\n" % removed
+        if name == b"LRANGE":
+            q = self._lists.get(args[0])
+            lo, hi = int(args[1]), int(args[2])
+            items = list(q) if q else []
+            n = len(items)
+            lo = max(lo + n if lo < 0 else lo, 0)
+            hi = hi + n if hi < 0 else hi
+            # a stop still negative after conversion is out of range:
+            # real Redis replies with an empty array, not a slice
+            sel = items[lo:hi + 1] if 0 <= hi and lo <= hi else []
+            return b"*%d\r\n" % len(sel) + b"".join(
+                _encode_bulk(v) for v in sel)
+        if name == b"LINDEX":
+            q = self._lists.get(args[0])
+            idx = int(args[1])
+            if q is None:
+                return _encode_bulk(None)
+            pos = idx if idx >= 0 else len(q) + idx
+            if 0 <= pos < len(q):
+                return _encode_bulk(q[pos])
+            return _encode_bulk(None)
+        if name == b"LLEN":
+            q = self._lists.get(args[0])
+            return b":%d\r\n" % (len(q) if q else 0)
+        if name == b"DEL":
+            n = 0
+            for key in args:
+                n += 1 if self._lists.pop(key, None) is not None else 0
+                n += 1 if self._strings.pop(key, None) is not None else 0
+            return b":%d\r\n" % n
+        if name == b"FLUSHALL":
+            self._lists.clear()
+            self._strings.clear()
+            return b"+OK\r\n"
+        return b"-ERR unknown command '%s'\r\n" % name
 
 
 # --------------------------------------------------------------------------
@@ -239,43 +359,137 @@ class MiniRedisClient:
     read back together — the transport primitive that collapses the
     serving loop's per-event round trips. ``calls`` counts broker round
     trips (a pipeline ``execute`` is one), which the serving bench uses
-    to report round-trips-per-batch."""
+    to report round-trips-per-batch.
+
+    Every blocking socket op observes ``timeout`` — a dead or hung broker
+    surfaces as :class:`BrokerUnavailable`, never an indefinite recv hang.
+    ``reconnect=True`` additionally survives broker restarts: on a
+    connection failure the client redials with capped exponential backoff
+    + jitter (up to ``reconnect_timeout`` per outage) and RESENDS the
+    in-flight command or pipeline batch. Resend is at-least-once — the
+    lost reply's command may have executed — so it is only safe under the
+    ledger + dedup discipline the serving tier already runs;
+    ``reconnects`` counts successful redials, which ``RedisQueues`` uses
+    to trigger its in-flight-ledger reconciliation."""
 
     def __init__(self, host: str = "localhost", port: int = 6379,
-                 timeout: float = 30.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._rfile = self._sock.makefile("rb")
+                 timeout: float = DEFAULT_TIMEOUT,
+                 reconnect: bool = False,
+                 reconnect_timeout: float = 10.0):
+        self.host, self.port = host, port
+        self._timeout = timeout
+        self._reconnect_armed = bool(reconnect)
+        self._reconnect_timeout = float(reconnect_timeout)
         self._lock = threading.Lock()
         self.calls = 0
+        self.reconnects = 0
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection((self.host, self.port),
+                                              timeout=self._timeout)
+        self._rfile = self._sock.makefile("rb")
 
     def close(self) -> None:
-        self._rfile.close()
-        self._sock.close()
+        try:
+            self._rfile.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _unavailable(self, exc: Exception) -> BrokerUnavailable:
+        return BrokerUnavailable(
+            f"broker {self.host}:{self.port} unavailable: {exc!r}")
+
+    @staticmethod
+    def _backoff(attempt: int) -> float:
+        """Capped exponential backoff + jitter (uniform 0.5-1.5x): keeps
+        a restarted broker from being stampeded by every worker redialing
+        in lockstep."""
+        return min(0.02 * (2 ** attempt), 0.5) * (0.5 + random.random())
+
+    def _failover(self, exc: OSError, state: Dict) -> None:
+        """Shared resend bookkeeping for ``_call``/``_call_many``: the
+        FIRST failure of an operation arms a per-operation deadline
+        (``reconnect_timeout``); every subsequent failure — including a
+        broker that accepts redials but dies again mid-command — backs
+        off and redials until that single deadline expires. Without the
+        operation-level bound, a listening-but-dead broker would loop
+        connect/resend/fail forever."""
+        if not self._reconnect_armed:
+            raise self._unavailable(exc) from exc
+        now = time.monotonic()
+        if "deadline" not in state:
+            state["deadline"] = now + self._reconnect_timeout
+        elif now > state["deadline"]:
+            raise self._unavailable(exc) from exc
+        else:
+            time.sleep(self._backoff(state["attempt"]))
+        self._redial(exc, state["deadline"])
+        state["attempt"] += 1
+
+    def _redial(self, cause: Exception, deadline: float) -> None:
+        """Reconnect with backoff until ``deadline``, else raise
+        BrokerUnavailable."""
+        self.close()
+        attempt = 0
+        while True:
+            if time.monotonic() > deadline:
+                raise self._unavailable(cause) from cause
+            try:
+                self._connect()
+                self.reconnects += 1
+                return
+            except OSError as exc:
+                cause = exc
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise self._unavailable(cause) from cause
+            time.sleep(min(self._backoff(attempt), remaining))
+            attempt += 1
 
     def _call(self, *parts: bytes):
         msg = _encode_command(parts)
         with self._lock:
             self.calls += 1
-            self._sock.sendall(msg)
-            return self._reply()
+            state: Dict = {"attempt": 0}
+            while True:
+                try:
+                    self._sock.sendall(msg)
+                    return self._reply()
+                except RuntimeError:
+                    raise             # -ERR reply: the stream is intact
+                except OSError as exc:
+                    self._failover(exc, state)  # then resend
+                    # (at-least-once: the lost reply's command may have
+                    # executed — ledger + dedup absorb the repeat)
 
     def _call_many(self, commands):
         """One write carrying every buffered command, then the matching
         replies in order (the pipeline transport). Error replies are
         collected — never left unread, which would desync the stream —
-        and the first one raises after the batch completes."""
+        and the first one raises after the batch completes. A connection
+        failure anywhere in the batch (with reconnect armed) redials and
+        resends the WHOLE batch: partial replies are discarded, because
+        without them there is no telling which commands executed."""
         msg = b"".join(_encode_command(parts) for parts in commands)
         with self._lock:
             self.calls += 1
-            self._sock.sendall(msg)
-            replies, first_err = [], None
-            for _ in commands:
+            state: Dict = {"attempt": 0}
+            while True:
                 try:
-                    replies.append(self._reply())
-                except RuntimeError as exc:   # -ERR reply: stream is intact
-                    replies.append(exc)
-                    if first_err is None:
-                        first_err = exc
+                    self._sock.sendall(msg)
+                    replies, first_err = [], None
+                    for _ in commands:
+                        try:
+                            replies.append(self._reply())
+                        except RuntimeError as exc:  # -ERR: stream intact
+                            replies.append(exc)
+                            if first_err is None:
+                                first_err = exc
+                    break
+                except OSError as exc:
+                    self._failover(exc, state)
         if first_err is not None:
             raise first_err
         return replies
@@ -314,6 +528,12 @@ class MiniRedisClient:
     def ping(self):
         return self._call(b"PING")
 
+    def set(self, key, value):
+        return self._call(b"SET", self._b(key), self._b(value))
+
+    def get(self, key) -> Optional[bytes]:
+        return self._call(b"GET", self._b(key))
+
     def lpush(self, key, *values) -> int:
         return self._call(b"LPUSH", self._b(key),
                           *[self._b(v) for v in values])
@@ -322,6 +542,11 @@ class MiniRedisClient:
         if count is not None:
             return self._call(b"RPOP", self._b(key), self._b(count))
         return self._call(b"RPOP", self._b(key))
+
+    def lpop(self, key, count: Optional[int] = None):
+        if count is not None:
+            return self._call(b"LPOP", self._b(key), self._b(count))
+        return self._call(b"LPOP", self._b(key))
 
     def rpoplpush(self, src, dst) -> Optional[bytes]:
         return self._call(b"RPOPLPUSH", self._b(src), self._b(dst))
@@ -400,21 +625,34 @@ class MiniRedisPipeline:
         return self._client._call_many(commands)
 
 
-def connect_with_retry(host: str, port: int,
-                       timeout: float = 10.0) -> MiniRedisClient:
-    """Client to a broker that may still be starting (subprocess spawn)."""
+def connect_with_retry(host: str, port: int, timeout: float = 10.0,
+                       socket_timeout: Optional[float] = None,
+                       **client_kw) -> MiniRedisClient:
+    """Client to a broker that may still be starting (subprocess spawn).
+    Raises :class:`BrokerUnavailable` once ``timeout`` (the overall
+    budget) is spent — a never-accepting or never-answering endpoint
+    fails loudly instead of hanging the caller, since each attempt's
+    connect/ping observes ``socket_timeout`` (the client default when
+    None). Extra kwargs (``reconnect=``...) pass through to
+    :class:`MiniRedisClient`."""
+    if socket_timeout is not None:
+        client_kw["timeout"] = socket_timeout
     deadline = time.monotonic() + timeout
+    last: Exception = BrokerUnavailable(f"no broker at {host}:{port}")
     while True:
         client = None
         try:
-            client = MiniRedisClient(host, port)
+            client = MiniRedisClient(host, port, **client_kw)
             client.ping()
             return client
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as exc:
+            last = exc
             if client is not None:     # connected but ping failed: no leak
                 client.close()
             if time.monotonic() > deadline:
-                raise
+                raise BrokerUnavailable(
+                    f"no broker at {host}:{port} after {timeout:.1f}s "
+                    f"of retries: {last!r}") from last
             time.sleep(0.05)
 
 
@@ -426,8 +664,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="localhost")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--aof", default=None, metavar="PATH",
+                    help="append-only command log: mutations are logged "
+                         "and replayed on start, so a SIGKILLed broker "
+                         "restarted over the same file resumes its "
+                         "pre-crash store (the chaos-harness contract)")
     args = ap.parse_args(argv)
-    srv = MiniRedisServer(args.host, args.port)
+    srv = MiniRedisServer(args.host, args.port, aof_path=args.aof)
     print(f"miniredis listening {srv.host}:{srv.port}", flush=True)
     srv._thread.start()
     try:

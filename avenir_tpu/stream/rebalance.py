@@ -1,0 +1,416 @@
+"""Elastic group ownership: epoch-numbered assignment, rebalance, handoff.
+
+The reference gets serving elasticity from its substrate: Storm's
+fieldsGrouping re-targets tuples when workers join or die, and the
+supervisor respawns dead workers (PAPER.md §L0/§L3). Our scale-out tier
+froze ownership at ``group i -> worker i mod N`` at spawn time — a fleet
+that can neither grow, shrink, nor survive a permanently dead worker.
+This module supplies the missing control plane:
+
+- **Assignment record**: one epoch-numbered JSON blob under the broker
+  key ``assignment`` (``SET`` — single-key, single-command atomic swap).
+  The coordinator is its only writer; workers only read. Epochs are
+  strictly increasing, so a worker can never act on a stale record twice.
+
+- **Coordinator** (driver-side): consumes the same heartbeat stream the
+  fleet already ships, maintains per-worker liveness
+  (``scaleout.worker_liveness`` — age > 3x cadence means dead), and
+  rewrites the assignment whenever membership changes: a first heartbeat
+  is a JOIN, ``remove_worker`` is a directed LEAVE, a stale heartbeat is
+  a DEATH. Reassignment is sticky (surviving owners keep their groups)
+  plus a balancing pass, so each membership change moves the minimum
+  number of groups.
+
+- **Worker rebalancer**: polled at batch boundaries on the heartbeat-ish
+  cadence. On a new epoch the worker RELEASES groups it no longer owns —
+  publishing each group's learner state to the lifecycle
+  ``SnapshotRegistry`` (kind ``learner-handoff``, tagged with group +
+  epoch) — and ACQUIRES newly assigned ones: reclaim the group's pending
+  ledger (a dead predecessor's un-acked pops replay; dedup downstream
+  keeps exactly-once), wait briefly for the releasing owner's publish
+  when one is expected, schema-check and install it. State moves through
+  the registry exactly as ISSUE 7's hot-swap does, so the swap parity
+  contract (identical to stop/restore/resume) carries over to handoffs.
+
+Delivery across a rebalance stays exactly-once-after-dedup by the same
+two invariants the chaos harness already enforces: every pop is an
+atomic move into a per-group ledger acked only after the answer is
+written, and the action consumer deduplicates by event id.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from avenir_tpu.obs import telemetry
+from avenir_tpu.obs.exporters import set_hub_gauges_if_live as _hub_gauges
+
+ASSIGNMENT_KEY = "assignment"
+HANDOFF_KIND = "learner-handoff"
+
+# how long an acquiring worker polls for the releasing owner's publish
+# before serving from a fresh learner: release rides the releaser's own
+# batch-boundary sync, so a couple of poll cadences covers it
+HANDOFF_WAIT_S = 5.0
+
+
+@dataclass
+class AssignmentRecord:
+    """One committed ownership epoch: ``groups`` maps every group to its
+    owning worker id. ``handoff`` lists the groups whose PREVIOUS owner
+    is alive and will publish-on-release (the acquirer should wait for
+    that snapshot); a dead predecessor's groups are absent — there is
+    nothing to wait for, reclaim + fresh state is the recovery path.
+    ``stop`` tells ownerless workers the run is over."""
+
+    epoch: int
+    groups: Dict[str, int] = field(default_factory=dict)
+    handoff: List[str] = field(default_factory=list)
+    # the full alive membership this epoch was computed FROM — a
+    # superset of the owners when workers outnumber groups. The
+    # coordinator's change detection compares against THIS, not the
+    # owner set: otherwise a groupless-but-alive worker would read as a
+    # membership change every tick and churn epochs forever.
+    members: List[int] = field(default_factory=list)
+    stop: bool = False
+
+    def owned_by(self, worker_id: int) -> List[str]:
+        return sorted(g for g, w in self.groups.items() if w == worker_id)
+
+    def workers(self) -> List[int]:
+        return sorted(set(self.groups.values()))
+
+    def to_json(self) -> str:
+        return json.dumps({"epoch": self.epoch, "groups": self.groups,
+                           "handoff": sorted(self.handoff),
+                           "members": sorted(self.members),
+                           "stop": self.stop}, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "AssignmentRecord":
+        data = json.loads(raw)
+        return cls(epoch=int(data["epoch"]),
+                   groups={g: int(w)
+                           for g, w in (data.get("groups") or {}).items()},
+                   handoff=list(data.get("handoff") or []),
+                   members=[int(w) for w in (data.get("members") or [])],
+                   stop=bool(data.get("stop", False)))
+
+
+def read_assignment(client) -> Optional[AssignmentRecord]:
+    raw = client.get(ASSIGNMENT_KEY)
+    if raw is None:
+        return None
+    return AssignmentRecord.from_json(
+        raw.decode() if isinstance(raw, bytes) else raw)
+
+
+def write_assignment(client, record: AssignmentRecord) -> None:
+    """One SET: readers observe the old record or the new one, never a
+    torn mix — the broker applies each command atomically."""
+    client.set(ASSIGNMENT_KEY, record.to_json())
+
+
+def rebalance_assignment(groups: Sequence[str], workers: Sequence[int],
+                         previous: Optional[Dict[str, int]] = None
+                         ) -> Dict[str, int]:
+    """Sticky, balanced reassignment: a group keeps its previous owner
+    when that owner survives; orphaned groups go to the least-loaded
+    member; then groups move from the most- to the least-loaded member
+    until the spread is <= 1. Every move is one handoff, so minimizing
+    moves minimizes state transfer. Deterministic (ties break on worker
+    id, groups scan in the given order) — two coordinators computing from
+    the same inputs write the same record."""
+    members = sorted(set(int(w) for w in workers))
+    if not members:
+        raise ValueError("cannot assign groups to an empty fleet")
+    prev = dict(previous or {})
+    out: Dict[str, int] = {}
+    load = {w: 0 for w in members}
+    for g in groups:
+        w = prev.get(g)
+        if w in load:
+            out[g] = w
+            load[w] += 1
+    for g in groups:
+        if g not in out:
+            w = min(load, key=lambda x: (load[x], x))
+            out[g] = w
+            load[w] += 1
+    while True:
+        hi = max(load, key=lambda w: (load[w], w))
+        lo = min(load, key=lambda w: (load[w], w))
+        if load[hi] - load[lo] <= 1:
+            return out
+        mover = next(g for g in groups if out[g] == hi)
+        out[mover] = lo
+        load[hi] -= 1
+        load[lo] += 1
+
+
+class Coordinator:
+    """Driver-side assignment authority — the role Storm's nimbus +
+    supervisors played. Single instance per fleet (the record's only
+    writer). Feed it the drained heartbeat stream on whatever cadence
+    the driver polls; it rewrites the assignment iff membership changed."""
+
+    def __init__(self, client, groups: Sequence[str],
+                 cadence_s: float = 0.5,
+                 dead_after_factor: Optional[float] = None):
+        from avenir_tpu.stream.scaleout import DEAD_AFTER_FACTOR
+        self.client = client
+        self.groups = list(groups)
+        self.cadence_s = float(cadence_s)
+        self.dead_after_factor = float(dead_after_factor
+                                       or DEAD_AFTER_FACTOR)
+        self.dead_after_s = self.dead_after_factor * self.cadence_s
+        self.last_seen: Dict[int, float] = {}
+        self.removed: set = set()
+        self.record = read_assignment(client) or AssignmentRecord(0)
+
+    # -- membership ----------------------------------------------------------
+
+    def note_heartbeats(self, heartbeats: Sequence[Dict]) -> None:
+        for hb in heartbeats:
+            worker = int(hb["worker"])
+            self.last_seen[worker] = max(self.last_seen.get(worker, 0.0),
+                                         float(hb["ts"]))
+
+    def _liveness(self, now: Optional[float] = None) -> Dict[int, Dict]:
+        """Per-worker liveness over the latest-known heartbeats — the
+        one stale-heartbeat rule, shared with the fleet report
+        (``scaleout.worker_liveness``), never a second copy."""
+        from avenir_tpu.stream.scaleout import worker_liveness
+        return worker_liveness(
+            [{"worker": w, "ts": ts} for w, ts in self.last_seen.items()],
+            self.cadence_s, now=now,
+            dead_after_factor=self.dead_after_factor)
+
+    def alive_workers(self, now: Optional[float] = None) -> List[int]:
+        return sorted(w for w, info in self._liveness(now).items()
+                      if w not in self.removed and not info["dead"])
+
+    def remove_worker(self, worker_id: int,
+                      now: Optional[float] = None
+                      ) -> Optional[AssignmentRecord]:
+        """Directed leave: the worker is healthy but must drain out —
+        its groups move away and it publishes each one on release."""
+        self.removed.add(int(worker_id))
+        return self.step(now)
+
+    # -- the rebalance step --------------------------------------------------
+
+    def observe(self, now: Optional[float] = None
+                ) -> Optional[AssignmentRecord]:
+        """Drain pending heartbeats off the broker and advance: the one
+        call a driver loop needs per poll tick."""
+        from avenir_tpu.stream.scaleout import read_heartbeats
+        self.note_heartbeats(read_heartbeats(self.client))
+        return self.step(now)
+
+    def step(self, now: Optional[float] = None
+             ) -> Optional[AssignmentRecord]:
+        """Rewrite the assignment iff the alive membership differs from
+        the serving membership. Returns the new record when one was
+        written. With every known worker dead/removed the current record
+        stands — groups must never be left ownerless (events queue up
+        for the next join instead)."""
+        t_now = time.time() if now is None else now
+        liveness = self._liveness(t_now)
+        members = sorted(w for w, info in liveness.items()
+                         if w not in self.removed and not info["dead"])
+        if not members:
+            return None
+        # compare against the membership the CURRENT record was computed
+        # from (not the owner set derived from it): with more workers
+        # than groups a groupless-but-alive worker is normal, not a
+        # membership change — comparing owners would churn epochs on
+        # every tick
+        serving = self.record.members or self.record.workers()
+        if members == serving and self.record.epoch > 0:
+            return None
+        assign = rebalance_assignment(self.groups, members,
+                                      self.record.groups)
+        # a moved group's acquirer waits for the release-publish only
+        # when the previous owner is around to publish it: any worker
+        # with a fresh heartbeat (members AND removed-but-healthy
+        # leavers), not a dead one
+        fresh = {w for w, info in liveness.items() if not info["dead"]}
+        handoff = [g for g, w in assign.items()
+                   if self.record.groups.get(g) not in (None, w)
+                   and self.record.groups[g] in fresh]
+        self.record = AssignmentRecord(self.record.epoch + 1, assign,
+                                       handoff=handoff, members=members)
+        write_assignment(self.client, self.record)
+        _hub_gauges({"rebalance.epoch": self.record.epoch})
+        return self.record
+
+    def stop_fleet(self) -> AssignmentRecord:
+        """Flag the run as over: workers that own nothing exit; owners
+        exit once their groups' stop sentinels arrive."""
+        self.record = AssignmentRecord(self.record.epoch + 1,
+                                       dict(self.record.groups),
+                                       handoff=[],
+                                       members=list(self.record.members),
+                                       stop=True)
+        write_assignment(self.client, self.record)
+        return self.record
+
+
+# --------------------------------------------------------------------------
+# worker side: watch, release, acquire
+# --------------------------------------------------------------------------
+
+def publish_handoff(registry, group: str, state, epoch: int,
+                    worker_id: int):
+    """Publish-on-release: the departing owner's final learner state for
+    ``group``, tagged so the acquirer can find exactly this epoch's
+    snapshot."""
+    return registry.publish(state, kind=HANDOFF_KIND,
+                            extra={"group": group, "epoch": int(epoch),
+                                   "worker": int(worker_id)})
+
+
+class WorkerRebalancer:
+    """Worker-side half of the rebalance protocol.
+
+    ``make_server(group)`` builds the per-group serving object (a
+    ``ServingEngine`` in the elastic worker) with a fresh learner;
+    ``sync()`` is called at batch boundaries — the only points a release
+    can be clean (nothing popped-but-unanswered) — and applies any new
+    epoch: release first (publish every departing group's state), then
+    acquire (reclaim the ledger, restore the handoff snapshot,
+    schema-checked). Servers the caller should run live in ``servers``;
+    released/retired ones move to ``retired`` so their stats survive."""
+
+    def __init__(self, client, worker_id: int, make_server:
+                 Callable[[str], Any], registry=None,
+                 min_poll_interval_s: float = 0.0,
+                 handoff_wait_s: float = HANDOFF_WAIT_S):
+        self.client = client
+        self.worker_id = int(worker_id)
+        self.make_server = make_server
+        self.registry = registry
+        self.servers: Dict[str, Any] = {}
+        self.retired: List = []        # (group, server) after release
+        self.epoch = 0
+        self.stop = False
+        self.released = 0
+        self.acquired = 0
+        self.handoff_swap_ms: List[float] = []
+        self.handoff_wait_ms: List[float] = []
+        self.handoff_wait_s = float(handoff_wait_s)
+        self.min_poll_interval_s = float(min_poll_interval_s)
+        self._last_poll = 0.0
+        self._tel = telemetry.tracer()
+
+    def sync(self, force: bool = False) -> bool:
+        """Poll the assignment record (throttled to the heartbeat-ish
+        cadence); apply a new epoch's deltas. Returns True when the
+        server set changed."""
+        if not force and self.min_poll_interval_s > 0.0:
+            now = time.monotonic()
+            if now - self._last_poll < self.min_poll_interval_s:
+                return False
+            self._last_poll = now
+        rec = read_assignment(self.client)
+        if rec is None or rec.epoch <= self.epoch:
+            return False
+        self.epoch = rec.epoch
+        self.stop = rec.stop
+        target = set(rec.owned_by(self.worker_id))
+        current = set(self.servers)
+        for g in sorted(current - target):
+            self._release(g, rec)
+        for g in sorted(target - current):
+            self._acquire(g, rec)
+        changed = current != target
+        if changed:
+            _hub_gauges({"rebalance.epoch": self.epoch,
+                         "rebalance.owned_groups": len(self.servers)})
+        return changed
+
+    def _release(self, group: str, rec: AssignmentRecord) -> None:
+        server = self.servers.pop(group)
+        if self.registry is not None:
+            publish_handoff(self.registry, group, server.learner.state,
+                            rec.epoch, self.worker_id)
+        self.retired.append((group, server))
+        self.released += 1
+
+    def _wait_for_handoff(self, group: str, rec: AssignmentRecord):
+        """The releasing owner publishes on ITS next sync, so the
+        acquirer may see the new epoch first: poll for the tagged
+        snapshot (expected only when the record says the old owner is
+        alive to publish it), fall back to the newest handoff for the
+        group — or None (dead predecessor: reclaim already replayed its
+        ledger; a fresh learner plus the reward stream is the recovery
+        state)."""
+        if self.registry is None:
+            return None
+        deadline = (time.monotonic() + self.handoff_wait_s
+                    if group in rec.handoff else time.monotonic())
+        while True:
+            snap = self.registry.latest_where(kind=HANDOFF_KIND,
+                                              group=group)
+            if snap is not None:
+                epoch = (snap.manifest.get("extra") or {}).get("epoch")
+                # >= because a releaser that slept through epochs syncs
+                # straight to the newest record and tags its publish
+                # with THAT epoch
+                if isinstance(epoch, int) and epoch >= rec.epoch:
+                    return snap
+            if time.monotonic() >= deadline:
+                return snap        # newest older handoff, or None
+            time.sleep(0.02)
+
+    def _acquire(self, group: str, rec: AssignmentRecord) -> None:
+        from avenir_tpu.lifecycle.registry import state_schema_hash
+        from avenir_tpu.stream.loop import reclaim_pending
+        server = self.make_server(group)
+        # a dead predecessor's un-acked pops replay to the new owner;
+        # graceful handoffs left the ledger empty (batch-boundary
+        # release) so this is a no-op round trip
+        reclaim_pending(self.client, f"pendingQueue:{group}",
+                        f"eventQueue:{group}")
+        t_wait = time.perf_counter()
+        snap = self._wait_for_handoff(group, rec)
+        t_swap = time.perf_counter()
+        self.handoff_wait_ms.append((t_swap - t_wait) * 1e3)
+        if snap is not None:
+            try:
+                if not snap.has_payload:
+                    raise ValueError(f"handoff v{snap.version} carries "
+                                     f"no pytree payload")
+                like = server.learner.state
+                if (snap.schema_hash is not None
+                        and snap.schema_hash != state_schema_hash(like)):
+                    raise ValueError(
+                        f"handoff v{snap.version} schema "
+                        f"{snap.schema_hash} != live state")
+                server.swap_state(snap.restore(like=like),
+                                  version=snap.version)
+            except Exception:
+                # schema-checked contract: a bad snapshot must not take
+                # the acquiring worker down — alarm and serve fresh
+                _hub_gauges({"rebalance.handoff_rejected": 1.0})
+        ms = (time.perf_counter() - t_swap) * 1e3
+        self.handoff_swap_ms.append(ms)
+        if self._tel.enabled:
+            self._tel.record("rebalance.handoff", ms)
+        self.servers[group] = server
+        self.acquired += 1
+
+    def retire(self, group: str) -> None:
+        """Move a sentinel-stopped group's server out of the active set
+        (stream over — no release-publish)."""
+        server = self.servers.pop(group, None)
+        if server is not None:
+            self.retired.append((group, server))
+
+    def all_servers(self) -> List:
+        """Live + retired servers (stats aggregation)."""
+        return list(self.servers.values()) + [s for _, s in self.retired]

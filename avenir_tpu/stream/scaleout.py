@@ -162,6 +162,41 @@ def worker_throughput(heartbeats: Sequence[Dict]) -> Dict[int, float]:
     return out
 
 
+# a worker whose last heartbeat is older than this many cadence
+# intervals is DEAD, not merely slow — the liveness signal the ownership
+# rebalancer consumes (ISSUE 8): its groups get reassigned and its
+# un-acked ledger entries reclaimed by the new owners
+DEAD_AFTER_FACTOR = 3.0
+
+
+def worker_liveness(heartbeats: Sequence[Dict], cadence_s: float,
+                    now: Optional[float] = None,
+                    dead_after_factor: float = DEAD_AFTER_FACTOR
+                    ) -> Dict[int, Dict]:
+    """Per-worker liveness from the heartbeat stream: latest heartbeat
+    age against the expected cadence, ``dead=True`` past
+    ``dead_after_factor`` (default 3x) cadence intervals —
+    ``detect_stragglers`` flags slow workers, this flags gone ones.
+    Returns ``{worker_id: {"last_ts", "age_s", "events", "dead"}}``."""
+    t_now = time.time() if now is None else now
+    latest: Dict[int, Dict] = {}
+    for hb in heartbeats:
+        worker = int(hb["worker"])
+        cur = latest.get(worker)
+        if cur is None or hb["ts"] >= cur["ts"]:
+            latest[worker] = hb
+    out: Dict[int, Dict] = {}
+    for worker, hb in latest.items():
+        age = max(t_now - hb["ts"], 0.0)
+        out[worker] = {
+            "last_ts": hb["ts"],
+            "age_s": age,
+            "events": hb.get("events", 0),
+            "dead": age > dead_after_factor * cadence_s,
+        }
+    return out
+
+
 def detect_stragglers(heartbeats: Sequence[Dict],
                       min_events_fraction: float = 0.5,
                       stale_after_s: Optional[float] = None,
@@ -221,6 +256,34 @@ def worker_latency_p99(worker_reports: Dict[int, Dict]) -> Dict[int, float]:
     return out
 
 
+def _collect_worker(p: subprocess.Popen, timeout: float) -> Tuple[str, str]:
+    """``communicate()`` with a hung-worker guard (ISSUE 8 satellite):
+    a worker that outlives its budget is SIGKILLed and the failure
+    carries whatever output it produced — a raw ``TimeoutExpired`` would
+    leak the still-running process tree AND its diagnostics."""
+    try:
+        return p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # worker mode registers a SIGUSR1 faulthandler: ask the hung
+        # worker for its stacks before killing it, so the failure says
+        # WHERE it hung, not just that it did
+        try:
+            import signal as _sig
+            p.send_signal(_sig.SIGUSR1)
+            time.sleep(0.5)
+        except Exception:
+            pass
+        p.kill()
+        try:
+            out, err = p.communicate(timeout=10)
+        except Exception:
+            out, err = "", ""
+        raise RuntimeError(
+            f"worker pid {p.pid} hung past {timeout:.0f}s and was "
+            f"killed; partial stdout: {(out or '')[-500:]!r} "
+            f"partial stderr: {(err or '')[-2000:]!r}")
+
+
 class _StoppableQueues(RedisQueues):
     """Per-group queue view that retires on the driver's stop sentinel.
     Always runs with the ack/replay ledger armed: every pop is an atomic
@@ -261,6 +324,19 @@ class _StoppableQueues(RedisQueues):
             self.stopped = True
             events = events[:cut]
         return events
+
+    def shed_events(self, max_n: int, newest: bool = False):
+        """Admission shed with sentinel protection: a shed sweep that
+        swallowed the stop sentinel would discard the retire signal and
+        hang the group forever — push it back to the head (where the
+        driver put it: after every real event) and shed only the rest."""
+        if self.stopped:
+            return []
+        shed = super().shed_events(max_n, newest=newest)
+        if STOP_SENTINEL in shed:
+            shed = [e for e in shed if e != STOP_SENTINEL]
+            self._r.lpush(self.event_queue, STOP_SENTINEL)
+        return shed
 
 
 def shuffle_worker_main(host: str, port: int, worker_id: int,
@@ -371,7 +447,8 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
                 replay: bool = False, decision_io_ms: float = 0.0,
                 engine: bool = False,
                 event_timestamps: bool = False,
-                lifecycle_dir: Optional[str] = None) -> Dict:
+                lifecycle_dir: Optional[str] = None,
+                broker_reconnect: bool = False) -> Dict:
     """One serving process: loops for the owned groups until every group's
     stop sentinel arrives. Returns per-worker stats. ``replay`` implements
     ``replay.failed.message=true``: on startup, un-acked events a dead
@@ -389,8 +466,12 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
     (ISSUE 7): polled on the heartbeat-ish cadence, a newly published
     learner-state snapshot hot-swaps into every owned group's learner at
     its next step/batch boundary — the fleet re-models without a single
-    dropped event or restart."""
-    client = MiniRedisClient(host, port)
+    dropped event or restart. ``broker_reconnect`` arms the failover
+    transport (ISSUE 8): broker death surfaces as capped-backoff redials
+    + at-least-once resend instead of a worker crash, and the queue layer
+    reconciles its pending ledger after every reconnect."""
+    client = MiniRedisClient(host, port, reconnect=broker_reconnect,
+                             reconnect_timeout=30.0)
     replayed = 0
     if replay:
         for g in owned_groups(groups, worker_id, n_workers):
@@ -459,6 +540,7 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
     events_total = sum(l.stats.events for l in loops.values())
     rewards_total = sum(l.stats.rewards for l in loops.values())
     push_heartbeat(client, worker_id, events_total, rewards_total)  # final
+    reconnects = client.reconnects
     client.close()
     return {
         "worker": worker_id,
@@ -466,6 +548,7 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
         "rewards": rewards_total,
         "replayed": replayed,
         "groups": sorted(loops),
+        "broker_reconnects": reconnects,
     }
 
 
@@ -529,6 +612,7 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
     events_total = sum(e.stats.events for e in engines.values())
     rewards_total = sum(e.stats.rewards for e in engines.values())
     push_heartbeat(client, worker_id, events_total, rewards_total)  # final
+    reconnects = client.reconnects
     client.close()
     return {
         "worker": worker_id,
@@ -537,6 +621,145 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
         "replayed": replayed,
         "groups": sorted(engines),
         "engine": True,
+        "broker_reconnects": reconnects,
+    }
+
+
+# bound on one engine visit in the elastic worker: an unbounded run()
+# would drain a deep backlog before the next assignment poll, stretching
+# rebalance latency to the full drain time
+_ELASTIC_RUN_BUDGET = 256
+
+
+def elastic_worker_main(host: str, port: int, worker_id: int,
+                        groups: Sequence[str], learner_type: str,
+                        actions: Sequence[str], config: Dict, seed: int,
+                        handoff_dir: Optional[str] = None,
+                        cadence_s: float = 0.5,
+                        event_timestamps: bool = False,
+                        broker_reconnect: bool = True) -> Dict:
+    """Rebalance-aware worker (ISSUE 8): ownership comes from the
+    coordinator's epoch-numbered assignment record on the broker, not
+    static mod-N. The worker announces itself with a heartbeat (the JOIN
+    signal), serves whatever the current epoch assigns it through one
+    pipelined ``ServingEngine`` per owned group, and at every batch
+    boundary polls for a new epoch: groups it lost are RELEASED (state
+    published to the ``handoff_dir`` registry), groups it gained are
+    ACQUIRED (pending ledger reclaimed, handoff snapshot restored,
+    schema-checked) — see stream/rebalance.py for the protocol.
+    Heartbeats are TIME-based (``cadence_s``) on top of the per-batch
+    cadence, so an idle worker still proves liveness — the signal the
+    coordinator's death detection (age > 3x cadence) consumes. Exits
+    when the assignment record says ``stop`` and every owned group's
+    sentinel has retired it."""
+    from avenir_tpu.stream.engine import ServingEngine
+    from avenir_tpu.stream.rebalance import WorkerRebalancer
+    client = MiniRedisClient(host, port, reconnect=broker_reconnect,
+                             reconnect_timeout=30.0)
+    # warm jax's shared dispatch/lowering infrastructure BEFORE the join
+    # heartbeat (first-ever jit in a process costs ~1s of one-time setup
+    # beyond the per-program compile): a worker that announces itself
+    # and then stalls in compiles looks like a dying worker to the
+    # coordinator's staleness detector. Per-group learners still compile
+    # their own programs lazily (compile caches are per-instance), so
+    # the coordinator's dead_after window must stay generous around
+    # fleet membership changes.
+    from avenir_tpu.models.bandits.learners import Learner
+    from avenir_tpu.stream.engine import warm_serving_paths
+    warm = Learner(learner_type, list(actions), dict(config),
+                   seed=seed + 7919)
+    warm_serving_paths(warm, rewards=False)
+    registry = None
+    if handoff_dir:
+        from avenir_tpu.lifecycle.registry import SnapshotRegistry
+        registry = SnapshotRegistry(handoff_dir)
+        # same story for the install path: the first install_state pays
+        # the per-shape copy-dispatch compiles process-wide, and that
+        # must not land inside a timed handoff
+        from avenir_tpu.lifecycle.swap import install_state
+        scratch = Learner(learner_type, list(actions), dict(config),
+                          seed=seed)
+        install_state(scratch, warm.state)
+    progress = {"served": 0, "hb_mark": 0}
+    rb_box: Dict[str, WorkerRebalancer] = {}
+
+    def rewards_total() -> int:
+        return sum(e.stats.rewards for e in rb_box["rb"].all_servers())
+
+    def on_batch(n_events: int) -> None:
+        progress["served"] += n_events
+        if (progress["served"] - progress["hb_mark"]) >= HEARTBEAT_EVERY:
+            progress["hb_mark"] = progress["served"]
+            push_heartbeat(client, worker_id, progress["served"],
+                           rewards_total(), "elastic")
+
+    def make_server(group: str) -> ServingEngine:
+        return ServingEngine(
+            learner_type, actions, dict(config),
+            _StoppableQueues(client, group),
+            seed=seed + 1000 * worker_id + list(groups).index(group),
+            on_batch=on_batch, event_timestamps=event_timestamps)
+
+    rb = WorkerRebalancer(client, worker_id, make_server,
+                          registry=registry,
+                          min_poll_interval_s=min(cadence_s / 2, 0.25))
+    rb_box["rb"] = rb
+    push_heartbeat(client, worker_id, 0, 0, "elastic")   # the JOIN signal
+    last_hb = time.monotonic()
+    idle_sleep = 0.001
+    while True:
+        rb.sync()
+        if rb.stop and not rb.servers:
+            break
+        progressed = False
+        for g in list(rb.servers):
+            eng = rb.servers.get(g)
+            if eng is None:
+                continue
+            if eng.queues.stopped:
+                rb.retire(g)      # sentinel: stream over, no release
+                continue
+            before = eng.stats.events
+            eng.run(max_events=_ELASTIC_RUN_BUDGET)
+            progressed = eng.stats.events > before or progressed
+            if rb.stop and not eng.queues.stopped:
+                # handoff overlap can leave a group transiently served
+                # by BOTH its old and new owner, and only one of them
+                # pops the single stop sentinel. The driver pushes every
+                # sentinel before writing the stop record, so under stop
+                # an EMPTY queue means this group's sentinel went to the
+                # concurrent owner — retire, don't wait forever
+                if eng.queues.depth() == 0:
+                    rb.retire(g)
+        now_m = time.monotonic()
+        if now_m - last_hb >= cadence_s:
+            push_heartbeat(client, worker_id, progress["served"],
+                           rewards_total(), "elastic")
+            last_hb = now_m
+        if progressed:
+            idle_sleep = 0.001
+        else:
+            time.sleep(idle_sleep)
+            idle_sleep = min(idle_sleep * 2, 0.016)
+    servers = rb.all_servers()
+    events_total = sum(e.stats.events for e in servers)
+    rewards = sum(e.stats.rewards for e in servers)
+    push_heartbeat(client, worker_id, events_total, rewards, "elastic")
+    client.close()
+    return {
+        "worker": worker_id,
+        "events": events_total,
+        "rewards": rewards,
+        "replayed": 0,
+        "groups": sorted(set(g for g, _ in rb.retired)
+                         | set(rb.servers)),
+        "elastic": True,
+        "epochs": rb.epoch,
+        "released": rb.released,
+        "acquired": rb.acquired,
+        "handoff_swap_ms": [round(x, 3) for x in rb.handoff_swap_ms],
+        "handoff_wait_ms": [round(x, 3) for x in rb.handoff_wait_ms],
+        "broker_reconnects": client.reconnects,
     }
 
 
@@ -599,7 +822,11 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
                   grouping: str = "fields",
                   engine: bool = False, telemetry: bool = False,
                   event_timestamps: bool = False,
-                  lifecycle_dir: Optional[str] = None) -> subprocess.Popen:
+                  lifecycle_dir: Optional[str] = None,
+                  elastic: bool = False,
+                  handoff_dir: Optional[str] = None,
+                  cadence_s: Optional[float] = None,
+                  broker_reconnect: bool = False) -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
            "--host", host, "--port", str(port),
@@ -619,6 +846,14 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
         cmd.append("--event-timestamps")
     if lifecycle_dir:
         cmd += ["--lifecycle-dir", lifecycle_dir]
+    if elastic:
+        cmd.append("--elastic")
+    if handoff_dir:
+        cmd += ["--handoff-dir", handoff_dir]
+    if cadence_s is not None:
+        cmd += ["--cadence-s", str(cadence_s)]
+    if broker_reconnect:
+        cmd.append("--broker-reconnect")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True)
 
@@ -630,14 +865,16 @@ def _spawn_workers(host: str, port: int, n_workers: int,
                    grouping: str = "fields",
                    engine: bool = False, telemetry: bool = False,
                    event_timestamps: bool = False,
-                   lifecycle_dir: Optional[str] = None
+                   lifecycle_dir: Optional[str] = None,
+                   broker_reconnect: bool = False
                    ) -> List[subprocess.Popen]:
     return [_spawn_worker(host, port, w, n_workers, groups, learner_type,
                           actions, config, seed,
                           decision_io_ms=decision_io_ms, grouping=grouping,
                           engine=engine, telemetry=telemetry,
                           event_timestamps=event_timestamps,
-                          lifecycle_dir=lifecycle_dir)
+                          lifecycle_dir=lifecycle_dir,
+                          broker_reconnect=broker_reconnect)
             for w in range(n_workers)]
 
 
@@ -792,7 +1029,7 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
                     client.lpush(f"eventQueue:{g}", STOP_SENTINEL)
             worker_stats = []
             for p in procs:
-                out, err = p.communicate(timeout=120)
+                out, err = _collect_worker(p, timeout=120)
                 if p.returncode != 0:
                     raise RuntimeError(f"worker failed: {err[-1500:]}")
                 worker_stats.append(json.loads(out.splitlines()[-1]))
@@ -936,7 +1173,7 @@ def run_chaos(n_workers: int = 2, *, n_groups: int = 4, n_actions: int = 4,
                 client.lpush(f"eventQueue:{g}", STOP_SENTINEL)
             worker_stats = []
             for p in procs:
-                out, err = p.communicate(timeout=60)
+                out, err = _collect_worker(p, timeout=60)
                 if p.returncode != 0:
                     raise RuntimeError(f"worker failed: {err[-1500:]}")
                 worker_stats.append(json.loads(out.splitlines()[-1]))
@@ -952,6 +1189,301 @@ def run_chaos(n_workers: int = 2, *, n_groups: int = 4, n_actions: int = 4,
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+@dataclass
+class RebalanceResult:
+    n_events: int
+    unique_answered: int          # after driver-side dedup by event id
+    duplicates: int
+    epochs: int                   # final assignment epoch
+    released: int                 # groups released across the fleet
+    acquired: int                 # groups acquired across the fleet
+    handoff_swap_ms: List[float] = field(default_factory=list)
+    handoff_wait_ms: List[float] = field(default_factory=list)
+    pending_left: int = 0
+    left_at: int = -1             # unique answers when the leave fired
+    joined_at: int = -1           # unique answers when the join fired
+    worker_stats: List[Dict] = field(default_factory=list)
+
+
+def run_rebalance(*, n_groups: int = 6, n_actions: int = 4,
+                  n_events: int = 360, learner_type: str = "softMax",
+                  seed: int = 17, host: str = "localhost",
+                  cadence_s: float = 0.4,
+                  dead_after_factor: float = 100.0,
+                  timeout_s: float = 240.0,
+                  server: Optional[MiniRedisServer] = None
+                  ) -> RebalanceResult:
+    """Elastic-serving scenario (chaos harness v2, ISSUE 8): two workers
+    bootstrap through the coordinator's epoch-1 assignment; mid-stream
+    worker 0 LEAVES (coordinator-directed — it publishes every owned
+    group's learner state on release) and a brand-new worker 2 JOINS
+    (announced by its first heartbeat; it acquires its groups' state
+    through the registry). Events flow the whole time; the contract under
+    test is the Storm one: every event answered exactly once after the
+    driver's dedup, the pending ledgers fully retired, and ownership
+    moving only through epoch-numbered assignment swaps.
+
+    ``dead_after_factor`` is deliberately generous by default: this
+    scenario exercises directed leave + join; death detection is timing-
+    sensitive on a loaded box and has its own unit coverage."""
+    import tempfile
+    import numpy as np
+    from avenir_tpu.stream.rebalance import Coordinator
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    ctr = {g: {a: (0.8 if i == int(rng.integers(n_actions)) else 0.15)
+               for i, a in enumerate(actions)} for g in groups}
+    config = {"current.decision.round": 1, "batch.size": 4}
+
+    procs: Dict[int, subprocess.Popen] = {}
+    try:
+        with tempfile.TemporaryDirectory() as handoff_dir, \
+                _broker(host, server) as (client, broker_host, port):
+            from avenir_tpu.lifecycle.registry import SnapshotRegistry
+            from avenir_tpu.stream.rebalance import HANDOFF_KIND
+            registry = SnapshotRegistry(handoff_dir)
+
+            def spawn(worker_id: int) -> subprocess.Popen:
+                return _spawn_worker(
+                    broker_host, port, worker_id, 0, groups, learner_type,
+                    actions, config, seed, elastic=True,
+                    handoff_dir=handoff_dir, cadence_s=cadence_s)
+
+            coord = Coordinator(client, groups, cadence_s=cadence_s,
+                                dead_after_factor=dead_after_factor)
+            procs[0] = spawn(0)
+            procs[1] = spawn(1)
+            deadline = time.monotonic() + timeout_s
+            # epoch 1 lands once both workers have announced themselves
+            while len(coord.alive_workers()) < 2:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("workers never joined")
+                coord.observe()
+                time.sleep(0.02)
+            assert coord.record.epoch >= 1
+
+            answered: set = set()
+            duplicates = 0
+            sent = 0
+            leave_mark = n_events // 4
+            join_mark = n_events // 2
+            # the final slice injects only after the JOIN epoch lands,
+            # so post-join traffic provably flows through the rebalanced
+            # assignment (the joiner owns groups; ownership means only
+            # it can serve them)
+            hold_mark = (3 * n_events) // 4
+            left_at = joined_at = -1
+            join_settled = False
+            while len(answered) < n_events:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"rebalance run stalled: {len(answered)}/"
+                        f"{n_events} answered (epoch "
+                        f"{coord.record.epoch})")
+                if not join_settled and joined_at >= 0:
+                    rec = coord.record
+                    # the join epoch has SETTLED once the joiner owns
+                    # groups AND the old owner's release-publishes for
+                    # this epoch are committed — past that point the old
+                    # owner is no longer serving the moved groups, so
+                    # the held-back traffic provably flows through the
+                    # joiner (ownership: only the owner can serve)
+                    join_settled = 2 in rec.workers() and all(
+                        (snap := registry.latest_where(
+                            kind=HANDOFF_KIND, group=g)) is not None
+                        and (snap.manifest.get("extra") or {}
+                             ).get("epoch") == rec.epoch
+                        for g in rec.owned_by(2))
+                if sent < n_events and (sent < hold_mark or join_settled):
+                    g = groups[sent % len(groups)]
+                    client.lpush(f"eventQueue:{g}", f"{g}:{sent}")
+                    sent += 1
+                raw = client.rpop("actionQueue")
+                if raw is None:
+                    time.sleep(0.001)
+                else:
+                    event_id, _, action = raw.decode().partition(",")
+                    action = action.split(",")[0]
+                    g = event_id.partition(":")[0]
+                    if event_id in answered:
+                        duplicates += 1
+                    else:
+                        answered.add(event_id)
+                        reward = (1.0 if rng.random() < ctr[g][action]
+                                  else 0.0)
+                        client.lpush(f"rewardQueue:{g}",
+                                     f"{action},{reward}")
+                coord.observe()     # joins + liveness on every tick
+                if left_at < 0 and len(answered) >= leave_mark:
+                    left_at = len(answered)
+                    coord.remove_worker(0)
+                if joined_at < 0 and len(answered) >= join_mark:
+                    joined_at = len(answered)
+                    procs[2] = spawn(2)
+
+            for g in groups:
+                client.lpush(f"eventQueue:{g}", STOP_SENTINEL)
+            coord.stop_fleet()
+            worker_stats = []
+            for worker_id in sorted(procs):
+                out, err = _collect_worker(procs[worker_id], timeout=90)
+                if procs[worker_id].returncode != 0:
+                    raise RuntimeError(
+                        f"worker {worker_id} failed: {err[-1500:]}")
+                worker_stats.append(json.loads(out.splitlines()[-1]))
+            pending_left = sum(client.llen(f"pendingQueue:{g}")
+                               for g in groups)
+            return RebalanceResult(
+                n_events=n_events,
+                unique_answered=len(answered),
+                duplicates=duplicates,
+                epochs=coord.record.epoch,
+                released=sum(w.get("released", 0) for w in worker_stats),
+                acquired=sum(w.get("acquired", 0) for w in worker_stats),
+                handoff_swap_ms=sorted(
+                    ms for w in worker_stats
+                    for ms in w.get("handoff_swap_ms", [])),
+                handoff_wait_ms=sorted(
+                    ms for w in worker_stats
+                    for ms in w.get("handoff_wait_ms", [])),
+                pending_left=pending_left,
+                left_at=left_at, joined_at=joined_at,
+                worker_stats=worker_stats)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+@dataclass
+class BrokerChaosResult:
+    n_events: int
+    unique_answered: int          # after driver-side dedup by event id
+    duplicates: int
+    broker_killed_at: int         # unique answers when the SIGKILL fired
+    pending_left: int = 0
+    worker_reconnects: int = 0    # redials across the worker fleet
+    driver_reconnects: int = 0
+    worker_stats: List[Dict] = field(default_factory=list)
+
+
+def run_broker_chaos(n_workers: int = 2, *, n_groups: int = 4,
+                     n_actions: int = 4, n_events: int = 240,
+                     kill_at: int = 60, learner_type: str = "softMax",
+                     seed: int = 13, host: str = "localhost",
+                     timeout_s: float = 240.0) -> BrokerChaosResult:
+    """Broker fault-tolerance scenario (chaos harness v2, ISSUE 8): the
+    broker subprocess is SIGKILLed mid-run — with worker sweeps in
+    flight — and restarted on the same port over the same append-only
+    command log. Reconnect-armed clients redial with capped backoff and
+    resend; the queue layer reconciles each worker's pending ledger
+    (``recover_in_flight``), replaying pops whose replies died with the
+    broker. After the driver's dedup every event is answered exactly
+    once: the crash turns into bounded duplicates, never loss."""
+    import signal as _signal
+    import tempfile
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    ctr = {g: {a: (0.8 if i == int(rng.integers(n_actions)) else 0.15)
+               for i, a in enumerate(actions)} for g in groups}
+    config = {"current.decision.round": 1, "batch.size": 4}
+
+    import socket as _socket
+    with _socket.socket() as s:
+        s.bind((host, 0))
+        port = s.getsockname()[1]
+
+    procs: List[subprocess.Popen] = []
+    broker_proc: Optional[subprocess.Popen] = None
+    with tempfile.TemporaryDirectory() as tmp:
+        aof = os.path.join(tmp, "broker.aof")
+
+        def spawn_broker() -> subprocess.Popen:
+            return subprocess.Popen(
+                [sys.executable, "-m", "avenir_tpu.stream.miniredis",
+                 "--host", host, "--port", str(port), "--aof", aof],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        try:
+            broker_proc = spawn_broker()
+            client = connect_with_retry(host, port, reconnect=True,
+                                        reconnect_timeout=30.0)
+            client.flushall()
+            procs = _spawn_workers(host, port, n_workers, groups,
+                                   learner_type, actions, config, seed,
+                                   engine=True, broker_reconnect=True)
+            for sent in range(n_events):
+                g = groups[sent % len(groups)]
+                client.lpush(f"eventQueue:{g}", f"{g}:{sent}")
+
+            answered: set = set()
+            duplicates = 0
+            killed_at = -1
+            deadline = time.monotonic() + timeout_s
+            while len(answered) < n_events:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"broker-chaos run stalled: {len(answered)}/"
+                        f"{n_events} answered, {duplicates} duplicates")
+                raw = client.rpop("actionQueue")
+                if raw is None:
+                    time.sleep(0.001)
+                else:
+                    event_id, _, action = raw.decode().partition(",")
+                    action = action.split(",")[0]
+                    g = event_id.partition(":")[0]
+                    if event_id in answered:
+                        duplicates += 1
+                    else:
+                        answered.add(event_id)
+                        reward = (1.0 if rng.random() < ctr[g][action]
+                                  else 0.0)
+                        client.lpush(f"rewardQueue:{g}",
+                                     f"{action},{reward}")
+                if killed_at < 0 and len(answered) >= kill_at:
+                    # SIGKILL: no flush, no goodbye — worker pipelines
+                    # lose their in-flight replies mid-batch. The AOF
+                    # already holds every executed mutation, so the
+                    # restart resumes the pre-crash store.
+                    killed_at = len(answered)
+                    broker_proc.send_signal(_signal.SIGKILL)
+                    broker_proc.wait(timeout=30)
+                    broker_proc = spawn_broker()
+
+            for g in groups:
+                client.lpush(f"eventQueue:{g}", STOP_SENTINEL)
+            worker_stats = []
+            for p in procs:
+                out, err = _collect_worker(p, timeout=90)
+                if p.returncode != 0:
+                    raise RuntimeError(f"worker failed: {err[-1500:]}")
+                worker_stats.append(json.loads(out.splitlines()[-1]))
+            pending_left = sum(client.llen(f"pendingQueue:{g}")
+                               for g in groups)
+            driver_reconnects = client.reconnects
+            client.close()
+            return BrokerChaosResult(
+                n_events=n_events,
+                unique_answered=len(answered),
+                duplicates=duplicates,
+                broker_killed_at=killed_at,
+                pending_left=pending_left,
+                worker_reconnects=sum(
+                    w.get("broker_reconnects", 0) for w in worker_stats),
+                driver_reconnects=driver_reconnects,
+                worker_stats=worker_stats)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            if broker_proc is not None and broker_proc.poll() is None:
+                broker_proc.terminate()
+                broker_proc.wait(timeout=10)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -998,6 +1530,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "published learner-state snapshots at batch "
                          "boundaries, polled on the heartbeat cadence "
                          "(fields grouping)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="worker mode: ownership from the coordinator's "
+                         "epoch-numbered assignment record instead of "
+                         "static mod-N; release/acquire groups on "
+                         "rebalance (ISSUE 8)")
+    ap.add_argument("--handoff-dir", default=None, metavar="PATH",
+                    help="elastic mode: snapshot registry for ownership "
+                         "handoff (publish-on-release, "
+                         "restore-on-acquire)")
+    ap.add_argument("--cadence-s", type=float, default=0.5,
+                    help="elastic mode: time-based heartbeat cadence — "
+                         "the coordinator's liveness unit (dead after "
+                         "3x)")
+    ap.add_argument("--broker-reconnect", action="store_true",
+                    help="worker mode: survive broker restarts — redial "
+                         "with capped backoff + jitter, resend in-flight "
+                         "sweeps, reconcile the pending ledger "
+                         "(ISSUE 8)")
     ap.add_argument("--metrics-out", default=None, metavar="PATH",
                     help="driver mode: arm worker telemetry and write the "
                          "merged FLEET report (JSONL + .prom) here")
@@ -1023,7 +1573,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # report; worker_id in meta keeps the fleet merge attributable
             from avenir_tpu.obs import exporters as obs_exporters
             obs_exporters.hub().enable().set_meta(worker_id=args.worker_id)
-        if args.grouping == "shuffle":
+        if args.elastic:
+            stats = elastic_worker_main(
+                args.host, args.port, args.worker_id,
+                args.groups.split(","),
+                args.learner_type, args.actions.split(","),
+                json.loads(args.config), args.seed,
+                handoff_dir=args.handoff_dir,
+                cadence_s=args.cadence_s,
+                event_timestamps=args.event_timestamps,
+                broker_reconnect=True)
+        elif args.grouping == "shuffle":
             stats = shuffle_worker_main(
                 args.host, args.port, args.worker_id,
                 args.n_workers, args.groups.split(","),
@@ -1041,7 +1601,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 decision_io_ms=args.decision_io_ms,
                 engine=args.engine,
                 event_timestamps=args.event_timestamps,
-                lifecycle_dir=args.lifecycle_dir)
+                lifecycle_dir=args.lifecycle_dir,
+                broker_reconnect=args.broker_reconnect)
         print(json.dumps(stats), flush=True)
         return 0
 

@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""Chaos harness v2 smoke gate (ISSUE 8 CI guard).
+
+Three fault scenarios over real broker subprocesses / sockets, each with
+hard pass/fail gates (non-zero exit on any failure):
+
+1. **Broker SIGKILL + restart** (``run_broker_chaos``): the broker
+   subprocess is SIGKILLed with worker pipelines in flight and restarted
+   on the same port over its append-only command log. Gates: every event
+   answered exactly once after dedup (ZERO lost), pending ledgers fully
+   retired, the kill actually fired mid-run, and at least one worker
+   actually exercised the reconnect path.
+
+2. **Worker leave + join rebalance** (``run_rebalance``): two workers
+   bootstrap through the coordinator's epoch-1 assignment; worker 0
+   leaves (publish-on-release), worker 2 joins (restore-on-acquire), and
+   the final quarter of traffic is injected only after the join epoch
+   settles — so the joiner provably serves. Gates: exactly-once after
+   dedup, >= 3 assignment epochs, every released group re-acquired, the
+   joiner served events from handed-off state, ledger clean, and the
+   handoff swap (restore + schema check + install) p99 <= 500ms.
+
+3. **Sustained overload + admission control**: one pipelined engine
+   against a live producer pushing ~4x the high-water mark in flight,
+   admission control armed (reject-new). Gates: EXACT shed accounting —
+   admitted + shed == produced, to the event; shedding actually engaged;
+   p99 decision latency of ADMITTED events under the serving_smoke SLO
+   bound; and full recovery — a post-overload wave is served 100%
+   shed-free.
+
+Prints ONE JSON line consumed by bench.py / CI.
+
+Usage: python scripts/chaos_smoke.py [--events N] [--p99-ms MS]
+       [--handoff-p99-ms MS] [--skip-gates]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu":  # pragma: no cover - TPU-pinned hosts
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+
+ACTIONS = ["a0", "a1", "a2", "a3"]
+CONFIG = {"current.decision.round": 1, "batch.size": 2}
+LEARNER = "softMax"
+SEED = 13
+P99_BOUND_MS = 500.0          # the serving_smoke SLO bound
+HANDOFF_P99_BOUND_MS = 500.0  # ISSUE 8 handoff-swap gate
+HIGH_WATER = 512
+LOW_WATER = 128
+OVERLOAD_EVENTS = 4 * HIGH_WATER   # in-flight target: 4x the high water
+RECOVERY_EVENTS = 96               # post-overload shed-free wave
+
+
+def fail(msg: str) -> None:
+    print(f"chaos_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+# --------------------------------------------------------------------------
+# gate 1: broker SIGKILL + restart
+# --------------------------------------------------------------------------
+
+def gate_broker_kill(events: int) -> dict:
+    from avenir_tpu.stream.scaleout import run_broker_chaos
+    r = run_broker_chaos(2, n_groups=4, n_events=events,
+                         kill_at=events // 4, learner_type=LEARNER,
+                         seed=SEED)
+    if r.unique_answered != r.n_events:
+        fail(f"broker-kill lost events: {r.unique_answered}/{r.n_events}")
+    if r.pending_left != 0:
+        fail(f"broker-kill left {r.pending_left} un-acked ledger entries")
+    if r.broker_killed_at < events // 4:
+        fail(f"broker kill never fired (killed_at={r.broker_killed_at})")
+    if r.worker_reconnects + r.driver_reconnects < 1:
+        fail("no client ever reconnected — the kill tested nothing")
+    return {
+        "events": r.n_events,
+        "duplicates": r.duplicates,
+        "broker_killed_at": r.broker_killed_at,
+        "worker_reconnects": r.worker_reconnects,
+        "driver_reconnects": r.driver_reconnects,
+        "zero_lost_after_dedup": True,
+    }
+
+
+# --------------------------------------------------------------------------
+# gate 2: worker leave + join rebalance
+# --------------------------------------------------------------------------
+
+def gate_rebalance(events: int, handoff_p99_ms: float,
+                   skip_gates: bool) -> dict:
+    from avenir_tpu.obs.telemetry import percentiles
+    from avenir_tpu.stream.scaleout import run_rebalance
+    r = run_rebalance(n_groups=6, n_events=events, learner_type=LEARNER,
+                      seed=SEED + 4)
+    if r.unique_answered != r.n_events:
+        fail(f"rebalance lost events: {r.unique_answered}/{r.n_events}")
+    if r.pending_left != 0:
+        fail(f"rebalance left {r.pending_left} un-acked ledger entries")
+    if r.epochs < 3:
+        fail(f"expected >= 3 assignment epochs (bootstrap/leave/join), "
+             f"got {r.epochs}")
+    if r.released < 3 or r.acquired < r.released:
+        fail(f"handoff counts off: released={r.released} "
+             f"acquired={r.acquired}")
+    joiner = next((w for w in r.worker_stats if w["worker"] == 2), None)
+    if joiner is None or joiner.get("acquired", 0) < 1:
+        fail(f"joiner never acquired groups: {joiner}")
+    if joiner["events"] < 1:
+        fail("joiner served nothing — the join rebalance was cosmetic")
+    pct = percentiles(r.handoff_swap_ms)
+    if pct[99] > handoff_p99_ms and not skip_gates:
+        fail(f"handoff swap p99 {pct[99]:.1f}ms exceeds "
+             f"{handoff_p99_ms:.0f}ms ({r.handoff_swap_ms})")
+    return {
+        "events": r.n_events,
+        "duplicates": r.duplicates,
+        "epochs": r.epochs,
+        "released": r.released,
+        "acquired": r.acquired,
+        "joiner_events": joiner["events"],
+        "handoff_swap_p50_ms": round(pct[50], 3),
+        "handoff_swap_p99_ms": round(pct[99], 3),
+        "handoff_swap_p99_bound_ms": handoff_p99_ms,
+        "exactly_once_after_dedup": True,
+    }
+
+
+# --------------------------------------------------------------------------
+# gate 3: sustained overload + admission control
+# --------------------------------------------------------------------------
+
+def _warmed_learner(seed: int):
+    """Every jitted select/reward shape a live run can trickle into,
+    pre-compiled on the learner that will actually serve (compile
+    caches are per-instance), state reset after — a compile inside a
+    timed batch would masquerade as an SLO miss."""
+    from avenir_tpu.models.bandits.learners import Learner
+    from avenir_tpu.stream.engine import warm_serving_paths
+    import jax.numpy as jnp
+    learner = Learner(LEARNER, ACTIONS, dict(CONFIG), seed=seed)
+    state0 = jax.tree_util.tree_map(jnp.array, learner.state)
+    warm_serving_paths(learner)
+    learner.state = state0
+    return learner
+
+
+def _run_overload_once(p99_bound_ms: float, skip_gates: bool) -> dict:
+    from avenir_tpu.obs import telemetry
+    from avenir_tpu.stream.engine import AdmissionControl, ServingEngine
+    from avenir_tpu.stream.loop import RedisQueues
+    from avenir_tpu.stream.miniredis import MiniRedisClient, MiniRedisServer
+
+    with MiniRedisServer() as srv:
+        producer_client = MiniRedisClient(srv.host, srv.port)
+        client = MiniRedisClient(srv.host, srv.port)
+        queues = RedisQueues(client=client, pending_queue="pendingQueue")
+        admission = AdmissionControl(high_water=HIGH_WATER,
+                                     low_water=LOW_WATER,
+                                     policy="reject-new", shed_chunk=256)
+        engine = ServingEngine(LEARNER, ACTIONS, dict(CONFIG), queues,
+                               seed=SEED, admission=admission,
+                               learner=_warmed_learner(SEED))
+        telemetry.enable(True)
+        produced = {"n": 0}
+        done = threading.Event()
+
+        # front-load 4x the high-water mark BEFORE the engine runs: the
+        # first depth poll must see genuine overload, not a race with
+        # the producer's ramp
+        for i in range(OVERLOAD_EVENTS):
+            producer_client.lpush("eventQueue", f"e{i:05d}")
+            produced["n"] += 1
+
+        def producer() -> None:
+            # ... and keep pushing while the engine serves — sustained
+            # pressure, not one burst
+            for i in range(OVERLOAD_EVENTS, 2 * OVERLOAD_EVENTS):
+                producer_client.lpush("eventQueue", f"e{i:05d}")
+                produced["n"] += 1
+                if i % 32 == 0:
+                    time.sleep(0.001)
+            done.set()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while not done.is_set() or (queues.depth() or 0) > 0:
+                engine.run()
+                time.sleep(0.002)
+        finally:
+            telemetry.enable(False)
+        t.join(timeout=30)
+        overload_admitted = engine.stats.events
+        overload_shed = engine.stats.shed_total
+        if engine.stats.events + engine.stats.shed_total != produced["n"]:
+            fail(f"shed accounting broken: admitted {engine.stats.events}"
+                 f" + shed {engine.stats.shed_total} != produced "
+                 f"{produced['n']}")
+        if engine.stats.shed_total == 0:
+            fail("overload never engaged admission control")
+        if admission.shedding:
+            fail("engine did not recover below the low-water mark")
+
+        snap = telemetry.tracer().snapshot().get("engine.decision_latency")
+        telemetry.tracer().reset()
+        if not snap or snap["count"] != overload_admitted:
+            fail(f"decision-latency count {snap and snap['count']} != "
+                 f"admitted {overload_admitted}")
+
+        # recovery: a calm wave must be served 100% shed-free
+        for i in range(RECOVERY_EVENTS):
+            producer_client.lpush("eventQueue", f"r{i:04d}")
+        engine.run()
+        recovery_admitted = engine.stats.events - overload_admitted
+        if engine.stats.shed_total != overload_shed:
+            fail(f"engine shed {engine.stats.shed_total - overload_shed} "
+                 f"events AFTER load dropped")
+        if recovery_admitted != RECOVERY_EVENTS:
+            fail(f"recovery wave served {recovery_admitted}/"
+                 f"{RECOVERY_EVENTS}")
+        if client.llen("pendingQueue") != 0:
+            fail("overload run left un-acked ledger entries")
+        client.close()
+        producer_client.close()
+
+    return {
+        "produced": produced["n"] + RECOVERY_EVENTS,
+        "admitted": engine.stats.events,
+        "shed": engine.stats.shed_total,
+        "accounting_exact": True,
+        "recovered_shed_free": True,
+        "decision_latency_p50_ms": round(snap["p50_ms"], 3),
+        "decision_latency_p99_ms": round(snap["p99_ms"], 3),
+        "decision_latency_p99_bound_ms": p99_bound_ms,
+    }
+
+
+def gate_overload(p99_bound_ms: float, skip_gates: bool) -> dict:
+    out = _run_overload_once(p99_bound_ms, skip_gates)
+    if out["decision_latency_p99_ms"] > p99_bound_ms and not skip_gates:
+        # one retry absorbs a co-tenant load spike (the serving_smoke
+        # discipline); the accounting gates inside already ran strict
+        retry = _run_overload_once(p99_bound_ms, skip_gates)
+        if retry["decision_latency_p99_ms"] < out["decision_latency_p99_ms"]:
+            out = retry
+    if out["decision_latency_p99_ms"] > p99_bound_ms and not skip_gates:
+        fail(f"admitted-event p99 {out['decision_latency_p99_ms']:.2f}ms "
+             f"exceeds the {p99_bound_ms:.0f}ms SLO under overload")
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=240,
+                    help="events per subprocess scenario (gates 1-2)")
+    ap.add_argument("--p99-ms", type=float, default=P99_BOUND_MS,
+                    help="admitted-event decision-latency SLO (gate 3)")
+    ap.add_argument("--handoff-p99-ms", type=float,
+                    default=HANDOFF_P99_BOUND_MS,
+                    help="handoff swap p99 bound (gate 2)")
+    ap.add_argument("--skip-gates", action="store_true",
+                    help="measure and report without failing the latency "
+                         "gates (bench mode on a loaded host)")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    broker_kill = gate_broker_kill(args.events)
+    rebalance = gate_rebalance(max(args.events, 240), args.handoff_p99_ms,
+                               args.skip_gates)
+    overload = gate_overload(args.p99_ms, args.skip_gates)
+
+    print("chaos_smoke OK", file=sys.stderr)
+    print(json.dumps({
+        "chaos_smoke": "ok",
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+        "broker_kill": broker_kill,
+        "rebalance": rebalance,
+        "overload": overload,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

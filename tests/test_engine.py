@@ -12,7 +12,8 @@ import numpy as np
 import pytest
 
 from avenir_tpu.stream.engine import (
-    GroupedServingEngine, ServingEngine, _AdaptiveCap)
+    AdmissionControl, EngineStats, GroupedServingEngine, ServingEngine,
+    _AdaptiveCap)
 from avenir_tpu.stream.loop import (
     GroupedLearner, InProcQueues, OnlineLearnerLoop, RedisQueues,
     reclaim_pending)
@@ -608,6 +609,169 @@ class TestTelemetryAndCallbacks:
                 actions.append(raw.decode().split(",")[0])
             assert actions == [f"e{i:02d}" for i in range(20)]
             client.close()
+
+
+class TestAdmissionControl:
+    """ISSUE 8: bounded-depth gate — hysteresis latch, both shed
+    policies, exact accounting, automatic recovery."""
+
+    CONFIG = {"current.decision.round": 1, "batch.size": 2}
+
+    def test_hysteresis_latch(self):
+        adm = AdmissionControl(high_water=100, low_water=25)
+        assert adm.update(50) is False
+        assert adm.update(101) is True       # past high: shed
+        assert adm.update(60) is True        # between marks: keep shedding
+        assert adm.update(25) is False       # at/below low: recover
+        assert adm.update(100) is False      # needs > high to re-enter
+        assert adm.update(None) is False     # unknown depth never sheds
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AdmissionControl(100, policy="nonsense")
+        with pytest.raises(ValueError):
+            AdmissionControl(100, low_water=200)
+        assert AdmissionControl(100).low_water == 25   # high // 4
+
+    def test_split_policies(self):
+        popped = ["e0", "e1", "e2", "e3", "e4"]
+        adm = AdmissionControl(10, policy="reject-new")
+        assert adm.split(popped, 3) == (["e0", "e1", "e2"], ["e3", "e4"])
+        adm = AdmissionControl(10, policy="drop-oldest")
+        assert adm.split(popped, 3) == (["e2", "e3", "e4"], ["e0", "e1"])
+        assert adm.split(popped, 9) == (popped, [])
+
+    @pytest.mark.parametrize("policy", ["reject-new", "drop-oldest"])
+    def test_exact_accounting_and_recovery_inproc(self, policy):
+        """admitted + shed == produced, to the event; shedding engages
+        past high water and the engine recovers to shed-free below low;
+        every admitted event is answered exactly once."""
+        q = InProcQueues()
+        n = 2000
+        for i in range(n):
+            q.push_event(f"e{i:04d}")
+        adm = AdmissionControl(high_water=512, low_water=128,
+                               policy=policy, shed_chunk=256)
+        eng = ServingEngine("softMax", ACTIONS, dict(self.CONFIG), q,
+                            seed=3, admission=adm)
+        stats = eng.run()
+        assert stats.events + stats.shed_total == n
+        assert stats.shed_total > 0
+        assert not adm.shedding
+        assert stats.actions_written == stats.events * 2
+        answered = set()
+        while (a := q.pop_action()) is not None:
+            answered.add(a[0])
+        assert len(answered) == stats.events
+        if policy == "reject-new":
+            assert "e0000" in answered       # oldest served in order
+        else:
+            assert "e0000" not in answered   # oldest shed first
+        # recovery: a calm wave below the marks is served shed-free
+        shed_before = stats.shed_total
+        for i in range(64):
+            q.push_event(f"r{i:03d}")
+        eng.run()
+        assert eng.stats.shed_total == shed_before
+        assert eng.stats.events + eng.stats.shed_total == n + 64
+
+    def test_exact_accounting_over_ledger(self):
+        """Redis adapter: the direct shed path (bulk RPOP/LPOP) bypasses
+        the pending ledger, and the ledger still fully retires for every
+        ADMITTED event."""
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            q = RedisQueues(client=c, pending_queue="pendingQueue")
+            n = 1200
+            for i in range(n):
+                c.lpush("eventQueue", f"e{i:04d}")
+            adm = AdmissionControl(high_water=256, low_water=64,
+                                   policy="reject-new", shed_chunk=128)
+            eng = ServingEngine("softMax", ACTIONS, dict(self.CONFIG), q,
+                                seed=3, admission=adm)
+            stats = eng.run()
+            assert stats.events + stats.shed_total == n
+            assert stats.shed_total > 0
+            assert c.llen("pendingQueue") == 0
+            assert c.llen("eventQueue") == 0
+            assert c.llen("actionQueue") == stats.events
+            c.close()
+
+    def test_default_engine_unchanged(self):
+        """No admission (the default): no shedding, no depth polls, and
+        EngineStats.shed_total stays 0 — pre-ISSUE-8 behavior exactly."""
+        q = _prefill_inproc(200, 0)
+        eng = ServingEngine("softMax", ACTIONS, dict(self.CONFIG), q,
+                            seed=3)
+        stats = eng.run()
+        assert stats.events == 200
+        assert stats.shed_total == 0
+
+    def test_shed_events_adapters_match(self):
+        """InProc and Redis shed_events agree: oldest-first (rpop side)
+        vs newest-first (lpush side)."""
+        q = InProcQueues()
+        for i in range(6):
+            q.push_event(f"e{i}")
+        assert q.shed_events(2) == ["e0", "e1"]                # oldest
+        assert q.shed_events(2, newest=True) == ["e5", "e4"]   # newest
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            rq = RedisQueues(client=c)
+            for i in range(6):
+                c.lpush("eventQueue", f"e{i}")
+            assert rq.shed_events(2) == ["e0", "e1"]
+            assert rq.shed_events(2, newest=True) == ["e5", "e4"]
+            assert rq.shed_events(99) == ["e2", "e3"]
+            assert rq.shed_events(1) == []
+            c.close()
+
+    def test_stoppable_queues_shed_preserves_sentinel(self):
+        """A shed sweep that swallows the stop sentinel must put it
+        back — discarding the retire signal would hang the group."""
+        from avenir_tpu.stream.scaleout import (
+            STOP_SENTINEL, _StoppableQueues)
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            q = _StoppableQueues(c, "g0")
+            for i in range(3):
+                c.lpush("eventQueue:g0", f"g0:{i}")
+            c.lpush("eventQueue:g0", STOP_SENTINEL)
+            shed = q.shed_events(10, newest=True)
+            assert STOP_SENTINEL not in shed
+            assert len(shed) == 3
+            assert q.pop_events(10) == [] and q.stopped
+            c.close()
+
+
+class TestHistoryDropped:
+    def test_cap_history_drop_is_counted(self):
+        """ISSUE 8 satellite: the bounded cap-history trace drops its
+        oldest half past the cap — the loss must be counted, never
+        silent."""
+        s = EngineStats()
+        for _ in range(EngineStats._CAP_HISTORY_MAX):
+            s.note_cap(64)
+        assert s.history_dropped == 0
+        s.note_cap(64)
+        assert s.history_dropped == EngineStats._CAP_HISTORY_MAX // 2
+        assert len(s.cap_history) == EngineStats._CAP_HISTORY_MAX // 2 + 1
+
+    def test_history_dropped_gauge_reaches_hub(self):
+        from avenir_tpu.obs import exporters as E
+        from avenir_tpu.stream.engine import _publish_engine_gauges
+        hub = E.hub().enable()
+        try:
+            s = EngineStats()
+            for _ in range(EngineStats._CAP_HISTORY_MAX + 1):
+                s.note_cap(64)
+            _publish_engine_gauges(s)
+            report = hub.report()
+            assert report["gauges"]["engine.history_dropped"] == \
+                EngineStats._CAP_HISTORY_MAX // 2
+            assert report["gauges"]["engine.shed_total"] == 0
+        finally:
+            hub.disable()
 
 
 class TestServingSmokeScript:

@@ -2,13 +2,20 @@
 ReinforcementLearnerTopology.java:64-82): N OnlineLearnerLoop processes
 over one RESP broker with per-group learner ownership."""
 
+import json
+import os
+import subprocess
+import sys
 import threading
+import time
 
 import pytest
 
 from avenir_tpu.stream.loop import RedisQueues, reclaim_pending
 from avenir_tpu.stream.miniredis import MiniRedisClient, MiniRedisServer
-from avenir_tpu.stream.scaleout import owned_groups, run_chaos, run_scaleout
+from avenir_tpu.stream.scaleout import (
+    _collect_worker, owned_groups, run_chaos, run_scaleout,
+    worker_liveness)
 
 
 class TestMiniRedis:
@@ -137,6 +144,135 @@ class TestOwnership:
         assert not (set(owned[0]) & set(owned[1]))
 
 
+class TestWorkerLiveness:
+    def test_stale_heartbeat_flags_dead(self):
+        """ISSUE 8 satellite: detect_stragglers flags slow workers,
+        worker_liveness flags GONE ones — age > 3x cadence -> dead."""
+        now = 1000.0
+        hbs = [
+            {"worker": 0, "events": 50, "ts": now - 0.4},   # fresh
+            {"worker": 1, "events": 40, "ts": now - 5.0},   # stale
+            {"worker": 1, "events": 30, "ts": now - 9.0},   # older: ignored
+        ]
+        lv = worker_liveness(hbs, cadence_s=0.5, now=now)
+        assert lv[0]["dead"] is False
+        assert lv[1]["dead"] is True
+        assert lv[1]["events"] == 40          # the LATEST heartbeat wins
+        assert lv[1]["age_s"] == pytest.approx(5.0)
+        # exactly at the 3x boundary: still alive (strict >)
+        lv = worker_liveness([{"worker": 2, "events": 1,
+                               "ts": now - 1.5}],
+                             cadence_s=0.5, now=now)
+        assert lv[2]["dead"] is False
+
+    def test_liveness_feeds_coordinator_death_detection(self):
+        """The rebalancer consumes exactly this signal: a worker whose
+        heartbeats go stale loses its groups at the next epoch."""
+        from avenir_tpu.stream.rebalance import Coordinator
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            coord = Coordinator(c, ["g0", "g1"], cadence_s=0.5)
+            now = 100.0
+            coord.note_heartbeats([
+                {"worker": 0, "events": 0, "ts": now},
+                {"worker": 1, "events": 0, "ts": now}])
+            rec = coord.step(now=now)
+            assert rec.workers() == [0, 1]
+            # worker 1 goes silent past 3x cadence; 0 stays fresh
+            coord.note_heartbeats([{"worker": 0, "events": 9,
+                                    "ts": now + 10}])
+            rec = coord.step(now=now + 10)
+            assert rec.workers() == [0]
+            assert rec.epoch == 2
+            # a dead worker's groups carry NO handoff expectation
+            assert rec.handoff == []
+            c.close()
+
+
+class TestCollectWorker:
+    def test_hung_worker_is_killed_with_partial_output(self):
+        """ISSUE 8 satellite: a worker that ignores its budget must be
+        killed (no leaked process tree) and the failure must carry its
+        captured output, not a raw TimeoutExpired."""
+        p = subprocess.Popen(
+            [sys.executable, "-u", "-c",
+             "import time; print('started', flush=True); time.sleep(60)"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError) as err:
+            _collect_worker(p, timeout=1.0)
+        assert time.monotonic() - t0 < 30
+        assert p.poll() is not None           # no leaked process
+        assert "hung past" in str(err.value)
+        assert "started" in str(err.value)    # partial stdout captured
+
+    def test_fast_worker_passes_through(self):
+        p = subprocess.Popen(
+            [sys.executable, "-c", "print('done')"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        out, _ = _collect_worker(p, timeout=30)
+        assert out.strip() == "done"
+
+
+class TestRebalanceAssignment:
+    def test_sticky_balanced_deterministic(self):
+        from avenir_tpu.stream.rebalance import rebalance_assignment
+        groups = [f"g{i}" for i in range(6)]
+        a1 = rebalance_assignment(groups, [0, 1])
+        assert sorted(set(a1.values())) == [0, 1]
+        assert list(a1.values()).count(0) == 3
+        # join: exactly the minimum number of groups move
+        a2 = rebalance_assignment(groups, [0, 1, 2], a1)
+        assert sorted(set(a2.values())) == [0, 1, 2]
+        assert sum(1 for g in groups if a2[g] != a1[g]) == 2
+        # leave: surviving owners keep every group they had
+        a3 = rebalance_assignment(groups, [1, 2], a2)
+        assert all(a3[g] == a2[g] for g in groups if a2[g] in (1, 2))
+        # deterministic: same inputs, same record
+        assert a2 == rebalance_assignment(groups, [0, 1, 2], a1)
+        with pytest.raises(ValueError):
+            rebalance_assignment(groups, [])
+
+    def test_groupless_workers_do_not_churn_epochs(self):
+        """Regression (review finding): with more alive workers than
+        groups, the spare worker owns nothing — that is steady state,
+        not a membership change, and must not rewrite the assignment on
+        every tick."""
+        from avenir_tpu.stream.rebalance import Coordinator
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            coord = Coordinator(c, ["g0"], cadence_s=0.5)
+            now = 100.0
+            coord.note_heartbeats([{"worker": 0, "events": 0, "ts": now},
+                                   {"worker": 1, "events": 0, "ts": now}])
+            rec = coord.step(now=now)
+            assert rec.epoch == 1
+            assert rec.members == [0, 1]
+            assert rec.workers() == [0]          # one group, one owner
+            for _ in range(5):
+                assert coord.step(now=now) is None
+            # the spare worker dying IS a change (it leaves membership)
+            coord.note_heartbeats([{"worker": 0, "events": 3,
+                                    "ts": now + 10}])
+            rec = coord.step(now=now + 10)
+            assert rec.epoch == 2 and rec.members == [0]
+            c.close()
+
+    def test_assignment_record_roundtrip_and_atomic_swap(self):
+        from avenir_tpu.stream.rebalance import (
+            AssignmentRecord, read_assignment, write_assignment)
+        with MiniRedisServer() as srv:
+            c = MiniRedisClient(srv.host, srv.port)
+            assert read_assignment(c) is None
+            rec = AssignmentRecord(3, {"g0": 1, "g1": 2},
+                                   handoff=["g1"], stop=False)
+            write_assignment(c, rec)
+            back = read_assignment(c)
+            assert back == rec
+            assert back.owned_by(2) == ["g1"]
+            c.close()
+
+
 def _lean_with_retries(run_once, attempts: int = 3) -> None:
     """Assert the planted-arm lean with seed-shifted retries. The lean
     is a REAL property (softMax over 0.8-vs-0.15 CTRs) but not a
@@ -242,3 +378,42 @@ class TestChaos:
         # the replacement's stats row is present and it reclaimed >= 0
         assert len(r.worker_stats) == 2
         assert all(w.get("replayed", 0) >= 0 for w in r.worker_stats)
+
+
+def test_chaos_smoke_script():
+    """CI hook (ISSUE 8, chaos harness v2): broker SIGKILL + AOF restart
+    with zero lost events after dedup; worker leave + join through
+    epoch-numbered rebalance with registry handoff (swap p99 <= 500ms)
+    and the joiner provably serving; sustained overload with EXACT shed
+    accounting (admitted + shed == produced), admitted-event p99 under
+    the serving_smoke SLO, and shed-free recovery. One retry absorbs a
+    transient co-tenant load spike (the lifecycle_smoke discipline); the
+    gates themselves are unchanged."""
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "chaos_smoke.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    last = None
+    for attempt in range(2):
+        proc = subprocess.run([sys.executable, script], env=env,
+                              capture_output=True, text=True, timeout=560)
+        last = proc
+        if proc.returncode == 0:
+            break
+        time.sleep(2)
+    assert last.returncode == 0, (
+        f"chaos_smoke failed twice:\nstdout: {last.stdout[-800:]}\n"
+        f"stderr: {last.stderr[-800:]}")
+    report = json.loads(last.stdout.strip().splitlines()[-1])
+    assert report["broker_kill"]["zero_lost_after_dedup"] is True
+    assert report["broker_kill"]["worker_reconnects"] >= 1
+    assert report["rebalance"]["exactly_once_after_dedup"] is True
+    assert report["rebalance"]["epochs"] >= 3
+    assert report["rebalance"]["joiner_events"] >= 1
+    assert (report["rebalance"]["handoff_swap_p99_ms"]
+            <= report["rebalance"]["handoff_swap_p99_bound_ms"])
+    assert report["overload"]["accounting_exact"] is True
+    assert report["overload"]["recovered_shed_free"] is True
+    assert report["overload"]["shed"] > 0
+    assert (report["overload"]["decision_latency_p99_ms"]
+            <= report["overload"]["decision_latency_p99_bound_ms"])
