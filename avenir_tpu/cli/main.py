@@ -67,6 +67,17 @@ def run_bayesian_distribution(conf: JobConfig, in_path: str, out_path: str) -> N
                               delim=conf.get("field.delim", ","))
         print(metrics.to_json())
         return
+    from avenir_tpu.utils.dataset import part_file_paths
+    shard_paths = part_file_paths(in_path)
+    if len(shard_paths) > 1 and (conf.get_bool("shard.parts", False)
+                                 or conf.get_bool("job.resume", False)):
+        # ISSUE 9: per-shard resumable train over an MR part-file dir —
+        # counts fold shard by shard through the resilient loader, each
+        # shard's partial counts journaled rename-atomically; --resume
+        # reuses committed shards (model file byte-identical to the
+        # merged-table train)
+        _run_nb_sharded(conf, in_path, out_path, shard_paths)
+        return
     if conf.get_bool("streaming.train", False):
         # round-5 out-of-core mode: window -> accumulate into the model
         # (the reference streaming mapper's memory envelope,
@@ -395,6 +406,267 @@ def _knn_feature_post(train, cfg):
     return jnp.asarray(bp.feature_post)
 
 
+# -- resilient sharded batch execution (ISSUE 9) ----------------------------
+
+def _shard_resilience_kwargs(conf: JobConfig, parse_stats) -> Dict:
+    """PrefetchLoader retry / speculation / bad-row knobs from the job
+    config — ONE reading shared by every sharded verb (KNN, NB, MI)."""
+    return dict(
+        retries=conf.get_int("shard.retries", 1),
+        shard_timeout_s=conf.get_float("shard.timeout.s", 0.0) or None,
+        speculate=conf.get_bool("shard.speculate", True),
+        speculative_factor=conf.get_float("shard.speculative.factor", 4.0),
+        speculative_min_wait_s=conf.get_float(
+            "shard.speculative.min.wait.s", 2.0),
+        on_bad_row=conf.get("on.bad.row", "raise"),
+        max_bad_fraction=conf.get_float("max.bad.fraction", 0.1),
+        quarantine_dir=conf.get("quarantine.dir"),
+        parse_stats=parse_stats)
+
+
+def _shard_journal(conf: JobConfig, verb: str, shard_paths, out_path: str):
+    """(journal, completed, resume, nonce) for a sharded job, honoring
+    ``shard.journal`` (default on — a killed job stays resumable) and
+    ``job.resume`` (the ``--resume`` flag). The fingerprint covers the
+    verb, the shard list (name + size) and the whole config minus the
+    resume switches, so ``--resume`` into a journal some other job wrote
+    refuses instead of mixing outputs."""
+    from avenir_tpu.utils.resume import (ShardJournal, job_fingerprint,
+                                         run_nonce, shard_file_facts)
+    resume = conf.get_bool("job.resume", False)
+    use_journal = conf.get_bool("shard.journal", True)
+    if resume and not use_journal:
+        raise ValueError("--resume (job.resume) needs shard.journal=true")
+    if not use_journal:
+        return None, {}, False, run_nonce()
+    # resume/reporting switches change VERBOSITY, never output bytes —
+    # a resume invocation legitimately differs from the killed run in
+    # exactly these keys, so they stay out of the fingerprint
+    conf_fp = {k: v for k, v in conf.as_dict().items()
+               if k not in ("job.resume", "shard.journal.keep",
+                            "shard.report")}
+    journal = ShardJournal(
+        out_path + ".shards",
+        job_fingerprint({"verb": verb,
+                         "shards": shard_file_facts(shard_paths),
+                         "conf": conf_fp}),
+        len(shard_paths))
+    return journal, journal.open(resume=resume), resume, run_nonce()
+
+
+def _print_shard_report(conf: JobConfig, *, shards_total: int,
+                        shards_resumed: int, shards_computed: int,
+                        rows_quarantined: int, loader) -> None:
+    """The exact-accounting JSON line (printed only when resilience is
+    armed — default runs keep their historical stdout byte-for-byte)."""
+    import json
+    if not (conf.get_bool("job.resume", False)
+            or conf.get("on.bad.row", "raise") != "raise"
+            or conf.get_bool("shard.report", False)):
+        return
+    stats = loader.stats
+    print(json.dumps({
+        "shards_total": shards_total,
+        "shards_resumed": shards_resumed,
+        "shards_computed": shards_computed,
+        "rows_quarantined": rows_quarantined,
+        "shard_retries": stats.shard_retries,
+        "speculative_launches": stats.speculative_launches,
+        "speculative_wins": stats.speculative_wins,
+        "duplicates_discarded": stats.duplicates_discarded,
+    }, sort_keys=True))
+
+
+def _sharded_featurizer(conf: JobConfig) -> Featurizer:
+    """Featurizer for the per-shard NB/MI training paths, fit WITHOUT
+    reading the merged part dir: like ``streaming.train``, these paths
+    require a fully-specified schema (cardinalities + min/max) or
+    ``featurizer.fit.data.path`` pointing at a bounded clean sample — a
+    data-dependent fit over the raw dir would both materialize every
+    token list in memory and crash on exactly the poison rows
+    ``on.bad.row`` exists to survive."""
+    schema = FeatureSchema.from_file(
+        conf.get_required("feature.schema.file.path"))
+    delim = conf.get("field.delim.regex", ",")
+    fz = Featurizer(schema, unseen=conf.get("unseen.value.handling",
+                                            "error"))
+    if fz.schema_data_dependent:
+        fit_path = conf.get("featurizer.fit.data.path")
+        if fit_path is None:
+            raise ValueError(
+                "sharded-parts training (shard.parts / --resume on a part "
+                "dir) needs a fully-specified schema (cardinalities + "
+                "min/max) or featurizer.fit.data.path pointing at a "
+                "bounded clean sample — fitting vocabularies from the raw "
+                "part dir would materialize it and die on poison rows")
+        fz.fit(read_csv_lines(fit_path, delim))
+    else:
+        fz.fit([])
+    return fz
+
+
+def _run_nb_sharded(conf: JobConfig, in_path: str, out_path: str,
+                    shard_paths) -> None:
+    """Resumable Naive Bayes train over an MR part-file dir (ISSUE 9):
+    shards featurize through the resilient PrefetchLoader (retry /
+    speculation / ``on.bad.row``) and fold into per-shard count payloads
+    committed rename-atomically; ``--resume`` reuses every committed
+    shard's counts (zero recompute). Counts are integers and the
+    cross-shard accumulation runs in host float64 (the train_streamed
+    discipline), so the saved model file is byte-identical to the
+    merged-table train."""
+    import os
+    import jax.numpy as jnp
+    from avenir_tpu.models import naive_bayes as nb
+    from avenir_tpu.native.loader import ParseStats
+    from avenir_tpu.native.prefetch import PrefetchLoader
+    from avenir_tpu.utils.metrics import MetricsRegistry
+    fz = _sharded_featurizer(conf)
+    parse_stats = ParseStats()
+    journal, completed, _resumed, nonce = _shard_journal(
+        conf, "BayesianDistribution", shard_paths, out_path)
+    if journal is None:
+        raise ValueError("shard.parts needs shard.journal=true (the "
+                         "partial-count payloads live in the journal)")
+    meta = nb.BayesModelMeta.from_table(fz.transform([], with_labels=True))
+
+    acc = None          # float64 host accumulator (exact to 2^53)
+    n_rows = 0
+    quarantined = 0
+    for i in sorted(completed):
+        rec = completed[i]
+        payload = journal.read_payload(i)
+        payload = {k: np.asarray(v, np.float64) for k, v in payload.items()}
+        acc = payload if acc is None else {k: acc[k] + payload[k]
+                                           for k in acc}
+        n_rows += int(rec.get("rows", 0))
+        quarantined += int(rec.get("rows_quarantined", 0))
+
+    pending = [(i, p) for i, p in enumerate(shard_paths)
+               if i not in completed]
+    loader = PrefetchLoader(
+        fz, [p for _, p in pending], conf.get("field.delim.regex", ","),
+        with_labels=True, depth=conf.get_int("shard.prefetch.depth", 2),
+        **_shard_resilience_kwargs(conf, parse_stats))
+    tables = iter(loader)
+    for i, path in pending:
+        table = next(tables)
+        model_i, _meta_i, _metrics_i = nb.train(table)
+        part = {
+            "class_counts": model_i.class_counts,
+            "post_counts": model_i.post_counts,
+            "prior_counts": model_i.prior_counts,
+            "cont_count": model_i.cont_count,
+            "cont_sum": model_i.cont_sum,
+            "cont_sumsq": model_i.cont_sumsq,
+        }
+        part = {k: np.asarray(v, np.float64) for k, v in part.items()}
+        journal.write_payload(i, part)
+        journal.mark_done(i, {
+            "file": os.path.basename(path),
+            "rows": int(table.n_rows),
+            "rows_quarantined": int(parse_stats.per_file.get(path, 0)),
+            "payload": True,
+            "run": nonce})
+        acc = part if acc is None else {k: acc[k] + part[k] for k in acc}
+        n_rows += table.n_rows
+    quarantined += sum(parse_stats.per_file.values())
+    if acc is None or n_rows == 0:
+        raise ValueError(f"no rows in {in_path}")
+    model = nb.BayesModel(
+        **{k: jnp.asarray(v, jnp.float32) for k, v in acc.items()})
+    nb.save_model(model, meta, out_path, delim=conf.get("field.delim", ","))
+    metrics = MetricsRegistry()
+    metrics.set("Distribution Data", "Records", n_rows)
+    metrics.set("Distribution Data", "Class prior", len(meta.class_values))
+    metrics.set("Distribution Data", "Feature posterior binned",
+                len(meta.binned_idx) * len(meta.class_values))
+    metrics.set("Distribution Data", "Feature posterior cont",
+                len(meta.cont_idx) * len(meta.class_values))
+    print(metrics.to_json())
+    _print_shard_report(
+        conf, shards_total=len(shard_paths), shards_resumed=len(completed),
+        shards_computed=len(pending), rows_quarantined=quarantined,
+        loader=loader)
+    if not conf.get_bool("shard.journal.keep", False):
+        journal.cleanup()
+
+
+def _run_mi_sharded(conf: JobConfig, in_path: str, out_path: str,
+                    shard_paths) -> None:
+    """Resumable MutualInformation distribution pass over an MR part-file
+    dir (ISSUE 9): the seven count families are additive over rows, so
+    each shard's distributions journal as a payload and sum — identical
+    integer counts to the merged pass (and byte-identical output; the
+    float64 accumulation casts back to the merged path's float32 exactly
+    because counts stay far under 2^24)."""
+    import os
+    from avenir_tpu.explore import mutual_information as mi
+    from avenir_tpu.native.loader import ParseStats
+    from avenir_tpu.native.prefetch import PrefetchLoader
+    fz = _sharded_featurizer(conf)
+    parse_stats = ParseStats()
+    journal, completed, _resumed, nonce = _shard_journal(
+        conf, "MutualInformation", shard_paths, out_path)
+    if journal is None:
+        raise ValueError("shard.parts needs shard.journal=true (the "
+                         "partial-count payloads live in the journal)")
+    meta_table = fz.transform([], with_labels=True)
+    # fail fast on continuous features BEFORE any shard parses — the
+    # merged path's compute_distributions contract
+    if any(meta_table.is_continuous):
+        raise ValueError("mutual information needs all features binned "
+                         "(categorical or bucketWidth numeric)")
+
+    keys = ("class_counts", "feature", "feature_class", "feature_pair",
+            "feature_pair_class")
+    acc = None
+    quarantined = 0
+    for i in sorted(completed):
+        payload = journal.read_payload(i)
+        payload = {k: np.asarray(payload[k], np.float64) for k in keys}
+        acc = payload if acc is None else {k: acc[k] + payload[k]
+                                           for k in acc}
+        quarantined += int(completed[i].get("rows_quarantined", 0))
+
+    pending = [(i, p) for i, p in enumerate(shard_paths)
+               if i not in completed]
+    loader = PrefetchLoader(
+        fz, [p for _, p in pending], conf.get("field.delim.regex", ","),
+        with_labels=True, depth=conf.get_int("shard.prefetch.depth", 2),
+        **_shard_resilience_kwargs(conf, parse_stats))
+    tables = iter(loader)
+    for i, path in pending:
+        table = next(tables)
+        d = mi.compute_distributions(table)
+        part = {k: np.asarray(getattr(d, k), np.float64) for k in keys}
+        journal.write_payload(i, part)
+        journal.mark_done(i, {
+            "file": os.path.basename(path),
+            "rows": int(table.n_rows),
+            "rows_quarantined": int(parse_stats.per_file.get(path, 0)),
+            "payload": True,
+            "run": nonce})
+        acc = part if acc is None else {k: acc[k] + part[k] for k in acc}
+    quarantined += sum(parse_stats.per_file.values())
+    if acc is None:
+        raise ValueError(f"no rows in {in_path}")
+    dists = mi.MiDistributions(
+        # float32, like the merged pass: downstream score math must see
+        # the IDENTICAL arrays for byte-identical output
+        **{k: np.asarray(acc[k], np.float32) for k in keys},
+        feature_ordinals=tuple(f.ordinal
+                               for f in meta_table.feature_fields),
+        class_values=tuple(meta_table.class_values))
+    _write_mi_output(conf, out_path, dists)
+    _print_shard_report(
+        conf, shards_total=len(shard_paths), shards_resumed=len(completed),
+        shards_computed=len(pending), rows_quarantined=quarantined,
+        loader=loader)
+    if not conf.get_bool("shard.journal.keep", False):
+        journal.cleanup()
+
+
 def _run_knn_sharded(conf: JobConfig, cfg, fz, train, shard_paths, out_path,
                      validation: bool, delim: str) -> None:
     """Classification over an MR part-file dir, one shard at a time:
@@ -405,44 +677,111 @@ def _run_knn_sharded(conf: JobConfig, cfg, fz, train, shard_paths, out_path,
     layer (ISSUE 3). Output rows match the merged path's order (same
     sorted file walk; per-row scoring is shard-independent). Disable
     with ``shard.prefetch=false`` to force the merged single-table
-    path."""
+    path.
+
+    ISSUE 9 made this path RESILIENT AND RESUMABLE: shard attempts
+    retry/speculate per ``shard.*`` keys, poison rows follow
+    ``on.bad.row``, and (``shard.journal``, default on) each shard's
+    output fragment + completion record commit rename-atomically to
+    ``<out>.shards/`` so a SIGKILLed job re-run with ``--resume`` skips
+    every completed shard — final output byte-identical to an
+    uninterrupted run, assembled from fragments in shard order."""
     import dataclasses
+    import os
     from avenir_tpu.models import knn
+    from avenir_tpu.native.loader import ParseStats
     from avenir_tpu.native.prefetch import PrefetchLoader
     from avenir_tpu.utils.metrics import ConfusionMatrix
     feature_post = _knn_feature_post(train, cfg)
     # shard tables arrive device-resident + bucketed, so the in-classify
     # feed (which chunks HOST arrays) would bounce them back — keep it off
     cfg = dataclasses.replace(cfg, feed_chunk_rows=0)
-    loader = PrefetchLoader(
-        fz, shard_paths, conf.get("field.delim.regex", ","),
-        with_labels=validation,
-        depth=conf.get_int("shard.prefetch.depth", 2),
-        to_device=True, bucket=True)
+    parse_stats = ParseStats()
+    journal, completed, resumed, nonce = _shard_journal(
+        conf, "NearestNeighbor", shard_paths, out_path)
     output_distr = conf.get_bool("output.class.distr", False)
-    cm = (ConfusionMatrix(train.class_values,
-                          positive_class=conf.get("positive.class.value"))
+    positive_class = conf.get("positive.class.value")
+    cm = (ConfusionMatrix(train.class_values, positive_class=positive_class)
           if validation else None)
     cm_updated = False
-    with open(out_path, "w") as fh:
-        for test in loader:
+    quarantined_resumed = 0
+    for i in sorted(completed):
+        rec = completed[i]
+        quarantined_resumed += int(rec.get("rows_quarantined", 0))
+        if cm is not None and rec.get("cm") is not None:
+            cm.matrix += np.asarray(rec["cm"], dtype=np.int64)
+            cm.invalid += int(rec.get("cm_invalid", 0))
+            cm_updated = True
+
+    pending = [(i, p) for i, p in enumerate(shard_paths)
+               if i not in completed]
+    loader = PrefetchLoader(
+        fz, [p for _, p in pending], conf.get("field.delim.regex", ","),
+        with_labels=validation,
+        depth=conf.get_int("shard.prefetch.depth", 2),
+        to_device=True, bucket=True,
+        **_shard_resilience_kwargs(conf, parse_stats))
+    direct = open(out_path, "w") if journal is None else None
+    try:
+        tables = iter(loader)
+        for i, path in pending:
+            test = next(tables)
             pred = knn.classify(train, test, cfg, feature_post=feature_post)
-            for i in range(test.n_rows):   # real rows only (arrays padded)
-                parts = [test.ids[i],
-                         train.class_values[int(pred.predicted[i])]]
+            lines = []
+            for r in range(test.n_rows):   # real rows only (arrays padded)
+                parts = [test.ids[r],
+                         train.class_values[int(pred.predicted[r])]]
                 if output_distr and pred.class_prob is not None:
                     for ci, cls in enumerate(train.class_values):
-                        parts += [cls, str(int(pred.class_prob[i, ci]))]
-                fh.write(delim.join(parts) + "\n")
+                        parts += [cls, str(int(pred.class_prob[r, ci]))]
+                lines.append(delim.join(parts))
+            shard_cm = None
             if cm is not None and test.labels is not None:
-                cm.update(np.asarray(pred.predicted)[:test.n_rows],
-                          np.asarray(test.labels)[:test.n_rows])
+                shard_cm = ConfusionMatrix(train.class_values,
+                                           positive_class=positive_class)
+                shard_cm.update(np.asarray(pred.predicted)[:test.n_rows],
+                                np.asarray(test.labels)[:test.n_rows])
+                cm.matrix += shard_cm.matrix
+                cm.invalid += shard_cm.invalid
                 cm_updated = True
+            text = "\n".join(lines) + ("\n" if lines else "")
+            if journal is not None:
+                # fragment first, record strictly after: a kill between
+                # the two leaves a recomputable shard, never a committed
+                # record pointing at nothing
+                journal.write_fragment(i, text)
+                journal.mark_done(i, {
+                    "file": os.path.basename(path),
+                    "rows": int(test.n_rows),
+                    "rows_quarantined":
+                        int(parse_stats.per_file.get(path, 0)),
+                    "cm": (None if shard_cm is None
+                           else shard_cm.matrix.tolist()),
+                    "cm_invalid": (0 if shard_cm is None
+                                   else int(shard_cm.invalid)),
+                    "fragment": True,
+                    "run": nonce})
+            else:
+                direct.write(text)
+    finally:
+        if direct is not None:
+            direct.close()
+    if journal is not None:
+        journal.assemble(out_path)
     # mirror the merged path's `test.labels is not None` guard: label-less
     # shards (schema without a class field) must print NO report, not an
     # all-zero one
     if cm is not None and cm_updated:
         print(cm.report().to_json())
+    _print_shard_report(
+        conf, shards_total=len(shard_paths), shards_resumed=len(completed),
+        shards_computed=len(pending),
+        rows_quarantined=(quarantined_resumed
+                          + sum(parse_stats.per_file.values())),
+        loader=loader)
+    if journal is not None and not conf.get_bool("shard.journal.keep",
+                                                 False):
+        journal.cleanup()
 
 
 def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
@@ -1551,6 +1890,14 @@ def run_mutual_information(conf: JobConfig, in_path: str,
     pair MI lines, then the chosen selection algorithm's ranking
     (``mi.score.algorithms`` names match the reference registry)."""
     from avenir_tpu.explore import mutual_information as mi
+    from avenir_tpu.utils.dataset import part_file_paths
+    shard_paths = part_file_paths(in_path)
+    if len(shard_paths) > 1 and (conf.get_bool("shard.parts", False)
+                                 or conf.get_bool("job.resume", False)):
+        # ISSUE 9: per-shard resumable distribution pass (additive count
+        # families journaled per shard; --resume reuses committed shards)
+        _run_mi_sharded(conf, in_path, out_path, shard_paths)
+        return
     fz, rows = _load_table(conf, in_path)
     table = fz.transform(rows)
     if conf.get_bool("train.sharded", False):
@@ -1565,6 +1912,13 @@ def run_mutual_information(conf: JobConfig, in_path: str,
         dists = mi.compute_distributions(st.table, mesh=mesh, mask=st.mask)
     else:
         dists = mi.compute_distributions(table)
+    _write_mi_output(conf, out_path, dists)
+
+
+def _write_mi_output(conf: JobConfig, out_path: str, dists) -> None:
+    """Scores + file emission shared by the merged and per-shard MI paths
+    (identical ``dists`` arrays -> identical bytes)."""
+    from avenir_tpu.explore import mutual_information as mi
     scores = mi.compute_scores(dists)
     delim = conf.get("field.delim.out", ",")
     # the reference's key/value names (MutualInformation.java:452-455,
@@ -1828,12 +2182,21 @@ def main(argv: List[str] = None) -> int:
                              "Perfetto) — the flag form of the "
                              "profile.trace.dir config key, mirroring "
                              "--metrics-out")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume a killed sharded batch job from its "
+                             "per-shard completion journal (<out>.shards/): "
+                             "completed shards are skipped (zero recompute) "
+                             "and the final output is byte-identical to an "
+                             "uninterrupted run — the flag form of the "
+                             "job.resume config key")
     args = parser.parse_args(argv)
 
     conf = JobConfig.from_file(args.conf)
     for override in args.D:
         key, _, value = override.partition("=")
         conf.set(key, value)
+    if args.resume:
+        conf.set("job.resume", "true")
 
     # observability (SURVEY.md §5): the reference's ``debug.on`` log switch
     # plus the TPU-native additions — an XLA trace when

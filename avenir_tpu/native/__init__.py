@@ -94,6 +94,16 @@ def _open_and_register():
         lib.avt_encode_parallel.restype = ctypes.c_void_p
         lib.avt_encode_parallel.argtypes = (
             list(lib.avt_encode.argtypes) + [ctypes.c_int32])  # n_threads
+        # v2: + skip_bad (record-and-skip malformed rows; the poison-row
+        # quarantine substrate) and the bad-row inspection pair
+        lib.avt_encode_parallel2.restype = ctypes.c_void_p
+        lib.avt_encode_parallel2.argtypes = (
+            list(lib.avt_encode_parallel.argtypes) + [ctypes.c_int32])
+        lib.avt_bad_count.restype = ctypes.c_int64
+        lib.avt_bad_count.argtypes = [ctypes.c_void_p]
+        lib.avt_bad_fill.restype = None
+        lib.avt_bad_fill.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_int64)]
         lib.avt_rows.restype = ctypes.c_int64
         lib.avt_rows.argtypes = [ctypes.c_void_p]
         lib.avt_error_msg.restype = ctypes.c_char_p

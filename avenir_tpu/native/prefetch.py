@@ -1,25 +1,102 @@
-"""Prefetching shard reader: overlap CSV featurization with device compute.
+"""Prefetching shard reader: overlap CSV featurization with device compute,
+now with the Hadoop-MR task semantics the reference rented (ISSUE 9):
+bounded per-shard retry, per-shard deadlines, and speculative re-execution
+of stragglers.
 
 The reference's input stage is Hadoop handing each mapper one HDFS split,
 parsed inside the mapper JVM while other splits parse elsewhere
-(SURVEY.md §2.10 "Data parallelism"). Here the analogue is a small
-double-buffered pipeline: shard n+1 (and deeper, up to ``depth``) featurizes
-on background threads — each file through the multi-threaded native C++
-encoder (``native/avt_io.cpp`` avt_encode_parallel) — while the caller's
-device step consumes shard n. Order is preserved.
+(SURVEY.md §2.10 "Data parallelism") — and Hadoop also re-runs failed task
+attempts (``mapreduce.map.maxattempts``) and launches speculative duplicates
+of stragglers, first finisher wins. Here the analogue is a small
+double-buffered pipeline over daemon attempt threads:
 
-Intended for driving batch jobs over ``part-*`` style multi-file inputs —
-e.g. hand each host process its per-process shard list and feed the tables
-to ``parallel/data.py`` ``shard_table`` as they arrive.
+- shard n+1 (and deeper, up to ``depth``) featurizes on background threads
+  — each file through the multi-threaded native C++ encoder — while the
+  caller's device step consumes shard n. Order is preserved: the consuming
+  iterator always yields shard i before shard i+1, whatever order attempts
+  finish in.
+- a failed attempt (worker exception) surfaces PROMPTLY at the consuming
+  iterator as a :class:`ShardError` naming the shard path — after
+  ``retries`` re-attempts; it can never deadlock the pipeline (attempts
+  are daemon threads the consumer merely observes).
+- ``shard_timeout_s`` bounds one attempt's wall clock; an expired attempt
+  is re-executed (budget permitting) without waiting for the stuck one.
+- ``speculate``: once ``speculative_min_samples`` shards have completed, a
+  shard exceeding ``speculative_factor`` × the p99 completed-attempt time
+  is re-executed on a SPARE worker slot. First result wins; the loser's
+  result is discarded and accounted (``LoaderStats.duplicates_discarded``).
+  First-result-wins preserves byte parity because attempts are
+  deterministic: both run the same featurize+stage over the same bytes, so
+  whichever finishes yields the identical table.
+
+Bad-row policy (``on_bad_row``/``max_bad_fraction``/``quarantine_dir``)
+passes straight through to ``native.loader.transform_file`` with ONE shared
+:class:`~avenir_tpu.native.loader.ParseStats`, so a sharded job's
+``rows_quarantined`` is exact across shards.
 """
 
 from __future__ import annotations
 
-import concurrent.futures
+import threading
+import time
+from dataclasses import dataclass, field as dc_field
 from typing import Callable, Iterator, List, Optional, Sequence
 
-from avenir_tpu.native.loader import transform_file
+import numpy as np
+
+from avenir_tpu.native.loader import ParseStats, transform_file
 from avenir_tpu.utils.dataset import EncodedTable, Featurizer
+
+
+class ShardError(RuntimeError):
+    """A shard exhausted its attempt budget. ``path`` names the shard;
+    the failing attempt's exception is chained as ``__cause__``."""
+
+    def __init__(self, path: str, message: str):
+        super().__init__(message)
+        self.path = path
+
+
+@dataclass
+class LoaderStats:
+    """Exact retry/speculation accounting for one exhausted loader."""
+
+    shards: int = 0                  # shards yielded
+    shard_retries: int = 0           # re-attempts (failure or deadline)
+    speculative_launches: int = 0    # straggler duplicates launched
+    speculative_wins: int = 0        # duplicates that finished first
+    duplicates_discarded: int = 0    # losing attempts (result dropped)
+    attempt_durations_s: List[float] = dc_field(default_factory=list)
+
+
+class _ShardTask:
+    """One shard's attempt ledger: result slot, error list, timing.
+
+    ``budget_used`` counts only NON-speculative launches (the retry
+    budget); ``inflight`` counts attempts still running — an exhausted
+    budget with a live attempt racing means WAIT, not raise (first
+    result wins, and a losing duplicate's error must never kill a shard
+    whose other attempt is about to land)."""
+
+    __slots__ = ("path", "index", "cond", "result", "done", "won_spec",
+                 "errors", "errors_seen", "attempts", "budget_used",
+                 "inflight", "spec_launched", "first_start", "deadline")
+
+    def __init__(self, path: str, index: int):
+        self.path = path
+        self.index = index
+        self.cond = threading.Condition()
+        self.result = None
+        self.done = False
+        self.won_spec = False
+        self.errors: list = []
+        self.errors_seen = 0
+        self.attempts = 0
+        self.budget_used = 0
+        self.inflight = 0
+        self.spec_launched = False
+        self.first_start: Optional[float] = None
+        self.deadline: Optional[float] = None
 
 
 class PrefetchLoader:
@@ -39,6 +116,13 @@ class PrefetchLoader:
     callable run on the worker thread (e.g. ``lambda t: shard_table(t,
     mesh)`` to hand ``parallel/data.py`` mesh-sharded tables that arrive
     resident).
+
+    Resilience knobs (module docstring): ``retries`` (default 1 —
+    Hadoop's maxattempts=2), ``shard_timeout_s`` (default None — no
+    deadline), ``speculate``/``speculative_factor``/
+    ``speculative_min_samples``/``speculative_min_wait_s``, and the
+    bad-row policy trio. Read :attr:`stats` / :attr:`parse_stats` after
+    exhaustion.
     """
 
     def __init__(self, fz: Featurizer, paths: Sequence[str],
@@ -46,11 +130,23 @@ class PrefetchLoader:
                  depth: int = 2, n_threads: int = 0,
                  force_python: bool = False, to_device: bool = False,
                  bucket: bool = False, device=None,
-                 stage: Optional[Callable[[EncodedTable], object]] = None):
+                 stage: Optional[Callable[[EncodedTable], object]] = None,
+                 retries: int = 1,
+                 shard_timeout_s: Optional[float] = None,
+                 speculate: bool = True,
+                 speculative_factor: float = 4.0,
+                 speculative_min_samples: int = 3,
+                 speculative_min_wait_s: float = 2.0,
+                 on_bad_row: str = "raise",
+                 max_bad_fraction: float = 0.1,
+                 quarantine_dir: Optional[str] = None,
+                 parse_stats: Optional[ParseStats] = None):
         if not fz.fitted:
             raise RuntimeError("fit the Featurizer before prefetching")
         if depth < 1:
             raise ValueError("depth must be >= 1")
+        if retries < 0:
+            raise ValueError("retries must be >= 0")
         if stage is not None and to_device:
             raise ValueError("pass to_device=True OR a custom stage, "
                              "not both")
@@ -69,12 +165,36 @@ class PrefetchLoader:
             from avenir_tpu.parallel.pipeline import stage_table
             stage = lambda t: stage_table(t, device=device, bucket=bucket)
         self._stage = stage
+        self._retries = retries
+        self._timeout_s = shard_timeout_s
+        self._speculate = speculate
+        self._spec_factor = speculative_factor
+        self._spec_min_samples = max(speculative_min_samples, 1)
+        self._spec_min_wait_s = speculative_min_wait_s
+        self._on_bad_row = on_bad_row
+        self._max_bad_fraction = max_bad_fraction
+        self._quarantine_dir = quarantine_dir
+        self.parse_stats = (parse_stats if parse_stats is not None
+                            else ParseStats())
+        self.stats = LoaderStats()
+        self._stats_lock = threading.Lock()
+        # primary attempts cap concurrency at depth (each shard parse is
+        # itself multi-threaded in C++, so more would oversubscribe);
+        # relaunches (speculative / deadline / failure-retry while the
+        # original may still hold its slot) ride ONE spare slot so a
+        # wedged primary can never starve its own replacement
+        self._sem = threading.Semaphore(depth)
+        self._spare_sem = threading.Semaphore(1)
 
     def _load(self, path: str) -> EncodedTable:
         table = transform_file(self._fz, path, self._delim,
                                self._with_labels,
                                force_python=self._force_python,
-                               n_threads=self._n_threads)
+                               n_threads=self._n_threads,
+                               on_bad_row=self._on_bad_row,
+                               max_bad_fraction=self._max_bad_fraction,
+                               quarantine_dir=self._quarantine_dir,
+                               parse_stats=self.parse_stats)
         if self._stage is not None:
             table = self._stage(table)
         return table
@@ -82,20 +202,163 @@ class PrefetchLoader:
     def __len__(self) -> int:
         return len(self._paths)
 
+    # -- attempt threads ----------------------------------------------------
+    def _launch(self, task: _ShardTask, spare: bool,
+                speculative: bool = False) -> None:
+        with task.cond:
+            task.attempts += 1
+            task.inflight += 1
+            if not speculative:
+                task.budget_used += 1
+            if task.first_start is None:
+                task.first_start = time.perf_counter()
+                if self._timeout_s:
+                    task.deadline = task.first_start + self._timeout_s
+        sem = self._spare_sem if spare else self._sem
+        t = threading.Thread(target=self._attempt,
+                             args=(task, sem, speculative),
+                             name=f"avenir-shard-{task.index}", daemon=True)
+        t.start()
+
+    def _attempt(self, task: _ShardTask, sem: threading.Semaphore,
+                 speculative: bool) -> None:
+        table = None
+        error = None
+        dt = 0.0
+        with sem:
+            t0 = time.perf_counter()
+            try:
+                table = self._load(task.path)
+            except BaseException as exc:   # surfaced at the consumer
+                error = exc
+            dt = time.perf_counter() - t0
+        with task.cond:
+            task.inflight -= 1
+            if error is not None:
+                task.errors.append(error)
+            elif task.done:
+                # first result won already; this duplicate is discarded
+                with self._stats_lock:
+                    self.stats.duplicates_discarded += 1
+            else:
+                task.result = table
+                task.done = True
+                task.won_spec = speculative
+                with self._stats_lock:
+                    self.stats.attempt_durations_s.append(dt)
+            task.cond.notify_all()
+
+    def _spec_threshold_s(self) -> Optional[float]:
+        """Straggler bar: ``speculative_factor`` × p99 of completed attempt
+        times, once enough samples exist; never below the min wait."""
+        with self._stats_lock:
+            samples = list(self.stats.attempt_durations_s)
+        if len(samples) < self._spec_min_samples:
+            return None
+        p99 = float(np.percentile(np.asarray(samples), 99))
+        return max(self._spec_factor * p99, self._spec_min_wait_s)
+
+    # -- consumer side ------------------------------------------------------
     def __iter__(self) -> Iterator[EncodedTable]:
         if not self._paths:
             return
-        # one worker per outstanding shard; each shard parse is itself
-        # multi-threaded in C++, so more workers would oversubscribe
-        with concurrent.futures.ThreadPoolExecutor(
-                max_workers=self._depth) as pool:
-            pending = [pool.submit(self._load, p)
-                       for p in self._paths[:self._depth]]
-            next_submit = self._depth
-            for _ in range(len(self._paths)):
-                fut = pending.pop(0)
-                if next_submit < len(self._paths):
-                    pending.append(
-                        pool.submit(self._load, self._paths[next_submit]))
-                    next_submit += 1
-                yield fut.result()
+        tasks = [_ShardTask(p, i) for i, p in enumerate(self._paths)]
+        launched = 0
+
+        def top_up(consumed: int) -> None:
+            nonlocal launched
+            while launched < len(tasks) and launched < consumed + self._depth:
+                self._launch(tasks[launched], spare=False)
+                launched += 1
+
+        top_up(0)
+        for i, task in enumerate(tasks):
+            while True:
+                relaunch = False
+                launch_spec = False
+                with task.cond:
+                    if task.done:
+                        result = task.result
+                        task.result = None    # the loader holds no shard
+                        won_spec = task.won_spec
+                        break
+                    if len(task.errors) > task.errors_seen:
+                        # a failed attempt: retry within budget; with the
+                        # budget spent but another attempt still racing
+                        # (e.g. a speculative duplicate), WAIT — first
+                        # result wins, a loser's error must not kill the
+                        # shard; only raise once nothing is running
+                        task.errors_seen = len(task.errors)
+                        exc = task.errors[-1]
+                        if task.budget_used <= self._retries:
+                            relaunch = True
+                            if self._timeout_s:   # a fresh attempt gets a
+                                task.deadline = (time.perf_counter()
+                                                 + self._timeout_s)
+                        elif task.inflight == 0:
+                            raise ShardError(
+                                task.path,
+                                f"shard {task.path} failed after "
+                                f"{task.attempts} attempt(s): "
+                                f"{exc!r}") from exc
+                    else:
+                        now = time.perf_counter()
+                        elapsed = (now - task.first_start
+                                   if task.first_start is not None else 0.0)
+                        # per-shard deadline: a stuck attempt is replaced
+                        # (budget permitting), never waited out
+                        if task.deadline is not None and now > task.deadline:
+                            if task.budget_used <= self._retries:
+                                relaunch = True
+                                task.deadline = now + self._timeout_s
+                            elif task.spec_launched:
+                                # a replacement is already racing; extend
+                                # rather than double-launching
+                                task.deadline = now + self._timeout_s
+                            else:
+                                raise ShardError(
+                                    task.path,
+                                    f"shard {task.path} exceeded its "
+                                    f"{self._timeout_s}s deadline on all "
+                                    f"{task.attempts} attempt(s)")
+                        if not relaunch and (self._speculate
+                                             and not task.spec_launched):
+                            bar = self._spec_threshold_s()
+                            if bar is not None and elapsed > bar:
+                                task.spec_launched = True
+                                launch_spec = True
+                        if not relaunch and not launch_spec:
+                            task.cond.wait(timeout=0.05)
+                            continue
+                # relaunches happen OUTSIDE task.cond (thread start +
+                # semaphore must not run under the lock)
+                if relaunch:
+                    with self._stats_lock:
+                        self.stats.shard_retries += 1
+                    self._launch(task, spare=True)
+                if launch_spec:
+                    with self._stats_lock:
+                        self.stats.speculative_launches += 1
+                    self._launch(task, spare=True, speculative=True)
+            if won_spec:
+                with self._stats_lock:
+                    self.stats.speculative_wins += 1
+            with self._stats_lock:
+                self.stats.shards += 1
+            top_up(i + 1)
+            yield result
+        self._publish()
+
+    def _publish(self) -> None:
+        """Exhaustion hook: exact counters to the hub when it is live."""
+        try:
+            from avenir_tpu.obs.exporters import set_hub_gauges_if_live
+            set_hub_gauges_if_live({
+                "loader.shard_retries": float(self.stats.shard_retries),
+                "loader.speculative_wins":
+                    float(self.stats.speculative_wins),
+                "loader.duplicates_discarded":
+                    float(self.stats.duplicates_discarded),
+            })
+        except Exception:
+            pass   # telemetry must never sink the loader

@@ -29,9 +29,11 @@ bounded-memory path when it does not.
 
 from __future__ import annotations
 
+import itertools
 import os
+import threading
 from dataclasses import dataclass, replace
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
@@ -41,6 +43,76 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from avenir_tpu.parallel.mesh import DATA_AXIS
 from avenir_tpu.utils.dataset import EncodedTable, Featurizer, iter_csv_rows
+
+# default wall-clock bound on the multi-host row-count allgather (ISSUE 9):
+# a dead or never-started worker used to hang every OTHER process in the
+# collective forever; now the survivors fail with a diagnostic naming the
+# missing process indices. Override per call or via the environment.
+DEFAULT_BARRIER_TIMEOUT_S = float(
+    os.environ.get("AVT_BARRIER_TIMEOUT_S", "600"))
+
+_BARRIER_CALLS = itertools.count()     # SPMD-symmetric per-process sequence
+
+
+def _await_barrier(fn: Callable[[], "object"], *, beacon_dir: str,
+                   process_index: int, process_count: int,
+                   timeout_s: Optional[float]):
+    """Run a blocking collective with a timeout and a "who is missing"
+    diagnostic. Each process drops a beacon file in a shared-filesystem
+    dir (the input lives on one — the HDFS analogue) BEFORE entering the
+    collective; on timeout the survivor lists the beacons to name exactly
+    which process indices never arrived. Beacons are best-effort: an
+    unwritable dir degrades the diagnostic, never the load."""
+    beacon = None
+    try:
+        os.makedirs(beacon_dir, exist_ok=True)
+        beacon = os.path.join(beacon_dir, f"proc-{process_index:05d}")
+        with open(beacon, "w"):
+            pass
+    except OSError:
+        beacon = None
+    result: dict = {}
+    done = threading.Event()
+
+    def run() -> None:
+        try:
+            result["value"] = fn()
+        except BaseException as exc:     # surfaces on the caller thread
+            result["error"] = exc
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True, name="avenir-barrier")
+    t.start()
+    if not done.wait(timeout_s):
+        present = {process_index}
+        try:
+            for name in os.listdir(beacon_dir):
+                if name.startswith("proc-"):
+                    present.add(int(name.split("-", 1)[1]))
+        except (OSError, ValueError):
+            pass
+        missing = sorted(set(range(process_count)) - present)
+        if missing:
+            miss_txt = f"process(es) {missing} missing"
+        else:
+            miss_txt = ("missing process set unknown — every beacon is "
+                        "present or the beacon dir was unwritable; the "
+                        "collective itself is stuck")
+        raise RuntimeError(
+            f"multi-host barrier timed out after {timeout_s:.0f}s: "
+            f"{len(present & set(range(process_count)))}/{process_count} "
+            f"processes reached the row-count allgather; {miss_txt}. A "
+            f"worker died or never called load_sharded_table — restart "
+            f"the job once every process is up (beacons: {beacon_dir}).")
+    if beacon is not None:
+        try:
+            os.remove(beacon)
+            os.rmdir(beacon_dir)         # last one out sweeps the dir
+        except OSError:
+            pass
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
 
 
 def process_slice(n_global: int, n_processes: Optional[int] = None,
@@ -197,7 +269,9 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
                        axis: str = DATA_AXIS, delim_regex: str = ",",
                        with_labels: bool = True,
                        chunk_rows: int = 65536,
-                       stream: bool = False) -> ShardedTable:
+                       stream: bool = False,
+                       barrier_timeout_s: Optional[float] = None
+                       ) -> ShardedTable:
     """Each process streams + featurizes only its row slice of ``path`` (a
     shared filesystem, the HDFS analogue) with bounded memory — see the
     module docstring for the two-pass byte-window protocol — then the
@@ -210,7 +284,13 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
     Row-slice padding (the ceil-sized tail slices of ``process_slice``)
     materializes as copies of the file's LAST real row, masked out of every
     reduction — identical semantics on every path (single-host, native,
-    multi-host)."""
+    multi-host).
+
+    ``barrier_timeout_s`` (default ``AVT_BARRIER_TIMEOUT_S`` env, 600s)
+    bounds the cross-host row-count allgather: instead of hanging forever
+    when a process died before the barrier, survivors raise a diagnostic
+    naming exactly which process indices are missing (ISSUE 9; see
+    :func:`_await_barrier`). Pass ``0`` to wait indefinitely."""
     if not fz.fitted:
         raise ValueError("featurizer must be fit before distributed loading")
     if fz.schema_data_dependent:
@@ -241,8 +321,15 @@ def load_sharded_table(fz: Featurizer, path: str, mesh: Mesh, *,
     my_window = windows[jax.process_index()]
     my_count = sum(1 for _ in iter_csv_rows(path, delim_regex,
                                             byte_window=my_window))
-    counts = np.asarray(multihost_utils.process_allgather(
-        np.asarray(my_count, np.int64)))
+    if barrier_timeout_s is None:
+        barrier_timeout_s = DEFAULT_BARRIER_TIMEOUT_S
+    counts = np.asarray(_await_barrier(
+        lambda: multihost_utils.process_allgather(
+            np.asarray(my_count, np.int64)),
+        beacon_dir=f"{path}.barrier-{next(_BARRIER_CALLS)}",
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        timeout_s=barrier_timeout_s or None))
     prefix = np.concatenate([[0], np.cumsum(counts)])
     n_real = int(prefix[-1])
     if n_real == 0:

@@ -1,0 +1,181 @@
+"""Resumable sharded batch jobs: the per-shard completion manifest.
+
+The reference's batch half leans on Hadoop MR's job ledger: a killed job
+re-runs only the splits whose task attempts never committed. This module is
+that contract for the sharded CLI jobs (ISSUE 9): each shard's output
+fragment (or partial-count payload) plus a completion record land
+RENAME-ATOMICALLY in a journal directory next to the job's output (the PR 7
+registry's temp + ``os.replace`` idiom — a SIGKILL can never leave a torn
+record, only a missing one, and a missing record just recomputes that one
+shard). ``--resume`` skips every completed shard; the final output is
+assembled from fragments in shard order, so a resumed run is byte-identical
+to an uninterrupted one.
+
+A job fingerprint guards against resuming into a journal some OTHER job
+wrote (different config, different shard list): mismatches refuse with a
+clear error instead of silently mixing outputs.
+
+Layout (``<out_path>.shards/``)::
+
+    _job.json           {"key": <fingerprint>, "n_shards": N}
+    shard-00007.json    completion record (counters, cm partial, run nonce)
+    shard-00007.out     output fragment (KNN classification lines)
+    shard-00007.npz     partial-count payload (NB/MI sharded training)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Dict, Iterable, Optional
+
+_JOB_FILE = "_job.json"
+
+
+def job_fingerprint(parts: dict) -> str:
+    """Stable digest of everything that must match for a resume to be
+    sound: the verb, the shard list (path + size), and the job config
+    (minus the resume switches themselves — the caller strips those)."""
+    blob = json.dumps(parts, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def shard_file_facts(paths: Iterable[str]) -> list:
+    """(basename, size) per shard — part of the fingerprint, so a shard
+    file that changed size since the journal was written refuses resume."""
+    return [[os.path.basename(p), os.path.getsize(p)] for p in paths]
+
+
+def run_nonce() -> str:
+    """Identifies ONE driver invocation in shard records — the
+    zero-recompute gate reads it: a resumed run must leave pre-kill
+    records' nonces untouched."""
+    return f"{os.getpid()}-{time.time_ns():x}"
+
+
+def _atomic_write(path: str, data) -> None:
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if isinstance(data, bytes):
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+    else:
+        with open(tmp, "w") as fh:
+            fh.write(data)
+    os.replace(tmp, path)
+
+
+class ShardJournal:
+    """Rename-atomic per-shard completion manifest (module docstring)."""
+
+    def __init__(self, journal_dir: str, job_key: str, n_shards: int):
+        self.dir = journal_dir
+        self.key = job_key
+        self.n_shards = n_shards
+
+    # -- lifecycle ----------------------------------------------------------
+    def open(self, resume: bool) -> Dict[int, dict]:
+        """Prepare the journal; return completed shard records (index ->
+        record). Without ``resume`` any existing journal is CLEARED — a
+        stale journal from an unrelated earlier run must never leak
+        fragments into a fresh job. With ``resume``, a fingerprint
+        mismatch refuses loudly."""
+        if os.path.isdir(self.dir) and not resume:
+            shutil.rmtree(self.dir)
+        os.makedirs(self.dir, exist_ok=True)
+        job_path = os.path.join(self.dir, _JOB_FILE)
+        if resume and os.path.exists(job_path):
+            try:
+                with open(job_path) as fh:
+                    job = json.load(fh)
+            except (OSError, json.JSONDecodeError) as exc:
+                raise ValueError(
+                    f"shard journal {self.dir} has a corrupt {_JOB_FILE} "
+                    f"({exc}); delete the journal or rerun without "
+                    f"--resume") from exc
+            if job.get("key") != self.key:
+                raise ValueError(
+                    f"shard journal {self.dir} was written by a different "
+                    f"job (input shards or config changed); delete it or "
+                    f"rerun without --resume")
+        else:
+            _atomic_write(job_path, json.dumps(
+                {"key": self.key, "n_shards": self.n_shards},
+                sort_keys=True))
+        return self._completed()
+
+    def _completed(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for name in os.listdir(self.dir):
+            if not (name.startswith("shard-") and name.endswith(".json")):
+                continue
+            full = os.path.join(self.dir, name)
+            try:
+                with open(full) as fh:
+                    rec = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                continue   # records are atomic; treat anything odd as absent
+            idx = rec.get("shard")
+            if not isinstance(idx, int) or not (0 <= idx < self.n_shards):
+                continue
+            # a record without its fragment/payload (pre-record kill cannot
+            # produce this, but a hand-pruned journal can) = not done
+            if rec.get("fragment") and not os.path.exists(
+                    self.fragment_path(idx)):
+                continue
+            if rec.get("payload") and not os.path.exists(
+                    self.payload_path(idx)):
+                continue
+            out[idx] = rec
+        return out
+
+    def cleanup(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    # -- per-shard artifacts ------------------------------------------------
+    def fragment_path(self, index: int) -> str:
+        return os.path.join(self.dir, f"shard-{index:05d}.out")
+
+    def payload_path(self, index: int) -> str:
+        return os.path.join(self.dir, f"shard-{index:05d}.npz")
+
+    def write_fragment(self, index: int, text: str) -> None:
+        _atomic_write(self.fragment_path(index), text)
+
+    def write_payload(self, index: int, arrays: Dict[str, "object"]) -> None:
+        import io
+
+        import numpy as np
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        _atomic_write(self.payload_path(index), buf.getvalue())
+
+    def read_payload(self, index: int) -> dict:
+        import numpy as np
+        with np.load(self.payload_path(index)) as z:
+            return {k: z[k] for k in z.files}
+
+    def mark_done(self, index: int, record: dict) -> None:
+        """Commit a shard: the record lands atomically and STRICTLY AFTER
+        its fragment/payload (the caller wrote those first), so a kill
+        between the two leaves a recomputable shard, never a committed
+        record pointing at nothing."""
+        record = dict(record)
+        record["shard"] = index
+        _atomic_write(os.path.join(self.dir, f"shard-{index:05d}.json"),
+                      json.dumps(record, sort_keys=True))
+
+    # -- output assembly ----------------------------------------------------
+    def assemble(self, out_path: str, n_shards: Optional[int] = None) -> None:
+        """Concatenate fragments in shard order into ``out_path``
+        (atomically) — byte-identical to a direct streaming write of the
+        same shards."""
+        n = self.n_shards if n_shards is None else n_shards
+        tmp = f"{out_path}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as out:
+            for i in range(n):
+                with open(self.fragment_path(i), "rb") as frag:
+                    shutil.copyfileobj(frag, out)
+        os.replace(tmp, out_path)

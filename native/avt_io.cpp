@@ -64,6 +64,14 @@ struct Spec {
   char delim = ',';
 };
 
+// bad-row reason codes (mirrored by avenir_tpu/native/loader.py)
+enum BadReason : int32_t {
+  kBadRagged = 1,        // a needed ordinal is missing (short row)
+  kBadNumeric = 2,       // unparseable numeric token
+  kBadCategorical = 3,   // unseen categorical value (no OOV bin)
+  kBadClass = 4,         // unseen class value
+};
+
 struct Table {
   int64_t rows = 0;
   int32_t n_feat = 0;
@@ -71,6 +79,9 @@ struct Table {
   std::vector<float> numeric;     // [rows, n_feat]
   std::vector<int32_t> labels;    // [rows] (only when a class column exists)
   std::vector<int64_t> id_spans;  // [rows, 2] byte offsets of the id token
+  // flattened [n_bad, 4]: (row, line-start byte offset, reason, ordinal) —
+  // the wrapper derives line numbers / offending tokens from the offset
+  std::vector<int64_t> bad_info;
   bool has_labels = false;
   std::string error;
 };
@@ -151,10 +162,16 @@ int64_t count_rows(const char* buf, int64_t end, int64_t begin) {
 }
 
 // Parse lines in [begin, end) into t's buffers starting at output row
-// base_row. begin must sit at a line start; end at a line boundary. On a bad
-// row, sets err (with the global row number) and returns false.
+// base_row. begin must sit at a line start; end at a line boundary.
+//
+// A malformed row (ragged / non-numeric / unseen categorical or class) is
+// recorded into `bad` as (row, line-start offset, reason, ordinal). With
+// skip_bad the parse continues past it — the row keeps its output slot,
+// filled with junk the wrapper compacts away — otherwise err is set (with
+// the global row number, as before) and the range aborts.
 bool encode_range(const char* buf, int64_t end, int64_t begin,
                   const Spec& spec, Table* t, int64_t base_row,
+                  bool skip_bad, std::vector<int64_t>* bad,
                   std::string* err) {
   const int32_t n_feat = t->n_feat;
   int64_t r = base_row;
@@ -167,7 +184,9 @@ bool encode_range(const char* buf, int64_t end, int64_t begin,
     const char* line_end = buf + eol;
     const char* cursor = buf + p;
     bool row_done = false;
-    while (!row_done) {
+    int32_t bad_reason = 0, bad_ord = -1;
+    std::string_view bad_tok;
+    while (!row_done && !bad_reason) {
       const char* field_end = cursor;
       while (field_end < line_end && *field_end != spec.delim) ++field_end;
       std::string_view tok = trim(cursor, field_end);
@@ -185,12 +204,10 @@ bool encode_range(const char* buf, int64_t end, int64_t begin,
           case kClass: {
             auto it = c.vocab.find(std::string(tok));
             if (it == c.vocab.end()) {
-              std::snprintf(msg, sizeof(msg),
-                            "row %lld: unseen class value '%.*s'",
-                            static_cast<long long>(r),
-                            static_cast<int>(tok.size()), tok.data());
-              *err = msg;
-              return false;
+              bad_reason = kBadClass;
+              bad_ord = ord;
+              bad_tok = tok;
+              break;
             }
             t->labels[static_cast<size_t>(r)] = it->second;
             break;
@@ -203,13 +220,10 @@ bool encode_range(const char* buf, int64_t end, int64_t begin,
             } else if (c.oov_index >= 0) {
               idx = c.oov_index;
             } else {
-              std::snprintf(msg, sizeof(msg),
-                            "row %lld ordinal %d: unseen categorical "
-                            "value '%.*s'",
-                            static_cast<long long>(r), ord,
-                            static_cast<int>(tok.size()), tok.data());
-              *err = msg;
-              return false;
+              bad_reason = kBadCategorical;
+              bad_ord = ord;
+              bad_tok = tok;
+              break;
             }
             const size_t o =
                 static_cast<size_t>(r * n_feat + c.feat_slot);
@@ -221,12 +235,10 @@ bool encode_range(const char* buf, int64_t end, int64_t begin,
           case kContinuous: {
             double v;
             if (!parse_double(tok, &v)) {
-              std::snprintf(msg, sizeof(msg),
-                            "row %lld ordinal %d: non-numeric value '%.*s'",
-                            static_cast<long long>(r), ord,
-                            static_cast<int>(tok.size()), tok.data());
-              *err = msg;
-              return false;
+              bad_reason = kBadNumeric;
+              bad_ord = ord;
+              bad_tok = tok;
+              break;
             }
             const size_t o =
                 static_cast<size_t>(r * n_feat + c.feat_slot);
@@ -239,6 +251,7 @@ bool encode_range(const char* buf, int64_t end, int64_t begin,
           }
         }
       }
+      if (bad_reason) break;
       ++ord;
       if (field_end >= line_end) {
         row_done = true;
@@ -246,17 +259,54 @@ bool encode_range(const char* buf, int64_t end, int64_t begin,
           // a needed column is missing in this row?
           for (int32_t rest = ord; rest < spec.n_ord; ++rest) {
             if (spec.cols[static_cast<size_t>(rest)].kind != kIgnore) {
-              std::snprintf(msg, sizeof(msg),
-                            "row %lld has %d fields, needs ordinal %d",
-                            static_cast<long long>(r), ord, rest);
-              *err = msg;
-              return false;
+              bad_reason = kBadRagged;
+              bad_ord = rest;
+              break;
             }
           }
         }
       } else {
         cursor = field_end + 1;
       }
+    }
+    if (bad_reason) {
+      if (bad) {
+        bad->push_back(r);
+        bad->push_back(p);
+        bad->push_back(bad_reason);
+        bad->push_back(bad_ord);
+      }
+      if (!skip_bad) {
+        switch (bad_reason) {
+          case kBadClass:
+            std::snprintf(msg, sizeof(msg),
+                          "row %lld: unseen class value '%.*s'",
+                          static_cast<long long>(r),
+                          static_cast<int>(bad_tok.size()), bad_tok.data());
+            break;
+          case kBadCategorical:
+            std::snprintf(msg, sizeof(msg),
+                          "row %lld ordinal %d: unseen categorical "
+                          "value '%.*s'",
+                          static_cast<long long>(r), bad_ord,
+                          static_cast<int>(bad_tok.size()), bad_tok.data());
+            break;
+          case kBadNumeric:
+            std::snprintf(msg, sizeof(msg),
+                          "row %lld ordinal %d: non-numeric value '%.*s'",
+                          static_cast<long long>(r), bad_ord,
+                          static_cast<int>(bad_tok.size()), bad_tok.data());
+            break;
+          default:
+            std::snprintf(msg, sizeof(msg),
+                          "row %lld has %d fields, needs ordinal %d",
+                          static_cast<long long>(r), ord, bad_ord);
+        }
+        *err = msg;
+        return false;
+      }
+      ++r;  // the bad row keeps its slot; the wrapper compacts
+      continue;
     }
     if (spec.id_ord < 0) {  // no id column: span empty, Python uses row index
       t->id_spans[static_cast<size_t>(r * 2)] = 0;
@@ -294,11 +344,14 @@ extern "C" {
 //   n_feat          : number of output feature columns
 //
 // Returns a Table handle (check avt_error_msg; rows < 0 on failure).
-void* avt_encode(const char* buf, int64_t len, char delim,
-                 int32_t n_ordinals, const int8_t* kinds,
-                 const int32_t* feat_slot, const double* bucket_width,
-                 const int64_t* bin_offset, const char* vocab_blob,
-                 const int32_t* vocab_counts, int32_t oov, int32_t n_feat) {
+// skip_bad: malformed rows are recorded (avt_bad_count/avt_bad_fill) and
+// skipped instead of failing the parse; the caller compacts their slots.
+void* avt_encode2(const char* buf, int64_t len, char delim,
+                  int32_t n_ordinals, const int8_t* kinds,
+                  const int32_t* feat_slot, const double* bucket_width,
+                  const int64_t* bin_offset, const char* vocab_blob,
+                  const int32_t* vocab_counts, int32_t oov, int32_t n_feat,
+                  int32_t skip_bad) {
   auto* t = new Table();
   t->n_feat = n_feat;
   Spec spec = build_spec(delim, n_ordinals, kinds, feat_slot, bucket_width,
@@ -306,9 +359,21 @@ void* avt_encode(const char* buf, int64_t len, char delim,
   t->has_labels = spec.class_ord >= 0;
   const int64_t rows = count_rows(buf, len, 0);
   alloc_table(t, rows);
-  if (!encode_range(buf, len, 0, spec, t, 0, &t->error)) return t;
+  if (!encode_range(buf, len, 0, spec, t, 0, skip_bad != 0, &t->bad_info,
+                    &t->error))
+    return t;
   t->rows = rows;
   return t;
+}
+
+void* avt_encode(const char* buf, int64_t len, char delim,
+                 int32_t n_ordinals, const int8_t* kinds,
+                 const int32_t* feat_slot, const double* bucket_width,
+                 const int64_t* bin_offset, const char* vocab_blob,
+                 const int32_t* vocab_counts, int32_t oov, int32_t n_feat) {
+  return avt_encode2(buf, len, delim, n_ordinals, kinds, feat_slot,
+                     bucket_width, bin_offset, vocab_blob, vocab_counts, oov,
+                     n_feat, 0);
 }
 
 // avt_encode with a thread-pool executor: the buffer splits into n_threads
@@ -317,13 +382,14 @@ void* avt_encode(const char* buf, int64_t len, char delim,
 // the shared output buffers (disjoint row slices — no merge copy). The
 // earliest bad row wins error reporting, exactly as the serial pass would
 // have reported it.
-void* avt_encode_parallel(const char* buf, int64_t len, char delim,
-                          int32_t n_ordinals, const int8_t* kinds,
-                          const int32_t* feat_slot,
-                          const double* bucket_width,
-                          const int64_t* bin_offset, const char* vocab_blob,
-                          const int32_t* vocab_counts, int32_t oov,
-                          int32_t n_feat, int32_t n_threads) {
+void* avt_encode_parallel2(const char* buf, int64_t len, char delim,
+                           int32_t n_ordinals, const int8_t* kinds,
+                           const int32_t* feat_slot,
+                           const double* bucket_width,
+                           const int64_t* bin_offset, const char* vocab_blob,
+                           const int32_t* vocab_counts, int32_t oov,
+                           int32_t n_feat, int32_t n_threads,
+                           int32_t skip_bad) {
   if (n_threads <= 0) {
     unsigned hw = std::thread::hardware_concurrency();
     n_threads = hw ? static_cast<int32_t>(std::min(hw, 16u)) : 4;
@@ -332,9 +398,9 @@ void* avt_encode_parallel(const char* buf, int64_t len, char delim,
     if (len < (1 << 20)) n_threads = 1;
   }
   if (n_threads == 1)
-    return avt_encode(buf, len, delim, n_ordinals, kinds, feat_slot,
-                      bucket_width, bin_offset, vocab_blob, vocab_counts,
-                      oov, n_feat);
+    return avt_encode2(buf, len, delim, n_ordinals, kinds, feat_slot,
+                       bucket_width, bin_offset, vocab_blob, vocab_counts,
+                       oov, n_feat, skip_bad);
 
   auto* t = new Table();
   t->n_feat = n_feat;
@@ -379,17 +445,24 @@ void* avt_encode_parallel(const char* buf, int64_t len, char delim,
   // pass 2: parse each range into its disjoint output slice (parallel)
   std::vector<std::string> errors(n_ranges);
   std::vector<char> failed(n_ranges, 0);
+  std::vector<std::vector<int64_t>> range_bad(n_ranges);
   {
     std::vector<std::thread> pool;
     pool.reserve(n_ranges);
     for (size_t i = 0; i < n_ranges; ++i)
       pool.emplace_back([&, i] {
         if (!encode_range(buf, starts[i + 1], starts[i], spec, t, base[i],
-                          &errors[i]))
+                          skip_bad != 0, &range_bad[i], &errors[i]))
           failed[i] = 1;
       });
     for (auto& th : pool) th.join();
   }
+  // range order == ascending global row order, so the concatenated bad
+  // list stays row-sorted (and under !skip_bad the earliest failed range
+  // holds the globally earliest bad row)
+  for (size_t i = 0; i < n_ranges; ++i)
+    t->bad_info.insert(t->bad_info.end(), range_bad[i].begin(),
+                       range_bad[i].end());
   for (size_t i = 0; i < n_ranges; ++i) {
     if (failed[i]) {        // earliest range's error = earliest bad row
       t->error = errors[i];
@@ -398,6 +471,31 @@ void* avt_encode_parallel(const char* buf, int64_t len, char delim,
   }
   t->rows = base[n_ranges];
   return t;
+}
+
+void* avt_encode_parallel(const char* buf, int64_t len, char delim,
+                          int32_t n_ordinals, const int8_t* kinds,
+                          const int32_t* feat_slot,
+                          const double* bucket_width,
+                          const int64_t* bin_offset, const char* vocab_blob,
+                          const int32_t* vocab_counts, int32_t oov,
+                          int32_t n_feat, int32_t n_threads) {
+  return avt_encode_parallel2(buf, len, delim, n_ordinals, kinds, feat_slot,
+                              bucket_width, bin_offset, vocab_blob,
+                              vocab_counts, oov, n_feat, n_threads, 0);
+}
+
+int64_t avt_bad_count(void* handle) {
+  return static_cast<int64_t>(
+      static_cast<Table*>(handle)->bad_info.size() / 4);
+}
+
+// out must hold avt_bad_count(handle) * 4 int64s: per bad row
+// (row, line-start byte offset, reason, ordinal), row-ascending.
+void avt_bad_fill(void* handle, int64_t* out) {
+  auto* t = static_cast<Table*>(handle);
+  std::memcpy(out, t->bad_info.data(),
+              t->bad_info.size() * sizeof(int64_t));
 }
 
 int64_t avt_rows(void* handle) {

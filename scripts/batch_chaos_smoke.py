@@ -1,0 +1,350 @@
+#!/usr/bin/env python
+"""Resilient batch execution smoke gate (ISSUE 9 CI guard).
+
+Three fault scenarios over the sharded batch CLI path, each with hard
+pass/fail gates (non-zero exit on any failure):
+
+1. **SIGKILL + --resume** : a sharded NearestNeighbor job over an MR
+   part-file dir is SIGKILLed mid-run (after >= 2 shards committed their
+   rename-atomic completion records), then resumed. Gates: the resumed
+   run's output is BYTE-IDENTICAL to an uninterrupted run; ZERO
+   completed-shard recompute (pre-kill records keep their run nonce and
+   the resume report's ``shards_resumed`` matches); and a clean-input run
+   with the journal on stays byte-identical to the journal-off (HEAD
+   direct-write) path.
+
+2. **Poison-row quarantine** : the same job with malformed rows injected
+   (ragged, non-numeric, unseen class) under ``on.bad.row=quarantine``.
+   Gates: the job completes; EXACT accounting — report
+   ``rows_quarantined`` == injected count == total quarantine-sidecar
+   entries; surviving output equals the clean run's output minus exactly
+   the poisoned ids.
+
+3. **Hung shard + speculative re-execution** : a PrefetchLoader run whose
+   stage wedges the FIRST attempt of one shard far past the job budget.
+   Gates: the job completes within its deadline anyway (the straggler is
+   speculatively re-executed on the spare slot, first result wins), with
+   ``speculative_wins >= 1`` and order/content preserved.
+
+Prints ONE JSON line consumed by bench.py / CI.
+
+Usage: python scripts/batch_chaos_smoke.py [--shards N] [--rows-per-shard N]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu":  # pragma: no cover - TPU-pinned hosts
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+
+N_POISON = 7
+HUNG_SHARD_SLEEP_S = 30.0      # the wedged attempt's nap
+HUNG_JOB_DEADLINE_S = 15.0     # the job must beat this anyway
+
+
+def fail(msg: str) -> None:
+    print(f"batch_chaos_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _write_fixtures(d: str, n_shards: int, rows_per_shard: int):
+    from avenir_tpu.datagen.generators import elearn_rows, elearn_schema_json
+    n_test = n_shards * rows_per_shard
+    rows = elearn_rows(900 + n_test, seed=21)
+    with open(f"{d}/train.csv", "w") as fh:
+        fh.write("\n".join(",".join(r) for r in rows[:900]) + "\n")
+    os.makedirs(f"{d}/testdir")
+    test_rows = rows[900:]
+    for s in range(n_shards):
+        part = test_rows[s * rows_per_shard:(s + 1) * rows_per_shard]
+        with open(f"{d}/testdir/part-{s:05d}", "w") as fh:
+            fh.write("\n".join(",".join(r) for r in part) + "\n")
+    with open(f"{d}/elearn.json", "w") as fh:
+        json.dump(elearn_schema_json(), fh)
+    with open(f"{d}/knn.properties", "w") as fh:
+        fh.write("field.delim.regex=,\nfield.delim=,\n"
+                 f"feature.schema.file.path={d}/elearn.json\n"
+                 f"train.data.path={d}/train.csv\n"
+                 "top.match.count=5\nvalidation.mode=true\n"
+                 "positive.class.value=fail\n"
+                 # determinism for byte-compares across runs: no wall-clock
+                 # speculation heuristics firing on a loaded CI box
+                 "shard.speculate=false\n")
+    return test_rows
+
+
+def _cli_cmd(d: str, out: str, *extra: str):
+    return [sys.executable, "-m", "avenir_tpu", "NearestNeighbor",
+            f"{d}/testdir", out, "--conf", f"{d}/knn.properties",
+            *extra]
+
+
+def _cli_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _run_cli(d: str, out: str, *extra: str, timeout: int = 240) -> str:
+    proc = subprocess.run(_cli_cmd(d, out, *extra), env=_cli_env(),
+                          capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        fail(f"CLI run {out} failed rc={proc.returncode}: "
+             f"{proc.stderr[-1500:]}")
+    return proc.stdout
+
+
+def _journal_records(journal_dir: str) -> dict:
+    recs = {}
+    if not os.path.isdir(journal_dir):
+        return recs
+    for name in os.listdir(journal_dir):
+        if name.startswith("shard-") and name.endswith(".json"):
+            try:
+                with open(os.path.join(journal_dir, name)) as fh:
+                    r = json.load(fh)
+                recs[r["shard"]] = r
+            except (OSError, ValueError, KeyError):
+                pass
+    return recs
+
+
+# --------------------------------------------------------------------------
+# gate 1: SIGKILL mid-run + --resume, byte-identical with zero recompute
+# --------------------------------------------------------------------------
+
+def gate_resume(d: str, n_shards: int) -> dict:
+    # uninterrupted reference (journal ON, default) ...
+    _run_cli(d, f"{d}/out_ref.txt")
+    with open(f"{d}/out_ref.txt") as fh:
+        ref = fh.read()
+    # ... must be byte-identical to the journal-off direct-write path
+    # (clean runs stay byte-identical to HEAD behavior)
+    _run_cli(d, f"{d}/out_direct.txt", "-D", "shard.journal=false")
+    with open(f"{d}/out_direct.txt") as fh:
+        if fh.read() != ref:
+            fail("journal-on clean run is not byte-identical to the "
+                 "direct-write path")
+
+    # killed run: SIGKILL once >= 2 shards committed
+    journal = f"{d}/out_kill.txt.shards"
+    proc = subprocess.Popen(
+        _cli_cmd(d, f"{d}/out_kill.txt", "-D", "shard.journal.keep=true"),
+        env=_cli_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    deadline = time.time() + 180
+    killed = False
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        if len(_journal_records(journal)) >= 2:
+            proc.send_signal(signal.SIGKILL)
+            killed = True
+            break
+        time.sleep(0.005)
+    proc.wait(timeout=60)
+    pre = _journal_records(journal)
+    if not killed or len(pre) >= n_shards:
+        fail(f"SIGKILL never landed mid-run (killed={killed}, "
+             f"committed={len(pre)}/{n_shards}) — widen the kill window "
+             f"with more/larger shards")
+    if os.path.exists(f"{d}/out_kill.txt"):
+        fail("killed run left a (possibly torn) final output file — "
+             "assembly must be rename-atomic at job end only")
+
+    # resume: skips completed shards, byte-identical output
+    report_out = _run_cli(d, f"{d}/out_kill.txt", "--resume",
+                          "-D", "shard.journal.keep=true",
+                          "-D", "shard.report=true")
+    report = json.loads(report_out.strip().splitlines()[-1])
+    post = _journal_records(journal)
+    with open(f"{d}/out_kill.txt") as fh:
+        resumed_bytes = fh.read()
+    if resumed_bytes != ref:
+        fail("resumed output is not byte-identical to the uninterrupted run")
+    if report["shards_resumed"] != len(pre):
+        fail(f"resume report shards_resumed={report['shards_resumed']} != "
+             f"pre-kill committed {len(pre)}")
+    recomputed = [i for i in pre if post[i]["run"] != pre[i]["run"]]
+    if recomputed:
+        fail(f"completed shards {recomputed} were RECOMPUTED on resume "
+             f"(run nonce changed) — the zero-recompute contract is broken")
+    return {
+        "shards_total": n_shards,
+        "committed_before_kill": len(pre),
+        "shards_resumed": report["shards_resumed"],
+        "shards_computed": report["shards_computed"],
+        "byte_identical": True,
+        "zero_recompute": True,
+    }
+
+
+# --------------------------------------------------------------------------
+# gate 2: poison rows quarantined with exact accounting
+# --------------------------------------------------------------------------
+
+def gate_quarantine(d: str, n_shards: int) -> dict:
+    import shutil
+    shutil.copytree(f"{d}/testdir", f"{d}/poisondir")
+    # poison N_POISON rows across shards: ragged, non-numeric, unseen class
+    poisoned_ids = []
+    flavors = ["ragged", "numeric", "class"]
+    per_shard = {}
+    for k in range(N_POISON):
+        shard = k % max(n_shards - 1, 1)   # leave the last shard clean
+        row_i = 3 + 5 * k
+        per_shard.setdefault(shard, []).append((row_i, flavors[k % 3]))
+    for shard, edits in per_shard.items():
+        path = f"{d}/poisondir/part-{shard:05d}"
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+        for row_i, flavor in edits:
+            tokens = lines[row_i].split(",")
+            poisoned_ids.append(tokens[0])
+            if flavor == "ragged":
+                tokens = tokens[:2]
+            elif flavor == "numeric":
+                tokens[2] = "NaP"
+            else:
+                tokens[-1] = "limbo"
+            lines[row_i] = ",".join(tokens)
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+
+    out = subprocess.run(
+        [sys.executable, "-m", "avenir_tpu", "NearestNeighbor",
+         f"{d}/poisondir", f"{d}/out_poison.txt",
+         "--conf", f"{d}/knn.properties",
+         "-D", "on.bad.row=quarantine",
+         "-D", f"quarantine.dir={d}/quarantine"],
+        env=_cli_env(), capture_output=True, text=True, timeout=240)
+    if out.returncode != 0:
+        fail(f"quarantine run crashed: {out.stderr[-1500:]}")
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    if report["rows_quarantined"] != N_POISON:
+        fail(f"rows_quarantined={report['rows_quarantined']} != injected "
+             f"{N_POISON}")
+    sidecar_entries = []
+    for name in sorted(os.listdir(f"{d}/quarantine")):
+        with open(f"{d}/quarantine/{name}") as fh:
+            sidecar_entries += [json.loads(l) for l in fh]
+    if len(sidecar_entries) != N_POISON:
+        fail(f"quarantine sidecars hold {len(sidecar_entries)} entries, "
+             f"expected {N_POISON}")
+    # surviving output == clean output minus exactly the poisoned ids
+    with open(f"{d}/out_ref.txt") as fh:
+        ref_lines = fh.read().splitlines()
+    want = [l for l in ref_lines if l.split(",")[0] not in poisoned_ids]
+    with open(f"{d}/out_poison.txt") as fh:
+        got = fh.read().splitlines()
+    if got != want:
+        fail(f"surviving rows diverge from clean-run-minus-poison "
+             f"({len(got)} vs {len(want)} lines)")
+    reasons = sorted({e["reason"] for e in sidecar_entries})
+    if reasons != ["non-numeric", "ragged", "unseen-class"]:
+        fail(f"unexpected quarantine reasons: {reasons}")
+    return {
+        "poisoned": N_POISON,
+        "rows_quarantined": report["rows_quarantined"],
+        "sidecar_entries": len(sidecar_entries),
+        "survivors_exact": True,
+        "reasons": reasons,
+    }
+
+
+# --------------------------------------------------------------------------
+# gate 3: hung shard -> speculative re-execution within the deadline
+# --------------------------------------------------------------------------
+
+def gate_hung_shard(d: str, n_shards: int) -> dict:
+    import threading
+    from avenir_tpu.datagen.generators import elearn_schema
+    from avenir_tpu.native.prefetch import PrefetchLoader
+    from avenir_tpu.utils.dataset import Featurizer, read_csv_lines
+
+    paths = [f"{d}/testdir/part-{s:05d}" for s in range(n_shards)]
+    fz = Featurizer(elearn_schema()).fit(read_csv_lines(f"{d}/train.csv"))
+    hang_path = paths[n_shards // 2]
+    wedged = threading.Event()
+
+    def stage(table):
+        # wedge the FIRST attempt of one mid-stream shard well past the
+        # job deadline; the speculative re-attempt sails through
+        if table.ids and table.ids[0] == _first_id(hang_path) \
+                and not wedged.is_set():
+            wedged.set()
+            time.sleep(HUNG_SHARD_SLEEP_S)
+        return table
+
+    def _first_id(p):
+        with open(p) as fh:
+            return fh.readline().split(",", 1)[0]
+
+    loader = PrefetchLoader(
+        fz, paths, depth=2, stage=stage,
+        speculate=True, speculative_min_samples=3,
+        speculative_min_wait_s=0.3, speculative_factor=4.0)
+    t0 = time.perf_counter()
+    tables = list(loader)
+    elapsed = time.perf_counter() - t0
+    if elapsed >= HUNG_JOB_DEADLINE_S:
+        fail(f"hung-shard job took {elapsed:.1f}s (deadline "
+             f"{HUNG_JOB_DEADLINE_S:.0f}s) — speculation never rescued it")
+    if not wedged.is_set():
+        fail("the hang injection never fired — the gate tested nothing")
+    if loader.stats.speculative_wins < 1:
+        fail(f"no speculative win recorded: {loader.stats}")
+    if len(tables) != n_shards:
+        fail(f"yielded {len(tables)}/{n_shards} shards")
+    for p, t in zip(paths, tables):   # order preserved
+        if t.ids[0] != _first_id(p):
+            fail(f"shard order broken at {p}")
+    return {
+        "elapsed_s": round(elapsed, 2),
+        "deadline_s": HUNG_JOB_DEADLINE_S,
+        "speculative_launches": loader.stats.speculative_launches,
+        "speculative_wins": loader.stats.speculative_wins,
+        "duplicates_discarded": loader.stats.duplicates_discarded,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=12)
+    ap.add_argument("--rows-per-shard", type=int, default=150)
+    args = ap.parse_args()
+
+    import tempfile
+    t0 = time.perf_counter()
+    d = tempfile.mkdtemp(prefix="batch_chaos_")
+    _write_fixtures(d, args.shards, args.rows_per_shard)
+    resume = gate_resume(d, args.shards)
+    quarantine = gate_quarantine(d, args.shards)
+    hung = gate_hung_shard(d, args.shards)
+
+    print("batch_chaos_smoke OK", file=sys.stderr)
+    print(json.dumps({
+        "batch_chaos_smoke": "ok",
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+        "resume": resume,
+        "quarantine": quarantine,
+        "hung_shard": hung,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,7 +112,9 @@ class TestParallel:
         bad = [list(r) for r in rows]
         bad[700][1] = "NEVER_SEEN"
         path = _write(tmp_path, bad)
-        with pytest.raises(ValueError, match="row 700"):
+        # ISSUE 9: raise-mode errors name the 1-based PHYSICAL line — the
+        # earliest bad row must win even when a later parallel range fails
+        with pytest.raises(ValueError, match="line 701"):
             encode_file(fz, path, n_threads=4)
 
     def test_parallel_crlf_blank_lines(self, tmp_path):

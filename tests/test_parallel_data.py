@@ -243,10 +243,22 @@ def test_shard_table_roundtrip(mesh, churn_fixture):
     assert float(jnp.sum(st.mask)) == 333
 
 
+# jax 0.4.x's CPU client has no cross-process collective runtime (gloo
+# landed in later jax releases): any multi-process psum/allgather dies with
+# this exact XLA error. The subprocess tests below cannot pass on such
+# hosts WHATEVER the repo code does — they skip with the root cause, and
+# TestSimulatedMultiProcessLoad keeps the load_sharded_table slice logic
+# itself regression-covered in-process (the part that used to be masked).
+_CPU_MULTIPROCESS_UNSUPPORTED = (
+    "Multiprocess computations aren't implemented on the CPU backend")
+
+
 def _run_distributed_workers(n_proc, path, mode="load", ckpt="",
                              n_iters=0, timeout=240):
     """Spawn n_proc jax.distributed subprocesses over a localhost
-    coordinator and collect each worker's RESULT json."""
+    coordinator and collect each worker's RESULT json. Skips (with the
+    root cause) when the host's jax build cannot run multi-process
+    collectives at all."""
     import json
     import os
     import socket
@@ -267,6 +279,12 @@ def _run_distributed_workers(n_proc, path, mode="load", ckpt="",
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for i in range(n_proc)]
     outs = [p.communicate(timeout=timeout) for p in procs]
+    if any(_CPU_MULTIPROCESS_UNSUPPORTED in err for _, err in outs):
+        pytest.skip(
+            "this jax build's CPU backend has no multi-process collective "
+            f"runtime (XLA: {_CPU_MULTIPROCESS_UNSUPPORTED!r}); the "
+            "distributed-subprocess contract needs a multi-host-capable "
+            "backend (TPU, or a jax with gloo CPU collectives)")
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, err[-2000:]
     results = []
@@ -335,6 +353,102 @@ def test_cross_process_count_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(res_b[0]["ll"], ll, rtol=1e-4)
     np.testing.assert_allclose(res_b[0]["trans"], model.trans, atol=2e-3)
     np.testing.assert_allclose(res_b[0]["emit"], model.emit, atol=2e-3)
+
+
+class TestSimulatedMultiProcessLoad:
+    """The multi-process slice protocol of load_sharded_table, simulated
+    in-process (ISSUE 9): the subprocess tests above skip on hosts whose
+    jax cannot run cross-process collectives, which used to leave the
+    byte-window → count → slice → featurize → pad pipeline with NO
+    regression coverage at all. This drives the exact same helpers with
+    explicit process ids and checks the assembled global table against
+    the plain in-memory transform."""
+
+    @pytest.mark.parametrize("n_proc", [2, 3, 4])
+    def test_slices_assemble_to_plain_transform(self, tmp_path, n_proc):
+        import math
+        from avenir_tpu.parallel.data import (_pad_local_slice,
+                                              _stream_global_rows)
+        rows = churn_rows(333, seed=4)       # deliberately unaligned
+        path = str(tmp_path / "churn.csv")
+        with open(path, "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows) + "\n")
+        fz = Featurizer(churn_schema()).fit(rows)
+        plain = fz.transform(rows)
+
+        # pass 1 (per process): count rows in this process's byte window
+        size = __import__("os").path.getsize(path)
+        windows = _byte_windows(size, n_proc)
+        counts = [sum(1 for _ in iter_csv_rows(path, byte_window=w))
+                  for w in windows]
+        prefix = np.concatenate([[0], np.cumsum(counts)])
+        n_real = int(prefix[-1])
+        assert n_real == 333                 # windows partition exactly
+
+        # pass 2 (per process): stream-featurize the global row slice
+        q = math.lcm(8, n_proc)              # 8 mesh devices
+        g = ((n_real + q - 1) // q) * q
+        parts, masks = [], []
+        for p in range(n_proc):
+            start, stop = process_slice(g, n_proc, p)
+            lo, hi = min(start, n_real), min(stop, n_real)
+            if lo == hi:
+                lo, hi = n_real - 1, n_real  # all-padding slice prototype
+            binned, numeric, labels, ids = fz.transform_chunked_arrays(
+                _stream_global_rows(path, ",", lo, hi, prefix, windows),
+                with_labels=True, chunk_rows=37)
+            prep, mask, _ids = _pad_local_slice(start, stop, n_real, ids)
+            parts.append((prep(binned), prep(numeric), prep(labels)))
+            masks.append(mask)
+        got_binned = np.concatenate([p[0] for p in parts])
+        got_labels = np.concatenate([p[2] for p in parts])
+        mask = np.concatenate(masks)
+        assert got_binned.shape[0] == g and mask.sum() == n_real
+        keep = mask.astype(bool)
+        np.testing.assert_array_equal(got_binned[keep],
+                                      np.asarray(plain.binned))
+        np.testing.assert_array_equal(got_labels[keep],
+                                      np.asarray(plain.labels))
+
+
+class TestBarrierTimeout:
+    """ISSUE 9 (d): the multi-host allgather barrier must time out with a
+    'process N missing' diagnostic instead of hanging forever."""
+
+    def test_timeout_names_missing_processes(self, tmp_path):
+        import threading
+        from avenir_tpu.parallel.data import _await_barrier
+        beacon_dir = str(tmp_path / "b")
+        # processes 0 (us) and 2 reached the barrier; 1 and 3 never did
+        import os
+        os.makedirs(beacon_dir)
+        open(os.path.join(beacon_dir, "proc-00002"), "w").close()
+        hang = threading.Event()
+        with pytest.raises(RuntimeError) as exc:
+            _await_barrier(lambda: hang.wait(60), beacon_dir=beacon_dir,
+                           process_index=0, process_count=4, timeout_s=0.2)
+        hang.set()                     # release the leaked daemon thread
+        msg = str(exc.value)
+        assert "[1, 3]" in msg and "2/4" in msg and "timed out" in msg
+
+    def test_success_returns_value_and_sweeps_beacon(self, tmp_path):
+        import os
+        from avenir_tpu.parallel.data import _await_barrier
+        beacon_dir = str(tmp_path / "b2")
+        out = _await_barrier(lambda: 42, beacon_dir=beacon_dir,
+                             process_index=0, process_count=1,
+                             timeout_s=5.0)
+        assert out == 42
+        assert not os.path.exists(beacon_dir)   # last one out swept it
+
+    def test_collective_error_propagates(self, tmp_path):
+        from avenir_tpu.parallel.data import _await_barrier
+
+        def boom():
+            raise ValueError("collective exploded")
+        with pytest.raises(ValueError, match="collective exploded"):
+            _await_barrier(boom, beacon_dir=str(tmp_path / "b3"),
+                           process_index=0, process_count=2, timeout_s=5.0)
 
 
 def test_data_dependent_schema_rejected(mesh, tmp_path):
