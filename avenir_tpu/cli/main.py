@@ -898,7 +898,15 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
         # exact = the bit-stable golden path)
         sharded=conf.get_bool("knn.sharded", False),
         mesh_shape=tuple(conf.get_int_list("mesh.shape") or ()),
-        mode=conf.get("knn.mode", "fast"))
+        mode=conf.get("knn.mode", "fast"),
+        # knn.fused hands RAW feed chunks to the normalize→distance→top-k
+        # megakernel (TPU Pallas feed path; bit-identical, default on);
+        # knn.quantized opts into the int8/bf16 candidate pass + exact
+        # f32 re-rank (any backend — passes the bench parity gate)
+        fused=conf.get_bool("knn.fused", True),
+        quantized=conf.get_bool("knn.quantized", False),
+        quantized_oversample=conf.get_int("knn.quantized.oversample", 4),
+        quantized_dtype=conf.get("knn.quantized.dtype", "int8"))
     delim = conf.get("field.delim.out", ",")
 
     if not regression:

@@ -53,6 +53,41 @@ def _distribution_kernel(oh_bins: jnp.ndarray, oh_cls: jnp.ndarray):
         feature_pair_class
 
 
+def _distributions_pallas(bins: jnp.ndarray, labels: jnp.ndarray,
+                          n_bins: int, n_classes: int) -> tuple:
+    """The seven families via the blocked Pallas ``pair_counts`` kernel
+    (ISSUE 10): each family is a contingency count, so the combined-index
+    trick covers them all without ever materializing the [N, F, B] (or
+    [N, F, F, B, B, C]-shaped fused) one-hots the einsum path contracts —
+    ``feature_pair_class[f, g]`` is ``pair_counts(bins_f, bins_g·C +
+    labels)`` reshaped. Counts are exact integers, so every family is
+    byte-identical to ``_distribution_kernel``'s output."""
+    from avenir_tpu.ops import histogram as _hist
+    from avenir_tpu.ops import pallas_histogram as ph
+    interpret = _hist._pallas_hist_interpret()
+    n_f = bins.shape[1]
+    # class counts stay a [N, C] one-hot sum — never a scatter problem
+    cls = jnp.sum(jax.nn.one_hot(labels, n_classes, dtype=jnp.float32),
+                  axis=0)
+    combined = bins * n_classes + labels[:, None]               # [N, F]
+    fpc = jnp.stack([
+        jnp.stack([ph.pair_counts(bins[:, f], combined[:, g], n_bins,
+                                  n_bins * n_classes, interpret=interpret
+                                  ).reshape(n_bins, n_bins, n_classes)
+                   for g in range(n_f)])
+        for f in range(n_f)])                           # [F, F, B, B, C]
+    # every other family is an exact-integer marginal of fpc, so summing
+    # it is bit-identical to launching its own kernel: feature_pair drops
+    # the class axis; feature_class is the diagonal (bin_f == bin_g when
+    # f == g) summed over the redundant second bin axis; feature drops
+    # the class axis from that
+    fp = jnp.sum(fpc, axis=-1)                                  # [F, F, B, B]
+    fc = jnp.stack([jnp.sum(fpc[f, f], axis=1)
+                    for f in range(n_f)])                       # [F, B, C]
+    feature = jnp.sum(fc, axis=-1)                              # [F, B]
+    return cls, feature, fc, fp, fpc
+
+
 @lru_cache(maxsize=None)
 def _sharded_distribution_fn(n_bins: int, n_classes: int):
     """shard_map body for the psum-reduced distribution pass: one-hot +
@@ -101,6 +136,20 @@ def compute_distributions(table: EncodedTable, mesh=None,
             feature_pair_class=np.asarray(fpc),
             feature_ordinals=tuple(f.ordinal for f in table.feature_fields),
             class_values=tuple(table.class_values))
+    from avenir_tpu.ops import histogram as _hist
+    if _hist.pallas_histograms_active():
+        try:
+            cls, feat, fc, fp, fpc = _distributions_pallas(
+                bins, table.labels, n_bins, table.n_classes)
+            return MiDistributions(
+                class_counts=np.asarray(cls), feature=np.asarray(feat),
+                feature_class=np.asarray(fc), feature_pair=np.asarray(fp),
+                feature_pair_class=np.asarray(fpc),
+                feature_ordinals=tuple(
+                    f.ordinal for f in table.feature_fields),
+                class_values=tuple(table.class_values))
+        except Exception as exc:
+            _hist._pallas_fallback(exc)
     oh_bins = jax.nn.one_hot(bins, n_bins, dtype=jnp.float32)
     oh_cls = jax.nn.one_hot(table.labels, table.n_classes, dtype=jnp.float32)
     cls, feat, fc, fp, fpc = _distribution_kernel(oh_bins, oh_cls)

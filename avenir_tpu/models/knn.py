@@ -78,6 +78,19 @@ class KnnConfig:
     # lays every device on the data axis; a second entry adds 'model').
     sharded: bool = False                    # knn.sharded
     mesh_shape: Tuple[int, ...] = ()         # mesh.shape
+    # knn.fused: on the Pallas feed path, hand RAW feature chunks to the
+    # fused normalize→distance→top-k megakernel (ops/pallas_fused.py) —
+    # the normalization scales ride in as kernel operands and the
+    # normalized chunk never materializes host- or HBM-side. Bit-identical
+    # to the staged path; off restores host-side normalize per table.
+    fused: bool = True                       # knn.fused
+    # knn.quantized: low-precision candidate top-k' (k' = oversample·k)
+    # + exact f32 re-rank of the survivors (ops/quantized.py). Passes the
+    # bench parity gate (recall ≥ 0.985, vote agreement ≥ 0.99); the
+    # re-rank restores exact f32 ordering among survivors. Euclidean only.
+    quantized: bool = False                  # knn.quantized
+    quantized_oversample: int = 4            # knn.quantized.oversample
+    quantized_dtype: str = "int8"            # knn.quantized.dtype int8|bf16
 
 
 def _split_features(table: EncodedTable
@@ -116,6 +129,33 @@ def _split_features_host(table: EncodedTable
     return x_num, x_cat
 
 
+def _split_features_host_raw(table: EncodedTable
+                             ) -> Tuple[Optional[np.ndarray],
+                                        Optional[np.ndarray],
+                                        Optional[np.ndarray],
+                                        Optional[np.ndarray]]:
+    """RAW twin of :func:`_split_features_host` for the fused-megakernel
+    feed path: numeric features stay on the fit scale and the
+    normalization range returns alongside — ``(x_num_raw, x_cat, mins,
+    span)`` with ``span`` pre-sanitized (zero-width → 1.0) exactly like
+    the host normalize, so the kernel's ``(x − mins) / span`` reproduces
+    it bit-for-bit. ``mins``/``span`` are ``None`` when the table records
+    no range (already-normalized input)."""
+    num_idx = [i for i, f in enumerate(table.feature_fields)
+               if f.is_numeric or table.is_continuous[i]]
+    cat_idx = [i for i, f in enumerate(table.feature_fields)
+               if f.is_categorical]
+    x_num = np.asarray(table.numeric)[:, num_idx] if num_idx else None
+    x_cat = np.asarray(table.binned)[:, cat_idx] if cat_idx else None
+    mins = span = None
+    if table.norm_min and num_idx:
+        mins_all = np.asarray(table.norm_min, np.float32)
+        span_all = np.asarray(table.norm_max, np.float32) - mins_all
+        span_all = np.where(span_all > 0, span_all, np.float32(1.0))
+        mins, span = mins_all[num_idx], span_all[num_idx]
+    return x_num, x_cat, mins, span
+
+
 def _on_tpu() -> bool:
     return jax.devices()[0].platform == "tpu"
 
@@ -129,29 +169,64 @@ def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
     ``config.feed_chunk_rows`` > 0 streams the test rows through the
     double-buffered DeviceFeed instead of one monolithic dispatch (host
     arrays returned in that case — the chunked path's readback sweep
-    already lands them host-side). ``config.sharded`` scales the whole
-    computation out over the device mesh (train rows sharded, distributed
-    top-k merge) — see :func:`_neighbors_sharded`."""
+    already lands them host-side); with ``config.fused`` (default) the
+    feed hands RAW chunks to the fused normalize→distance→top-k
+    megakernel. ``config.quantized`` opts into the low-precision
+    candidate pass + exact f32 re-rank (any backend, euclidean only).
+    ``config.sharded`` scales the whole computation out over the device
+    mesh (train rows sharded, distributed top-k merge) — see
+    :func:`_neighbors_sharded`."""
     if config.sharded:
+        if config.quantized:
+            raise ValueError(
+                "knn.quantized does not compose with knn.sharded yet: the "
+                "distributed merge runs per-shard XLA candidates; drop one")
         return _neighbors_sharded(train, test, config)
+    if config.quantized and config.algorithm != "euclidean":
+        raise ValueError("knn.quantized supports euclidean only")
     tr_num, tr_cat, n_bins = _split_features(train)
     m = int(test.binned.shape[0])
     feed_active = 0 < config.feed_chunk_rows < m
-    if feed_active:
-        te_num, te_cat = _split_features_host(test)
-    else:
-        te_num, te_cat, _ = _split_features(test)
     from avenir_tpu.ops import pallas_distance
     encoded_width = ((tr_num.shape[1] if tr_num is not None else 0) +
                      (tr_cat.shape[1] if tr_cat is not None else 0) * n_bins)
-    use_pallas = _on_tpu() and pallas_distance.supported(
-        algorithm=config.algorithm, k=config.top_match_count,
-        mode=config.mode, encoded_width=encoded_width)
+    use_pallas = (not config.quantized and _on_tpu() and
+                  pallas_distance.supported(
+                      algorithm=config.algorithm, k=config.top_match_count,
+                      mode=config.mode, encoded_width=encoded_width))
+    # the fused megakernel takes RAW chunks (normalize happens in VMEM,
+    # scales ride as kernel operands) — feed + Pallas only; every other
+    # path keeps the staged host normalize
+    use_fused = feed_active and use_pallas and config.fused
+    if use_fused:
+        te_num, te_cat, norm_mins, norm_span = _split_features_host_raw(test)
+        mins_a = None if norm_mins is None else jnp.asarray(norm_mins)
+        span_a = None if norm_span is None else jnp.asarray(norm_span)
+    elif feed_active:
+        te_num, te_cat = _split_features_host(test)
+    else:
+        te_num, te_cat, _ = _split_features(test)
     # donate the fed test buffers on TPU (chunk HBM reclaimed at consume;
     # the pallas jit manages its own scratch, so only the XLA path opts in)
-    donate = feed_active and _on_tpu() and not use_pallas
+    donate = (feed_active and _on_tpu() and not use_pallas and
+              not config.quantized)
 
     def run(xn, xc):
+        if config.quantized:
+            from avenir_tpu.ops.quantized import quantized_topk
+            return quantized_topk(
+                xn, tr_num, xc, tr_cat,
+                k=config.top_match_count, n_cat_bins=n_bins,
+                distance_scale=config.distance_scale,
+                oversample=config.quantized_oversample,
+                qdtype=config.quantized_dtype,
+                block_size=config.block_size)
+        if use_fused:
+            from avenir_tpu.ops.pallas_fused import fused_topk_pallas
+            return fused_topk_pallas(
+                xn, tr_num, xc, tr_cat, mins=mins_a, span=span_a,
+                k=config.top_match_count, n_cat_bins=n_bins,
+                distance_scale=config.distance_scale)
         if use_pallas:
             return pallas_distance.pairwise_topk_pallas(
                 xn, tr_num, xc, tr_cat,

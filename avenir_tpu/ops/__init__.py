@@ -2,13 +2,97 @@
 
 - ``histogram``        — one-hot/segment count reductions (the MR
   combiner+shuffle+reduce replacement): class/feature/bin counts, pair
-  counts, per-class moments, transition counts
+  counts, per-class moments, transition counts — with a Pallas dispatch
+  for the scatter-shaped families (``pallas_histogram``)
 - ``distance``         — blocked pairwise distance + top-k (XLA path;
   ``pairwise_full`` emits the SameTypeSimilarity scaled-int matrix)
 - ``pallas_distance``  — the hand-scheduled fused TPU kernel for the same
   computation (north-star benchmark path)
+- ``pallas_fused``     — the normalize→distance→top-k megakernel (raw
+  staged chunks in, [M, k] out; nothing between touches HBM)
+- ``quantized``        — int8/bf16 candidate distance pass + exact f32
+  re-rank of the survivors
 - ``infotheory``       — entropy/gini/Hellinger/class-confidence split
   stats, mutual information, gain-ratio pieces
 - ``scanops``          — Viterbi as lax.scan + max-plus associative form
   (the long-sequence/sequence-parallel decode)
+
+This package re-exports the DISPATCH ENTRY POINTS so callers stop
+importing private ``_raw`` helpers: ``pairwise_topk`` /
+``pairwise_topk_raw`` / ``finalize_topk`` (XLA), ``pairwise_topk_pallas``
+/ ``supported`` (Pallas, stubbed when the toolchain lacks Pallas),
+``fused_topk`` (mode/backend dispatch over the fused family) and
+``quantized_topk``. ``HAS_PALLAS`` says whether the Pallas members are
+real.
 """
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.ops.distance import (  # noqa: F401
+    TOPK_BIG, finalize_topk, fused_topk_xla, pairwise_full, pairwise_topk,
+    pairwise_topk_donated, pairwise_topk_raw)
+from avenir_tpu.ops.quantized import quantized_topk  # noqa: F401
+
+try:
+    from avenir_tpu.ops.pallas_distance import (  # noqa: F401
+        encode_mixed, pairwise_topk_pallas, supported)
+    from avenir_tpu.ops.pallas_fused import fused_topk_pallas  # noqa: F401
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover - toolchains without Pallas
+    HAS_PALLAS = False
+
+    def supported(**kwargs) -> bool:
+        """Pallas is unavailable in this toolchain: nothing is supported."""
+        return False
+
+    def pairwise_topk_pallas(*args, **kwargs):
+        raise RuntimeError("Pallas is unavailable in this jax install; "
+                           "use ops.pairwise_topk (the XLA path)")
+
+    def fused_topk_pallas(*args, **kwargs):
+        raise RuntimeError("Pallas is unavailable in this jax install; "
+                           "use ops.fused_topk (dispatches to XLA)")
+
+    def encode_mixed(*args, **kwargs):
+        raise RuntimeError("Pallas is unavailable in this jax install")
+
+
+def fused_topk(x_num_raw: Optional[jnp.ndarray],
+               y_num: Optional[jnp.ndarray],
+               x_cat: Optional[jnp.ndarray] = None,
+               y_cat: Optional[jnp.ndarray] = None,
+               *, k: int, mins: Optional[jnp.ndarray] = None,
+               span: Optional[jnp.ndarray] = None,
+               n_cat_bins: int = 0, distance_scale: int = 1000,
+               algorithm: str = "euclidean", block_size: int = 65536,
+               mode: str = "fast", recall_target: float = 0.99
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused normalize→distance→top-k over RAW test features: the Pallas
+    megakernel on TPU fast-euclidean shapes, else the single-program XLA
+    composition (bit-identical to staged normalize→``pairwise_topk`` in
+    every mode; ``mode="exact"`` is the parity anchor). ``mins``/``span``
+    are the per-numeric-feature fit-time range (``span`` pre-sanitized:
+    zero-width → 1); ``None`` means already normalized."""
+    n_num = x_num_raw.shape[1] if x_num_raw is not None else 0
+    n_cat = x_cat.shape[1] if x_cat is not None else 0
+    encoded_width = n_num + n_cat * n_cat_bins
+    use_pallas = (HAS_PALLAS and
+                  jax.devices()[0].platform == "tpu" and
+                  supported(algorithm=algorithm, k=k, mode=mode,
+                            encoded_width=encoded_width))
+    if use_pallas:
+        return fused_topk_pallas(
+            x_num_raw, y_num, x_cat, y_cat, mins=mins, span=span, k=k,
+            n_cat_bins=n_cat_bins, distance_scale=distance_scale)
+    mins_a = None if mins is None else jnp.asarray(mins, jnp.float32)
+    span_a = None if span is None else jnp.asarray(span, jnp.float32)
+    return fused_topk_xla(
+        x_num_raw, mins_a, span_a, y_num, x_cat, y_cat, k=k,
+        block_size=block_size, algorithm=algorithm, n_cat_bins=n_cat_bins,
+        distance_scale=distance_scale, mode=mode,
+        recall_target=recall_target)

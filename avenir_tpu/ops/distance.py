@@ -36,9 +36,36 @@ from __future__ import annotations
 from functools import partial
 from typing import Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+#: "no neighbor" scaled-int sentinel (shared across the kernel family)
+INT_BIG = 2 ** 30
+
+
+def encode_mixed(num: Optional[jnp.ndarray], cat: Optional[jnp.ndarray],
+                 n_cat_bins: int) -> jnp.ndarray:
+    """Concatenate numeric features with 1/√2-scaled one-hot categoricals so
+    plain squared euclidean equals numeric² + mismatch count. Shared by the
+    Pallas kernels and the quantized pass (pallas-free — toolchains without
+    Pallas still quantize)."""
+    parts = []
+    if num is not None and num.shape[1]:
+        parts.append(num.astype(jnp.float32))
+    if cat is not None and cat.shape[1]:
+        fc = cat.shape[1]
+        offsets = (jnp.arange(fc) * n_cat_bins)[None, :]
+        oh = jax.nn.one_hot(cat + offsets, fc * n_cat_bins,
+                            dtype=jnp.float32)          # [B, fc, fc*n_bins]
+        # offsets give each field a disjoint slot range: summing over the
+        # field axis yields the flat multi-hot row
+        parts.append(jnp.sum(oh, axis=1) * np.float32(1.0 / np.sqrt(2.0)))
+    if not parts:
+        raise ValueError("no features")
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
 
 
 def _sq_euclidean(x: jnp.ndarray, y: jnp.ndarray,
@@ -297,6 +324,13 @@ def _pairwise_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
                           distance_scale=distance_scale, mode=mode)
 
 
+#: public names for the pre-finalize split (the multi-chip merge and the
+#: kernel-family dispatch build on them; the underscore originals remain
+#: as aliases so existing imports keep working)
+pairwise_topk_raw = _pairwise_topk_raw
+finalize_topk = _finalize_topk
+
+
 _TOPK_STATICS = ("k", "block_size", "algorithm", "n_cat_bins",
                  "distance_scale", "mode", "recall_target")
 
@@ -312,6 +346,40 @@ pairwise_topk = partial(jax.jit, static_argnames=_TOPK_STATICS)(
 #: backends that do not support it, so callers gate on platform.
 pairwise_topk_donated = partial(jax.jit, static_argnames=_TOPK_STATICS,
                                 donate_argnums=(0, 2))(_pairwise_topk)
+
+
+def _fused_topk_xla(x_num_raw: Optional[jnp.ndarray],
+                    mins: Optional[jnp.ndarray],
+                    span: Optional[jnp.ndarray],
+                    y_num: Optional[jnp.ndarray],
+                    x_cat: Optional[jnp.ndarray] = None,
+                    y_cat: Optional[jnp.ndarray] = None,
+                    *, k: int, block_size: int = 65536,
+                    algorithm: str = "euclidean", n_cat_bins: int = 0,
+                    distance_scale: int = 1000, mode: str = "fast",
+                    recall_target: float = 0.99
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Normalize→distance→top-k as ONE jitted program: ``x_num_raw`` holds
+    fit-scale test values, ``mins``/``span`` the per-feature range
+    (``span`` pre-sanitized: zero-width → 1; ``None`` scales = identity).
+    The normalize is the identical IEEE elementwise expression the host
+    path (``normalize_numeric`` / ``_split_features_host``) applies, so
+    this is bit-identical to staged normalize→``pairwise_topk`` in every
+    mode — the XLA member of the fused kernel family (the Pallas
+    megakernel ``ops.pallas_fused.fused_topk_pallas`` covers the TPU fast
+    euclidean case; :func:`avenir_tpu.ops.fused_topk` dispatches)."""
+    x_num = x_num_raw
+    if x_num_raw is not None and mins is not None and span is not None:
+        x_num = (x_num_raw - mins[None, :]) / span[None, :]
+    return _pairwise_topk(
+        x_num, y_num, x_cat, y_cat, k=k, block_size=block_size,
+        algorithm=algorithm, n_cat_bins=n_cat_bins,
+        distance_scale=distance_scale, mode=mode,
+        recall_target=recall_target)
+
+
+fused_topk_xla = partial(jax.jit, static_argnames=_TOPK_STATICS)(
+    _fused_topk_xla)
 
 
 @partial(jax.jit, static_argnames=("algorithm", "n_cat_bins",

@@ -10,14 +10,68 @@ combiner, shuffle, and reducer in one compiled op.
 
 All functions take an optional per-row ``weights`` vector; padding rows get
 weight 0 so statically-padded batches never contaminate counts.
+
+PALLAS DISPATCH (ISSUE 10): the two scatter-shaped reductions —
+:func:`class_feature_bin_counts` (the NB train joint) and
+:func:`pair_counts` (MI/Markov contingency) — route to the blocked Pallas
+kernels in ``ops/pallas_histogram.py`` when ``AVENIR_TPU_PALLAS_HIST``
+allows it: ``auto`` (default) uses them on TPU backends only, ``on``
+forces them, ``off`` pins the jnp path, ``interpret`` forces them in
+interpret mode (the CPU tier-1/smoke hook). Integer count families are
+bit-identical either way (exact-in-f32 integers), so callers — including
+``parallel/collective.psum_reduce`` bodies, which trace these functions
+per shard — never see a value change. Any Pallas failure (missing
+import, unsupported backend) falls back to the jnp path with a one-time
+warning; the dispatch must never sink a train job. KNOWN LIMIT: the
+fallback can only catch TRACE-time errors — a Mosaic compile failure
+surfacing when an OUTER jit/shard_map program compiles happens outside
+this dispatch, so if a TPU toolchain rejects these (deliberately plain
+2D int/f32) kernels, ``AVENIR_TPU_PALLAS_HIST=off`` is the kill switch.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+_PALLAS_HIST_ENV = "AVENIR_TPU_PALLAS_HIST"
+_warned_fallback = False
+
+
+def pallas_histograms_active() -> bool:
+    """Should the count reductions run the Pallas kernels? Consulted at
+    trace time (the env read is host-side Python), so a jitted caller
+    bakes the decision per compiled program."""
+    mode = os.environ.get(_PALLAS_HIST_ENV, "auto").lower()
+    if mode in ("on", "interpret"):
+        return True
+    if mode != "auto":
+        return False
+    try:
+        from avenir_tpu.ops import pallas_histogram  # noqa: F401
+    except Exception:
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _pallas_hist_interpret() -> bool:
+    return os.environ.get(_PALLAS_HIST_ENV, "auto").lower() == "interpret"
+
+
+def _pallas_fallback(exc: Exception) -> None:
+    global _warned_fallback
+    if not _warned_fallback:
+        _warned_fallback = True
+        from avenir_tpu.utils.profiling import get_logger
+        get_logger("ops.histogram").warning(
+            "pallas histogram kernel unavailable, using the jnp path: %r",
+            exc)
 
 
 def class_counts(labels: jnp.ndarray, n_classes: int,
@@ -47,14 +101,33 @@ def class_feature_bin_counts(bins: jnp.ndarray, labels: jnp.ndarray,
     This single reduction is the whole BayesianDistribution train job
     (mapper emit (classVal, ord, bin)→1 at BayesianDistribution.java:166-173
     + reducer sum), psum-closed when rows shard over the data axis.
+    Dispatches to the blocked Pallas kernel when
+    ``pallas_histograms_active()`` (module docstring) — bit-identical for
+    the integer count families either way.
 
-    Formulation (round 2, measured interleaved on-chip,
+    jnp formulation (round 2, measured interleaved on-chip,
     scripts/exp_nb_variants*.txt): ONE one-hot over the combined
     (class, bin) index column-summed on the VPU — 1.6× the two-one-hot
     einsum the MXU route needs (and 12× a scatter-add segment-sum, which
     lowers pathologically on TPU). Unweighted calls skip the row multiply
     (another 1.6×) and sum a bf16 one-hot with an exact f32 accumulator.
     """
+    if pallas_histograms_active():
+        try:
+            from avenir_tpu.ops import pallas_histogram
+            return pallas_histogram.class_feature_bin_counts(
+                bins, labels, n_classes, n_bins, weights,
+                interpret=_pallas_hist_interpret())
+        except Exception as exc:
+            _pallas_fallback(exc)
+    return _class_feature_bin_counts_jnp(bins, labels, n_classes, n_bins,
+                                         weights)
+
+
+def _class_feature_bin_counts_jnp(bins: jnp.ndarray, labels: jnp.ndarray,
+                                  n_classes: int, n_bins: int,
+                                  weights: Optional[jnp.ndarray] = None
+                                  ) -> jnp.ndarray:
     if weights is not None:
         # weighted (masked/padded) path: the two-one-hot einsum folds the
         # weights into the narrow [N, C] label term — the combined-index
@@ -91,7 +164,21 @@ def per_class_moments(values: jnp.ndarray, labels: jnp.ndarray,
 def pair_counts(a: jnp.ndarray, b: jnp.ndarray, n_a: int, n_b: int,
                 weights: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """[N] × [N] ids -> [n_a, n_b] contingency counts (Cramér, MI pairs,
-    Markov bigrams all reduce to this)."""
+    Markov bigrams all reduce to this). Dispatches to the blocked Pallas
+    kernel when ``pallas_histograms_active()`` — bit-identical counts."""
+    if pallas_histograms_active():
+        try:
+            from avenir_tpu.ops import pallas_histogram
+            return pallas_histogram.pair_counts(
+                a, b, n_a, n_b, weights,
+                interpret=_pallas_hist_interpret())
+        except Exception as exc:
+            _pallas_fallback(exc)
+    return _pair_counts_jnp(a, b, n_a, n_b, weights)
+
+
+def _pair_counts_jnp(a: jnp.ndarray, b: jnp.ndarray, n_a: int, n_b: int,
+                     weights: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     oh_a = jax.nn.one_hot(a, n_a, dtype=jnp.float32)
     oh_b = jax.nn.one_hot(b, n_b, dtype=jnp.float32)
     if weights is not None:

@@ -97,17 +97,18 @@ from __future__ import annotations
 from functools import partial
 from typing import Optional, Tuple
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# shared with the pallas-free family members (quantized/XLA paths) — the
+# mixed encode and the scaled-int sentinel live in ops.distance
+from avenir_tpu.ops.distance import INT_BIG, encode_mixed  # noqa: F401
+
 LANES = 128
 BIG = 3.0e38          # float sentinel (fits float32)
-INT_BIG = 2 ** 30
 # Tile budget (empirical, v5e): tile_m*tile_n beyond ~4M slab elements blows
 # the 16MB scoped-VMEM limit once the train sweep gets long (observed at
 # (1024, 8192) with 1M train rows). The defaults sit exactly at 4M; callers
@@ -115,31 +116,19 @@ INT_BIG = 2 ** 30
 # configs genuinely failing rather than being silently shrunk).
 
 
-def _topk_kernel(x_ref, y_ref, y2_ref, out_d_ref, out_i_ref,
-                 acc_d, acc_i, *, k: int, tn: int, n_acc: int,
-                 use_bf16: bool):
-    """One (test tile i, train tile j) grid step; j is the inner dimension."""
-    j = pl.program_id(1)
+def _init_accumulators(acc_d, acc_i):
+    """First-train-step reset of the cross-sweep VMEM accumulators."""
+    acc_d[:] = jnp.full(acc_d.shape, BIG, jnp.float32)
+    acc_i[:] = jnp.full(acc_i.shape, -1, jnp.int32)
 
-    @pl.when(j == 0)
-    def _():
-        acc_d[:] = jnp.full(acc_d.shape, BIG, jnp.float32)
-        acc_i[:] = jnp.full(acc_i.shape, -1, jnp.int32)
 
-    x = x_ref[:]
-    y = y_ref[:]
-    if use_bf16:
-        # bf16 MXU inputs (the fast mode's accepted error); the slab and the
-        # min-fold stay f32 — a bf16 fold was tried and sends Mosaic compile
-        # time pathological (per-chunk 16↔32-bit mask relayouts)
-        x = x.astype(jnp.bfloat16)
-        y = y.astype(jnp.bfloat16)
-    cross = lax.dot_general(x, y, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    metric = y2_ref[:] - 2.0 * cross      # [1, TN] broadcast; padded get BIG
-
-    # fold each 128-lane chunk into its accumulator block (global index
-    # tracked alongside); the accumulators persist across the train sweep
+def _fold_lane_chunks(metric, j, acc_d, acc_i, *, tn: int, n_acc: int):
+    """Fold each 128-lane chunk of ``metric`` into its accumulator block
+    (global index tracked alongside); the accumulators persist across the
+    train sweep. Shared by the production kernel and the fused
+    normalize→distance→top-k megakernel (``ops/pallas_fused.py``) — the
+    fold is the part of the schedule the roofline work tuned, so every
+    family member runs the identical op sequence."""
     tm = metric.shape[0]
     n_chunks = tn // LANES
     lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
@@ -153,23 +142,55 @@ def _topk_kernel(x_ref, y_ref, y2_ref, out_d_ref, out_i_ref,
         cur_i = acc_i[:, s * LANES:(s + 1) * LANES]
         acc_i[:, s * LANES:(s + 1) * LANES] = jnp.where(better, idx, cur_i)
 
+
+def _extract_min_k(val, idx, out_d_ref, out_i_ref, *, k: int, tm: int):
+    """k exact min-extractions over the accumulator buckets (ties break to
+    the LOWEST global row id via the inner min-over-equal-values), writing
+    results into the first k lanes of the output refs. Shared by every
+    kernel in the family."""
+    new_d = jnp.full((tm, LANES), BIG, jnp.float32)
+    new_i = jnp.full((tm, LANES), -1, jnp.int32)
+    slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+    for slot in range(k):
+        min_d = jnp.min(val, axis=1, keepdims=True)           # [TM, 1]
+        min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
+                        axis=1, keepdims=True)
+        new_d = jnp.where(slot_lane == slot, min_d, new_d)
+        new_i = jnp.where(slot_lane == slot, min_i, new_i)
+        val = jnp.where((val == min_d) & (idx == min_i), BIG, val)
+    out_d_ref[:] = new_d
+    out_i_ref[:] = new_i
+
+
+def _topk_kernel(x_ref, y_ref, y2_ref, out_d_ref, out_i_ref,
+                 acc_d, acc_i, *, k: int, tn: int, n_acc: int,
+                 use_bf16: bool):
+    """One (test tile i, train tile j) grid step; j is the inner dimension."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        _init_accumulators(acc_d, acc_i)
+
+    x = x_ref[:]
+    y = y_ref[:]
+    if use_bf16:
+        # bf16 MXU inputs (the fast mode's accepted error); the slab and the
+        # min-fold stay f32 — a bf16 fold was tried and sends Mosaic compile
+        # time pathological (per-chunk 16↔32-bit mask relayouts)
+        x = x.astype(jnp.bfloat16)
+        y = y.astype(jnp.bfloat16)
+    cross = lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    metric = y2_ref[:] - 2.0 * cross      # [1, TN] broadcast; padded get BIG
+
+    tm = metric.shape[0]
+    _fold_lane_chunks(metric, j, acc_d, acc_i, tn=tn, n_acc=n_acc)
+
     # last train step: k exact min-extractions over the n_acc*128 buckets
     @pl.when(j == pl.num_programs(1) - 1)
     def _():
-        val = acc_d[:]
-        idx = acc_i[:]
-        new_d = jnp.full((tm, LANES), BIG, jnp.float32)
-        new_i = jnp.full((tm, LANES), -1, jnp.int32)
-        slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
-        for slot in range(k):
-            min_d = jnp.min(val, axis=1, keepdims=True)           # [TM, 1]
-            min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
-                            axis=1, keepdims=True)
-            new_d = jnp.where(slot_lane == slot, min_d, new_d)
-            new_i = jnp.where(slot_lane == slot, min_i, new_i)
-            val = jnp.where((val == min_d) & (idx == min_i), BIG, val)
-        out_d_ref[:] = new_d
-        out_i_ref[:] = new_i
+        _extract_min_k(acc_d[:], acc_i[:], out_d_ref, out_i_ref, k=k, tm=tm)
 
 
 def _pad_rows(a: jnp.ndarray, multiple: int, fill=0.0) -> jnp.ndarray:
@@ -227,26 +248,6 @@ def _pallas_topk_raw(x: jnp.ndarray, y: jnp.ndarray, *, k: int,
     return out_d[:m], out_i[:m]
 
 
-def encode_mixed(num: Optional[jnp.ndarray], cat: Optional[jnp.ndarray],
-                 n_cat_bins: int) -> jnp.ndarray:
-    """Concatenate numeric features with 1/√2-scaled one-hot categoricals so
-    plain squared euclidean equals numeric² + mismatch count."""
-    parts = []
-    if num is not None and num.shape[1]:
-        parts.append(num.astype(jnp.float32))
-    if cat is not None and cat.shape[1]:
-        fc = cat.shape[1]
-        offsets = (jnp.arange(fc) * n_cat_bins)[None, :]
-        oh = jax.nn.one_hot(cat + offsets, fc * n_cat_bins,
-                            dtype=jnp.float32)          # [B, fc, fc*n_bins]
-        # offsets give each field a disjoint slot range: summing over the
-        # field axis yields the flat multi-hot row
-        parts.append(jnp.sum(oh, axis=1) * np.float32(1.0 / np.sqrt(2.0)))
-    if not parts:
-        raise ValueError("no features")
-    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
-
-
 # beyond this encoded width the fixed train BlockSpec no longer fits VMEM
 # comfortably (tile_n * width * 4B); the streaming XLA path handles it instead
 MAX_ENCODED_WIDTH = 512
@@ -270,8 +271,7 @@ def _tpose_tag_kernel(xt_ref, yt_ref, y2_ref, out_d_ref, out_i_ref,
 
     @pl.when(j == 0)
     def _():
-        acc_d[:] = jnp.full(acc_d.shape, BIG, jnp.float32)
-        acc_i[:] = jnp.full(acc_i.shape, -1, jnp.int32)
+        _init_accumulators(acc_d, acc_i)
 
     xt = xt_ref[:]
     yt = yt_ref[:]
@@ -299,18 +299,7 @@ def _tpose_tag_kernel(xt_ref, yt_ref, y2_ref, out_d_ref, out_i_ref,
         tags = acc_i[:]
         col = lax.broadcasted_iota(jnp.int32, val.shape, 1)
         idx = jnp.where(tags < 0, -1, tags * LANES + (col % LANES))
-        new_d = jnp.full((tm, LANES), BIG, jnp.float32)
-        new_i = jnp.full((tm, LANES), -1, jnp.int32)
-        slot_lane = lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
-        for slot in range(k):
-            min_d = jnp.min(val, axis=1, keepdims=True)
-            min_i = jnp.min(jnp.where(val == min_d, idx, INT_BIG),
-                            axis=1, keepdims=True)
-            new_d = jnp.where(slot_lane == slot, min_d, new_d)
-            new_i = jnp.where(slot_lane == slot, min_i, new_i)
-            val = jnp.where((val == min_d) & (idx == min_i), BIG, val)
-        out_d_ref[:] = new_d
-        out_i_ref[:] = new_i
+        _extract_min_k(val, idx, out_d_ref, out_i_ref, k=k, tm=tm)
 
 
 @partial(jax.jit, static_argnames=("k", "tile_m", "tile_n", "n_acc", "mode",
@@ -368,6 +357,23 @@ def supported(*, algorithm: str, k: int, mode: str,
             1 <= k <= LANES and encoded_width <= MAX_ENCODED_WIDTH)
 
 
+def _tile_plan(m: int, n: int, k: int, tile_m: int, tile_n: int, n_acc: int
+               ) -> Tuple[int, int, int, int]:
+    """(k_eff, tile_m, tile_n, n_acc) for a launch — the clamp/grow rules
+    every family member shares: train tile clamps to the 128-rounded train
+    count, test tile to the 8-sublane-rounded query count (small queries
+    must not pay a full default-tile padded sweep), and the bucket count
+    grows with k so expected recall ~1 − (k−1)/(2·buckets) stays ≥ ~97%
+    even at the k=128 ceiling (shrinking the test tile in step keeps the
+    accumulator scratch a few MB of VMEM)."""
+    k_eff = min(k, n)
+    tn = min(tile_n, max(LANES, ((n + LANES - 1) // LANES) * LANES))
+    tile_m = min(tile_m, max(8, ((m + 7) // 8) * 8))
+    n_acc_eff = max(n_acc, (17 * k_eff + LANES - 1) // LANES)
+    tm = tile_m if n_acc_eff <= 8 else max(min(tile_m, 256), 8)
+    return k_eff, tm, tn, n_acc_eff
+
+
 @partial(jax.jit, static_argnames=("k", "n_cat_bins", "distance_scale",
                                    "tile_m", "tile_n", "n_acc", "mode",
                                    "interpret", "layout"))
@@ -396,16 +402,7 @@ def pairwise_topk_pallas(x_num: Optional[jnp.ndarray],
                (x_cat.shape[1] if x_cat is not None else 0))
     n = y.shape[0]
     m = x.shape[0]
-    k_eff = min(k, n)
-    tn = min(tile_n, max(LANES, ((n + LANES - 1) // LANES) * LANES))
-    # clamp the test tile to the (8-sublane-rounded) query count so small
-    # queries don't pay a full default-tile padded sweep
-    tile_m = min(tile_m, max(8, ((m + 7) // 8) * 8))
-    # grow the bucket count with k so expected recall ~1 − (k−1)/(2·buckets)
-    # stays ≥ ~97% even at the k=128 ceiling (needs ~17·k/128 blocks); shrink
-    # the test tile in step so the accumulator scratch stays a few MB of VMEM
-    n_acc_eff = max(n_acc, (17 * k_eff + LANES - 1) // LANES)
-    tm = tile_m if n_acc_eff <= 8 else max(min(tile_m, 256), 8)
+    k_eff, tm, tn, n_acc_eff = _tile_plan(m, n, k, tile_m, tile_n, n_acc)
     raw_fn = (_pallas_topk_tpose_raw if layout == "tpose"
               else _pallas_topk_raw)
     raw_d, raw_i = raw_fn(x, y, k=k_eff, tile_m=tm,

@@ -1,0 +1,202 @@
+"""Fused normalize→distance→top-k Pallas megakernel.
+
+BENCH_r05's frontier is no longer the distance kernel (7.82M rows/s with
+transport removed) but everything around it (4.89M bulk): after PR 3's
+device feed, staged test chunks still pass through a HOST normalize pass
+(``models/knn._split_features_host``) before staging, and the normalized
+copy of every chunk is a real intermediate on the transfer path. This
+module closes that seam: the feed hands RAW feature chunks straight to
+the device and the normalization scales ride into the kernel as
+operands — the per-chunk normalize pass and the full ``[M, N]`` distance
+tile both live only in VMEM.
+
+The kernel is the production ``_topk_kernel`` schedule with one extra
+VPU pass on the test tile: ``x = (x − mins) / span`` (the same IEEE f32
+elementwise ops ``normalize_numeric`` / ``_split_features_host`` apply
+host-side, so the fused path is BIT-IDENTICAL to staged
+normalize→``pairwise_topk_pallas`` — tested in interpret mode). The
+train side is normalized ONCE at staging (it is resident across every
+chunk; re-normalizing it per grid step would re-pay the pass per test
+tile), and the ``|x|²`` finalization constant is computed in the same
+jitted program from the same normalize expression, so XLA fuses it into
+a reduction and the normalized chunk never materializes in HBM either.
+
+Scale layout: ``mins``/``span`` are per-NUMERIC-feature vectors (the
+fit-time range the table records); categorical one-hot columns get the
+identity scale (min 0, span 1) appended inside, so the whole encoded
+matrix normalizes with one broadcast. ``span`` must arrive sanitized
+(zero-width ranges replaced by 1.0) exactly like the host path does.
+
+``mode="exact"`` / non-TPU callers use :func:`avenir_tpu.ops.fused_topk`
+(the dispatch entry), which lowers to the XLA composition
+``ops.distance.fused_topk_xla`` — one jitted program, bit-identical to
+staged normalize→``pairwise_topk`` by construction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from avenir_tpu.ops.pallas_distance import (
+    BIG, INT_BIG, LANES, _extract_min_k, _fold_lane_chunks,
+    _init_accumulators, _pad_rows, _tile_plan, encode_mixed)
+from jax import lax
+
+
+def _fused_topk_kernel(x_ref, y_ref, y2_ref, mins_ref, span_ref,
+                       out_d_ref, out_i_ref, acc_d, acc_i, *,
+                       k: int, tn: int, n_acc: int, use_bf16: bool):
+    """``_topk_kernel`` with the normalize pass fused in front of the dot:
+    the test tile arrives RAW and is scaled in VMEM. One (i, j) grid step;
+    j (train tiles) is the inner dimension."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        _init_accumulators(acc_d, acc_i)
+
+    # the fused normalize: identical elementwise f32 ops to the host path,
+    # so staged and fused paths see bit-equal operands into the dot
+    x = (x_ref[:] - mins_ref[:]) / span_ref[:]
+    y = y_ref[:]
+    if use_bf16:
+        x = x.astype(jnp.bfloat16)
+        y = y.astype(jnp.bfloat16)
+    cross = lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    metric = y2_ref[:] - 2.0 * cross
+
+    tm = metric.shape[0]
+    _fold_lane_chunks(metric, j, acc_d, acc_i, tn=tn, n_acc=n_acc)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        _extract_min_k(acc_d[:], acc_i[:], out_d_ref, out_i_ref, k=k, tm=tm)
+
+
+@partial(jax.jit, static_argnames=("k", "tile_m", "tile_n", "n_acc", "mode",
+                                   "interpret"))
+def _pallas_fused_raw(x: jnp.ndarray, y: jnp.ndarray,
+                      mins: jnp.ndarray, span: jnp.ndarray, *, k: int,
+                      tile_m: int, tile_n: int, n_acc: int, mode: str,
+                      interpret: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Raw fused launch: ``x`` is the RAW encoded test matrix, ``y`` the
+    normalized encoded train matrix; ``mins``/``span`` are full-encoded-
+    width scale vectors. Same contract as ``_pallas_topk_raw``."""
+    m, d = x.shape
+    n = y.shape[0]
+    xp = _pad_rows(x, tile_m)
+    yp = _pad_rows(y, tile_n)
+    y2 = jnp.sum(y * y, axis=1)
+    y2p = jnp.pad(y2, (0, yp.shape[0] - n), constant_values=BIG)[None, :]
+
+    grid = (xp.shape[0] // tile_m, yp.shape[0] // tile_n)
+    kernel = partial(_fused_topk_kernel, k=k, tn=tile_n, n_acc=n_acc,
+                     use_bf16=mode == "fast")
+    out_d, out_i = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_m, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, d), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_m, LANES), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.float32),
+            jax.ShapeDtypeStruct((xp.shape[0], LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_m, n_acc * LANES), jnp.float32),
+            pltpu.VMEM((tile_m, n_acc * LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xp, yp, y2p, mins[None, :], span[None, :])
+    return out_d[:m], out_i[:m]
+
+
+def _encoded_scales(mins: Optional[jnp.ndarray], span: Optional[jnp.ndarray],
+                    n_num: int, cat_width: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pad per-numeric-feature scales with the identity for the one-hot
+    categorical columns so one broadcast normalizes the encoded matrix.
+    ``None`` scales mean "already normalized" — full identity."""
+    if mins is None or span is None:
+        mins_n = jnp.zeros((n_num,), jnp.float32)
+        span_n = jnp.ones((n_num,), jnp.float32)
+    else:
+        mins_n = jnp.asarray(mins, jnp.float32).reshape(-1)
+        span_n = jnp.asarray(span, jnp.float32).reshape(-1)
+    if cat_width:
+        mins_n = jnp.concatenate(
+            [mins_n, jnp.zeros((cat_width,), jnp.float32)])
+        span_n = jnp.concatenate(
+            [span_n, jnp.ones((cat_width,), jnp.float32)])
+    return mins_n, span_n
+
+
+@partial(jax.jit, static_argnames=("k", "n_cat_bins", "distance_scale",
+                                   "tile_m", "tile_n", "n_acc", "mode",
+                                   "interpret"))
+def fused_topk_pallas(x_num: Optional[jnp.ndarray],
+                      y_num: Optional[jnp.ndarray],
+                      x_cat: Optional[jnp.ndarray] = None,
+                      y_cat: Optional[jnp.ndarray] = None,
+                      *, mins: Optional[jnp.ndarray] = None,
+                      span: Optional[jnp.ndarray] = None,
+                      k: int, n_cat_bins: int = 0,
+                      distance_scale: int = 1000,
+                      tile_m: int = 1024, tile_n: int = 4096,
+                      n_acc: int = 4, mode: str = "fast",
+                      interpret: bool = False
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``pairwise_topk_pallas`` taking RAW (un-normalized) test features:
+    ``x_num`` holds fit-scale values, ``mins``/``span`` the per-numeric-
+    feature normalization range (``span`` pre-sanitized: zero-width → 1).
+    ``y_*`` arrive ALREADY normalized (the train table is staged once).
+    Returns the same (scaled-int distances [M, min(k, N)], train indices)
+    contract — bit-identical to host-normalizing ``x_num`` and calling
+    ``pairwise_topk_pallas``."""
+    x = encode_mixed(x_num, x_cat, n_cat_bins)
+    y = encode_mixed(y_num, y_cat, n_cat_bins)
+    n_num = x_num.shape[1] if x_num is not None else 0
+    n_attrs = n_num + (x_cat.shape[1] if x_cat is not None else 0)
+    mins_e, span_e = _encoded_scales(mins, span, n_num, x.shape[1] - n_num)
+    n = y.shape[0]
+    m = x.shape[0]
+    k_eff, tm, tn, n_acc_eff = _tile_plan(m, n, k, tile_m, tile_n, n_acc)
+    raw_d, raw_i = _pallas_fused_raw(x, y, mins_e, span_e, k=k_eff,
+                                     tile_m=tm, tile_n=tn, n_acc=n_acc_eff,
+                                     mode=mode, interpret=interpret)
+    raw_d, raw_i = raw_d[:, :k_eff], raw_i[:, :k_eff]
+    # |x|² from the SAME normalize expression (XLA fuses the elementwise
+    # scale into the reduction — the normalized chunk never lands in HBM),
+    # bit-equal to the staged path's sum over the pre-normalized matrix
+    xn = (x - mins_e[None, :]) / span_e[None, :]
+    x2 = jnp.sum(xn * xn, axis=1, keepdims=True)
+    found = raw_i >= 0
+    sq = jnp.maximum(raw_d + x2, 0.0) / max(n_attrs, 1)
+    dist = jnp.sqrt(sq)
+    scaled = jnp.where(found,
+                       jnp.asarray(jnp.rint(dist * distance_scale),
+                                   jnp.int32),
+                       INT_BIG)
+    return scaled, jnp.where(found, raw_i, -1)

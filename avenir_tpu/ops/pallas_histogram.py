@@ -1,0 +1,193 @@
+"""Pallas one-hot histogram reductions — the count kernels without the
+materialized one-hot.
+
+The jnp paths in ``ops/histogram.py`` build an explicit one-hot tensor
+([N, F, C·B] for the NB joint counts, [N, n] per side for pair counts)
+and contract it; XLA usually fuses the encode into the reduction, but
+the intermediate still sizes the fusion and on large N the scatter-shaped
+layouts spill. Here each count kernel streams row blocks through VMEM:
+the block's one-hot exists only as a compare-against-iota mask in
+registers, accumulated straight into the (tiny) output tile, which is
+revisited across every grid step (the standard Pallas accumulation
+pattern — the output BlockSpec maps all steps to block (0, 0)).
+
+Count semantics are IDENTICAL to the jnp path: out-of-range ids DROP
+(a compare never matches them — the one_hot behavior), padding rows ride
+in with id −1, and integer count families are bit-identical because
+every value is an exact-in-f32 integer (< 2²⁴) regardless of summation
+order. 0/1-weighted (mask) calls keep that exactness; float weights are
+supported with the usual f32 accumulation caveat.
+
+Dispatch lives in ``ops/histogram.py`` (``AVENIR_TPU_PALLAS_HIST``);
+these entry points take an explicit ``interpret=`` so the CPU-only tier-1
+suite covers the kernel logic (tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_DEFAULT_BLOCK = 2048
+
+
+def _block_plan(n: int, block_rows: int) -> int:
+    """Clamp the row block to the (8-sublane-rounded) row count so tiny
+    tables don't pay a full default block of padding."""
+    return min(block_rows, max(8, ((n + 7) // 8) * 8))
+
+
+def _pad_ids(a: np.ndarray | jnp.ndarray, n_pad: int, fill: int
+             ) -> jnp.ndarray:
+    a = jnp.asarray(a, jnp.int32)
+    if n_pad == 0:
+        return a
+    width = ((0, n_pad),) + ((0, 0),) * (a.ndim - 1)
+    return jnp.pad(a, width, constant_values=fill)
+
+
+def _cfb_kernel(bins_ref, labels_ref, w_ref, out_ref, *, n_classes: int,
+                n_bins: int, n_f: int, weighted: bool):
+    """class_feature_bin_counts block step: fold this row block's combined
+    (class, bin) ids into the [F, C·B] accumulator."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[:]                                    # [TN, F]
+    labels = labels_ref[:]                                # [TN, 1]
+    tn = bins.shape[0]
+    cb = n_classes * n_bins
+    valid = ((bins >= 0) & (bins < n_bins) &
+             (labels >= 0) & (labels < n_classes))
+    cid = jnp.where(valid, labels * n_bins + bins, -1)    # [TN, F]
+    iota = lax.broadcasted_iota(jnp.int32, (tn, cb), 1)
+    rows = []
+    for f in range(n_f):
+        oh = (cid[:, f:f + 1] == iota).astype(jnp.float32)   # [TN, CB]
+        if weighted:
+            oh = oh * w_ref[:]                               # [TN, 1] bcast
+        rows.append(jnp.sum(oh, axis=0, keepdims=True))      # [1, CB]
+    acc = rows[0] if n_f == 1 else jnp.concatenate(rows, axis=0)
+    out_ref[:] += acc
+
+
+@partial(jax.jit, static_argnames=("n_classes", "n_bins", "block_rows",
+                                   "interpret"))
+def class_feature_bin_counts(bins: jnp.ndarray, labels: jnp.ndarray,
+                             n_classes: int, n_bins: int,
+                             weights: Optional[jnp.ndarray] = None,
+                             *, block_rows: int = _DEFAULT_BLOCK,
+                             interpret: bool = False) -> jnp.ndarray:
+    """[N, F] bins × [N] labels -> [C, F, B] joint counts — the Pallas twin
+    of ``histogram.class_feature_bin_counts`` (same drop semantics, same
+    [C, F, B] layout, bit-identical for integer-weight families)."""
+    n, n_f = bins.shape
+    if n_f == 0:
+        return jnp.zeros((n_classes, 0, n_bins), jnp.float32)
+    if n == 0:
+        # grid=(0,) would skip the zero-init step and return uninitialized
+        # output memory; the jnp path returns exact zeros here
+        return jnp.zeros((n_classes, n_f, n_bins), jnp.float32)
+    tn = _block_plan(n, block_rows)
+    n_pad = (-n) % tn
+    bins_p = _pad_ids(bins, n_pad, -1)                    # padding drops
+    labels_p = _pad_ids(labels.reshape(-1, 1), n_pad, 0)
+    weighted = weights is not None
+    w_p = (jnp.pad(jnp.asarray(weights, jnp.float32).reshape(-1, 1),
+                   ((0, n_pad), (0, 0)))
+           if weighted else jnp.zeros((bins_p.shape[0], 1), jnp.float32))
+    cb = n_classes * n_bins
+    grid = (bins_p.shape[0] // tn,)
+    kernel = partial(_cfb_kernel, n_classes=n_classes, n_bins=n_bins,
+                     n_f=n_f, weighted=weighted)
+    flat = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tn, n_f), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((n_f, cb), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_f, cb), jnp.float32),
+        interpret=interpret,
+    )(bins_p, labels_p, w_p)
+    return flat.reshape(n_f, n_classes, n_bins).transpose(1, 0, 2)
+
+
+def _pair_kernel(a_ref, b_ref, w_ref, out_ref, *, n_a: int, n_b: int,
+                 weighted: bool):
+    """pair_counts block step: two compare-iota one-hots contracted over
+    the row axis on the MXU, accumulated into the [n_a, n_b] tile."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    a = a_ref[:]                                          # [TN, 1]
+    b = b_ref[:]
+    tn = a.shape[0]
+    oh_a = (a == lax.broadcasted_iota(jnp.int32, (tn, n_a), 1)
+            ).astype(jnp.float32)
+    oh_b = (b == lax.broadcasted_iota(jnp.int32, (tn, n_b), 1)
+            ).astype(jnp.float32)
+    if weighted:
+        oh_a = oh_a * w_ref[:]
+    out_ref[:] += lax.dot_general(oh_a, oh_b, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("n_a", "n_b", "block_rows", "interpret"))
+def pair_counts(a: jnp.ndarray, b: jnp.ndarray, n_a: int, n_b: int,
+                weights: Optional[jnp.ndarray] = None,
+                *, block_rows: int = _DEFAULT_BLOCK,
+                interpret: bool = False) -> jnp.ndarray:
+    """[N] × [N] ids -> [n_a, n_b] contingency counts — the Pallas twin of
+    ``histogram.pair_counts`` (weights fold into the ``a`` side exactly
+    like the jnp einsum)."""
+    n = a.shape[0]
+    if n == 0:
+        # zero grid steps would never run the init; match the jnp zeros
+        return jnp.zeros((n_a, n_b), jnp.float32)
+    tn = _block_plan(n, block_rows)
+    n_pad = (-n) % tn
+    a_p = _pad_ids(jnp.asarray(a).reshape(-1, 1), n_pad, -1)
+    b_p = _pad_ids(jnp.asarray(b).reshape(-1, 1), n_pad, -1)
+    weighted = weights is not None
+    w_p = (jnp.pad(jnp.asarray(weights, jnp.float32).reshape(-1, 1),
+                   ((0, n_pad), (0, 0)))
+           if weighted else jnp.zeros((a_p.shape[0], 1), jnp.float32))
+    grid = (a_p.shape[0] // tn,)
+    kernel = partial(_pair_kernel, n_a=n_a, n_b=n_b, weighted=weighted)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tn, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((n_a, n_b), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_a, n_b), jnp.float32),
+        interpret=interpret,
+    )(a_p, b_p, w_p)

@@ -1,0 +1,181 @@
+"""Quantized distance candidates + exact f32 re-rank.
+
+The second member of the fused kernel family (ISSUE 10 / ROADMAP item 3):
+compute a low-precision candidate top-k' (k' ≈ 4k) — int8 on the MXU's
+8-bit path, or bf16 — then re-score ONLY the survivors in exact f32 and
+re-rank. The expensive [M, N] sweep runs at quantized arithmetic cost;
+the f32 work is O(M·k'·D), a vanishing fraction. Because the re-rank
+recomputes the survivors' metrics with the exact path's own f32 formula
+and sorts them with the exact path's tie rule (lowest global row id
+wins), the output ordering among survivors IS the exact f32 ordering —
+only a true top-k row missing from the candidate set can differ, which
+is what the bench parity gate (recall ≥ 0.985, vote agreement ≥ 0.99,
+scaled-dist error bound) bounds.
+
+Quantization scheme (int8): ONE global symmetric scale
+``s = 127 / max(|x|, |y|)`` — per-feature scales would distort the
+euclidean metric (sum of per-feature squares only survives a uniform
+scale as a monotone transform), so mixed-magnitude features instead cost
+small-feature precision, which the 4× oversample absorbs and the re-rank
+repairs (the adversarial-scale parity matrix in tests/test_quantized.py
+pins this). The candidate metric is the deferred ``y² − 2·x·y`` form in
+int32 (exactly representable in f32 below 2²⁴ — true for every
+encoded width this kernel admits at int8 range), streamed over train
+blocks with a running top-k' merge so the [M, N] slab never
+materializes, exactly like ``_pairwise_topk_raw``.
+
+Euclidean only (the quantized dot has no manhattan form); categorical
+features ride the same ``encode_mixed`` one-hot contraction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from avenir_tpu.ops.distance import INT_BIG, encode_mixed
+
+#: candidate-metric sentinel (mirrors distance.TOPK_BIG)
+_BIG = 3.4e38
+
+QDTYPES = ("int8", "bf16")
+
+
+def _quantize_int8(x: jnp.ndarray, y: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Global symmetric int8 quantization of both operands (shared scale —
+    ranking survives only a uniform transform)."""
+    amax = jnp.maximum(jnp.max(jnp.abs(x)), jnp.max(jnp.abs(y)))
+    s = 127.0 / jnp.maximum(amax, jnp.float32(1e-30))
+    qx = jnp.clip(jnp.round(x * s), -127, 127).astype(jnp.int8)
+    qy = jnp.clip(jnp.round(y * s), -127, 127).astype(jnp.int8)
+    return qx, qy
+
+
+def _candidate_metric(xq, yq_block, qdtype: str) -> jnp.ndarray:
+    """[M, B] deferred low-precision metric ``y² − 2·x·y`` for one train
+    block (per-test-row constants are irrelevant for ranking)."""
+    if qdtype == "int8":
+        cross = lax.dot_general(xq, yq_block, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+        y2 = jnp.sum(yq_block.astype(jnp.int32) ** 2, axis=1)[None, :]
+        return (y2 - 2 * cross).astype(jnp.float32)
+    cross = lax.dot_general(xq.astype(jnp.bfloat16),
+                            yq_block.astype(jnp.bfloat16),
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    y2 = jnp.sum(yq_block * yq_block, axis=1)[None, :]
+    return y2 - 2.0 * cross
+
+
+def _candidate_topk(x: jnp.ndarray, y: jnp.ndarray, kprime: int,
+                    block_size: int, qdtype: str) -> jnp.ndarray:
+    """[M, kprime] candidate train indices from the quantized metric,
+    streamed over train blocks with a running merge (the [M, N] slab
+    stays block-sized)."""
+    m, _ = x.shape
+    n = y.shape[0]
+    if qdtype == "int8":
+        xq, yq = _quantize_int8(x, y)
+    else:
+        xq, yq = x, y
+    block_size = min(block_size, max(n, 1))
+    n_blocks = max((n + block_size - 1) // block_size, 1)
+    n_pad = n_blocks * block_size - n
+    yq_p = jnp.pad(yq, ((0, n_pad), (0, 0)))
+    blocks = yq_p.reshape(n_blocks, block_size, -1)
+    bases = jnp.arange(n_blocks, dtype=jnp.int32) * block_size
+    big = jnp.float32(_BIG)
+
+    def body(carry, xs):
+        best_d, best_i = carry
+        yb, base = xs
+        metric = _candidate_metric(xq, yb, qdtype)
+        col = base + jnp.arange(block_size, dtype=jnp.int32)[None, :]
+        metric = jnp.where(col < n, metric, big)     # padded cols never win
+        neg, li = lax.top_k(-metric, min(kprime, block_size))
+        cand_d, cand_i = -neg, base + li.astype(jnp.int32)
+        all_d = jnp.concatenate([best_d, cand_d], axis=1)
+        all_i = jnp.concatenate([best_i, cand_i], axis=1)
+        neg, pos = lax.top_k(-all_d, kprime)
+        return (-neg, jnp.take_along_axis(all_i, pos, axis=1)), None
+
+    init = (jnp.full((m, kprime), big, jnp.float32),
+            jnp.full((m, kprime), -1, jnp.int32))
+    if n_blocks == 1:
+        (_, best_i), _ = body(init, (blocks[0], bases[0]))
+    else:
+        (_, best_i), _ = lax.scan(body, init, (blocks, bases))
+    return best_i
+
+
+def _rerank_exact(x: jnp.ndarray, y: jnp.ndarray, cand_i: jnp.ndarray,
+                  k: int, n_attrs: int, distance_scale: int
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact f32 re-score of the candidate rows + lexicographic
+    (metric, global row id) sort — the exact path's ordering rule — then
+    the reference finalization (sqrt, ``distance_scale`` int).
+
+    The metric is the ELEMENTWISE ``Σ(x−y)²`` form, not the matmul
+    expansion the [M, N] sweep uses: on O(M·k'·D) gathered candidates the
+    elementwise form costs nothing and has no cancellation, so near-tie
+    survivors (gaps below the expansion's ``x²+y²−2xy`` f32 cancellation
+    noise) still order by their true f32 metric — the property the
+    adversarial parity matrix pins."""
+    found = cand_i >= 0
+    yc = y[jnp.maximum(cand_i, 0)]                     # [M, K', D]
+    diff = x[:, None, :] - yc
+    metric = jnp.sum(diff * diff, axis=2) / max(n_attrs, 1)
+    metric = jnp.where(found, metric, jnp.float32(_BIG))
+    idx_key = jnp.where(found, cand_i, INT_BIG)
+    metric_s, idx_s = lax.sort((metric, idx_key), dimension=1, num_keys=2)
+    metric_s, idx_s = metric_s[:, :k], idx_s[:, :k]
+    ok = metric_s < _BIG
+    dist = jnp.sqrt(metric_s)
+    scaled = jnp.where(ok, jnp.asarray(jnp.rint(dist * distance_scale),
+                                       jnp.int32), INT_BIG)
+    return scaled, jnp.where(ok, idx_s, -1)
+
+
+def _quantized_topk(x_num: Optional[jnp.ndarray],
+                    y_num: Optional[jnp.ndarray],
+                    x_cat: Optional[jnp.ndarray] = None,
+                    y_cat: Optional[jnp.ndarray] = None,
+                    *, k: int, n_cat_bins: int = 0,
+                    distance_scale: int = 1000, oversample: int = 4,
+                    qdtype: str = "int8", block_size: int = 65536,
+                    algorithm: str = "euclidean"
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantized candidate pass + exact f32 re-rank: drop-in for
+    ``pairwise_topk`` (euclidean) — (scaled-int distances
+    [M, min(k, N)], train indices), inputs already normalized like every
+    sibling. ``oversample`` sets k' = min(oversample·k, N)."""
+    if algorithm != "euclidean":
+        raise ValueError(
+            f"quantized distance supports euclidean only, got {algorithm!r}")
+    if qdtype not in QDTYPES:
+        raise ValueError(f"qdtype {qdtype!r} not one of {QDTYPES}")
+    if oversample < 1:
+        raise ValueError("oversample must be >= 1")
+    x = encode_mixed(x_num, x_cat, n_cat_bins)
+    y = encode_mixed(y_num, y_cat, n_cat_bins)
+    n_attrs = ((x_num.shape[1] if x_num is not None else 0) +
+               (x_cat.shape[1] if x_cat is not None else 0))
+    n = y.shape[0]
+    k_eff = min(k, n)
+    kprime = min(max(oversample * k_eff, k_eff), n)
+    cand_i = _candidate_topk(x, y, kprime, block_size, qdtype)
+    return _rerank_exact(x, y, cand_i, k_eff, n_attrs, distance_scale)
+
+
+_QUANT_STATICS = ("k", "n_cat_bins", "distance_scale", "oversample",
+                  "qdtype", "block_size", "algorithm")
+
+#: the production entry (works on every backend — the int8 dot lowers to
+#: the 8-bit MXU path on TPU and plain integer math elsewhere)
+quantized_topk = partial(jax.jit, static_argnames=_QUANT_STATICS)(
+    _quantized_topk)

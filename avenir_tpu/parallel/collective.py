@@ -9,7 +9,7 @@ explicitly-collective device programs over the ``data`` axis:
 
 - **Distributed KNN** (:func:`sharded_topk`): train rows shard over the
   mesh, test rows replicate; each shard runs the unchanged streaming
-  top-k core (``ops.distance._pairwise_topk_raw``) against its rows,
+  top-k core (``ops.distance.pairwise_topk_raw``) against its rows,
   then the per-shard ``[M, k]`` candidates all-gather and a second
   top-k over ``k × n_shards`` candidates closes the merge — the classic
   distributed-KNN reduce (the reference's secondary-sort shuffle,
@@ -58,7 +58,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from avenir_tpu.obs import telemetry
-from avenir_tpu.ops.distance import _finalize_topk, _pairwise_topk_raw
+from avenir_tpu.ops.distance import finalize_topk, pairwise_topk_raw
 from avenir_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, MeshSpec,
                                       make_mesh, shard_map)
 
@@ -190,7 +190,7 @@ def _topk_programs(mesh: Mesh, per: int, k_local: int, k_out: int,
                 P(axis))
 
     def local_shard(xn, yn, xc, yc, yv):
-        d, i = _pairwise_topk_raw(
+        d, i = pairwise_topk_raw(
             xn, yn, xc, yc, k=k_local, block_size=block_size,
             algorithm=algorithm, n_cat_bins=n_cat_bins, mode=mode,
             recall_target=recall_target, y_valid=yv)
@@ -205,7 +205,7 @@ def _topk_programs(mesh: Mesh, per: int, k_local: int, k_out: int,
         return -neg, jnp.take_along_axis(i_all, pos, axis=1)
 
     def finalize(d, i, xn, xc):
-        return _finalize_topk(
+        return finalize_topk(
             d, i, xn if xn.shape[1] else None, xc if xc.shape[1] else None,
             algorithm=algorithm, distance_scale=distance_scale, mode=mode)
 

@@ -35,6 +35,14 @@ Consumers wired in this round: ``models/knn.py`` chunked scoring
 (``to_device``/``stage`` — shard tables arrive device-resident), and
 ``parallel/data.py`` ``shard_table`` (the row-sharded arrays stage
 concurrently on this module's pool).
+
+RAW-CHUNK FEEDS (ISSUE 10): with the fused megakernel
+(``ops/pallas_fused.py``, ``KnnConfig.fused``) the feed stages RAW
+feature chunks — no host normalize pass runs before :func:`pad_rows`,
+and normalization happens inside the consumer kernel from scale
+operands. Zero-padded bucket rows therefore normalize to junk test rows
+on device; they stay row-independent by construction and the consumer's
+epoch-end sweep slices them off exactly like the staged path.
 """
 
 from __future__ import annotations

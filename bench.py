@@ -44,12 +44,23 @@ number is still measured and printed to stderr for the audit trail, and
 feed's telemetry) lands in the JSON artifact. BENCH_FEED_BATCHES=0
 restores the round-5 harness as the headline.
 
+ROUND-10 KERNEL FAMILY (ISSUE 10): two new sweep arms — ``fused`` (the
+normalize→distance→top-k megakernel of ``ops/pallas_fused.py``, fed RAW
+rows with the scale operands, so the number includes the in-kernel
+normalize the staged path pays host-side) and ``quantized`` (int8
+candidates + exact f32 re-rank, held to the same parity gate). The
+sweep winner per (shape, dtype, impl set, device) persists in
+``bench_autotune.json`` under the bench dir — repeated runs skip the
+re-sweep (BENCH_AUTOTUNE=0 re-opens it). The JSON now carries
+``kernel_rows_per_sec`` and ``kernel_gap_fraction`` (1 − bulk/kernel),
+the frontier metric this family is chartered to close.
+
 The reference publishes no numbers (BASELINE.md), so this repo establishes
 the baseline: ``vs_baseline`` is relative to BENCH_BASELINE.json when
 present, else 1.0.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"overlap_fraction", ...}.
+"overlap_fraction", "kernel_gap_fraction", "autotune", ...}.
 """
 
 import json
@@ -61,8 +72,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from avenir_tpu.ops.distance import pairwise_topk
-from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas
+from avenir_tpu.ops import (fused_topk_pallas, pairwise_topk,
+                            pairwise_topk_pallas, quantized_topk)
 
 # bench shape: elearnActivity-like (9 numeric features), scaled up
 N_TRAIN = int(os.environ.get("BENCH_N_TRAIN", 65536))
@@ -78,8 +89,55 @@ REPEATS = int(os.environ.get("BENCH_REPEATS", 12))
 # TPU (the faster one takes the timed sweep — the jax 0.9 toolchain moved
 # their ordering under round 2, and relay mood swings the gap 1.04-1.22x
 # same-day, so a static choice leaves throughput on the table); "pallas" /
-# "xla" pin one path
+# "xla" / "fused" / "quantized" pin one path (ISSUE 10 arms: "fused" is
+# the normalize→distance→top-k megakernel fed raw rows, "quantized" the
+# int8 candidate pass + exact f32 re-rank)
 IMPL = os.environ.get("BENCH_IMPL", "auto")
+_IMPL_CHOICES = ("auto", "pallas", "xla", "fused", "quantized")
+
+# ISSUE 10 autotune cache: the impl-sweep winner per (shape, dtype, impl
+# set, device kind) persists under the bench dir so repeated runs and the
+# smoke scripts skip the re-sweep (every arm costs a parity gate + compile
+# + REPEATS timed draws). BENCH_AUTOTUNE=0 disables; a cache hit times
+# (and parity-gates) ONLY the recorded winner.
+AUTOTUNE = os.environ.get("BENCH_AUTOTUNE", "1").lower() not in (
+    "0", "false", "no", "off", "")
+
+
+def _autotune_path() -> str:
+    return os.environ.get("BENCH_AUTOTUNE_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_autotune.json")
+
+
+def _autotune_key(impl_names) -> str:
+    dev = jax.devices()[0].device_kind.replace(" ", "_")
+    return (f"{N_TRAIN}x{M_TEST}x{N_FEATURES}/k{K}/f32/{dev}/"
+            + "+".join(sorted(impl_names)))
+
+
+def _autotune_load(key: str):
+    try:
+        with open(_autotune_path()) as fh:
+            return json.load(fh).get(key)
+    except Exception:
+        return None
+
+
+def _autotune_store(key: str, winner: str, best_ms: float) -> None:
+    path = _autotune_path()
+    try:
+        cache = {}
+        if os.path.exists(path):
+            with open(path) as fh:
+                cache = json.load(fh)
+        cache[key] = {"winner": winner, "best_ms": round(best_ms, 3)}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(cache, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception as exc:   # the cache must never sink the bench
+        import sys
+        print(f"autotune cache write skipped: {exc!r}", file=sys.stderr)
 
 
 def _timed(chain, test, train) -> float:
@@ -351,16 +409,15 @@ def main() -> None:
     train = jnp.asarray(rng.random((N_TRAIN, N_FEATURES), dtype=np.float32))
     test = jnp.asarray(rng.random((M_TEST, N_FEATURES), dtype=np.float32))
 
-    if IMPL not in ("auto", "pallas", "xla"):
+    if IMPL not in _IMPL_CHOICES:
         # validate up front: previously a typo (e.g. 'palas') fell through
         # to the XLA path on non-TPU backends and benched silently
         # (ADVICE round 3)
-        raise ValueError(
-            f"BENCH_IMPL={IMPL!r} not one of 'auto', 'pallas', 'xla'")
+        raise ValueError(f"BENCH_IMPL={IMPL!r} not one of {_IMPL_CHOICES}")
     on_tpu = jax.devices()[0].platform == "tpu"
-    if IMPL == "pallas" and not on_tpu:
+    if IMPL in ("pallas", "fused") and not on_tpu:
         # a pinned pallas request must not silently time the XLA path
-        raise ValueError("BENCH_IMPL=pallas needs a TPU backend")
+        raise ValueError(f"BENCH_IMPL={IMPL} needs a TPU backend")
     impls = {}
     if IMPL in ("pallas", "auto") and on_tpu:
         impls["pallas"] = lambda t, tr: pairwise_topk_pallas(t, tr, k=K)
@@ -370,32 +427,72 @@ def main() -> None:
         # draw-to-draw jitter, so the min-over-draws gains diversification
         impls["pallas_t"] = lambda t, tr: pairwise_topk_pallas(
             t, tr, k=K, layout="tpose")
-    if IMPL in ("xla", "auto") or not on_tpu:
+    if IMPL in ("fused", "auto") and on_tpu:
+        # ISSUE 10: the megakernel fed RAW rows — the bench rows are
+        # already in [0,1], so the identity scale exercises the in-kernel
+        # normalize at full cost without changing the metric
+        _mins = jnp.zeros((N_FEATURES,), jnp.float32)
+        _span = jnp.ones((N_FEATURES,), jnp.float32)
+        impls["fused"] = lambda t, tr: fused_topk_pallas(
+            t, tr, mins=_mins, span=_span, k=K)
+    if IMPL == "quantized" or (IMPL == "auto" and on_tpu):
+        # int8 candidates on the 8-bit MXU path + exact f32 re-rank; the
+        # shared _parity_gate holds it to the same recall/vote/dist bounds
+        impls["quantized"] = lambda t, tr: quantized_topk(t, tr, k=K)
+    if IMPL in ("xla", "auto"):
         impls["xla"] = lambda t, tr: pairwise_topk(t, tr, k=K, mode="fast")
     if not impls:
         raise ValueError(
             f"BENCH_IMPL={IMPL!r} selects no implementation "
-            "(expected 'auto', 'pallas', or 'xla')")
+            f"(expected one of {_IMPL_CHOICES})")
 
-    chains = {}
-    gate_errors = {}
-    for name, topk in impls.items():
-        try:
-            if on_tpu:
-                _parity_gate(test, train, topk, name)
-            chain = _chain_for(topk)
-            np.asarray(chain(test, train))          # compile + warm
-            chains[name] = chain     # only a WARMED chain enters the
-            #                          timed sweep (a failed warm must not
-            #                          leave a broken chain behind)
-        except AssertionError:
-            raise                                    # a WRONG kernel must
-        except Exception as exc:                     # still sink the bench
-            # a compile/transfer failure on ONE arm must not lose the
-            # round's measurement while other gated arms work (round 5:
-            # three arms; the auto-select tolerates a missing one)
-            gate_errors[name] = exc
-            print(f"arm {name} dropped: {exc!r}", file=sys.stderr)
+    # autotune: a cached winner for this exact (shape, dtype, impl set,
+    # device) restricts the sweep to one arm
+    autotune_info = {"cache": "off"}
+    at_key = None
+    full_impls = dict(impls)
+    if AUTOTUNE and IMPL == "auto" and len(impls) > 1:
+        at_key = _autotune_key(impls)
+        hit = _autotune_load(at_key)
+        if hit and hit.get("winner") in impls:
+            impls = {hit["winner"]: impls[hit["winner"]]}
+            autotune_info = {"cache": "hit", "winner": hit["winner"]}
+            print(f"autotune cache hit: {at_key} -> {hit['winner']} "
+                  f"(sweep skipped; BENCH_AUTOTUNE=0 to re-sweep)",
+                  file=sys.stderr)
+        else:
+            autotune_info = {"cache": "miss"}
+
+    def gate_and_warm(candidates):
+        chains, gate_errors = {}, {}
+        for name, topk in candidates.items():
+            try:
+                if on_tpu:
+                    _parity_gate(test, train, topk, name)
+                chain = _chain_for(topk)
+                np.asarray(chain(test, train))      # compile + warm
+                chains[name] = chain    # only a WARMED chain enters the
+                #                         timed sweep (a failed warm must
+                #                         not leave a broken chain behind)
+            except AssertionError:
+                raise                                # a WRONG kernel must
+            except Exception as exc:                 # still sink the bench
+                # a compile/transfer failure on ONE arm must not lose the
+                # round's measurement while other gated arms work (round
+                # 5: three arms; the auto-select tolerates a missing one)
+                gate_errors[name] = exc
+                print(f"arm {name} dropped: {exc!r}", file=sys.stderr)
+        return chains, gate_errors
+
+    chains, gate_errors = gate_and_warm(impls)
+    if not chains and autotune_info.get("cache") == "hit":
+        # a STALE cached winner (toolchain upgrade broke its compile) must
+        # not lose the round: fall back to the full sweep and re-record
+        print(f"autotune winner {autotune_info['winner']} no longer "
+              f"compiles — falling back to the full sweep", file=sys.stderr)
+        impls = {n: f for n, f in full_impls.items() if n not in gate_errors}
+        autotune_info = {"cache": "stale"}
+        chains, gate_errors = gate_and_warm(impls)
     if not chains:
         raise RuntimeError(f"every impl failed: {gate_errors}")
 
@@ -416,6 +513,10 @@ def main() -> None:
             + f" -> {chosen}", file=sys.stderr)
     elapsed = best[chosen]
     rows_per_sec = M_TEST * ITERS / elapsed
+    if at_key is not None and autotune_info.get("cache") in ("miss",
+                                                             "stale"):
+        _autotune_store(at_key, chosen, elapsed * 1e3)
+    autotune_info.setdefault("winner", chosen)
 
     # ROUND-6 headline: the feed-pipelined bulk (module docstring). The
     # single-draw number above stays as the audit anchor; a feed failure
@@ -444,6 +545,7 @@ def main() -> None:
     # number deliberately stays bulk so vs_baseline is like-for-like with
     # rounds 1-3 MODULO the round-4 single-fetch fix (module docstring),
     # whose effect the legacy-chain line below quantifies in-run
+    kernel_rate = None
     try:
         long_chain = _chain_for_iters(impls[chosen], 4 * ITERS)
         np.asarray(long_chain(test, train))
@@ -501,6 +603,15 @@ def main() -> None:
     }
     if overlap is not None:
         out["overlap_fraction"] = round(overlap, 3)
+    out["autotune"] = autotune_info
+    if kernel_rate:
+        # ISSUE 10 frontier metric: the share of wall time still OUTSIDE
+        # the kernel (1 − bulk/kernel; 0.0 = the kernel is the whole
+        # cost). BENCH_r05 measured 0.37; the fused family exists to
+        # drive this down, so the JSON tracks it per round.
+        out["kernel_rows_per_sec"] = round(kernel_rate, 1)
+        out["kernel_gap_fraction"] = round(
+            max(1.0 - rows_per_sec / kernel_rate, 0.0), 3)
     # ROUND-7 MULTICHIP: aggregate rows/s across the mesh + scaling
     # efficiency vs 1 chip — the metric that makes MULTICHIP_rN.json a
     # measurement instead of a dryrun. The per-chip basis is the XLA

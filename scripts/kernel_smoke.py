@@ -1,0 +1,188 @@
+"""Kernel-family smoke (ISSUE 10, tier-1 via tests/test_pallas.py):
+interpret-mode fused-vs-unfused bit/parity checks plus NB/MI count
+bit-identity across the Pallas histogram dispatch.
+
+Three gates, one JSON line on stdout, non-zero exit on any failure:
+
+1. FUSED: the normalize→distance→top-k megakernel over raw rows +
+   scale operands is BIT-identical to staged host-normalize →
+   ``pairwise_topk_pallas`` (interpret mode), and the XLA composition
+   (``fused_topk_xla``) is bit-identical to staged normalize →
+   ``pairwise_topk`` in exact mode.
+2. QUANTIZED: the int8 candidate pass + exact f32 re-rank holds the
+   bench parity bounds (recall ≥ 0.985, vote agreement ≥ 0.99) and its
+   survivor distances match the f64 ground truth within the rint edge.
+3. NB/MI BIT-IDENTITY: ``--dump`` mode computes a Naive Bayes model and
+   the MI distribution families on a deterministic synthetic table and
+   prints per-array sha256 hashes; the driver runs it twice in
+   subprocesses — ``AVENIR_TPU_PALLAS_HIST=interpret`` (Pallas count
+   kernels) vs ``off`` (jnp) — and compares. Subprocesses, not in-process
+   env flips, because the jit caches bake the dispatch per trace
+   (chaos-smoke discipline: each mode gets a pristine process).
+
+Pallas-free toolchains skip gates 1 and 3's kernel half gracefully
+(``"pallas": "absent"``) — the smoke must stay runnable everywhere.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _nb_mi_hashes() -> dict:
+    """Deterministic NB model + MI families -> {name: sha256}."""
+    from avenir_tpu.explore import mutual_information as mi
+    from avenir_tpu.models import naive_bayes as nb
+    from avenir_tpu.utils.dataset import Featurizer
+    from avenir_tpu.utils.schema import FeatureSchema
+    schema = FeatureSchema.from_json({
+        "fields": [
+            {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+            {"name": "c1", "ordinal": 1, "dataType": "categorical",
+             "cardinality": ["a", "b", "c"], "feature": True},
+            {"name": "c2", "ordinal": 2, "dataType": "categorical",
+             "cardinality": ["x", "y"], "feature": True},
+            {"name": "c3", "ordinal": 3, "dataType": "categorical",
+             "cardinality": ["p", "q", "r", "s"], "feature": True},
+            {"name": "label", "ordinal": 4, "dataType": "categorical",
+             "cardinality": ["no", "yes"]},
+        ]})
+    rng = np.random.default_rng(42)
+    rows = [[str(i), "abc"[rng.integers(3)], "xy"[rng.integers(2)],
+             "pqrs"[rng.integers(4)], ["no", "yes"][rng.integers(2)]]
+            for i in range(613)]
+    table = Featurizer(schema).fit_transform(rows)
+    model, _, _ = nb.train(table)
+    dists = mi.compute_distributions(table)
+    scores = mi.compute_scores(dists)
+    out = {}
+    for name in ("class_counts", "post_counts", "prior_counts"):
+        out[f"nb.{name}"] = hashlib.sha256(
+            np.asarray(getattr(model, name)).tobytes()).hexdigest()
+    for name in ("class_counts", "feature", "feature_class",
+                 "feature_pair", "feature_pair_class"):
+        out[f"mi.{name}"] = hashlib.sha256(
+            getattr(dists, name).tobytes()).hexdigest()
+    # the score files the CLI would write, as a canonical JSON digest
+    out["mi.scores"] = hashlib.sha256(json.dumps(
+        {"fc": sorted(scores.feature_class_mi.items()),
+         "fp": sorted(scores.feature_pair_mi.items()),
+         "ccp": sorted(scores.class_cond_pair_mi.items())},
+        sort_keys=True).encode()).hexdigest()
+    return out
+
+
+def _check_fused() -> dict:
+    try:
+        import jax.experimental.pallas  # noqa: F401
+    except Exception:
+        return {"pallas": "absent", "bit_identical_to_staged": True,
+                "xla_exact_bit_identical": None}
+    from avenir_tpu.ops.distance import fused_topk_xla, pairwise_topk
+    from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas
+    from avenir_tpu.ops.pallas_fused import fused_topk_pallas
+    rng = np.random.default_rng(7)
+    m, n, fn = 48, 700, 6
+    mins = (rng.random(fn).astype(np.float32) - 0.5) * 10.0
+    span = rng.random(fn).astype(np.float32) * 4.0 + 0.25
+    x_raw = rng.random((m, fn), dtype=np.float32) * span + mins
+    y = rng.random((n, fn), dtype=np.float32)
+    x_norm = (x_raw - mins) / span
+    d1, i1 = pairwise_topk_pallas(jnp.asarray(x_norm), jnp.asarray(y), k=5,
+                                  interpret=True, tile_m=32, tile_n=256)
+    d2, i2 = fused_topk_pallas(jnp.asarray(x_raw), jnp.asarray(y),
+                               mins=jnp.asarray(mins), span=jnp.asarray(span),
+                               k=5, interpret=True, tile_m=32, tile_n=256)
+    bit = (np.array_equal(np.asarray(d1), np.asarray(d2)) and
+           np.array_equal(np.asarray(i1), np.asarray(i2)))
+    d3, i3 = pairwise_topk(jnp.asarray(x_norm), jnp.asarray(y), k=5,
+                           mode="exact")
+    d4, i4 = fused_topk_xla(jnp.asarray(x_raw), jnp.asarray(mins),
+                            jnp.asarray(span), jnp.asarray(y), k=5,
+                            mode="exact")
+    xla_bit = (np.array_equal(np.asarray(d3), np.asarray(d4)) and
+               np.array_equal(np.asarray(i3), np.asarray(i4)))
+    return {"pallas": "present", "bit_identical_to_staged": bool(bit),
+            "xla_exact_bit_identical": bool(xla_bit)}
+
+
+def _check_quantized() -> dict:
+    from avenir_tpu.ops.quantized import quantized_topk
+    rng = np.random.default_rng(9)
+    m, n, k = 256, 2048, 5
+    x = rng.random((m, 9), dtype=np.float32)
+    y = rng.random((n, 9), dtype=np.float32)
+    dd = ((x[:, None, :].astype(np.float64) -
+           y[None].astype(np.float64)) ** 2).sum(-1)
+    truth = np.argsort(dd, axis=1)[:, :k]
+    dq, iq = map(np.asarray, quantized_topk(
+        jnp.asarray(x), jnp.asarray(y), k=k, block_size=512))
+    recall = float(np.mean([len(set(t) & set(q.tolist())) / k
+                            for t, q in zip(truth, iq)]))
+    labels = (y[:, 0] > 0.5).astype(np.int64)
+    vote = lambda idx: (labels[idx].mean(axis=1) > 0.5).astype(np.int64)
+    agreement = float((vote(truth) == vote(iq)).mean())
+    ref = np.take_along_axis(dd, iq.astype(np.int64), axis=1)
+    ref_scaled = np.rint(np.sqrt(ref / 9) * 1000).astype(np.int64)
+    err = int(np.max(np.abs(dq.astype(np.int64) - ref_scaled)))
+    return {"recall": recall, "vote_agreement": agreement,
+            "survivor_max_scaled_err": err}
+
+
+def _check_nb_mi() -> dict:
+    """Run --dump twice in pristine subprocesses (interpret vs off) and
+    byte-compare every count family's hash."""
+    results = {}
+    for mode in ("interpret", "off"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   AVENIR_TPU_PALLAS_HIST=mode)
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--dump"],
+            env=env, capture_output=True, text=True, timeout=240)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--dump ({mode}) rc={proc.returncode}: "
+                f"{proc.stderr[-400:]}")
+        results[mode] = json.loads(proc.stdout.strip().splitlines()[-1])
+    mismatched = sorted(
+        name for name in results["off"]
+        if results["interpret"].get(name) != results["off"][name])
+    return {"identical": not mismatched, "mismatched": mismatched,
+            "families": len(results["off"])}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dump", action="store_true",
+                        help="print NB/MI count hashes and exit (the "
+                             "subprocess half of the bit-identity gate)")
+    args = parser.parse_args()
+    if args.dump:
+        print(json.dumps(_nb_mi_hashes(), sort_keys=True))
+        return 0
+    report = {"fused": _check_fused(),
+              "quantized": _check_quantized(),
+              "nb_mi_bit_identity": _check_nb_mi()}
+    ok = (report["fused"]["bit_identical_to_staged"] is True and
+          report["fused"]["xla_exact_bit_identical"] in (True, None) and
+          report["quantized"]["recall"] >= 0.985 and
+          report["quantized"]["vote_agreement"] >= 0.99 and
+          report["quantized"]["survivor_max_scaled_err"] <= 1 and
+          report["nb_mi_bit_identity"]["identical"] is True)
+    report["ok"] = bool(ok)
+    print(json.dumps(report, sort_keys=True))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

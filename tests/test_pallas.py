@@ -1,0 +1,264 @@
+"""Kernel family (ISSUE 10) in interpret mode on CPU: fused
+normalize→distance→top-k megakernel, Pallas histogram reductions, and the
+kernel_smoke tier-1 hook.
+
+Every Pallas launch here runs ``interpret=True`` with small shapes so the
+kernel LOGIC — masking, edge-pad, tie-break by global row id, the fused
+normalize — is covered without a TPU; the whole module skips cleanly on
+a jax install without Pallas (the dispatch entry points in
+``avenir_tpu.ops`` stay importable regardless — pinned below).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+pytest.importorskip("jax.experimental.pallas")
+
+from avenir_tpu import ops
+from avenir_tpu.ops import histogram as H
+from avenir_tpu.ops import pallas_histogram as PH
+from avenir_tpu.ops.distance import fused_topk_xla, pairwise_topk
+from avenir_tpu.ops.pallas_distance import pairwise_topk_pallas
+from avenir_tpu.ops.pallas_fused import fused_topk_pallas
+
+
+def _norm_case(seed, m, n, fn, fc=0, n_bins=4):
+    """(raw x, normalized x, normalized y, cats, mins, span) with mixed
+    per-feature ranges so the fused normalize is doing real work."""
+    rng = np.random.default_rng(seed)
+    mins = (rng.random(fn).astype(np.float32) - 0.5) * 20.0
+    span = rng.random(fn).astype(np.float32) * 9.0 + 0.5
+    x_norm = rng.random((m, fn), dtype=np.float32)
+    y_norm = rng.random((n, fn), dtype=np.float32)
+    x_raw = x_norm * span + mins
+    # recompute the normalized values through the HOST formula so the
+    # comparison target is the staged path's exact bits, not the draw
+    x_norm = (x_raw - mins) / span
+    x_cat = (rng.integers(0, n_bins, (m, fc)).astype(np.int32)
+             if fc else None)
+    y_cat = (rng.integers(0, n_bins, (n, fc)).astype(np.int32)
+             if fc else None)
+    return x_raw, x_norm, y_norm, x_cat, y_cat, mins, span
+
+
+class TestFusedMegakernel:
+    @pytest.mark.parametrize("m,n,fc", [(64, 300, 0), (33, 1000, 2),
+                                        (8, 4, 3)])
+    def test_bit_identical_to_staged_pallas(self, m, n, fc):
+        """Fused (raw chunks + scale operands) == staged (host normalize
+        then the production kernel), BIT-identical — the acceptance bar
+        for handing the feed raw chunks."""
+        x_raw, x_norm, y, x_cat, y_cat, mins, span = _norm_case(
+            0, m, n, 5, fc)
+        d1, i1 = pairwise_topk_pallas(
+            jnp.asarray(x_norm), jnp.asarray(y), None if x_cat is None
+            else jnp.asarray(x_cat), None if y_cat is None
+            else jnp.asarray(y_cat), k=5, n_cat_bins=4,
+            interpret=True, tile_m=32, tile_n=256)
+        d2, i2 = fused_topk_pallas(
+            jnp.asarray(x_raw), jnp.asarray(y), None if x_cat is None
+            else jnp.asarray(x_cat), None if y_cat is None
+            else jnp.asarray(y_cat), mins=jnp.asarray(mins),
+            span=jnp.asarray(span), k=5, n_cat_bins=4,
+            interpret=True, tile_m=32, tile_n=256)
+        assert np.array_equal(np.asarray(d1), np.asarray(d2))
+        assert np.array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_xla_composition_bit_identical_in_exact_mode(self):
+        """The dispatch's XLA member: one-jit normalize→topk == staged
+        normalize→``pairwise_topk``, bit-identical in exact mode (the
+        golden-path acceptance criterion)."""
+        x_raw, x_norm, y, _, _, mins, span = _norm_case(1, 40, 500, 7)
+        d1, i1 = pairwise_topk(jnp.asarray(x_norm), jnp.asarray(y), k=5,
+                               mode="exact")
+        d2, i2 = fused_topk_xla(jnp.asarray(x_raw), jnp.asarray(mins),
+                                jnp.asarray(span), jnp.asarray(y), k=5,
+                                mode="exact")
+        assert np.array_equal(np.asarray(d1), np.asarray(d2))
+        assert np.array_equal(np.asarray(i1), np.asarray(i2))
+        # the package-level dispatcher lowers to the same thing off-TPU
+        d3, i3 = ops.fused_topk(jnp.asarray(x_raw), jnp.asarray(y), k=5,
+                                mins=jnp.asarray(mins),
+                                span=jnp.asarray(span), mode="exact")
+        assert np.array_equal(np.asarray(d1), np.asarray(d3))
+        assert np.array_equal(np.asarray(i1), np.asarray(i3))
+
+    @pytest.mark.parametrize("n", [1, 3, 7, 13])
+    def test_edge_pad_small_train_sets(self, n):
+        """Train tiles round up to tile_n: the padded rows carry a BIG
+        sentinel and must never become anyone's neighbor, at the same
+        adversarial row counts the collective tests use. Train rows sit
+        on well-separated shells (gaps far above bf16 noise) so the
+        expected neighbor SET is unambiguous at fast-mode precision."""
+        rng = np.random.default_rng(2)
+        fn, k = 5, 5
+        mins = (rng.random(fn).astype(np.float32) - 0.5) * 8.0
+        span = rng.random(fn).astype(np.float32) * 3.0 + 0.5
+        x_norm = rng.random((16, fn), dtype=np.float32) * 0.01
+        y = (np.arange(1, n + 1, dtype=np.float32)[:, None] *
+             np.ones((1, fn), np.float32) * 0.3)       # shells 0.3 apart
+        x_raw = x_norm * span + mins
+        x_norm = (x_raw - mins) / span
+        d, i = fused_topk_pallas(
+            jnp.asarray(x_raw), jnp.asarray(y), mins=jnp.asarray(mins),
+            span=jnp.asarray(span), k=k, interpret=True,
+            tile_m=16, tile_n=128)
+        d, i = np.asarray(d), np.asarray(i)
+        assert i.shape == (16, min(k, n))
+        assert np.all((i >= 0) & (i < n))
+        assert np.all(d < 2 ** 30)
+        d_ex, i_ex = map(np.asarray, pairwise_topk(
+            jnp.asarray(x_norm), jnp.asarray(y), k=k, mode="exact"))
+        assert np.array_equal(i_ex, i)      # nearest shells, in order
+        assert np.max(np.abs(d.astype(np.int64) -
+                             d_ex.astype(np.int64))) <= 25
+
+    def test_tie_break_by_global_row_id(self):
+        """Exact duplicate train rows: every slot must resolve to the
+        LOWEST global row id (the single-chip contract the distributed
+        merge reproduces)."""
+        rng = np.random.default_rng(3)
+        row = rng.random(6, dtype=np.float32)
+        y = np.vstack([row] * 8 + [rng.random(6).astype(np.float32) + 5.0
+                                   for _ in range(56)])
+        x = np.repeat(row[None, :], 9, axis=0)
+        mins = np.zeros(6, np.float32)
+        span = np.ones(6, np.float32)
+        d, i = fused_topk_pallas(
+            jnp.asarray(x), jnp.asarray(y), mins=jnp.asarray(mins),
+            span=jnp.asarray(span), k=3, interpret=True,
+            tile_m=16, tile_n=128)
+        i = np.asarray(i)
+        assert np.array_equal(i, np.tile([0, 1, 2], (9, 1)))
+
+
+class TestPallasHistograms:
+    @pytest.mark.parametrize("weighted", [False, True])
+    def test_class_feature_bin_counts_identical(self, weighted):
+        rng = np.random.default_rng(4)
+        n, f, c, b = 1003, 4, 3, 7
+        bins = rng.integers(-1, b + 1, (n, f)).astype(np.int32)  # incl. OOR
+        labels = rng.integers(0, c, (n,)).astype(np.int32)
+        w = ((rng.random(n) < 0.8).astype(np.float32)
+             if weighted else None)
+        ref = np.asarray(H._class_feature_bin_counts_jnp(
+            jnp.asarray(bins), jnp.asarray(labels), c, b,
+            None if w is None else jnp.asarray(w)))
+        got = np.asarray(PH.class_feature_bin_counts(
+            jnp.asarray(bins), jnp.asarray(labels), c, b,
+            None if w is None else jnp.asarray(w), interpret=True,
+            block_rows=128))
+        assert ref.shape == got.shape == (c, f, b)
+        assert np.array_equal(ref, got)
+
+    @pytest.mark.parametrize("weighted", [False, True])
+    def test_pair_counts_identical(self, weighted):
+        rng = np.random.default_rng(5)
+        n = 517                     # forces a ragged padded tail block
+        a = rng.integers(-1, 6, (n,)).astype(np.int32)
+        b = rng.integers(0, 9, (n,)).astype(np.int32)
+        w = ((rng.random(n) < 0.7).astype(np.float32)
+             if weighted else None)
+        ref = np.asarray(H._pair_counts_jnp(
+            jnp.asarray(a), jnp.asarray(b), 5, 9,
+            None if w is None else jnp.asarray(w)))
+        got = np.asarray(PH.pair_counts(
+            jnp.asarray(a), jnp.asarray(b), 5, 9,
+            None if w is None else jnp.asarray(w), interpret=True,
+            block_rows=256))
+        assert np.array_equal(ref, got)
+
+    def test_dispatch_env_interpret(self, monkeypatch):
+        """The ``AVENIR_TPU_PALLAS_HIST`` dispatch seam: ``interpret``
+        routes the public entry through the Pallas kernel, ``off`` pins
+        jnp — same counts either way (byte-identity of the full NB/MI
+        jobs is gated by scripts/kernel_smoke.py in subprocesses, where
+        the jit caches cannot alias across modes)."""
+        rng = np.random.default_rng(6)
+        a = rng.integers(0, 4, (201,)).astype(np.int32)
+        b = rng.integers(0, 5, (201,)).astype(np.int32)
+        monkeypatch.setenv("AVENIR_TPU_PALLAS_HIST", "off")
+        assert not H.pallas_histograms_active()
+        ref = np.asarray(H.pair_counts(jnp.asarray(a), jnp.asarray(b), 4, 5))
+        monkeypatch.setenv("AVENIR_TPU_PALLAS_HIST", "interpret")
+        assert H.pallas_histograms_active()
+        got = np.asarray(H.pair_counts(jnp.asarray(a), jnp.asarray(b), 4, 5))
+        assert np.array_equal(ref, got)
+
+    def test_mi_distributions_byte_identical(self, monkeypatch):
+        from avenir_tpu.explore import mutual_information as mi
+        from avenir_tpu.utils.dataset import Featurizer
+        from avenir_tpu.utils.schema import FeatureSchema
+        schema = FeatureSchema.from_json({
+            "fields": [
+                {"name": "id", "ordinal": 0, "id": True,
+                 "dataType": "string"},
+                {"name": "c1", "ordinal": 1, "dataType": "categorical",
+                 "cardinality": ["a", "b", "c"], "feature": True},
+                {"name": "c2", "ordinal": 2, "dataType": "categorical",
+                 "cardinality": ["x", "y"], "feature": True},
+                {"name": "label", "ordinal": 3, "dataType": "categorical",
+                 "cardinality": ["no", "yes"]},
+            ]})
+        rng = np.random.default_rng(7)
+        rows = [[str(i), "abc"[rng.integers(3)], "xy"[rng.integers(2)],
+                 ["no", "yes"][rng.integers(2)]] for i in range(137)]
+        table = Featurizer(schema).fit_transform(rows)
+        monkeypatch.setenv("AVENIR_TPU_PALLAS_HIST", "off")
+        ref = mi.compute_distributions(table)
+        monkeypatch.setenv("AVENIR_TPU_PALLAS_HIST", "interpret")
+        got = mi.compute_distributions(table)
+        for name in ("class_counts", "feature", "feature_class",
+                     "feature_pair", "feature_pair_class"):
+            assert getattr(ref, name).tobytes() == \
+                getattr(got, name).tobytes(), name
+
+
+def test_ops_exports_public_entry_points():
+    """Satellite: callers must reach every dispatch entry through the
+    package — no more private ``_raw`` imports."""
+    for name in ("pairwise_topk", "pairwise_topk_raw", "finalize_topk",
+                 "pairwise_topk_pallas", "supported", "fused_topk",
+                 "fused_topk_pallas", "fused_topk_xla", "quantized_topk",
+                 "encode_mixed", "HAS_PALLAS"):
+        assert hasattr(ops, name), name
+    assert ops.supported(algorithm="euclidean", k=5, mode="fast")
+    assert not ops.supported(algorithm="manhattan", k=5, mode="fast")
+
+
+def test_kernel_smoke_script():
+    """CI hook (ISSUE 10): interpret-mode fused-vs-unfused bit/parity
+    checks plus NB/MI count bit-identity across the histogram dispatch,
+    mirroring the chaos-smoke pattern (subprocess, one retry for
+    co-tenant load spikes)."""
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "kernel_smoke.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("AVENIR_TPU_PALLAS_HIST", None)
+    last = None
+    for attempt in range(2):
+        proc = subprocess.run([sys.executable, script], env=env,
+                              capture_output=True, text=True, timeout=420)
+        last = proc
+        if proc.returncode == 0:
+            break
+        time.sleep(2)
+    assert last.returncode == 0, (
+        f"kernel_smoke failed twice:\nstdout: {last.stdout[-800:]}\n"
+        f"stderr: {last.stderr[-800:]}")
+    report = json.loads(last.stdout.strip().splitlines()[-1])
+    assert report["fused"]["bit_identical_to_staged"] is True
+    assert report["fused"]["xla_exact_bit_identical"] is True
+    assert report["quantized"]["recall"] >= 0.985
+    assert report["quantized"]["vote_agreement"] >= 0.99
+    assert report["quantized"]["survivor_max_scaled_err"] <= 1
+    assert report["nb_mi_bit_identity"]["identical"] is True
