@@ -2184,6 +2184,18 @@ def main(argv: List[str] = None) -> int:
                              "(spans, compile counts, RSS, counters) after "
                              "the job: JSONL events at PATH, Prometheus "
                              "text exposition at PATH.prom")
+    parser.add_argument("--obs-port", type=int, default=None,
+                        metavar="PORT",
+                        help="serve live observability for this job: "
+                             "/metrics (Prometheus text), /metrics/rates "
+                             "(windowed decisions/s etc.) and /healthz on "
+                             "PORT (0 = auto-assign; the bound port is "
+                             "printed as a JSON line before the job "
+                             "runs). Flag form of the obs.http.port "
+                             "config key; also arms the metrics pump + "
+                             "flight recorder (<metrics-out>.flight.jsonl "
+                             "on crash/SIGUSR2/SLO breach, bar = "
+                             "obs.slo.p99.ms) — ISSUE 11")
     parser.add_argument("--profile-dir", metavar="PATH", default=None,
                         help="profile the job through jax.profiler into "
                              "PATH (an XLA trace viewable in TensorBoard/"
@@ -2230,6 +2242,36 @@ def main(argv: List[str] = None) -> int:
         from avenir_tpu.obs import exporters as obs_exporters
         from avenir_tpu.obs import telemetry as obs_telemetry
         tel_hub = obs_exporters.hub().enable()
+    # live observability (ISSUE 11): --obs-port / obs.http.port arms the
+    # metrics pump (windowed rates ring) + scrape endpoint + flight
+    # recorder for the duration of this job. Port 0 auto-assigns; the
+    # bound port is printed as a JSON line up front (the job JSON smokes
+    # read) because the job's own summary only prints after the run.
+    live_obs = None
+    obs_port = args.obs_port
+    if obs_port is None:
+        conf_port = conf.get_int("obs.http.port", -1)
+        obs_port = conf_port if conf_port >= 0 else None
+    conf_flight = conf.get("obs.flight.path")
+    flight_path = conf_flight or (
+        args.metrics_out + ".flight.jsonl" if args.metrics_out else None)
+    # an EXPLICIT obs.flight.path arms the bundle by itself (like the
+    # worker's --obs-flight); the <metrics-out>.flight.jsonl default is
+    # only where the recorder lands once something else armed it
+    if (obs_port is not None or conf.get_bool("obs.live", False)
+            or conf_flight):
+        import json as _json
+        import os as _os
+        from avenir_tpu.obs.live import start_live_obs
+        slo = conf.get("obs.slo.p99.ms")
+        live_obs = start_live_obs(
+            port=obs_port,
+            interval_s=float(conf.get("obs.pump.interval.s") or 0.25),
+            flight_path=flight_path,
+            slo_p99_ms=float(slo) if slo else None)
+        if live_obs.port is not None:
+            print(_json.dumps({"obs_port": live_obs.port,
+                               "pid": _os.getpid()}), flush=True)
     # the reference's task-retry budget (mapreduce.map.maxattempts=2,
     # resource/knn.properties:5-6) applied at the job level: transient
     # runtime/IO failures (e.g. a dropped accelerator connection) re-run the
@@ -2271,7 +2313,20 @@ def main(argv: List[str] = None) -> int:
                     logger.warning("attempt %d/%d of %s failed; retrying",
                                    attempt, attempts, args.verb,
                                    exc_info=True)
+    except BaseException:
+        # a failing job leaves its flight record (the last N windows
+        # of live rates) beside the metrics file; a clean exit just
+        # tears the pump + endpoint down. The engine/loop crash hooks
+        # usually dumped already — this covers batch verbs. An except
+        # clause, not exc_info-sniffing in finally: a caller invoking
+        # main() inside its own exception handler must not read as a
+        # crashed job.
+        if live_obs is not None:
+            live_obs.crash_dump("crash:cli")
+        raise
     finally:
+        if live_obs is not None:
+            live_obs.stop()
         if tel_hub is not None:
             # the wall-time summary (now with p50/p95/p99) rides along as
             # gauges; dump even on failure — a crashed job's partial

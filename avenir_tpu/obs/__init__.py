@@ -10,11 +10,22 @@ The measurement substrate every job and loop reports into (ISSUE 2):
 - :mod:`avenir_tpu.obs.exporters` — JSONL event log + Prometheus text
   exposition, merged by the :class:`TelemetryHub` singleton together
   with ``MetricsRegistry`` counters.
+- :mod:`avenir_tpu.obs.timeseries` — the LIVE half (ISSUE 11): bounded
+  ring of windowed hub-report deltas (rates, window percentiles), the
+  background :class:`MetricsPump`, and the :class:`FlightRecorder`
+  (crash / SIGUSR2 / SLO-breach dumps).
+- :mod:`avenir_tpu.obs.live` — per-process scrape endpoints
+  (``/metrics``, ``/metrics/rates``, ``/healthz``) and the
+  :func:`start_live_obs` bundle.
+- :mod:`avenir_tpu.obs.tracing` — sampled cross-process event tracing
+  (``id|ts|traceid`` wire stamps) exported as Chrome-trace JSON.
 
-One switch: ``obs.hub().enable()`` (the CLI's ``--metrics-out`` flag).
+One switch: ``obs.hub().enable()`` (the CLI's ``--metrics-out`` flag);
+the live layer opts in per process (``--obs-port`` / ``obs.http.port``).
 """
 
 from avenir_tpu.obs.exporters import (TelemetryHub, hub, merge_reports,
+                                      parse_prometheus_text,
                                       prometheus_text, read_jsonl,
                                       report_to_events, events_to_report,
                                       source_label, write_jsonl,
@@ -27,12 +38,19 @@ from avenir_tpu.obs.telemetry import (BUCKET_BOUNDS_MS, LatencyHistogram,
                                       Tracer, enable, percentiles,
                                       percentiles_weighted,
                                       snapshot_slot_counts, span, tracer)
+from avenir_tpu.obs.timeseries import (FlightRecorder, MetricsPump,
+                                       MetricsRing, counter_delta,
+                                       flight_dump_if_armed)
 
 __all__ = [
-    "BUCKET_BOUNDS_MS", "CompileTracker", "LatencyHistogram",
-    "RuntimeSampler", "TelemetryHub", "Tracer", "device_memory_stats",
-    "enable", "events_to_report", "hub", "install_compile_listener",
-    "merge_reports", "percentiles", "percentiles_weighted",
+    "BUCKET_BOUNDS_MS", "CompileTracker", "FlightRecorder",
+    "LatencyHistogram", "MetricsPump", "MetricsRing",
+    "RuntimeSampler", "TelemetryHub", "Tracer", "counter_delta",
+    "device_memory_stats",
+    "enable", "events_to_report", "flight_dump_if_armed", "hub",
+    "install_compile_listener",
+    "merge_reports", "parse_prometheus_text", "percentiles",
+    "percentiles_weighted",
     "prometheus_text", "read_jsonl",
     "read_proc_status", "report_to_events", "snapshot_brief",
     "snapshot_slot_counts", "source_label", "span", "tracer",

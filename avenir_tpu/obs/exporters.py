@@ -31,7 +31,7 @@ import re
 import socket
 import threading
 import time
-from typing import Callable, Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from avenir_tpu.obs import runtime as _runtime
 from avenir_tpu.obs import telemetry as _telemetry
@@ -48,8 +48,57 @@ def _prom_name(name: str) -> str:
 
 
 def _prom_label(value: str) -> str:
+    """Escape a label VALUE per the exposition format (0.0.4): backslash
+    first (it is the escape character), then double-quote, then newline.
+    Hostile span/gauge/source names — workers are free to put anything
+    in a group id — must not be able to smuggle extra labels or break a
+    scraper's line parse; :func:`parse_prometheus_text` round-trips the
+    escape (tier-1 covered with hostile names)."""
     return value.replace("\\", r"\\").replace('"', r"\"").replace(
         "\n", r"\n")
+
+
+def parse_prometheus_text(text: str) -> List[Tuple[str, Dict[str, str],
+                                                   float]]:
+    """Minimal exposition-format reader: ``(metric name, labels, value)``
+    per sample line, label values UNESCAPED — the inverse of
+    :func:`_prom_label`. Exists for the escaping round-trip tests and
+    the live-scrape smokes (assert decisions/s > 0 straight off a
+    ``/metrics`` body); not a general Prometheus client."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        labels: Dict[str, str] = {}
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            i = 0
+            while i < len(rest) and rest[i] != "}":
+                eq = rest.index("=", i)
+                key = rest[i:eq].lstrip(",").strip()
+                if eq + 1 >= len(rest) or rest[eq + 1] != '"':
+                    raise ValueError(f"malformed label in {line!r}")
+                j = eq + 2
+                buf: List[str] = []
+                while j < len(rest) and rest[j] != '"':
+                    if rest[j] == "\\" and j + 1 < len(rest):
+                        esc = rest[j + 1]
+                        buf.append("\n" if esc == "n" else esc)
+                        j += 2
+                    else:
+                        buf.append(rest[j])
+                        j += 1
+                if j >= len(rest):
+                    raise ValueError(f"unterminated label in {line!r}")
+                labels[key] = "".join(buf)
+                i = j + 1
+            value = float(rest[i + 1:])
+        else:
+            name, _, value_s = line.partition(" ")
+            value = float(value_s)
+        out.append((name, labels, value))
+    return out
 
 
 def report_to_events(report: Dict) -> List[Dict]:

@@ -1,0 +1,279 @@
+"""Live scrape endpoints + the one-call live-observability bundle.
+
+The reference leaned on Storm UI + Hadoop counters to watch a run
+(PAPER.md §1 L0); this is the TPU-native equivalent (ISSUE 11): a tiny
+stdlib ``http.server`` thread per opted-in process serving
+
+- ``GET /metrics`` — Prometheus text exposition of the hub's CURRENT
+  cumulative report (what an actual Prometheus scrapes),
+- ``GET /metrics/rates`` — the :class:`~avenir_tpu.obs.timeseries.
+  MetricsRing` windows as JSON (decisions/s, rewards/s, shed/s, window
+  percentiles — the live dashboard feed),
+- ``GET /healthz`` — liveness + identity + whatever the process's
+  health provider reports (engine workers: model version; elastic
+  workers: current epoch + owned groups).
+
+Opt-in only: nothing here starts unless a process asks
+(``--obs-port`` / ``obs.http.port``), and ``port=0`` auto-assigns —
+the bound port is returned (and printed into the job JSON by callers)
+so smokes and operators can find it.
+
+:func:`start_live_obs` is the bundle every entry point calls: enable
+the hub if needed, start the pump into a fresh ring, optionally bind
+the HTTP thread, arm the flight recorder (crash hooks + atexit backstop
++ SIGUSR2 when on the main thread) — and :meth:`LiveObs.stop` undoes
+all of it cleanly (a clean stop disarms the atexit crash dump).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from avenir_tpu.obs import timeseries as _timeseries
+
+
+class _ObsHandler(BaseHTTPRequestHandler):
+    server_version = "avenir-obs/1"
+
+    def log_message(self, *args) -> None:   # scrapes must not spam stderr
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:   # noqa: N802 (http.server API)
+        owner: "ObsHttpServer" = self.server.owner  # type: ignore
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(200, owner.metrics_text().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/metrics/rates":
+                self._send(200, json.dumps(owner.rates(),
+                                           sort_keys=True).encode(),
+                           "application/json")
+            elif path == "/healthz":
+                self._send(200, json.dumps(owner.health(),
+                                           sort_keys=True).encode(),
+                           "application/json")
+            else:
+                self._send(404, b'{"error": "not found"}',
+                           "application/json")
+        except Exception as exc:
+            # a scrape defect must never take the serving process with
+            # it — and a 500 with the repr beats a dropped connection
+            try:
+                self._send(500, json.dumps(
+                    {"error": repr(exc)}).encode(), "application/json")
+            except Exception:
+                pass
+
+
+class ObsHttpServer:
+    """The per-process scrape endpoint: daemon-threaded stdlib HTTP
+    server over the hub + a ring. ``port=0`` auto-assigns; ``.port``
+    holds the bound one after ``start()``."""
+
+    def __init__(self, ring: Optional[_timeseries.MetricsRing] = None,
+                 host: str = "localhost", port: int = 0,
+                 health_provider: Optional[Callable[[], Dict]] = None):
+        self.ring = ring
+        self.host = host
+        self.port = int(port)
+        self.health_provider = health_provider
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started_at: Optional[float] = None
+
+    # -- endpoint bodies (handler delegates here; tests call directly) ----
+    def metrics_text(self) -> str:
+        from avenir_tpu.obs.exporters import hub, prometheus_text
+        return prometheus_text(hub().report())
+
+    def rates(self) -> Dict:
+        if self.ring is None:
+            return {"format": "avenir-timeseries-v1", "n": 0,
+                    "windows": [], "current": {}}
+        return self.ring.rates_snapshot()
+
+    def health(self) -> Dict:
+        from avenir_tpu.obs.exporters import TelemetryHub
+        h = TelemetryHub._instance
+        out: Dict = {
+            "ok": True,
+            "ts": time.time(),
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "uptime_s": (round(time.time() - self._started_at, 3)
+                         if self._started_at else 0.0),
+            "telemetry_enabled": bool(h is not None and h.enabled),
+        }
+        if self.health_provider is not None:
+            try:
+                out.update(self.health_provider() or {})
+            except Exception as exc:
+                out["provider_error"] = repr(exc)
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "ObsHttpServer":
+        if self.running:
+            return self
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          _ObsHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._started_at = time.time()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="avenir-obs-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self._thread = None
+
+
+class LiveObs:
+    """Handle over one process's live-observability bundle (ring, pump,
+    optional HTTP endpoint, optional flight recorder)."""
+
+    def __init__(self, ring, pump, server: Optional[ObsHttpServer],
+                 recorder, enabled_hub_here: bool):
+        self.ring = ring
+        self.pump = pump
+        self.server = server
+        self.recorder = recorder
+        self._enabled_hub_here = enabled_hub_here
+        self._stopped = False
+
+    @property
+    def port(self) -> Optional[int]:
+        return self.server.port if self.server is not None else None
+
+    def set_health_provider(self, provider: Callable[[], Dict]) -> None:
+        if self.server is not None:
+            self.server.health_provider = provider
+
+    def crash_dump(self, fallback_reason: str) -> None:
+        """Backstop dump for a death that may bypass the engine/loop
+        crash hooks: one final pump sample so the fatal window makes
+        the ring, then a dump that forwards a crash hook's richer
+        attribution when one already landed (``backstop_reason``)."""
+        if self.recorder is not None:
+            self.pump.sample_once()
+            self.recorder.dump(
+                self.recorder.backstop_reason(fallback_reason))
+
+    def _atexit(self) -> None:
+        # the crash backstop: a process that dies without a clean
+        # stop() leaves its flight record behind
+        if not self._stopped:
+            self.crash_dump("atexit")
+
+    def stop(self, dump: bool = False) -> None:
+        """Clean teardown: final pump sample, optional farewell dump,
+        endpoint + pump down, recorder disarmed (no atexit dump, SIGUSR2
+        handler restored, this bundle no longer ``current()``) — a later
+        ``start_live_obs`` in the same process starts from a clean
+        slate instead of chaining into this run's handlers."""
+        global _CURRENT
+        if self._stopped:
+            return
+        self._stopped = True
+        self.pump.stop()
+        if dump and self.recorder is not None:
+            self.recorder.dump("stop")
+        if self.server is not None:
+            self.server.stop()
+        if self.recorder is not None:
+            self.recorder.disarm_signal()
+            atexit.unregister(self._atexit)
+        # disarm only OUR recorder: a newer bundle's armed crash hook
+        # must survive an older (or recorder-less) bundle's stop
+        if (self.recorder is not None
+                and _timeseries.armed_flight_recorder() is self.recorder):
+            _timeseries.arm_flight_recorder(None)
+        if _CURRENT is self:
+            _CURRENT = None
+        if self._enabled_hub_here:
+            from avenir_tpu.obs.exporters import hub
+            hub().disable()
+
+
+# one live bundle per process is the norm (like the hub); entry points
+# that armed it leave it discoverable for deeper wiring (the elastic
+# worker installing its epoch/ownership health provider)
+_CURRENT: Optional[LiveObs] = None
+
+
+def current() -> Optional[LiveObs]:
+    return _CURRENT
+
+
+def start_live_obs(port: Optional[int] = None, host: str = "localhost",
+                   interval_s: float = 0.25,
+                   flight_path: Optional[str] = None,
+                   slo_p99_ms: Optional[float] = None,
+                   ring_windows: int = 240,
+                   health_provider: Optional[Callable[[], Dict]] = None,
+                   arm_signal: bool = True) -> LiveObs:
+    """Arm the live half of ``obs`` for this process.
+
+    - Enables the :class:`TelemetryHub` if nothing else has (remembering
+      whether it did, so ``stop()`` only disables what it enabled).
+    - Starts a :class:`MetricsPump` into a fresh ring at ``interval_s``.
+    - ``port`` not None: binds the scrape endpoint there (0 =
+      auto-assign; read ``.port`` back and surface it in the job JSON).
+    - ``flight_path``: arms a :class:`FlightRecorder` there — crash
+      hooks + atexit backstop + SIGUSR2 (main thread only) + SLO breach
+      at ``slo_p99_ms``.
+    """
+    global _CURRENT
+    from avenir_tpu.obs.exporters import hub
+    h = hub()
+    enabled_here = not h.enabled
+    if enabled_here:
+        h.enable()
+    ring = _timeseries.MetricsRing(max_windows=ring_windows)
+    recorder = None
+    if flight_path:
+        recorder = _timeseries.FlightRecorder(ring, flight_path,
+                                              slo_p99_ms=slo_p99_ms)
+        _timeseries.arm_flight_recorder(recorder)
+        if arm_signal:
+            recorder.arm_signal()
+    pump = _timeseries.MetricsPump(
+        ring, interval_s=interval_s, hub=h,
+        on_window=recorder.check if recorder is not None else None)
+    pump.start()
+    server = None
+    if port is not None:
+        server = ObsHttpServer(ring=ring, host=host, port=port,
+                               health_provider=health_provider)
+        server.start()
+    live = LiveObs(ring, pump, server, recorder, enabled_here)
+    if recorder is not None:
+        atexit.register(live._atexit)
+    _CURRENT = live
+    return live

@@ -67,6 +67,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from avenir_tpu.models.bandits.learners import Learner
 from avenir_tpu.obs import telemetry
+from avenir_tpu.obs import tracing as _tracing
 
 
 @dataclass
@@ -407,8 +408,13 @@ class ServingEngine:
         pairs = _drain_rewards(self.queues, self._drain_max)
         io_s = time.perf_counter() - t0
         if pairs:
+            from avenir_tpu.stream.loop import record_reward_fold
+            tel = self._tel.enabled
+            t1 = time.perf_counter() if tel else 0.0
             self.learner.set_reward_batch(pairs)
             self.stats.rewards += len(pairs)
+            if tel:
+                record_reward_fold(self._tel, t1, len(pairs))
             if self._drift is not None:
                 self._drift.observe_rewards(r for _, r in pairs)
         backlog = getattr(self.queues, "reward_backlog", None)
@@ -417,17 +423,21 @@ class ServingEngine:
         return io_s, len(pairs)
 
     def _complete(self, events: List[str], acks: List[str], handles,
-                  t_pop: float, batch_size: int) -> None:
+                  t_pop: float, traces, batch_size: int) -> None:
         """Finish an in-flight batch: the ONLY blocking readback on the
         path, then the batch's bulk write + bulk ack. Ack strictly after
         write — a death in between replays the batch (at-least-once via
         the pending ledger). ``t_pop`` is the clock read taken before the
         batch's pop: write-done minus it is the pop→action-written
         decision latency every event of the batch observed, recorded once
-        per batch with count ``len(events)`` (ISSUE 6)."""
+        per batch with count ``len(events)`` (ISSUE 6). ``traces`` is the
+        batch's sampled trace ids (None unless the producer stamped one,
+        ISSUE 11): the readback is each traced decision's ``resolve``
+        stamp."""
         t0 = time.perf_counter()
         selections = self.learner.resolve_action_batch(handles)
         t1 = time.perf_counter()
+        _tracing.record_batch(traces, "resolve")
         entries = [(event_id,
                     selections[i * batch_size:(i + 1) * batch_size])
                    for i, event_id in enumerate(events)]
@@ -466,6 +476,11 @@ class ServingEngine:
         self.stats.shed_total += n
         if self._tel.enabled:
             self._tel.record("engine.shed", elapsed_s * 1e3, n)
+            # push the gauge set NOW: shedding means the queue is not
+            # draining, so run()'s end-of-run publish is far away — a
+            # live scrape's shed_per_s must move during the overload,
+            # not arrive as one artificial spike in the final window
+            self._publish_gauges()
 
     def _shed_direct(self) -> None:
         """Preferred shed path: one bulk pop off the adapter
@@ -505,7 +520,17 @@ class ServingEngine:
         """Drain the queues to completion (or ``max_events``), pipelined.
         Per iteration: fold drained rewards, pop the next micro-batch,
         DISPATCH its select, and only then do batch n-1's readback +
-        queue I/O — which the device hides behind batch n's compute."""
+        queue I/O — which the device hides behind batch n's compute.
+
+        Wrapped in the shared flight-recorder crash hook (ISSUE 11):
+        when live obs is armed, the ring's last N windows land beside
+        the metrics file before an exception propagates — the
+        per-second record of what the engine was doing when it died."""
+        from avenir_tpu.obs.timeseries import run_with_flight_dump
+        return run_with_flight_dump(
+            "engine", lambda: self._run_impl(max_events))
+
+    def _run_impl(self, max_events: Optional[int] = None) -> EngineStats:
         learner = self.learner
         batch_size = learner.cfg.batch_size
         processed = 0
@@ -544,13 +569,15 @@ class ServingEngine:
                 events = self._shed(events, cap)
             t1 = time.perf_counter()
             acks = events
+            traces = None
             if events and self._event_ts:
-                from avenir_tpu.stream.loop import strip_event_timestamps
-                events = strip_event_timestamps(acks, self._tel)
+                from avenir_tpu.stream.loop import strip_event_stamps
+                events, traces = strip_event_stamps(acks, self._tel)
             handles = None
             if events:
                 handles = learner.next_action_batch_async(
                     len(events) * batch_size)
+                _tracing.record_batch(traces, "dispatch")
             t2 = time.perf_counter()
             self.stats.io_ms += (io_s + (t1 - t0)) * 1e3
             self.stats.dispatch_ms += (t2 - t1) * 1e3
@@ -562,7 +589,7 @@ class ServingEngine:
                 break
             # the pre-pop clock read rides along as the batch's
             # decision-latency anchor
-            pending = (events, acks, handles, t_anchor)
+            pending = (events, acks, handles, t_anchor, traces)
             processed += len(events)
             if max_events is None or processed < max_events:
                 self._cap.update(len(events))
@@ -634,6 +661,8 @@ class GroupedServingEngine:
         self.stats.io_ms += (time.perf_counter() - t0) * 1e3
         if not pairs:
             return
+        tel = self._tel.enabled
+        t_fold = time.perf_counter() if tel else 0.0
         n = len(self.groups)
         # wave w = the w-th reward of each context, assigned by a
         # per-context counter (O(pairs); a linear wave scan would be
@@ -656,21 +685,29 @@ class GroupedServingEngine:
                 idx[gidx], rew[gidx], mask[gidx] = aidx, reward, True
             self.gl.reward_masked(idx, rew, mask)
         self.stats.rewards += len(pairs)
+        if tel:
+            # fold time per reward covers wave build + masked dispatches
+            from avenir_tpu.stream.loop import record_reward_fold
+            record_reward_fold(self._tel, t_fold, len(pairs))
         backlog = getattr(self.queues, "reward_backlog", None)
         if backlog is not None:
             self.stats.reward_backlog = int(backlog)
 
     def _make_waves(self, events: List[str]
-                    ) -> List[List[Tuple[str, int, str]]]:
-        """Wave w = the w-th pending event of each context, in pop order
-        (per-context counters: O(events), not a per-event wave scan).
-        Entries are ``(write_id, group_index, raw_payload)`` — write id
-        and raw differ only in timestamps mode, where the enqueue stamp
-        is peeled into ``engine.queue_wait``."""
-        ids = events
+                    ) -> Tuple[List[List[Tuple[str, int, str]]],
+                               Optional[List[str]]]:
+        """``(waves, sampled trace ids)``. Wave w = the w-th pending
+        event of each context, in pop order (per-context counters:
+        O(events), not a per-event wave scan). Entries are
+        ``(write_id, group_index, raw_payload)`` — write id and raw
+        differ only in timestamps mode, where the enqueue stamp is
+        peeled into ``engine.queue_wait`` (and any trace id is kept:
+        the batch's dispatch/resolve stamps are recorded like
+        ServingEngine's, ISSUE 11)."""
+        ids, traces = events, None
         if self._event_ts:
-            from avenir_tpu.stream.loop import strip_event_timestamps
-            ids = strip_event_timestamps(events, self._tel)
+            from avenir_tpu.stream.loop import strip_event_stamps
+            ids, traces = strip_event_stamps(events, self._tel)
         waves: List[List[Tuple[str, int, str]]] = []
         depth: Dict[int, int] = {}
         for event_id, raw in zip(ids, events):
@@ -680,13 +717,14 @@ class GroupedServingEngine:
             if w == len(waves):
                 waves.append([])
             waves[w].append((event_id, gidx, raw))
-        return waves
+        return waves, traces
 
-    def _complete(self, waves, handles, t_pop: float) -> None:
+    def _complete(self, waves, handles, t_pop: float, traces) -> None:
         import numpy as np
         t0 = time.perf_counter()
         resolved = [np.asarray(h) for h in handles]   # the blocking fetch
         t1 = time.perf_counter()
+        _tracing.record_batch(traces, "resolve")
         entries = []
         acks = []
         for wave, actions in zip(waves, resolved):
@@ -716,6 +754,11 @@ class GroupedServingEngine:
             self._on_batch(n_events)
 
     def run(self, max_events: Optional[int] = None) -> EngineStats:
+        from avenir_tpu.obs.timeseries import run_with_flight_dump
+        return run_with_flight_dump(
+            "engine", lambda: self._run_impl(max_events))
+
+    def _run_impl(self, max_events: Optional[int] = None) -> EngineStats:
         processed = 0
         pending = None
         while True:
@@ -726,15 +769,17 @@ class GroupedServingEngine:
                 cap = min(cap, max_events - processed)
             events = _pop_events(self.queues, cap)
             self.stats.io_ms += (time.perf_counter() - t0) * 1e3
-            waves = self._make_waves(events) if events else []
+            waves, traces = (self._make_waves(events) if events
+                             else ([], None))
             t1 = time.perf_counter()
             handles = [self.gl.next_all_async() for _ in waves]
             self.stats.dispatch_ms += (time.perf_counter() - t1) * 1e3
+            _tracing.record_batch(traces, "dispatch")
             if pending is not None:
                 self._complete(*pending)
             if not events:
                 break
-            pending = (waves, handles, t0)
+            pending = (waves, handles, t0, traces)
             processed += len(events)
             if max_events is None or processed < max_events:
                 self._cap.update(len(events))

@@ -36,39 +36,97 @@ import jax.numpy as jnp
 from avenir_tpu.models.bandits.learners import (
     ALGORITHMS, Learner, LearnerConfig)
 from avenir_tpu.obs import telemetry
+from avenir_tpu.obs import tracing as _tracing
 
 
 def split_event_timestamp(payload: str) -> Tuple[str, Optional[float]]:
-    """Split an opt-in ``id|enqueue_ts`` event payload (ISSUE 6: true
-    end-to-end queue wait). Returns ``(event_id, ts)``; a payload without
-    a parseable trailing timestamp comes back unchanged with ``ts=None``,
-    so a producer that never stamps is handled bit-identically — the wire
-    format only changes when the harness opts in on BOTH ends."""
-    event_id, sep, ts = payload.rpartition("|")
+    """PR 6 view of :func:`split_event_stamp` — ``(event_id, ts)`` with
+    any trace id dropped, so there is ONE parser for the stamp wire
+    format and a traced payload degrades to its PR 6 meaning here too."""
+    event_id, ts, _ = split_event_stamp(payload)
+    return event_id, ts
+
+
+def split_event_stamp(payload: str
+                      ) -> Tuple[str, Optional[float], Optional[str]]:
+    """Split the full opt-in event stamp family (ISSUE 11): bare ``id``,
+    ``id|enqueue_ts`` (PR 6), or ``id|enqueue_ts|traceid`` (a 1-in-N
+    sampled trace context). Returns ``(event_id, ts, trace_id)``;
+    anything that parses as neither comes back unchanged with both
+    extras None — the wire format is byte-identical until the producer
+    opts in, and a traced payload degrades to its PR 6 meaning for a
+    consumer that ignores the trace id."""
+    head, sep, tail = payload.rpartition("|")
     if not sep:
-        return payload, None
+        return payload, None, None
     try:
-        return event_id, float(ts)
+        return head, float(tail), None
     except ValueError:
-        return payload, None
+        pass
+    # the 3-field parse accepts ONLY a minted t<pid>-<seq> tail: an
+    # unstamped id like "user|42|page" must come back unchanged (the
+    # PR 6 invariant), not lose its tail to a bogus trace id
+    if _tracing.is_trace_id(tail):
+        event_id, sep2, ts = head.rpartition("|")
+        if sep2:
+            try:
+                return event_id, float(ts), tail
+            except ValueError:
+                pass
+    return payload, None, None
 
 
-def strip_event_timestamps(raws: Sequence[str], tel) -> List[str]:
-    """Peel enqueue timestamps off a popped batch: returns the bare ids
-    (for action writes; callers keep ``raws`` for acks — the ledger
-    stores the verbatim popped bytes) and records each stamped payload's
-    enqueue→pop gap into the ``engine.queue_wait`` histogram. ONE
-    wall-clock read for the whole batch; each event still gets its own
-    record because enqueue times differ per event. The single home for
-    this logic — the loop's both paths and both engines call it."""
+def strip_event_stamps(raws: Sequence[str], tel
+                       ) -> Tuple[List[str], Optional[List[str]]]:
+    """Peel enqueue timestamps + trace ids off a popped batch: returns
+    ``(bare ids, the batch's trace ids or None when none appeared)``.
+    Trace ids come back SPARSE (just the sampled ones, usually 0 or 1
+    per batch — dispatch/resolve stamps are batch-granular, so no
+    per-event alignment is needed and the N-1 unsampled events cost
+    nothing downstream). Bare ids feed the action writes (downstream
+    wire format unchanged); callers keep ``raws`` for acks — the ledger
+    stores the verbatim popped bytes. Each stamped payload's
+    enqueue→pop gap lands in the ``engine.queue_wait`` histogram (ONE
+    wall-clock read for the whole batch; per-event records because
+    enqueue times differ), and each traced payload gets a
+    ``broker_pop`` stamp. The single home for this logic — the loop's
+    both paths and both engines call it."""
     now = time.time()
-    ids = []
+    ids: List[str] = []
+    traces: Optional[List[str]] = None
     for raw in raws:
-        event_id, ts = split_event_timestamp(raw)
+        if "|" not in raw:
+            # bare-producer fast path (timestamps off): one substring
+            # check keeps unstamped payloads at append cost. Traced
+            # deployments stamp EVERY payload ``id|ts`` (trace_out
+            # forces event.timestamps), so there all N pay the parse
+            # below and only the |traceid suffix is 1-in-N
+            ids.append(raw)
+            continue
+        event_id, ts, trace = split_event_stamp(raw)
         ids.append(event_id)
         if ts is not None and tel.enabled:
             tel.record("engine.queue_wait", max(now - ts, 0.0) * 1e3)
-    return ids
+        if trace is not None:
+            if traces is None:
+                traces = []
+            traces.append(trace)
+            _tracing.record_if_on(trace, "broker_pop", ts=now)
+    return ids, traces
+
+
+def record_reward_fold(tel, t_start: float, n: int) -> None:
+    """Weighted per-reward fold-time record — the ONE home for the
+    ``engine.reward_fold`` histogram's clock and weighting, shared by
+    every serving path (both engines, both loop paths). ``t_start`` is
+    a clock read taken just before the fold: drain I/O is the
+    ``engine.io``/loop spans' job, and one histogram must not mix the
+    two latencies across processes (the live rates layer reads
+    rewards/s off this counter, ISSUE 11). Callers gate on
+    ``tel.enabled`` so the disabled path never reads the clock."""
+    if n:
+        tel.record("engine.reward_fold",
+                   (time.perf_counter() - t_start) * 1e3 / n, n)
 
 
 # --------------------------------------------------------------------------
@@ -427,7 +485,7 @@ class RedisQueues:
             # contract is oldest-first
             for raw in reversed(raws):
                 action_id, _, reward = raw.decode().partition(self.delim)
-                out.append((action_id, float(reward)))
+                out.append((action_id, self._reward_value(reward)))
             self._reward_cursor -= len(raws)
             self.reward_backlog = max(
                 int(total) + self._reward_cursor + 1, 0)
@@ -440,7 +498,7 @@ class RedisQueues:
                 self.reward_backlog = 0
                 break
             action_id, _, reward = raw.decode().partition(self.delim)
-            out.append((action_id, float(reward)))
+            out.append((action_id, self._reward_value(reward)))
             self._reward_cursor -= 1
         else:
             # sweep stopped at the cap, not the end: the gauge must not
@@ -455,6 +513,19 @@ class RedisQueues:
                                        self._reward_cursor)
                 self.reward_backlog = 1 if probe is not None else 0
         return out
+
+    @staticmethod
+    def _reward_value(reward: str) -> float:
+        """Reward VALUE field -> float, peeling an opt-in trace suffix
+        (``0.0|t123-64``, ISSUE 11) into a ``reward_fold`` stamp. The
+        untraced path — every reward until a producer samples one — is
+        the same single ``float()`` it always was."""
+        try:
+            return float(reward)
+        except ValueError:
+            value, trace = _tracing.split_reward_trace(reward)
+            _tracing.record_if_on(trace, "reward_fold")
+            return value
 
     def write_actions(self, event_id: str, actions: Sequence[str]) -> None:
         self._r.lpush(self.action_queue,
@@ -656,6 +727,20 @@ class OnlineLearnerLoop:
         folded (append-only sources re-drain from the start on restart)."""
         return self._drain_new_rewards_counted()[0]
 
+    def _fold_reward_batch(self, pairs: List[Tuple[str, float]]) -> None:
+        """Fold one drained reward batch plus its telemetry: the
+        batch-granular ``loop.reward_fold`` span, and the weighted
+        per-reward ``engine.reward_fold`` histogram — the counter the
+        live rates layer de-accumulates into rewards/s (ISSUE 11).
+        Disabled telemetry pays zero clock reads beyond the span no-op."""
+        tel = self._tel.enabled
+        t0 = time.perf_counter() if tel else 0.0
+        with self._tel.span("loop.reward_fold"):
+            self.learner.set_reward_batch(pairs)
+        self.stats.rewards += len(pairs)
+        if tel:
+            record_reward_fold(self._tel, t0, len(pairs))
+
     def _save_checkpoint(self) -> None:
         self._ckpt_mod.save_loop_state(
             self._ckpt, self.stats.events, self.learner.state,
@@ -733,13 +818,19 @@ class OnlineLearnerLoop:
         :96-99). Returns False when the event queue is empty."""
         self._maybe_swap()
         t0 = time.perf_counter()
-        for action_id, reward in self._drain_new_rewards():
+        pairs = self._drain_new_rewards()
+        # the fold clock starts AFTER the drain: the drain is broker I/O
+        # (see record_reward_fold's contract)
+        tel = self._tel.enabled
+        t_fold = time.perf_counter() if (tel and pairs) else 0.0
+        for action_id, reward in pairs:
             self.learner.set_reward(action_id, reward)
             self.stats.rewards += 1
+        if tel:
+            record_reward_fold(self._tel, t_fold, len(pairs))
         # decision latency is pop→action-written, so the clock restarts
-        # here (t0 includes the reward fold); gated so the disabled hot
+        # here (t0 includes drain + fold); gated so the disabled hot
         # path keeps its single clock read
-        tel = self._tel.enabled
         t_pop = time.perf_counter() if tel else t0
         raw_event = self.queues.pop_event()
         if raw_event is None:
@@ -747,10 +838,16 @@ class OnlineLearnerLoop:
             self.stats.reward_lag = max(
                 0, self.stats.events - self.stats.rewards)
             return False
-        event_id = raw_event
+        event_id, trace = raw_event, None
         if self._event_ts:
-            event_id = strip_event_timestamps([raw_event], self._tel)[0]
+            ids, traces = strip_event_stamps([raw_event], self._tel)
+            event_id = ids[0]
+            trace = traces[0] if traces else None
+        if trace is not None:
+            _tracing.record_if_on(trace, "dispatch")
         selections = self.learner.next_actions()
+        if trace is not None:
+            _tracing.record_if_on(trace, "resolve")
         self.queues.write_actions(event_id, selections)
         # ack AFTER the answer is on the wire: a death in between replays
         # the event (at-least-once) rather than losing it. Ack by the RAW
@@ -774,6 +871,10 @@ class OnlineLearnerLoop:
         calls minus the round-trips; with a LIVE reward producer (Redis),
         rewards arriving mid-batch fold only at the next batch boundary —
         use ``step`` when strict per-event interleaving matters."""
+        from avenir_tpu.obs.timeseries import run_with_flight_dump
+        return run_with_flight_dump("loop", lambda: self._run(max_events))
+
+    def _run(self, max_events: Optional[int] = None) -> LoopStats:
         processed = 0
         batch_size = self.learner.cfg.batch_size
         event_cap = Learner._SCAN_BUCKET_MAX
@@ -782,9 +883,7 @@ class OnlineLearnerLoop:
             t_batch = time.perf_counter()
             pairs = self._drain_new_rewards()
             if pairs:
-                with self._tel.span("loop.reward_fold"):
-                    self.learner.set_reward_batch(pairs)
-                self.stats.rewards += len(pairs)
+                self._fold_reward_batch(pairs)
             tel = self._tel.enabled
             t_pop = time.perf_counter() if tel else t_batch
             events: List[str] = []
@@ -806,20 +905,21 @@ class OnlineLearnerLoop:
                 while True:
                     pairs, raw = self._drain_new_rewards_counted()
                     if pairs:
-                        with self._tel.span("loop.reward_fold"):
-                            self.learner.set_reward_batch(pairs)
-                        self.stats.rewards += len(pairs)
+                        self._fold_reward_batch(pairs)
                     if raw == 0:
                         break
                 self.stats.reward_lag = max(
                     0, self.stats.events - self.stats.rewards)
                 break
             raws = events
+            traces = None
             if self._event_ts:
-                events = strip_event_timestamps(raws, self._tel)
+                events, traces = strip_event_stamps(raws, self._tel)
+            _tracing.record_batch(traces, "dispatch")
             with self._tel.span("loop.select"):
                 selections = self.learner.next_action_batch(
                     len(events) * batch_size)
+            _tracing.record_batch(traces, "resolve")
             events_before = self.stats.events
             for i, event_id in enumerate(events):
                 sel = selections[i * batch_size:(i + 1) * batch_size]

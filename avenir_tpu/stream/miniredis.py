@@ -28,6 +28,7 @@ Single-process uses need none of this — ``InProcQueues`` stays the default.
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import socket
@@ -93,21 +94,26 @@ def _read_command(rfile) -> Optional[List[bytes]]:
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
         srv: "MiniRedisServer" = self.server.owner  # type: ignore[attr-defined]
-        while True:
-            try:
-                cmd = _read_command(self.rfile)
-            except ConnectionError:
-                return
-            if cmd is None:
-                return
-            try:
-                reply = srv.execute(cmd)
-            except ConnectionError:
-                # simulated crash (crash_after): drop the connection with
-                # no reply, exactly what a SIGKILLed broker looks like
-                return
-            self.wfile.write(reply)
-            self.wfile.flush()
+        srv._client_connected()
+        try:
+            while True:
+                try:
+                    cmd = _read_command(self.rfile)
+                except ConnectionError:
+                    return
+                if cmd is None:
+                    return
+                try:
+                    reply = srv.execute(cmd)
+                except ConnectionError:
+                    # simulated crash (crash_after): drop the connection
+                    # with no reply, exactly what a SIGKILLed broker
+                    # looks like
+                    return
+                self.wfile.write(reply)
+                self.wfile.flush()
+        finally:
+            srv._client_disconnected()
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -147,6 +153,7 @@ class MiniRedisServer:
         self._aof_path = aof_path
         self._executed = 0
         self._crash_after = crash_after
+        self._clients = 0           # live connections (INFO gauge)
         if aof_path:
             self._replay_aof(aof_path)
             self._aof = open(aof_path, "ab")
@@ -197,6 +204,14 @@ class MiniRedisServer:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def _client_connected(self) -> None:
+        with self._lock:
+            self._clients += 1
+
+    def _client_disconnected(self) -> None:
+        with self._lock:
+            self._clients -= 1
+
     # -- command dispatch --------------------------------------------------
 
     def execute(self, cmd: List[bytes]) -> bytes:
@@ -218,6 +233,27 @@ class MiniRedisServer:
     def _apply(self, name: bytes, args: List[bytes]) -> bytes:
         if name == b"PING":
             return b"+PONG\r\n"
+        if name == b"INFO":
+            # broker introspection (ISSUE 11 satellite): queue depths,
+            # AOF byte size, connected clients, total commands — the
+            # coordinator polls this into broker.* hub gauges, making
+            # broker saturation (the known wall for the 1M/min run)
+            # visible instead of inferred. Read-only: not AOF-logged.
+            depths = {key.decode(): len(q)
+                      for key, q in self._lists.items() if q}
+            lines = [
+                "# avenir-miniredis",
+                f"connected_clients:{self._clients}",
+                f"total_commands_processed:{self._executed}",
+                f"aof_enabled:{1 if self._aof is not None else 0}",
+                f"aof_bytes:{self._aof.tell() if self._aof else 0}",
+                f"lists:{len(depths)}",
+                f"total_list_items:{sum(depths.values())}",
+                # queue names carry colons (eventQueue:g0), so depths
+                # travel as one JSON field instead of key:value lines
+                "queue_depths:" + json.dumps(depths, sort_keys=True),
+            ]
+            return _encode_bulk(("\r\n".join(lines) + "\r\n").encode())
         if name == b"SET":
             # the single-key atomic record (ownership assignments ride
             # this: one epoch-numbered JSON blob swapped in one command)
@@ -527,6 +563,31 @@ class MiniRedisClient:
 
     def ping(self):
         return self._call(b"PING")
+
+    def info(self) -> Dict:
+        """Parsed INFO reply: int-valued ``connected_clients`` /
+        ``total_commands_processed`` / ``aof_bytes`` / ``lists`` /
+        ``total_list_items`` plus the ``queue_depths`` dict
+        (``{queue name: pending entries}``) — the broker-saturation
+        signal the coordinator folds into ``broker.*`` hub gauges."""
+        raw = self._call(b"INFO")
+        out: Dict = {}
+        for line in (raw or b"").decode().splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, _, value = line.partition(":")
+            if key == "queue_depths":
+                try:
+                    out[key] = json.loads(value) if value else {}
+                except ValueError:
+                    out[key] = {}
+            else:
+                try:
+                    out[key] = int(value)
+                except ValueError:
+                    out[key] = value
+        return out
 
     def set(self, key, value):
         return self._call(b"SET", self._b(key), self._b(value))

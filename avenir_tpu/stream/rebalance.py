@@ -170,6 +170,18 @@ class Coordinator:
         self.last_seen: Dict[int, float] = {}
         self.removed: set = set()
         self.record = read_assignment(client) or AssignmentRecord(0)
+        # broker introspection (ISSUE 11 satellite): the latest INFO
+        # snapshot, polled on the cadence into broker.* hub gauges —
+        # broker saturation is the known wall for the 1M/min run and
+        # was previously invisible
+        self.broker_info: Dict = {}
+        self._last_info = 0.0
+        # live fleet view (ISSUE 11 satellite): the LATEST report per
+        # worker, accumulated across polls and AGED — without the
+        # 3x-cadence bar a departed worker's source-labeled gauges
+        # would haunt every later merge of this accumulator
+        self.worker_reports: Dict[int, Dict] = {}
+        self._last_reports = 0.0
 
     # -- membership ----------------------------------------------------------
 
@@ -209,7 +221,114 @@ class Coordinator:
         call a driver loop needs per poll tick."""
         from avenir_tpu.stream.scaleout import read_heartbeats
         self.note_heartbeats(read_heartbeats(self.client))
+        self.poll_broker_info(now)
+        self.poll_worker_reports(now)
         return self.step(now)
+
+    def poll_worker_reports(self, now: Optional[float] = None
+                            ) -> Dict[int, Dict]:
+        """Drain the fleet's shipped telemetry into the coordinator's
+        live view: latest report per worker, departed workers aged out
+        at the SAME bar this coordinator's liveness detector uses
+        (``dead_after_s`` — one rule, two consumers; 3x cadence by
+        default), keyed on each report's own ``meta.generated_at``.
+        Throttled to one drain per cadence (poll_broker_info's rule —
+        workers only push reports on the heartbeat cadence, so a
+        per-tick rpop would just hammer the single-core broker with
+        nils). Best-effort — a broker hiccup degrades to the previous
+        view, never raises."""
+        t_now = time.time() if now is None else now
+        if t_now - self._last_reports < self.cadence_s:
+            return self.worker_reports
+        self._last_reports = t_now
+        from avenir_tpu.stream.scaleout import read_worker_reports
+        try:
+            return read_worker_reports(
+                self.client, into=self.worker_reports,
+                max_age_s=self.dead_after_s, now=now)
+        except Exception:
+            return self.worker_reports
+
+    def _llen_depths(self) -> Dict[str, int]:
+        """Depth map for brokers whose INFO carries no ``queue_depths``
+        (real redis): LLEN over this coordinator's per-group queues.
+        Best-effort — a failed probe degrades to empty, never raises."""
+        llen = getattr(self.client, "llen", None)
+        if llen is None:
+            return {}
+        depths: Dict[str, int] = {}
+        try:
+            for group in self.groups:
+                for prefix in ("eventQueue", "rewardQueue",
+                               "pendingQueue"):
+                    depths[f"{prefix}:{group}"] = int(
+                        llen(f"{prefix}:{group}"))
+            # the one shared queue: consumer lag shows up here
+            depths["actionQueue"] = int(llen("actionQueue"))
+        except Exception:
+            return {}
+        return depths
+
+    def poll_broker_info(self, now: Optional[float] = None
+                         ) -> Optional[Dict]:
+        """Throttled (one per cadence) broker INFO poll -> ``broker.*``
+        hub gauges: connected clients, total commands, AOF bytes, and
+        the event/reward queue depths summed from the per-queue map —
+        the saturation signal for the single-core broker event loop.
+        No-ops (and never raises) on clients without ``info``.
+        ``queue_depths``/``aof_bytes`` are MiniRedis INFO extensions: a
+        real redis-py INFO lacks them, so depths fall back to LLEN over
+        this coordinator's per-group queues and AOF size to redis's own
+        ``aof_current_size`` — the gauges stay live either way."""
+        t_now = time.time() if now is None else now
+        if t_now - self._last_info < self.cadence_s:
+            return None
+        info = getattr(self.client, "info", None)
+        if info is None:
+            return None
+        self._last_info = t_now
+        try:
+            stats = info()
+        except Exception:
+            return None
+        depths = stats.get("queue_depths")
+        if depths is None:
+            depths = self._llen_depths()
+            stats = dict(stats, queue_depths=depths)
+        if "aof_bytes" not in stats and "aof_current_size" in stats:
+            stats = dict(stats, aof_bytes=stats["aof_current_size"])
+        # normalized BEFORE the snapshot lands: broker_info and the
+        # gauges below must agree on aof_bytes/queue_depths for real
+        # redis too
+        self.broker_info = stats
+        try:
+            def class_depth(prefix: str) -> float:
+                return float(sum(v for k, v in depths.items()
+                                 if k.startswith(prefix)))
+            by_class = {
+                "broker.event_depth": class_depth("eventQueue"),
+                "broker.reward_depth": class_depth("rewardQueue"),
+                "broker.pending_depth": class_depth("pendingQueue"),
+                "broker.action_depth": class_depth("actionQueue"),
+            }
+            gauges = {
+                "broker.connected_clients":
+                    float(stats.get("connected_clients", 0)),
+                "broker.commands_total":
+                    float(stats.get("total_commands_processed", 0)),
+                "broker.aof_bytes": float(stats.get("aof_bytes", 0)),
+                **by_class,
+                # total over the SAME class set on both broker kinds —
+                # MiniRedis INFO lists every queue (trace/telemetry/
+                # heartbeats included) while the real-redis LLEN
+                # fallback can only probe known names, so a raw
+                # sum(depths) would mean different things
+                "broker.queue_depth_total": sum(by_class.values()),
+            }
+        except (TypeError, ValueError):
+            return stats
+        _hub_gauges(gauges)
+        return stats
 
     def step(self, now: Optional[float] = None
              ) -> Optional[AssignmentRecord]:
@@ -295,6 +414,12 @@ class WorkerRebalancer:
         self.make_server = make_server
         self.registry = registry
         self.servers: Dict[str, Any] = {}
+        # sorted owned-group names for OTHER threads (the /healthz
+        # provider): rebuilt after every servers mutation and swapped
+        # in by one reference assignment — iterating ``servers`` from
+        # the HTTP handler thread mid-sync()/retire() could raise
+        # "dictionary changed size during iteration"
+        self.owned_view: tuple = ()
         self.retired: List = []        # (group, server) after release
         self.epoch = 0
         self.stop = False
@@ -333,8 +458,12 @@ class WorkerRebalancer:
                          "rebalance.owned_groups": len(self.servers)})
         return changed
 
+    def _note_owned(self) -> None:
+        self.owned_view = tuple(sorted(self.servers))
+
     def _release(self, group: str, rec: AssignmentRecord) -> None:
         server = self.servers.pop(group)
+        self._note_owned()
         if self.registry is not None:
             publish_handoff(self.registry, group, server.learner.state,
                             rec.epoch, self.worker_id)
@@ -402,12 +531,14 @@ class WorkerRebalancer:
         if self._tel.enabled:
             self._tel.record("rebalance.handoff", ms)
         self.servers[group] = server
+        self._note_owned()
         self.acquired += 1
 
     def retire(self, group: str) -> None:
         """Move a sentinel-stopped group's server out of the active set
         (stream over — no release-publish)."""
         server = self.servers.pop(group, None)
+        self._note_owned()
         if server is not None:
             self.retired.append((group, server))
 

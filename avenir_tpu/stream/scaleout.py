@@ -115,17 +115,42 @@ def push_worker_report(client, worker_id: int) -> None:
     _LAST_REPORT_PAYLOAD[worker_id] = payload
 
 
-def read_worker_reports(client) -> Dict[int, Dict]:
+def report_max_age_s(cadence_s: float) -> float:
+    """The staleness bar for shipped fleet reports: 3x the heartbeat
+    cadence — the same factor the liveness detector calls a worker DEAD
+    at (one rule, two consumers)."""
+    return DEAD_AFTER_FACTOR * float(cadence_s)
+
+
+def read_worker_reports(client, into: Optional[Dict[int, Dict]] = None,
+                        max_age_s: Optional[float] = None,
+                        now: Optional[float] = None) -> Dict[int, Dict]:
     """Drain the telemetry queue (driver side): the LATEST report per
     worker wins — interim cadence pushes are superseded snapshots of the
-    same monotone histograms, not increments to sum."""
-    out: Dict[int, Dict] = {}
+    same monotone histograms, not increments to sum.
+
+    ``into`` accumulates across polls (a live monitor's dict survives
+    between drains); ``max_age_s`` ages DEPARTED workers out — without
+    it a dead worker's final report (its ``source``-labeled gauges, its
+    straggler-detection p99) haunts every later fleet merge forever.
+    Staleness keys on the report's own ``meta.generated_at`` (the hub
+    stamps it at snapshot time), bar = 3x heartbeat cadence via
+    :func:`report_max_age_s`."""
+    out: Dict[int, Dict] = {} if into is None else into
     while True:
         raw = client.rpop(TELEMETRY_QUEUE)
         if raw is None:
-            return out
+            break
         entry = json.loads(raw.decode())
         out[int(entry["worker"])] = entry["report"]
+    if max_age_s is not None:
+        t_now = time.time() if now is None else now
+        for worker in list(out):
+            generated = (out[worker].get("meta") or {}).get(
+                "generated_at") or 0.0
+            if t_now - float(generated) > max_age_s:
+                del out[worker]
+    return out
 
 
 def push_heartbeat(client, worker_id: int, events: int, rewards: int,
@@ -134,6 +159,11 @@ def push_heartbeat(client, worker_id: int, events: int, rewards: int,
         {"worker": worker_id, "events": events, "rewards": rewards,
          "ts": time.time(), "grouping": grouping}))
     push_worker_report(client, worker_id)
+    # sampled trace stamps (ISSUE 11) ride the same cadence: one lpush
+    # per heartbeat when tracing is armed, nothing otherwise
+    from avenir_tpu.obs import tracing as _tracing
+    if _tracing.context().enabled:
+        _tracing.push_stamps(client)
 
 
 def read_heartbeats(client) -> List[Dict]:
@@ -587,6 +617,17 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
         for g, eng in engines.items():
             lc.register(g, eng)
         lc.poll_and_swap()      # join on the published head, if any
+    # live health (ISSUE 11): /healthz answers ownership + the serving
+    # model versions when this worker runs a scrape endpoint
+    from avenir_tpu.obs import live as _obs_live
+    live_obs = _obs_live.current()
+    if live_obs is not None:
+        live_obs.set_health_provider(lambda: {
+            "worker_id": worker_id,
+            "groups": sorted(engines),
+            "model_versions": {g: e.stats.model_version
+                               for g, e in engines.items()},
+            "events": progress["served"]})
     active = set(engines)
     idle_sleep = 0.001
     push_heartbeat(client, worker_id, 0, 0)  # alive, engines constructed
@@ -704,6 +745,22 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
                           registry=registry,
                           min_poll_interval_s=min(cadence_s / 2, 0.25))
     rb_box["rb"] = rb
+    # live health (ISSUE 11): an elastic worker's /healthz reports its
+    # current epoch + owned groups — the ownership view an operator
+    # checks when a rebalance looks stuck
+    from avenir_tpu.obs import live as _obs_live
+    live_obs = _obs_live.current()
+    if live_obs is not None:
+        live_obs.set_health_provider(lambda: {
+            "worker_id": worker_id,
+            "elastic": True,
+            "epoch": rb.epoch,
+            # owned_view, not servers: the serving thread mutates the
+            # dict mid-sync()/retire() while this lambda runs on the
+            # HTTP handler thread
+            "groups": list(rb.owned_view),
+            "stop": rb.stop,
+            "events": progress["served"]})
     push_heartbeat(client, worker_id, 0, 0, "elastic")   # the JOIN signal
     last_hb = time.monotonic()
     idle_sleep = 0.001
@@ -784,6 +841,11 @@ class ScaleoutResult:
     # writes. Both empty unless the run was telemetry-armed.
     worker_reports: Dict[int, Dict] = field(default_factory=dict)
     fleet_report: Optional[Dict] = None
+    # sampled cross-process tracing (ISSUE 11): stamp count collected
+    # across driver + workers and the Chrome-trace path written, when
+    # the run was trace-armed
+    trace_stamps: int = 0
+    trace_out: Optional[str] = None
 
 
 @contextlib.contextmanager
@@ -826,7 +888,11 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
                   elastic: bool = False,
                   handoff_dir: Optional[str] = None,
                   cadence_s: Optional[float] = None,
-                  broker_reconnect: bool = False) -> subprocess.Popen:
+                  broker_reconnect: bool = False,
+                  obs_port: Optional[int] = None,
+                  obs_flight: Optional[str] = None,
+                  obs_slo_ms: Optional[float] = None,
+                  trace: bool = False) -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
            "--host", host, "--port", str(port),
@@ -854,6 +920,14 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
         cmd += ["--cadence-s", str(cadence_s)]
     if broker_reconnect:
         cmd.append("--broker-reconnect")
+    if obs_port is not None:
+        cmd += ["--obs-port", str(obs_port)]
+    if obs_flight:
+        cmd += ["--obs-flight", obs_flight]
+    if obs_slo_ms is not None:
+        cmd += ["--obs-slo-ms", str(obs_slo_ms)]
+    if trace:
+        cmd.append("--trace")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True)
 
@@ -866,7 +940,8 @@ def _spawn_workers(host: str, port: int, n_workers: int,
                    engine: bool = False, telemetry: bool = False,
                    event_timestamps: bool = False,
                    lifecycle_dir: Optional[str] = None,
-                   broker_reconnect: bool = False
+                   broker_reconnect: bool = False,
+                   trace: bool = False
                    ) -> List[subprocess.Popen]:
     return [_spawn_worker(host, port, w, n_workers, groups, learner_type,
                           actions, config, seed,
@@ -874,15 +949,20 @@ def _spawn_workers(host: str, port: int, n_workers: int,
                           engine=engine, telemetry=telemetry,
                           event_timestamps=event_timestamps,
                           lifecycle_dir=lifecycle_dir,
-                          broker_reconnect=broker_reconnect)
+                          broker_reconnect=broker_reconnect,
+                          trace=trace)
             for w in range(n_workers)]
 
 
 def _consume_one(client: MiniRedisClient, ctr, rng, t_push,
                  latencies: List[float],
-                 picks: List[Tuple[str, str]]) -> bool:
+                 picks: List[Tuple[str, str]],
+                 trace_map: Optional[Dict[str, str]] = None) -> bool:
     """Pop one action line, record latency/pick, issue the planted-CTR
-    reward. False when the action queue is empty."""
+    reward. False when the action queue is empty. A traced event's
+    reward (``trace_map``, ISSUE 11) carries the trace id in its value
+    field so the owning worker's fold closes the loop with a
+    ``reward_fold`` stamp."""
     raw = client.rpop("actionQueue")
     if raw is None:
         return False
@@ -892,7 +972,13 @@ def _consume_one(client: MiniRedisClient, ctr, rng, t_push,
     latencies.append(time.perf_counter() - t_push[event_id])
     picks.append((g, action))
     reward = 1.0 if rng.random() < ctr[g][action] else 0.0
-    client.lpush(f"rewardQueue:{g}", f"{action},{reward}")
+    value = str(reward)
+    if trace_map is not None:
+        tid = trace_map.pop(event_id, None)
+        if tid is not None:
+            from avenir_tpu.obs import tracing as _tracing
+            value = _tracing.attach_reward_trace(value, tid)
+    client.lpush(f"rewardQueue:{g}", f"{action},{value}")
     return True
 
 
@@ -900,7 +986,8 @@ def _drive(client: MiniRedisClient, groups: Sequence[str],
            ctr: Dict[str, Dict[str, float]], n_events: int,
            rate: Optional[float], rng, t_push: Dict[str, float],
            latencies: List[float], picks: List[Tuple[str, str]],
-           shuffle: bool = False, stamp: bool = False) -> None:
+           shuffle: bool = False, stamp: bool = False,
+           trace_map: Optional[Dict[str, str]] = None) -> None:
     """Throughput mode (``rate=None``): BURST all events up-front so every
     group carries backlog and worker parallelism — not this driver's serial
     reward loop — sets the drain time. Paced mode: inject at ``rate``/s and
@@ -910,12 +997,28 @@ def _drive(client: MiniRedisClient, groups: Sequence[str],
     appends an enqueue timestamp (``id|ts``, the event.timestamps contract)
     so telemetry-armed workers measure true queue wait; workers write
     actions under the bare id, so ``t_push``/answer bookkeeping is
-    unchanged."""
+    unchanged. ``trace_map`` (ISSUE 11, requires ``stamp``) additionally
+    promotes 1-in-N events to ``id|ts|traceid`` — the sampling decision
+    lives in the process-wide :class:`~avenir_tpu.obs.tracing.
+    TraceContext` — stamping ``producer_enqueue`` and remembering the id
+    so the event's reward carries the same trace."""
+    from avenir_tpu.obs import tracing as _tracing
+
     def push(sent):
         g = groups[sent % len(groups)]
         event_id = f"{g}:{sent}"
         t_push[event_id] = time.perf_counter()
-        payload = f"{event_id}|{time.time()}" if stamp else event_id
+        payload = event_id
+        if stamp:
+            now = time.time()
+            payload = f"{event_id}|{now}"
+            if trace_map is not None:
+                tid = _tracing.context().maybe_start()
+                if tid is not None:
+                    payload = f"{payload}|{tid}"
+                    trace_map[event_id] = tid
+                    _tracing.context().record(tid, "producer_enqueue",
+                                              ts=now)
         client.lpush("eventQueue" if shuffle else f"eventQueue:{g}",
                      payload)
     if rate is None:
@@ -923,7 +1026,8 @@ def _drive(client: MiniRedisClient, groups: Sequence[str],
             push(sent)
         answered = 0
         while answered < n_events:
-            if _consume_one(client, ctr, rng, t_push, latencies, picks):
+            if _consume_one(client, ctr, rng, t_push, latencies, picks,
+                            trace_map):
                 answered += 1
             else:
                 time.sleep(0.0005)
@@ -937,7 +1041,8 @@ def _drive(client: MiniRedisClient, groups: Sequence[str],
             next_at = time.perf_counter() + 1.0 / rate
             push(sent)
             sent += 1
-        if not _consume_one(client, ctr, rng, t_push, latencies, picks):
+        if not _consume_one(client, ctr, rng, t_push, latencies, picks,
+                            trace_map):
             time.sleep(0.0005)
         else:
             answered += 1
@@ -953,7 +1058,9 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
                  engine: bool = False,
                  metrics_out: Optional[str] = None,
                  event_timestamps: bool = False,
-                 lifecycle_dir: Optional[str] = None) -> ScaleoutResult:
+                 lifecycle_dir: Optional[str] = None,
+                 trace_out: Optional[str] = None,
+                 trace_sample: int = 64) -> ScaleoutResult:
     """Measure N serving workers against one broker (started here unless
     passed in). Every event must come back answered exactly once.
     ``grouping="shuffle"`` runs the reference's shuffleGrouping discipline
@@ -967,13 +1074,53 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
     ``ScaleoutResult.fleet_report``/``worker_reports``. Straggler
     detection then also uses per-worker decision-latency p99.
     ``event_timestamps`` stamps every driven event ``id|ts`` so workers
-    measure true enqueue→pop queue wait (fields grouping only)."""
+    measure true enqueue→pop queue wait (fields grouping only).
+    ``trace_out`` (ISSUE 11) arms sampled cross-process tracing: 1 in
+    ``trace_sample`` events travels as ``id|ts|traceid`` (implies
+    ``event_timestamps``), its reward echoes the trace id, workers ship
+    their producer/broker-pop/dispatch/resolve/reward-fold stamps over
+    the broker on the heartbeat cadence, and the merged Chrome-trace
+    JSON (Perfetto-viewable) lands at that path."""
     if engine and grouping == "shuffle":
         raise ValueError("engine workers support fields grouping only")
+    if trace_out:
+        event_timestamps = True     # traces ride the stamped payloads
     if event_timestamps and grouping == "shuffle":
         raise ValueError(
             "event.timestamps is wired through the fields-grouping "
             "loops/engines; shuffle workers do not parse stamped payloads")
+    shuffle = grouping == "shuffle"
+    trace_map: Optional[Dict[str, str]] = None
+    if trace_out:
+        from avenir_tpu.obs import tracing as _tracing
+        _tracing.context().enable(sample_every=trace_sample)
+        trace_map = {}
+    try:
+        return _run_scaleout_measured(
+            n_workers, n_groups=n_groups, n_actions=n_actions,
+            throughput_events=throughput_events,
+            paced_events=paced_events, paced_rate=paced_rate,
+            learner_type=learner_type, seed=seed, host=host,
+            server=server, decision_io_ms=decision_io_ms,
+            grouping=grouping, engine=engine, metrics_out=metrics_out,
+            event_timestamps=event_timestamps,
+            lifecycle_dir=lifecycle_dir, trace_out=trace_out,
+            trace_map=trace_map, shuffle=shuffle)
+    finally:
+        if trace_out:
+            # a failed run must not leak enabled tracing (or its stale
+            # stamps) into the process's next traced run
+            from avenir_tpu.obs import tracing as _tracing
+            _tracing.context().disable()
+            _tracing.context().drain()
+
+
+def _run_scaleout_measured(n_workers, *, n_groups, n_actions,
+                           throughput_events, paced_events, paced_rate,
+                           learner_type, seed, host, server,
+                           decision_io_ms, grouping, engine, metrics_out,
+                           event_timestamps, lifecycle_dir, trace_out,
+                           trace_map, shuffle) -> ScaleoutResult:
     import numpy as np
     rng = np.random.default_rng(seed)
     groups = [f"g{i}" for i in range(n_groups)]
@@ -990,35 +1137,44 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
     # parallelism, not the driver's serial reward loop, sets throughput
     config = {"current.decision.round": 1, "batch.size": 8}
 
-    shuffle = grouping == "shuffle"
     with _broker(host, server) as (client, broker_host, broker_port):
+        if trace_out:
+            # a shared (or AOF-restored) broker may still hold stamps a
+            # prior failed traced run's workers flushed; they must not
+            # merge into this run's trace file
+            from avenir_tpu.obs import tracing as _tracing
+            _tracing.read_stamps(client)
         procs = _spawn_workers(broker_host, broker_port, n_workers, groups,
                                learner_type, actions, config, seed,
                                decision_io_ms=decision_io_ms,
                                grouping=grouping, engine=engine,
                                telemetry=metrics_out is not None,
                                event_timestamps=event_timestamps,
-                               lifecycle_dir=lifecycle_dir)
+                               lifecycle_dir=lifecycle_dir,
+                               trace=trace_out is not None)
         try:
             t_push: Dict[str, float] = {}
             latencies: List[float] = []
             picks: List[Tuple[str, str]] = []
             # warmup: first dispatch per worker pays jit compile; excluded
+            # from latencies AND from tracing — a sampled warmup event
+            # would ship its compile-inflated dispatch→resolve gap to
+            # Perfetto as if it were representative serving latency
             _drive(client, groups, ctr, 4 * n_groups, None, rng,
                    t_push, [], [], shuffle=shuffle,
-                   stamp=event_timestamps)
+                   stamp=event_timestamps, trace_map=None)
             t_push.clear()
 
             t0 = time.perf_counter()
             _drive(client, groups, ctr, throughput_events, None, rng,
                    t_push, [], picks, shuffle=shuffle,
-                   stamp=event_timestamps)
+                   stamp=event_timestamps, trace_map=trace_map)
             throughput_s = time.perf_counter() - t0
 
             t_push.clear()
             _drive(client, groups, ctr, paced_events, paced_rate, rng,
                    t_push, latencies, picks, shuffle=shuffle,
-                   stamp=event_timestamps)
+                   stamp=event_timestamps, trace_map=trace_map)
 
             if shuffle:
                 # one sentinel per worker on the shared queue
@@ -1065,6 +1221,16 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
                 obs_exporters.write_report(fleet_report, metrics_out)
         latency_p99 = worker_latency_p99(worker_reports)
 
+        # sampled traces: driver stamps + every worker's shipped stamps,
+        # merged into one Perfetto-viewable Chrome-trace file
+        n_stamps = 0
+        if trace_out:
+            from avenir_tpu.obs import tracing as _tracing
+            stamps = _tracing.context().drain()
+            stamps.extend(_tracing.read_stamps(client))
+            _tracing.write_chrome_trace(stamps, trace_out)
+            n_stamps = len(stamps)
+
         tail = picks[-int(0.3 * len(picks)):]
         best_frac = sum(ctr[g][a] > 0.5 for g, a in tail) / max(len(tail), 1)
         lat = sorted(latencies)
@@ -1082,7 +1248,9 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
                                          latency_p99=latency_p99 or None),
             heartbeats=len(heartbeats),
             worker_reports=worker_reports,
-            fleet_report=fleet_report)
+            fleet_report=fleet_report,
+            trace_stamps=n_stamps,
+            trace_out=trace_out if trace_out else None)
 
 
 @dataclass
@@ -1551,6 +1719,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--metrics-out", default=None, metavar="PATH",
                     help="driver mode: arm worker telemetry and write the "
                          "merged FLEET report (JSONL + .prom) here")
+    ap.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                    help="worker mode: serve live /metrics, "
+                         "/metrics/rates and /healthz on PORT (0 = "
+                         "auto-assign; the bound port is printed as a "
+                         "JSON line so harnesses can find it) — ISSUE 11")
+    ap.add_argument("--obs-flight", default=None, metavar="PATH",
+                    help="worker mode: arm the flight recorder — the "
+                         "live metrics ring dumps to PATH on crash, "
+                         "SIGUSR2, or SLO breach")
+    ap.add_argument("--obs-slo-ms", type=float, default=None,
+                    help="worker mode: flight-dump when a window's "
+                         "engine.decision_latency p99 crosses this bar")
+    ap.add_argument("--trace", action="store_true",
+                    help="worker mode: record broker-pop/dispatch/"
+                         "resolve/reward-fold stamps for trace-carrying "
+                         "payloads (id|ts|traceid) and ship them over "
+                         "the broker on the heartbeat cadence")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="driver mode: sample 1-in-N events into a "
+                         "cross-process trace and write the merged "
+                         "Chrome-trace JSON (Perfetto-viewable) here")
+    ap.add_argument("--trace-sample", type=int, default=64,
+                    help="driver mode: trace every Nth event "
+                         "(default 64)")
     args = ap.parse_args(argv)
 
     if args.worker:
@@ -1573,6 +1765,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # report; worker_id in meta keeps the fleet merge attributable
             from avenir_tpu.obs import exporters as obs_exporters
             obs_exporters.hub().enable().set_meta(worker_id=args.worker_id)
+        live_obs = None
+        if args.obs_port is not None or args.obs_flight:
+            # the live half (ISSUE 11): metrics pump + optional scrape
+            # endpoint + optional flight recorder, armed before serving
+            # so the first window covers the warmup. The bound port is
+            # printed as its own JSON line (stdout is line-JSON already;
+            # drivers parse the LAST line for stats) so a harness can
+            # curl a port-0 auto-assigned endpoint mid-run.
+            from avenir_tpu.obs.live import start_live_obs
+            wid = args.worker_id
+            live_obs = start_live_obs(
+                port=args.obs_port, flight_path=args.obs_flight,
+                slo_p99_ms=args.obs_slo_ms,
+                health_provider=lambda: {"worker_id": wid})
+            if live_obs.port is not None:
+                print(json.dumps({"worker": args.worker_id,
+                                  "obs_port": live_obs.port}), flush=True)
+        if args.trace:
+            from avenir_tpu.obs import tracing as obs_tracing
+            obs_tracing.context().enable()
         if args.elastic:
             stats = elastic_worker_main(
                 args.host, args.port, args.worker_id,
@@ -1603,6 +1815,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 event_timestamps=args.event_timestamps,
                 lifecycle_dir=args.lifecycle_dir,
                 broker_reconnect=args.broker_reconnect)
+        if live_obs is not None:
+            stats["obs_port"] = live_obs.port
+            live_obs.stop()
         print(json.dumps(stats), flush=True)
         return 0
 
@@ -1614,7 +1829,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          engine=args.engine,
                          metrics_out=args.metrics_out,
                          event_timestamps=args.event_timestamps,
-                         lifecycle_dir=args.lifecycle_dir)
+                         lifecycle_dir=args.lifecycle_dir,
+                         trace_out=args.trace_out,
+                         trace_sample=args.trace_sample)
         out = {
             "n_workers": r.n_workers,
             "grouping": args.grouping,
@@ -1636,6 +1853,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "p99_ms": round(dl.get("p99_ms", 0.0), 3)}
             if args.metrics_out:
                 out["metrics_out"] = args.metrics_out
+        if r.trace_out:
+            out["trace_out"] = r.trace_out
+            out["trace_stamps"] = r.trace_stamps
         print(json.dumps(out))
     return 0
 

@@ -405,6 +405,21 @@ def main() -> None:
     # is listener-based and adds nothing to the timed path
     from avenir_tpu.obs import runtime as obs_runtime
     obs_runtime.install_compile_listener()
+    # live observability (ISSUE 11): BENCH_OBS_PORT serves /metrics,
+    # /metrics/rates and /healthz for the duration of the bench (0 =
+    # auto-assign, port printed to stderr) — watch a long TPU sweep
+    # instead of waiting for its JSON line
+    live_obs = None
+    obs_port_env = os.environ.get("BENCH_OBS_PORT")
+    if obs_port_env not in (None, ""):
+        try:
+            from avenir_tpu.obs.live import start_live_obs
+            live_obs = start_live_obs(port=int(obs_port_env))
+            print(f"bench live obs on port {live_obs.port}",
+                  file=sys.stderr)
+        except Exception as exc:    # live obs must never sink the bench
+            print(f"live obs skipped: {exc!r}", file=sys.stderr)
+            live_obs = None
     rng = np.random.default_rng(0)
     train = jnp.asarray(rng.random((N_TRAIN, N_FEATURES), dtype=np.float32))
     test = jnp.asarray(rng.random((M_TEST, N_FEATURES), dtype=np.float32))
@@ -691,6 +706,15 @@ def main() -> None:
                 if name.startswith("feed.") or name.endswith("/feed.h2d")}
     except Exception as exc:   # the snapshot must never sink the bench
         print(f"telemetry snapshot skipped: {exc!r}", file=sys.stderr)
+    if live_obs is not None:
+        try:
+            out["live_obs"] = {"port": live_obs.port,
+                               "windows": live_obs.ring.windows_total,
+                               "current": live_obs.ring.rates_snapshot(
+                                   last=1)["current"]}
+        except Exception:
+            pass
+        live_obs.stop()
     print(json.dumps(out))
 
 

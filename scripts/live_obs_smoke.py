@@ -1,0 +1,349 @@
+"""Live-observability smoke gate (ISSUE 11 CI guard).
+
+Five checks, exit 0 only if all pass:
+
+1. **Live scrape mid-run**: a pipelined ``ServingEngine`` serves a
+   continuously fed queue on a background thread while the MAIN thread
+   curls the process's own scrape endpoint — ``/metrics`` must expose a
+   growing ``engine.decision_latency`` count, ``/metrics/rates`` must
+   report ``decisions/s > 0`` in at least one closed window, and
+   ``/healthz`` must answer liveness. This is the thing PR 2's
+   end-of-run report could not do: watch a run that has not ended.
+2. **SIGUSR2 flight dump**: mid-run, the process signals itself and the
+   flight recorder must leave a well-formed ``*.flight.jsonl``
+   (``flight-meta`` line + one ``window`` line per ring entry).
+3. **Injected mid-run crash** (the chaos-harness assertion): a queue
+   adapter poisoned to fail after N pops kills the engine mid-drain;
+   the engine's crash hook must dump a flight record with >= 3 complete
+   windows, strictly monotonic window timestamps, parseable as JSONL,
+   reason ``crash:engine:*``.
+4. **Cross-process trace**: ``run_scaleout(trace_out=...)`` samples
+   1-in-16 events into ``id|ts|traceid`` payloads; the exported
+   Chrome-trace JSON must contain at least one trace id carrying ALL
+   FIVE stamp kinds (producer_enqueue -> broker_pop -> dispatch ->
+   resolve -> reward_fold) spanning >= 2 processes (driver + worker).
+   Wire-format byte-identity when tracing is off is asserted directly.
+5. **Enabled-path overhead**: the engine with pump + scrape endpoint +
+   1/64 trace sampling ON vs the telemetry-off engine, same
+   ``_overhead_gate`` methodology (interleaved best-of-N, 5% + 1ms
+   slack) and scale as obs_smoke's enabled gate.
+
+Usage: JAX_PLATFORMS=cpu python scripts/live_obs_smoke.py
+"""
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_SCRIPTS))
+sys.path.insert(0, _SCRIPTS)
+
+from obs_smoke import _overhead_gate  # noqa: E402  (shared methodology)
+
+LEARNER_CFG = {"current.decision.round": 1, "batch.size": 2}
+ACTIONS = ["a", "b", "c"]
+PUMP_INTERVAL_S = 0.04
+N_ENABLED_EVENTS = 6400        # obs_smoke's enabled-gate scale
+
+
+def fail(msg: str) -> None:
+    print(f"live_obs_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _get(port: int, path: str) -> bytes:
+    return urllib.request.urlopen(
+        f"http://localhost:{port}{path}", timeout=5).read()
+
+
+def _read_flight(path: str):
+    """Parse + sanity-check a flight dump; returns (meta, windows)."""
+    if not os.path.exists(path):
+        fail(f"no flight dump at {path}")
+    lines = [json.loads(line) for line in open(path) if line.strip()]
+    if not lines or lines[0].get("type") != "flight-meta":
+        fail(f"flight dump missing meta line: {lines[:1]}")
+    windows = [ln for ln in lines[1:] if ln.get("type") == "window"]
+    if len(windows) != lines[0]["windows"]:
+        fail(f"flight meta says {lines[0]['windows']} windows, "
+             f"file carries {len(windows)}")
+    return lines[0], windows
+
+
+def check_live_scrape(tmp: str) -> dict:
+    """Checks 1 + 2: scrape a live engine mid-run; SIGUSR2 dump."""
+    from avenir_tpu.obs import exporters as E
+    from avenir_tpu.obs.live import start_live_obs
+    from avenir_tpu.stream.engine import ServingEngine
+    from avenir_tpu.stream.loop import InProcQueues
+
+    flight = os.path.join(tmp, "scrape_metrics.jsonl.flight.jsonl")
+    live = start_live_obs(port=0, interval_s=PUMP_INTERVAL_S,
+                          flight_path=flight)
+    queues = InProcQueues()
+    engine = ServingEngine("softMax", ACTIONS, dict(LEARNER_CFG),
+                           queues, seed=11)
+    stop = threading.Event()
+
+    def serve() -> None:
+        # keep the engine hot until the main thread has scraped: feed,
+        # drain, repeat — run() returns whenever the queue runs dry
+        batch = 0
+        while not stop.is_set():
+            for i in range(200):
+                queues.push_event(f"e{batch}-{i}")
+            batch += 1
+            engine.run()
+            time.sleep(0.005)
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 30
+        rates = None
+        while time.monotonic() < deadline:
+            time.sleep(3 * PUMP_INTERVAL_S)
+            rates = json.loads(_get(live.port, "/metrics/rates"))
+            if any(w["rates"]["decisions_per_s"] > 0
+                   for w in rates["windows"]):
+                break
+        else:
+            fail(f"no window ever showed decisions/s > 0: {rates}")
+        prom = _get(live.port, "/metrics").decode()
+        samples = {(name, labels.get("span")): value
+                   for name, labels, value in E.parse_prometheus_text(prom)}
+        count = samples.get(("avenir_span_latency_ms_count",
+                             "engine.decision_latency"), 0)
+        if count <= 0:
+            fail(f"/metrics mid-run shows no decision latency: {count}")
+        health = json.loads(_get(live.port, "/healthz"))
+        if not (health.get("ok") and health.get("pid") == os.getpid()
+                and health.get("telemetry_enabled")):
+            fail(f"healthz malformed: {health}")
+
+        # check 2: SIGUSR2 -> well-formed flight dump, mid-run
+        os.kill(os.getpid(), signal.SIGUSR2)
+        time.sleep(0.2)
+        meta, windows = _read_flight(flight)
+        if not meta["reason"].startswith("signal:SIGUSR2"):
+            fail(f"flight reason not SIGUSR2: {meta['reason']}")
+        if not windows:
+            fail("SIGUSR2 flight dump carries no windows")
+    finally:
+        stop.set()
+        thread.join(timeout=30)
+        live.stop()
+    from avenir_tpu.obs import telemetry
+    telemetry.tracer().reset()
+    return {"mid_run_decision_count": count,
+            "sigusr2_windows": len(windows)}
+
+
+class _PoisonQueues:
+    """InProcQueues that dies after serving ``fail_after`` events — the
+    injected mid-run crash (broker connection loss shape)."""
+
+    def __init__(self, inner, fail_after: int):
+        self._inner = inner
+        self._left = fail_after
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def pop_events(self, max_n):
+        if self._left <= 0:
+            raise ConnectionError("injected mid-run broker loss")
+        out = self._inner.pop_events(min(max_n, self._left))
+        self._left -= len(out)
+        return out
+
+
+def check_crash_flight(tmp: str) -> dict:
+    """Check 3: engine crash hook leaves >= 3 complete windows with
+    monotonic timestamps (the chaos-harness assertion)."""
+    from avenir_tpu.obs.live import start_live_obs
+    from avenir_tpu.stream.engine import ServingEngine
+    from avenir_tpu.stream.loop import InProcQueues
+
+    flight = os.path.join(tmp, "crash_metrics.jsonl.flight.jsonl")
+    live = start_live_obs(port=None, interval_s=0.02, flight_path=flight)
+    inner = InProcQueues()
+    queues = _PoisonQueues(inner, fail_after=2100)
+    engine = ServingEngine("softMax", ACTIONS, dict(LEARNER_CFG),
+                           queues, seed=12)
+    crashed = None
+    try:
+        for burst in range(8):
+            for i in range(300):
+                inner.push_event(f"c{burst}-{i}")
+            try:
+                engine.run()
+            except ConnectionError as exc:
+                crashed = exc
+                break
+            time.sleep(0.05)    # let >= 1 window close per burst
+    finally:
+        live.stop()
+    if crashed is None:
+        fail("poisoned adapter never crashed the engine")
+    meta, windows = _read_flight(flight)
+    if not meta["reason"].startswith("crash:engine:"):
+        fail(f"flight reason not an engine crash: {meta['reason']}")
+    complete = [w for w in windows
+                if w.get("dt_s", 0) > 0 and "rates" in w and "t" in w]
+    if len(complete) < 3:
+        fail(f"flight dump has {len(complete)} complete windows, need 3: "
+             f"{windows}")
+    ts = [w["t"] for w in windows]
+    if any(b < a for a, b in zip(ts, ts[1:])):
+        fail(f"flight window timestamps not monotonic: {ts}")
+    if not any(w["rates"]["decisions_per_s"] > 0 for w in windows):
+        fail("no flight window recorded serving activity")
+    from avenir_tpu.obs import telemetry
+    telemetry.tracer().reset()
+    return {"windows": len(windows), "complete": len(complete),
+            "reason": meta["reason"]}
+
+
+def check_cross_process_trace(tmp: str) -> dict:
+    """Check 4: one sampled decision's Chrome-trace carries all five
+    stamp kinds under a single trace id across >= 2 processes."""
+    from avenir_tpu.obs import tracing
+    from avenir_tpu.stream.loop import split_event_stamp
+    from avenir_tpu.stream.scaleout import run_scaleout
+
+    # byte-identity when tracing is OFF: the producer helpers must
+    # leave the PR 6 wire format untouched
+    tracing.context().disable()
+    if tracing.context().maybe_start() is not None:
+        fail("disabled trace context sampled an event")
+    if tracing.attach_reward_trace("0.5", None) != "0.5":
+        fail("reward wire format changed with tracing off")
+    if split_event_stamp("e1|1.25") != ("e1", 1.25, None):
+        fail("PR 6 stamped payload no longer parses")
+    if split_event_stamp("e1") != ("e1", None, None):
+        fail("bare payload no longer parses")
+
+    trace_out = os.path.join(tmp, "trace.json")
+    r = run_scaleout(1, n_groups=2, throughput_events=200,
+                     paced_events=40, paced_rate=400.0, engine=True,
+                     trace_out=trace_out, trace_sample=16)
+    if r.trace_stamps <= 0:
+        fail("scaleout run shipped no trace stamps")
+    doc = json.load(open(trace_out))
+    by: dict = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("cat") == "stamp":
+            by.setdefault(ev["args"]["trace"], []).append(
+                (ev["name"], ev["pid"]))
+    complete = {t: ss for t, ss in by.items()
+                if {s for s, _ in ss} >= set(tracing.TRACE_STAMPS)}
+    if not complete:
+        fail(f"no trace carries all {tracing.TRACE_STAMPS}; "
+             f"saw {[sorted({s for s, _ in ss}) for ss in by.values()]}")
+    tid, stamps = next(iter(complete.items()))
+    pids = {p for _, p in stamps}
+    if len(pids) < 2:
+        fail(f"trace {tid} stayed in one process: pids={pids}")
+    return {"traces": len(by), "complete": len(complete),
+            "stamps": r.trace_stamps, "pids_on_one_trace": len(pids)}
+
+
+def check_enabled_live_overhead() -> dict:
+    """Check 5: pump + scrape endpoint + 1/64 trace sampling ON vs the
+    telemetry-off engine, <= 5% + 1ms slack. The pump thread runs only
+    around the ON draws (its sampling cost lands on the side being
+    charged); the scrape endpoint stays bound throughout (an idle
+    listener costs nothing and mirrors deployment)."""
+    from avenir_tpu.obs import telemetry, tracing
+    from avenir_tpu.obs.live import ObsHttpServer
+    from avenir_tpu.obs.timeseries import MetricsPump, MetricsRing
+    from avenir_tpu.stream.engine import ServingEngine
+    from avenir_tpu.stream.loop import InProcQueues
+    if telemetry.tracer().enabled:
+        fail("tracer unexpectedly enabled before the live overhead gate")
+
+    ctx = tracing.context()
+    ring = MetricsRing()
+    pump = MetricsPump(ring, interval_s=0.1)
+    server = ObsHttpServer(ring=ring, port=0).start()
+
+    # BOTH engines run in event-timestamps mode: with bare payloads and
+    # tracing off that path is bit-identical to the plain engine (the
+    # PR 6 contract), so the measured diff is exactly the live-obs
+    # stack — enabled tracer, sampled stamps, pump — not the
+    # long-standing stamp-parse plumbing
+    q_on = InProcQueues()
+    eng_on = ServingEngine("softMax", ACTIONS, dict(LEARNER_CFG),
+                           q_on, seed=13, event_timestamps=True)
+    q_off = InProcQueues()
+    eng_off = ServingEngine("softMax", ACTIONS, dict(LEARNER_CFG),
+                            q_off, seed=13, event_timestamps=True)
+
+    def fill_on(n: int) -> None:
+        # 1-in-64 events travel as id|ts|traceid; the other 63 stay
+        # BARE — the sampled-trace wire contract
+        for i in range(n):
+            tid = ctx.maybe_start()
+            q_on.push_event(f"e{i}" if tid is None
+                            else f"e{i}|{time.time()}|{tid}")
+
+    def timed_on() -> float:
+        telemetry.enable(True)
+        ctx.enable(sample_every=64)
+        fill_on(N_ENABLED_EVENTS)
+        pump.start()
+        t0 = time.perf_counter()
+        eng_on.run()
+        elapsed = time.perf_counter() - t0
+        pump.stop()
+        telemetry.enable(False)
+        ctx.disable()
+        ctx.drain()
+        return elapsed
+
+    def timed_off() -> float:
+        for i in range(N_ENABLED_EVENTS):
+            q_off.push_event(f"e{i}")
+        t0 = time.perf_counter()
+        eng_off.run()
+        return time.perf_counter() - t0
+
+    try:
+        out = _overhead_gate(timed_on, timed_off,
+                             "live-obs (pump+scrape+trace) engine")
+        snap = telemetry.tracer().snapshot().get("engine.decision_latency")
+        if not snap or snap["count"] < N_ENABLED_EVENTS:
+            fail(f"enabled engine recorded no decision latency: {snap}")
+        if not any(w["rates"]["decisions_per_s"] > 0
+                   for w in ring.windows()):
+            fail("pump never observed serving while timing the ON side")
+    finally:
+        telemetry.enable(False)
+        ctx.disable()
+        telemetry.tracer().reset()
+        server.stop()
+    return out
+
+
+def main() -> int:
+    summary = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        summary["scrape"] = check_live_scrape(tmp)
+        summary["crash_flight"] = check_crash_flight(tmp)
+        summary["trace"] = check_cross_process_trace(tmp)
+    summary["enabled_overhead"] = check_enabled_live_overhead()
+    print(json.dumps({"live_obs_smoke": "ok", **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -508,6 +508,37 @@ class TestGroupedEngine:
 
 
 class TestTelemetryAndCallbacks:
+    def test_shed_gauge_published_mid_run(self):
+        """Regression: shed_per_s can only be a LIVE rate if
+        ``engine.shed_total`` reaches the hub WHILE the overloaded run
+        is in progress — under sustained overload run() never returns,
+        so the end-of-run publish alone would leave every scrape window
+        reading 0 and the whole count spiking in the final window."""
+        from avenir_tpu.obs import exporters as E
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=10.0)
+        q = _prefill_inproc(2000, 0)
+        mid_run = []
+
+        def on_batch(n):
+            if (q.depth() or 0) > 0:     # strictly before run() returns
+                mid_run.append(
+                    hub.report()["gauges"].get("engine.shed_total", 0.0))
+
+        try:
+            adm = AdmissionControl(high_water=512, low_water=128,
+                                   policy="drop-oldest", shed_chunk=256)
+            eng = ServingEngine("softMax", ACTIONS, dict(
+                TestAdmissionControl.CONFIG), q, seed=3, admission=adm,
+                on_batch=on_batch)
+            stats = eng.run()
+        finally:
+            hub.disable()
+            hub.reset()
+        assert stats.shed_total > 0
+        assert mid_run and max(mid_run) > 0
+
     def test_engine_spans_and_gauges(self):
         from avenir_tpu.obs import exporters as E
         hub = E.hub()
