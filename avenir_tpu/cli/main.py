@@ -1697,18 +1697,68 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
     # time lands in the engine.queue_wait histogram (requires telemetry,
     # i.e. --metrics-out, to be visible); actions keep the bare id
     event_ts = conf.get_bool("event.timestamps", False)
-    queues = InProcQueues()
+    # broker.shards (ISSUE 12): serve this job over a key-hashed broker
+    # FLEET instead of in-process queues — the job's group
+    # (``broker.group``, default g0) consistently hashes to one shard,
+    # whose queues carry the events/actions/rewards with the full
+    # ledger discipline. Strictly opt-in: unset keeps the in-proc path
+    # byte-identical to HEAD. Engine only (the loop path keeps files).
+    broker_spec = conf.get("broker.shards")
+    fleet = None
+    broker_shard = None
+    if broker_spec:
+        if not use_engine:
+            raise ValueError(
+                "broker.shards needs serving.engine=true — the fleet "
+                "transport is the engine's bulk protocol")
+        from avenir_tpu.stream.fleet import BrokerFleet, consistent_route
+        from avenir_tpu.stream.loop import RedisQueues
+        group = conf.get("broker.group", "g0")
+        fleet = BrokerFleet(broker_spec)
+        broker_shard = consistent_route([group],
+                                        range(fleet.n_shards))[group]
+        _bclient = fleet.client(broker_shard)
+        # this job OWNS its group's key family for the run: clear any
+        # residue a previous (or crashed) job left on a persistent
+        # broker — a fresh reward cursor would otherwise re-fold the
+        # prior run's rewards and stale actions would leak into the
+        # output file
+        _bclient.delete(f"eventQueue:{group}", f"actionQueue:{group}",
+                        f"rewardQueue:{group}", f"pendingQueue:{group}")
+        queues = RedisQueues(event_queue=f"eventQueue:{group}",
+                             action_queue=f"actionQueue:{group}",
+                             reward_queue=f"rewardQueue:{group}",
+                             pending_queue=f"pendingQueue:{group}",
+                             field_delim=conf.get("field.delim", ","),
+                             client=_bclient)
+    else:
+        queues = InProcQueues()
 
     def fill(resumed_events: int = 0) -> None:
         event_rows = read_csv_lines(in_path,
                                     conf.get("field.delim.regex", ","))
+        reward_path = conf.get("reward.data.path")
+        reward_rows = (read_csv_lines(reward_path,
+                                      conf.get("field.delim.regex", ","))
+                       if reward_path else [])
+        if fleet is not None:
+            # chunked multi-value LPUSH: one broker round trip per ~512
+            # rows, not per row (the driver must not be the bottleneck
+            # the fleet exists to remove); multi-value LPUSH appends
+            # left-to-right, so the queue matches per-row pushes exactly
+            def _bulk(queue, payloads, chunk=512):
+                for i in range(0, len(payloads), chunk):
+                    _bclient.lpush(queue, *payloads[i:i + chunk])
+            _bulk(queues.event_queue,
+                  [row[0] for row in event_rows[resumed_events:]])
+            _bulk(queues.reward_queue,
+                  [queues.delim.join([row[0], str(float(row[1]))])
+                   for row in reward_rows])
+            return
         for row in event_rows[resumed_events:]:
             queues.push_event(row[0])
-        reward_path = conf.get("reward.data.path")
-        if reward_path:
-            for row in read_csv_lines(reward_path,
-                                      conf.get("field.delim.regex", ",")):
-                queues.push_reward(row[0], float(row[1]))
+        for row in reward_rows:
+            queues.push_reward(row[0], float(row[1]))
 
     extra = ""
     if use_engine:
@@ -1789,12 +1839,27 @@ def run_reinforcement_learner(conf: JobConfig, in_path: str,
             stats = loop.run()
     delim_out = conf.get("field.delim", ",")
     with open(out_path, "w") as fh:
-        while True:
-            entry = queues.pop_action()
-            if entry is None:
-                break
-            event_id, selections = entry
-            fh.write(delim_out.join([event_id] + selections) + "\n")
+        if fleet is not None:
+            # answers came back through the job's broker shard; the
+            # count-form RPOP drains oldest-first in ~512-row round
+            # trips (the fill path's chunking rationale, applied to the
+            # drain)
+            while True:
+                raws = _bclient.rpop(queues.action_queue, 512)
+                if not raws:
+                    break
+                for raw in raws:
+                    fh.write(raw.decode() + "\n")
+        else:
+            while True:
+                entry = queues.pop_action()
+                if entry is None:
+                    break
+                event_id, selections = entry
+                fh.write(delim_out.join([event_id] + selections) + "\n")
+    if fleet is not None:
+        extra += f', "broker_shard": {broker_shard}'
+        fleet.close()
     print(f'{{"events": {stats.events}, "rewards": {stats.rewards}, '
           f'"actions": {stats.actions_written}{extra}}}')
 

@@ -176,14 +176,10 @@ def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
     ``config.sharded`` scales the whole computation out over the device
     mesh (train rows sharded, distributed top-k merge) — see
     :func:`_neighbors_sharded`."""
-    if config.sharded:
-        if config.quantized:
-            raise ValueError(
-                "knn.quantized does not compose with knn.sharded yet: the "
-                "distributed merge runs per-shard XLA candidates; drop one")
-        return _neighbors_sharded(train, test, config)
     if config.quantized and config.algorithm != "euclidean":
         raise ValueError("knn.quantized supports euclidean only")
+    if config.sharded:
+        return _neighbors_sharded(train, test, config)
     tr_num, tr_cat, n_bins = _split_features(train)
     m = int(test.binned.shape[0])
     feed_active = 0 < config.feed_chunk_rows < m
@@ -285,7 +281,7 @@ def _neighbors_sharded(train: EncodedTable, test: EncodedTable,
     cat_idx = [i for i, f in enumerate(train.feature_fields)
                if f.is_categorical]
     n_bins = max((train.bins_per_feature[i] for i in cat_idx), default=0)
-    if _on_tpu() and config.mode == "fast":
+    if not config.quantized and _on_tpu() and config.mode == "fast":
         # the sharded path runs the XLA streaming core per shard; the
         # hand-scheduled Pallas kernel is single-chip only (its own jit/
         # scratch management does not compose with shard_map). At low
@@ -309,13 +305,30 @@ def _neighbors_sharded(train: EncodedTable, test: EncodedTable,
         collective.publish_imbalance(
             collective.shard_imbalance(y_valid, n_shards))
 
-    def run(xn, xc):
-        return collective.sharded_topk(
-            xn, y_num, xc, y_cat, mesh=mesh, k=config.top_match_count,
-            y_valid=y_valid, n_real=n_real, block_size=config.block_size,
-            algorithm=config.algorithm, n_cat_bins=n_bins,
-            distance_scale=config.distance_scale, mode=config.mode,
-            recall_target=config.recall_target)
+    if config.quantized:
+        # knn.sharded × knn.quantized (ISSUE 12 satellite): each shard
+        # runs the int8/bf16 candidate scan + EXACT f32 re-rank over its
+        # own train rows before the top-k all-gather — the merge key is
+        # already exact, so per-shard quantization scales cannot skew
+        # the cross-shard order (parity-gated by the same recall/vote
+        # bars as one device, at 1/2/4 shards)
+        def run(xn, xc):
+            return collective.sharded_quantized_topk(
+                xn, y_num, xc, y_cat, mesh=mesh,
+                k=config.top_match_count, n_real=n_real,
+                block_size=config.block_size, n_cat_bins=n_bins,
+                distance_scale=config.distance_scale,
+                oversample=config.quantized_oversample,
+                qdtype=config.quantized_dtype)
+    else:
+        def run(xn, xc):
+            return collective.sharded_topk(
+                xn, y_num, xc, y_cat, mesh=mesh, k=config.top_match_count,
+                y_valid=y_valid, n_real=n_real,
+                block_size=config.block_size,
+                algorithm=config.algorithm, n_cat_bins=n_bins,
+                distance_scale=config.distance_scale, mode=config.mode,
+                recall_target=config.recall_target)
 
     te_num, te_cat = _split_features_host(test)
     m = int(test.binned.shape[0])

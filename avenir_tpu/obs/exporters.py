@@ -422,9 +422,19 @@ class TelemetryHub:
         the retried attempt's report."""
         del self._registries[mark:]
 
+    @staticmethod
+    def _gauge_value(value):
+        """A gauge is a float — or a per-source dict of floats (the
+        coordinator's per-shard ``broker.*`` gauges, ISSUE 12), which
+        the exporters already render under a Prometheus ``source``
+        label and the fleet merge splices per origin."""
+        if isinstance(value, dict):
+            return {str(k): float(v) for k, v in value.items()}
+        return float(value)
+
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
-            self._gauges[name] = float(value)
+            self._gauges[name] = self._gauge_value(value)
 
     def set_gauges(self, values: Dict[str, float]) -> None:
         """Publish several gauges under one lock acquisition (the serving
@@ -432,7 +442,7 @@ class TelemetryHub:
         reward backlog)."""
         with self._lock:
             for name, value in values.items():
-                self._gauges[name] = float(value)
+                self._gauges[name] = self._gauge_value(value)
 
     def set_meta(self, **kw) -> None:
         """Attach identity fields (``worker_id=3``) to every future

@@ -113,12 +113,16 @@ def _candidate_topk(x: jnp.ndarray, y: jnp.ndarray, kprime: int,
     return best_i
 
 
-def _rerank_exact(x: jnp.ndarray, y: jnp.ndarray, cand_i: jnp.ndarray,
-                  k: int, n_attrs: int, distance_scale: int
-                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _rerank_metric(x: jnp.ndarray, y: jnp.ndarray, cand_i: jnp.ndarray,
+                   k: int, n_attrs: int
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exact f32 re-score of the candidate rows + lexicographic
-    (metric, global row id) sort — the exact path's ordering rule — then
-    the reference finalization (sqrt, ``distance_scale`` int).
+    (metric, global row id) sort — the exact path's ordering rule —
+    returning the PRE-finalize key: (f32 metric with ``_BIG``
+    sentinels, row ids with ``INT_BIG`` sentinels). The sharded
+    composition merges shards on THIS key (exact f32, so per-shard
+    quantization scales cannot skew the cross-shard order) before one
+    shared finalization.
 
     The metric is the ELEMENTWISE ``Σ(x−y)²`` form, not the matmul
     expansion the [M, N] sweep uses: on O(M·k'·D) gathered candidates the
@@ -133,12 +137,29 @@ def _rerank_exact(x: jnp.ndarray, y: jnp.ndarray, cand_i: jnp.ndarray,
     metric = jnp.where(found, metric, jnp.float32(_BIG))
     idx_key = jnp.where(found, cand_i, INT_BIG)
     metric_s, idx_s = lax.sort((metric, idx_key), dimension=1, num_keys=2)
-    metric_s, idx_s = metric_s[:, :k], idx_s[:, :k]
+    return metric_s[:, :k], idx_s[:, :k]
+
+
+def finalize_quantized(metric_s: jnp.ndarray, idx_s: jnp.ndarray,
+                       distance_scale: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reference finalization of a sorted (metric, id) key: sqrt +
+    ``distance_scale`` int, sentinels to (INT_BIG, -1)."""
     ok = metric_s < _BIG
     dist = jnp.sqrt(metric_s)
     scaled = jnp.where(ok, jnp.asarray(jnp.rint(dist * distance_scale),
                                        jnp.int32), INT_BIG)
     return scaled, jnp.where(ok, idx_s, -1)
+
+
+def _rerank_exact(x: jnp.ndarray, y: jnp.ndarray, cand_i: jnp.ndarray,
+                  k: int, n_attrs: int, distance_scale: int
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact f32 re-rank + finalization (the single-device path):
+    byte-identical composition of :func:`_rerank_metric` and
+    :func:`finalize_quantized`."""
+    return finalize_quantized(
+        *_rerank_metric(x, y, cand_i, k, n_attrs), distance_scale)
 
 
 def _quantized_topk(x_num: Optional[jnp.ndarray],

@@ -324,6 +324,124 @@ def sharded_topk(x_num: Optional[jnp.ndarray], y_num: Optional[jnp.ndarray],
 
 
 # ---------------------------------------------------------------------------
+# sharded quantized KNN: per-shard int8/bf16 scan + exact re-rank + merge
+# ---------------------------------------------------------------------------
+
+_QTOPK_PROGRAMS: Dict[tuple, object] = {}
+
+
+def sharded_quantized_topk(x_num: Optional[jnp.ndarray],
+                           y_num: Optional[jnp.ndarray],
+                           x_cat: Optional[jnp.ndarray] = None,
+                           y_cat: Optional[jnp.ndarray] = None,
+                           *, mesh: Mesh, k: int,
+                           n_real: Optional[int] = None,
+                           block_size: int = 65536,
+                           n_cat_bins: int = 0,
+                           distance_scale: int = 1000,
+                           oversample: int = 4, qdtype: str = "int8"
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """``knn.sharded`` × ``knn.quantized`` composed (ISSUE 12
+    satellite; the "lift that first" gate for ROADMAP item 3's ANN
+    index): each shard runs the low-precision candidate scan over ITS
+    train rows, re-ranks its survivors in EXACT f32 locally, and only
+    then do the per-shard top-k candidates all-gather into the second
+    exact top-k — the same gather-of-top-k (never the [M, N] slab)
+    shape as :func:`sharded_topk`.
+
+    Correctness across shards holds because the merge key is the exact
+    f32 re-rank metric, not the quantized candidate metric: each
+    shard's int8 scale is computed from (test, LOCAL train) magnitudes
+    — scales may differ per shard, which only moves each shard's
+    RECALL (same failure mode, and same oversample remedy, as one
+    device), never the cross-shard ordering. Ties break by global row
+    id via the two-key sort, so output ordering matches the
+    single-device quantized path's rule. Train padding (edge copies —
+    contiguous at the global tail, ``shard_train_rows``) is masked by
+    global id >= ``n_real``; a pad can steal at most its own candidate
+    slot on the last shard, which the oversample absorbs."""
+    from avenir_tpu.ops.quantized import (QDTYPES, _BIG, _candidate_topk,
+                                          _rerank_metric,
+                                          finalize_quantized)
+    if qdtype not in QDTYPES:
+        raise ValueError(f"qdtype {qdtype!r} not one of {QDTYPES}")
+    if oversample < 1:
+        raise ValueError("oversample must be >= 1")
+    axis = DATA_AXIS
+    n_shards = mesh.shape[axis]
+    if x_num is None and x_cat is None:
+        raise ValueError("no test features")
+    if y_num is None and y_cat is None:
+        raise ValueError("no train features")
+    m = int((x_num if x_num is not None else x_cat).shape[0])
+    n = int((y_num if y_num is not None else y_cat).shape[0])
+    if n % n_shards:
+        raise ValueError(
+            f"{n} train rows not divisible by the {n_shards}-shard data "
+            "axis; pad with shard_train_rows/shard_table first")
+    n_real = n if n_real is None else int(n_real)
+    per = n // n_shards
+    n_attrs = ((x_num.shape[1] if x_num is not None else 0) +
+               (x_cat.shape[1] if x_cat is not None else 0))
+    k_out = max(min(k, n_real), 1)
+    k_local = min(k, per)
+    kprime = min(max(oversample * k_local, k_local), per)
+    xn = _zero_width(x_num, m, jnp.float32)
+    xc = _zero_width(x_cat, m, jnp.int32)
+    yn = _zero_width(y_num, n, jnp.float32)
+    yc = _zero_width(y_cat, n, jnp.int32)
+
+    key = (mesh, per, kprime, k_local, k_out, block_size, n_cat_bins,
+           distance_scale, oversample, qdtype, n_real, n_attrs)
+    prog = _QTOPK_PROGRAMS.get(key)
+    if prog is None:
+        from avenir_tpu.ops.distance import INT_BIG, encode_mixed
+        in_specs = (P(None, None), _row_spec(2), P(None, None),
+                    _row_spec(2))
+        # the SAME sentinel finalize_quantized's validity check compares
+        # against — a literal here would silently desync if _BIG moves
+        big = jnp.float32(_BIG)
+
+        def shard_body(sxn, syn, sxc, syc):
+            x = encode_mixed(sxn if sxn.shape[1] else None,
+                             sxc if sxc.shape[1] else None, n_cat_bins)
+            y = encode_mixed(syn if syn.shape[1] else None,
+                             syc if syc.shape[1] else None, n_cat_bins)
+            cand = _candidate_topk(x, y, kprime, block_size, qdtype)
+            metric, idx_local = _rerank_metric(x, y, cand, k_local,
+                                               n_attrs)
+            base = (lax.axis_index(axis) * per).astype(jnp.int32)
+            gid = idx_local + base
+            # sentinels (idx_local == INT_BIG) and padded train copies
+            # (gid >= n_real: edge-padding sits at the global tail)
+            # must never win a merge slot
+            valid = (idx_local < INT_BIG) & (gid < n_real)
+            metric = jnp.where(valid, metric, big)
+            gid = jnp.where(valid, gid, INT_BIG)
+            m_all = lax.all_gather(metric, axis, axis=1, tiled=True)
+            i_all = lax.all_gather(gid, axis, axis=1, tiled=True)
+            # exact two-key merge over k_local × n_shards candidates:
+            # the single-device quantized ordering rule (f32 metric,
+            # then lowest global row id) applied across shards
+            m_s, i_s = lax.sort((m_all, i_all), dimension=1, num_keys=2)
+            return m_s[:, :k_out], i_s[:, :k_out]
+
+        # check_rep=False: outputs ARE replicated (all_gather + an
+        # identical merge per shard) but the checker cannot see that
+        # through lax.scan — the sharded_topk discipline
+        sm = shard_map(shard_body, mesh=mesh, in_specs=in_specs,
+                       out_specs=(P(), P()), check_rep=False)
+
+        @jax.jit
+        def fused(fxn, fyn, fxc, fyc):
+            return finalize_quantized(*sm(fxn, fyn, fxc, fyc),
+                                      distance_scale)
+
+        prog = _QTOPK_PROGRAMS[key] = fused
+    return prog(xn, yn, xc, yc)
+
+
+# ---------------------------------------------------------------------------
 # psum-reduced accumulation: the shuffle+reduce analogue for count kernels
 # ---------------------------------------------------------------------------
 

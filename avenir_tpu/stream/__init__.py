@@ -11,15 +11,23 @@
 - ``scaleout``  — N-worker-process serving over one broker with per-group
                   ownership (the num.workers contract,
                   ReinforcementLearnerTopology.java:64-82)
+- ``fleet``     — key-hashed broker-fleet sharding (ISSUE 12): the
+                  consistent-hash group->shard router, the BrokerFleet
+                  client pool, and the ShardedQueues fan-out transport
+                  (one pipelined sweep per owned shard, concurrently)
 """
 
 from avenir_tpu.stream.engine import (
     EngineStats, GroupedServingEngine, ServingEngine,
 )
+from avenir_tpu.stream.fleet import (
+    BrokerFleet, ShardedQueues, consistent_route,
+)
 from avenir_tpu.stream.loop import (
     GroupedLearner, InProcQueues, LoopStats, OnlineLearnerLoop, RedisQueues,
 )
 
-__all__ = ["EngineStats", "GroupedLearner", "GroupedServingEngine",
-           "InProcQueues", "LoopStats", "OnlineLearnerLoop", "RedisQueues",
-           "ServingEngine"]
+__all__ = ["BrokerFleet", "EngineStats", "GroupedLearner",
+           "GroupedServingEngine", "InProcQueues", "LoopStats",
+           "OnlineLearnerLoop", "RedisQueues", "ServingEngine",
+           "ShardedQueues", "consistent_route"]

@@ -264,6 +264,28 @@ class RedisQueues:
     # boundary — bit-parity with an unbounded drain holds exactly.
     _DRAIN_MAX = 4096
 
+    def note_popped(self, raw: bytes) -> str:
+        """Bookkeeping for one raw payload popped OUTSIDE this adapter
+        (a fleet fan-out sweep builds ONE pipeline per broker shard
+        spanning several groups' queues, then hands each reply back to
+        its group's adapter here — stream/fleet.py). Identical to what
+        ``pop_event``/``pop_events`` do per reply: decode, and note the
+        ledger entry when the pending ledger is armed."""
+        decoded = raw.decode()
+        if self.pending_queue is not None:
+            self._note_pending(decoded, raw)
+        return decoded
+
+    def ack_command(self, event_id: str) -> Optional[Tuple[str, int, bytes]]:
+        """The (pending_queue, count, raw) LREM triple retiring one
+        ledger entry, with the host-side alias bookkeeping already
+        dropped — for fan-out callers batching many groups' acks into
+        one per-shard pipeline. None when no ledger is armed."""
+        if self.pending_queue is None:
+            return None
+        raw = self._ack_raw(event_id)
+        return (self.pending_queue, 1, raw)
+
     def _note_pending(self, decoded: str, raw: bytes) -> None:
         """Ledger bookkeeping for one popped raw payload: key by the full
         payload AND the id prefix, so ack_event(event_id) retires the
@@ -470,26 +492,17 @@ class RedisQueues:
         cap = self._DRAIN_MAX if max_items is None else max(int(max_items), 0)
         out: List[Tuple[str, float]] = []
         if hasattr(self._r, "lrange"):
-            start = self._reward_cursor - cap + 1
             pipe = getattr(self._r, "pipeline", None)
             if pipe is not None:
                 p = pipe()
-                p.lrange(self.reward_queue, start, self._reward_cursor)
-                p.llen(self.reward_queue)
+                self.queue_reward_sweep(p, cap)
                 raws, total = p.execute()
             else:
+                start = self._reward_cursor - cap + 1
                 raws = self._r.lrange(self.reward_queue, start,
                                       self._reward_cursor)
                 total = self._r.llen(self.reward_queue)
-            # lrange returns head->tail = newest->oldest here; the cursor
-            # contract is oldest-first
-            for raw in reversed(raws):
-                action_id, _, reward = raw.decode().partition(self.delim)
-                out.append((action_id, self._reward_value(reward)))
-            self._reward_cursor -= len(raws)
-            self.reward_backlog = max(
-                int(total) + self._reward_cursor + 1, 0)
-            return out
+            return self.apply_reward_sweep(raws, total)
         # clients without lrange (test fakes): the original lindex walk,
         # same bounded sweep
         while len(out) < cap:
@@ -512,6 +525,30 @@ class RedisQueues:
                 probe = self._r.lindex(self.reward_queue,
                                        self._reward_cursor)
                 self.reward_backlog = 1 if probe is not None else 0
+        return out
+
+    def queue_reward_sweep(self, pipe, cap: int) -> None:
+        """Queue this adapter's bounded reward sweep (the LRANGE window
+        off the cursor + an LLEN for the backlog gauge) onto a
+        CALLER-owned pipeline — the seam a fleet fan-out drain uses to
+        ride many groups' sweeps on one per-shard round trip
+        (stream/fleet.py). Apply the two replies, in order, with
+        :meth:`apply_reward_sweep`."""
+        start = self._reward_cursor - cap + 1
+        pipe.lrange(self.reward_queue, start, self._reward_cursor)
+        pipe.llen(self.reward_queue)
+
+    def apply_reward_sweep(self, raws, total) -> List[Tuple[str, float]]:
+        """Consume one sweep's (LRANGE reply, LLEN reply): parse
+        oldest-first (lrange returns head->tail = newest->oldest under
+        lpush producers), advance the cursor, refresh the backlog
+        gauge."""
+        out: List[Tuple[str, float]] = []
+        for raw in reversed(raws):
+            action_id, _, reward = raw.decode().partition(self.delim)
+            out.append((action_id, self._reward_value(reward)))
+        self._reward_cursor -= len(raws)
+        self.reward_backlog = max(int(total) + self._reward_cursor + 1, 0)
         return out
 
     @staticmethod

@@ -64,7 +64,16 @@ class AssignmentRecord:
     is alive and will publish-on-release (the acquirer should wait for
     that snapshot); a dead predecessor's groups are absent — there is
     nothing to wait for, reclaim + fresh state is the recovery path.
-    ``stop`` tells ownerless workers the run is over."""
+    ``stop`` tells ownerless workers the run is over.
+
+    With a broker FLEET armed (ISSUE 12) the record additionally
+    carries ``brokers`` (endpoint strings; list index = shard id) and
+    ``routing`` (group -> shard id, consistent-hashed): queue routing
+    and group ownership travel in the SAME atomically-swapped,
+    epoch-numbered record, so a worker can never pop a group's queues
+    on one shard while the coordinator thinks they moved. Single-broker
+    records never include these fields — the JSON is byte-identical to
+    the pre-fleet format."""
 
     epoch: int
     groups: Dict[str, int] = field(default_factory=dict)
@@ -76,6 +85,10 @@ class AssignmentRecord:
     # membership change every tick and churn epochs forever.
     members: List[int] = field(default_factory=list)
     stop: bool = False
+    # broker-fleet routing (empty = single broker, fields omitted from
+    # the wire format entirely)
+    brokers: List[str] = field(default_factory=list)
+    routing: Dict[str, int] = field(default_factory=dict)
 
     def owned_by(self, worker_id: int) -> List[str]:
         return sorted(g for g, w in self.groups.items() if w == worker_id)
@@ -84,10 +97,14 @@ class AssignmentRecord:
         return sorted(set(self.groups.values()))
 
     def to_json(self) -> str:
-        return json.dumps({"epoch": self.epoch, "groups": self.groups,
-                           "handoff": sorted(self.handoff),
-                           "members": sorted(self.members),
-                           "stop": self.stop}, sort_keys=True)
+        data = {"epoch": self.epoch, "groups": self.groups,
+                "handoff": sorted(self.handoff),
+                "members": sorted(self.members),
+                "stop": self.stop}
+        if self.brokers:
+            data["brokers"] = list(self.brokers)
+            data["routing"] = self.routing
+        return json.dumps(data, sort_keys=True)
 
     @classmethod
     def from_json(cls, raw: str) -> "AssignmentRecord":
@@ -97,7 +114,10 @@ class AssignmentRecord:
                            for g, w in (data.get("groups") or {}).items()},
                    handoff=list(data.get("handoff") or []),
                    members=[int(w) for w in (data.get("members") or [])],
-                   stop=bool(data.get("stop", False)))
+                   stop=bool(data.get("stop", False)),
+                   brokers=list(data.get("brokers") or []),
+                   routing={g: int(s) for g, s in
+                            (data.get("routing") or {}).items()})
 
 
 def read_assignment(client) -> Optional[AssignmentRecord]:
@@ -157,9 +177,16 @@ class Coordinator:
     writer). Feed it the drained heartbeat stream on whatever cadence
     the driver polls; it rewrites the assignment iff membership changed."""
 
+    #: consecutive empty sweeps (one per coordinator tick, i.e. one per
+    #: cadence) before a migration source retires: spans a stale
+    #: producer's record-poll window with margin, so an entry pushed
+    #: right after an empty observation is still swept
+    _MIGRATE_EMPTY_TICKS = 3
+
     def __init__(self, client, groups: Sequence[str],
                  cadence_s: float = 0.5,
-                 dead_after_factor: Optional[float] = None):
+                 dead_after_factor: Optional[float] = None,
+                 fleet=None):
         from avenir_tpu.stream.scaleout import DEAD_AFTER_FACTOR
         self.client = client
         self.groups = list(groups)
@@ -170,6 +197,40 @@ class Coordinator:
         self.last_seen: Dict[int, float] = {}
         self.removed: set = set()
         self.record = read_assignment(client) or AssignmentRecord(0)
+        # broker-fleet routing (ISSUE 12): with a BrokerFleet armed,
+        # every record this coordinator writes carries the group->shard
+        # consistent-hash map beside ownership; ``client`` must then be
+        # the fleet's CONTROL shard client (shard 0), where the record
+        # and the heartbeat/telemetry queues live
+        self.fleet = fleet
+        self.routing: Dict[str, int] = {}
+        self._force_write = False
+        # groups mid-migration after a routing change: {group: set of
+        # SOURCE shards}, swept every tick (source -> current routing)
+        # until a source's sweep moves nothing, catching stragglers a
+        # stale producer landed on an old shard. A set, not a scalar: a
+        # second re-route while a source is still backed up (broker
+        # hiccup) must not forget the first source — its entries would
+        # be stranded where no routing ever looks again.
+        self._moved: Dict[str, set] = {}
+        # (group, source) pairs whose INITIAL tail-splice ran: later
+        # sweeps of the same source are straggler sweeps and head-push
+        # (see fleet.migrate_group_queues tail=)
+        self._spliced: set = set()
+        # consecutive empty sweeps per (group, source): a source
+        # retires only after _MIGRATE_EMPTY_TICKS empty observations —
+        # one empty sweep proves nothing about a stale producer still
+        # inside its record-poll window
+        self._moved_empty: Dict[tuple, int] = {}
+        if fleet is not None:
+            from avenir_tpu.stream.fleet import consistent_route
+            self.routing = consistent_route(self.groups,
+                                            range(fleet.n_shards))
+            if self.record.routing != self.routing:
+                # a pre-existing record (coordinator restart over a
+                # resized fleet) re-routes at the next epoch — and the
+                # moved groups' queues migrate with it
+                self._force_write = True
         # broker introspection (ISSUE 11 satellite): the latest INFO
         # snapshot, polled on the cadence into broker.* hub gauges —
         # broker saturation is the known wall for the 1M/min run and
@@ -182,6 +243,80 @@ class Coordinator:
         # would haunt every later merge of this accumulator
         self.worker_reports: Dict[int, Dict] = {}
         self._last_reports = 0.0
+
+    # -- broker-fleet routing (ISSUE 12) -------------------------------------
+
+    def set_brokers(self, fleet) -> Optional["AssignmentRecord"]:
+        """Re-route the fleet over a new broker set (add/remove a
+        shard). Consistent hashing keeps the movement minimal (~1/N of
+        the groups re-home); routing and ownership land in ONE new
+        epoch's record, and each moved group's queues migrate old
+        shard -> new shard right after the swap (then re-sweep per tick
+        for stale-producer stragglers). Returns the new record, or None
+        when no worker is alive yet (the re-route then lands with the
+        first join)."""
+        from avenir_tpu.stream.fleet import consistent_route
+        self.fleet = fleet
+        self.routing = consistent_route(self.groups,
+                                        range(fleet.n_shards))
+        if (self.record.routing != self.routing
+                or self.record.brokers != fleet.endpoint_strings()
+                or self.record.epoch == 0):
+            self._force_write = True
+        return self.step()
+
+    def _migrate_moved(self) -> int:
+        """Sweep every mid-migration group's old-shard queues into its
+        CURRENT shard; a source retires from the sweep set once its
+        sweep comes back empty (copy-then-delete inside — see
+        ``fleet.migrate_group_queues`` for the crash ordering). The
+        first sweep of a (group, source) is the tail splice; repeats
+        are straggler sweeps and head-push."""
+        if self.fleet is None or not self._moved:
+            return 0
+        from avenir_tpu.stream.fleet import migrate_group_queues
+        total = 0
+        for g in list(self._moved):
+            target = self.routing[g]
+            for src in list(self._moved[g]):
+                key = (g, src)
+                if src == target:
+                    # a re-route brought the group BACK here: nothing
+                    # to move from a shard onto itself
+                    self._moved[g].discard(src)
+                    self._moved_empty.pop(key, None)
+                    continue
+                tail = key not in self._spliced
+                # marked on ATTEMPT, not success: a partially-failed
+                # tail splice must NOT retry as tail — a post-flip
+                # straggler pushed between attempts would splice below
+                # the kept consumer's cursor and never be read (loss).
+                # The head-push retry instead bounds the damage at a
+                # re-fold of already-consumed rewards (no ids to dedup
+                # rewards by — double-count beats silent loss).
+                self._spliced.add(key)
+                try:
+                    n = migrate_group_queues(self.fleet, g, src, target,
+                                             tail=tail)
+                except Exception:
+                    continue       # broker hiccup: retry next tick
+                total += n
+                if n == 0:
+                    # retire only after several consecutive empty
+                    # sweeps: one empty observation can race a stale
+                    # producer still inside its record-poll window
+                    empties = self._moved_empty.get(key, 0) + 1
+                    if empties >= self._MIGRATE_EMPTY_TICKS:
+                        self._moved[g].discard(src)
+                        self._spliced.discard(key)
+                        self._moved_empty.pop(key, None)
+                    else:
+                        self._moved_empty[key] = empties
+                else:
+                    self._moved_empty.pop(key, None)
+            if not self._moved[g]:
+                del self._moved[g]
+        return total
 
     # -- membership ----------------------------------------------------------
 
@@ -223,6 +358,7 @@ class Coordinator:
         self.note_heartbeats(read_heartbeats(self.client))
         self.poll_broker_info(now)
         self.poll_worker_reports(now)
+        self._migrate_moved()      # routing-change straggler sweep
         return self.step(now)
 
     def poll_worker_reports(self, now: Optional[float] = None
@@ -249,11 +385,12 @@ class Coordinator:
         except Exception:
             return self.worker_reports
 
-    def _llen_depths(self) -> Dict[str, int]:
+    def _llen_depths(self, client=None) -> Dict[str, int]:
         """Depth map for brokers whose INFO carries no ``queue_depths``
         (real redis): LLEN over this coordinator's per-group queues.
         Best-effort — a failed probe degrades to empty, never raises."""
-        llen = getattr(self.client, "llen", None)
+        client = self.client if client is None else client
+        llen = getattr(client, "llen", None)
         if llen is None:
             return {}
         depths: Dict[str, int] = {}
@@ -283,34 +420,19 @@ class Coordinator:
         t_now = time.time() if now is None else now
         if t_now - self._last_info < self.cadence_s:
             return None
+        if self.fleet is not None:
+            self._last_info = t_now
+            return self._poll_fleet_info()
         info = getattr(self.client, "info", None)
         if info is None:
             return None
         self._last_info = t_now
-        try:
-            stats = info()
-        except Exception:
+        stats = self._one_broker_stats(self.client)
+        if stats is None:
             return None
-        depths = stats.get("queue_depths")
-        if depths is None:
-            depths = self._llen_depths()
-            stats = dict(stats, queue_depths=depths)
-        if "aof_bytes" not in stats and "aof_current_size" in stats:
-            stats = dict(stats, aof_bytes=stats["aof_current_size"])
-        # normalized BEFORE the snapshot lands: broker_info and the
-        # gauges below must agree on aof_bytes/queue_depths for real
-        # redis too
         self.broker_info = stats
         try:
-            def class_depth(prefix: str) -> float:
-                return float(sum(v for k, v in depths.items()
-                                 if k.startswith(prefix)))
-            by_class = {
-                "broker.event_depth": class_depth("eventQueue"),
-                "broker.reward_depth": class_depth("rewardQueue"),
-                "broker.pending_depth": class_depth("pendingQueue"),
-                "broker.action_depth": class_depth("actionQueue"),
-            }
+            by_class = self._depth_by_class(stats["queue_depths"])
             gauges = {
                 "broker.connected_clients":
                     float(stats.get("connected_clients", 0)),
@@ -329,6 +451,99 @@ class Coordinator:
             return stats
         _hub_gauges(gauges)
         return stats
+
+    def _one_broker_stats(self, client) -> Optional[Dict]:
+        """One broker's INFO, normalized (queue_depths present via the
+        LLEN fallback, aof_bytes aliased from redis's own key) — the
+        shared half of the single-broker and per-shard polls."""
+        info = getattr(client, "info", None)
+        if info is None:
+            return None
+        try:
+            stats = info()
+        except Exception:
+            return None
+        depths = stats.get("queue_depths")
+        if depths is None:
+            depths = self._llen_depths(client)
+            stats = dict(stats, queue_depths=depths)
+        if "aof_bytes" not in stats and "aof_current_size" in stats:
+            stats = dict(stats, aof_bytes=stats["aof_current_size"])
+        return stats
+
+    @staticmethod
+    def _depth_by_class(depths: Dict[str, int]) -> Dict[str, float]:
+        def class_depth(prefix: str) -> float:
+            return float(sum(v for k, v in depths.items()
+                             if k.startswith(prefix)))
+        return {
+            "broker.event_depth": class_depth("eventQueue"),
+            "broker.reward_depth": class_depth("rewardQueue"),
+            "broker.pending_depth": class_depth("pendingQueue"),
+            "broker.action_depth": class_depth("actionQueue"),
+        }
+
+    def _poll_fleet_info(self) -> Optional[Dict]:
+        """Fleet poll (ISSUE 12): every shard's INFO, published as
+        PER-SHARD ``broker.*`` gauges — dict-valued, keyed ``shard<i>``,
+        which the exporters render under a Prometheus ``source`` label —
+        plus the scalar ``broker.queue_depth_total`` aggregate (the
+        fleet-wide saturation headline). ``broker_info`` keeps aggregate
+        top-level fields for existing consumers and the per-shard
+        snapshots under ``shards``."""
+        per_shard: Dict[str, Dict] = {}
+        for s in range(self.fleet.n_shards):
+            try:
+                stats = self._one_broker_stats(self.fleet.client(s))
+            except Exception:
+                stats = None
+            if stats is not None:
+                per_shard[f"shard{s}"] = stats
+        if not per_shard:
+            return None
+        merged_depths: Dict[str, int] = {}
+        for stats in per_shard.values():
+            for k, v in stats.get("queue_depths", {}).items():
+                merged_depths[k] = merged_depths.get(k, 0) + int(v)
+        self.broker_info = {
+            "shards": per_shard,
+            "queue_depths": merged_depths,
+            "aof_bytes": sum(int(s.get("aof_bytes", 0))
+                             for s in per_shard.values()),
+            "connected_clients": sum(int(s.get("connected_clients", 0))
+                                     for s in per_shard.values()),
+            "total_commands_processed": sum(
+                int(s.get("total_commands_processed", 0))
+                for s in per_shard.values()),
+        }
+        try:
+            gauges: Dict = {
+                "broker.connected_clients": {},
+                "broker.commands_total": {},
+                "broker.aof_bytes": {},
+                "broker.event_depth": {},
+                "broker.reward_depth": {},
+                "broker.pending_depth": {},
+                "broker.action_depth": {},
+            }
+            total = 0.0
+            for label, stats in per_shard.items():
+                by_class = self._depth_by_class(
+                    stats.get("queue_depths", {}))
+                gauges["broker.connected_clients"][label] = float(
+                    stats.get("connected_clients", 0))
+                gauges["broker.commands_total"][label] = float(
+                    stats.get("total_commands_processed", 0))
+                gauges["broker.aof_bytes"][label] = float(
+                    stats.get("aof_bytes", 0))
+                for name, value in by_class.items():
+                    gauges[name][label] = value
+                total += sum(by_class.values())
+            gauges["broker.queue_depth_total"] = total
+        except (TypeError, ValueError):
+            return self.broker_info
+        _hub_gauges(gauges)
+        return self.broker_info
 
     def step(self, now: Optional[float] = None
              ) -> Optional[AssignmentRecord]:
@@ -349,7 +564,8 @@ class Coordinator:
         # membership change — comparing owners would churn epochs on
         # every tick
         serving = self.record.members or self.record.workers()
-        if members == serving and self.record.epoch > 0:
+        if (members == serving and self.record.epoch > 0
+                and not self._force_write):
             return None
         assign = rebalance_assignment(self.groups, members,
                                       self.record.groups)
@@ -361,20 +577,44 @@ class Coordinator:
         handoff = [g for g, w in assign.items()
                    if self.record.groups.get(g) not in (None, w)
                    and self.record.groups[g] in fresh]
-        self.record = AssignmentRecord(self.record.epoch + 1, assign,
-                                       handoff=handoff, members=members)
+        prev_routing = dict(self.record.routing)
+        self.record = AssignmentRecord(
+            self.record.epoch + 1, assign, handoff=handoff,
+            members=members,
+            brokers=(self.fleet.endpoint_strings()
+                     if self.fleet is not None else []),
+            routing=dict(self.routing))
+        self._force_write = False
         write_assignment(self.client, self.record)
+        if self.fleet is not None and prev_routing:
+            # routing changed under this epoch: migrate each moved
+            # group's key family old shard -> new shard, strictly AFTER
+            # the record swap (writers/readers flip first; stragglers a
+            # stale producer lands on the old shard are swept again on
+            # the next ticks until the old side stays empty)
+            for g, new_shard in self.routing.items():
+                old_shard = prev_routing.get(g)
+                if old_shard is not None and old_shard != new_shard:
+                    self._moved.setdefault(g, set()).add(old_shard)
+                    # this source's tail-splice window restarts at the
+                    # new flip
+                    self._spliced.discard((g, old_shard))
+        self._migrate_moved()
         _hub_gauges({"rebalance.epoch": self.record.epoch})
         return self.record
 
     def stop_fleet(self) -> AssignmentRecord:
         """Flag the run as over: workers that own nothing exit; owners
-        exit once their groups' stop sentinels arrive."""
-        self.record = AssignmentRecord(self.record.epoch + 1,
-                                       dict(self.record.groups),
-                                       handoff=[],
-                                       members=list(self.record.members),
-                                       stop=True)
+        exit once their groups' stop sentinels arrive. The stop record
+        keeps carrying brokers+routing — a fleet worker must still know
+        WHERE its groups' queues live to drain them and pop their
+        sentinels; dropping the fields would read as every group
+        re-homing to shard 0 mid-shutdown."""
+        self.record = AssignmentRecord(
+            self.record.epoch + 1, dict(self.record.groups),
+            handoff=[], members=list(self.record.members), stop=True,
+            brokers=list(self.record.brokers),
+            routing=dict(self.record.routing))
         write_assignment(self.client, self.record)
         return self.record
 
@@ -408,11 +648,24 @@ class WorkerRebalancer:
     def __init__(self, client, worker_id: int, make_server:
                  Callable[[str], Any], registry=None,
                  min_poll_interval_s: float = 0.0,
-                 handoff_wait_s: float = HANDOFF_WAIT_S):
+                 handoff_wait_s: float = HANDOFF_WAIT_S,
+                 client_for_group: Optional[Callable[[str], Any]] = None,
+                 on_record: Optional[Callable[[AssignmentRecord], None]]
+                 = None):
         self.client = client
         self.worker_id = int(worker_id)
         self.make_server = make_server
         self.registry = registry
+        # broker-fleet seams (ISSUE 12): ``client`` stays the CONTROL
+        # client (assignment record home); ``client_for_group`` resolves
+        # the shard client a group's queues live on — the acquire-time
+        # ledger reclaim must run THERE. ``on_record`` observes every
+        # newly applied record BEFORE its release/acquire deltas, so a
+        # fleet worker can refresh its routing view first (make_server
+        # then binds acquired groups to the right shard).
+        self.client_for_group = client_for_group or (lambda g: client)
+        self.on_record = on_record
+        self.last_record: Optional[AssignmentRecord] = None
         self.servers: Dict[str, Any] = {}
         # sorted owned-group names for OTHER threads (the /healthz
         # provider): rebuilt after every servers mutation and swapped
@@ -446,6 +699,9 @@ class WorkerRebalancer:
             return False
         self.epoch = rec.epoch
         self.stop = rec.stop
+        self.last_record = rec
+        if self.on_record is not None:
+            self.on_record(rec)    # routing refresh BEFORE the deltas
         target = set(rec.owned_by(self.worker_id))
         current = set(self.servers)
         for g in sorted(current - target):
@@ -502,9 +758,10 @@ class WorkerRebalancer:
         server = self.make_server(group)
         # a dead predecessor's un-acked pops replay to the new owner;
         # graceful handoffs left the ledger empty (batch-boundary
-        # release) so this is a no-op round trip
-        reclaim_pending(self.client, f"pendingQueue:{group}",
-                        f"eventQueue:{group}")
+        # release) so this is a no-op round trip. On a broker fleet the
+        # reclaim runs on the SHARD the group's queues live on.
+        reclaim_pending(self.client_for_group(group),
+                        f"pendingQueue:{group}", f"eventQueue:{group}")
         t_wait = time.perf_counter()
         snap = self._wait_for_handoff(group, rec)
         t_swap = time.perf_counter()

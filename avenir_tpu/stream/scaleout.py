@@ -329,6 +329,32 @@ class _StoppableQueues(RedisQueues):
                          pending_queue=f"pendingQueue:{group}",
                          client=client)
         self.stopped = False
+        # shard-move deferral (ISSUE 12): while set, reward drains hold
+        # until the OLD shard's reward queue is empty — see
+        # hold_rewards_until_migrated
+        self._migrating_from = None
+
+    def hold_rewards_until_migrated(self, old_client) -> None:
+        """Arm the shard-move reward hold: this view was re-bound to a
+        new shard with its reward cursor carried over, but the carried
+        cursor is only valid once the coordinator's migration has
+        spliced the old queue's consumed prefix in at the tail. Until
+        the old shard's reward queue reads empty, drains return nothing
+        (one cheap LLEN probe per drain) — folding the new shard's
+        fresh rewards through a pre-splice cursor would misread them
+        and strand the tailmost ones forever. An unreachable old shard
+        releases the hold (its entries are gone with it)."""
+        self._migrating_from = old_client
+
+    def drain_rewards(self, max_items=None):
+        if self._migrating_from is not None:
+            try:
+                if int(self._migrating_from.llen(self.reward_queue)) > 0:
+                    return []
+            except Exception:
+                pass               # old shard dead: nothing to wait for
+            self._migrating_from = None
+        return super().drain_rewards(max_items)
 
     def pop_event(self) -> Optional[str]:
         if self.stopped:
@@ -458,6 +484,59 @@ def shuffle_worker_main(host: str, port: int, worker_id: int,
             "grouping": "shuffle"}
 
 
+def _wait_for_routing(control, timeout_s: float = 30.0) -> Dict[str, int]:
+    """Poll the control shard for an assignment record carrying the
+    group->shard routing map (ISSUE 12): the driver/coordinator writes
+    it — routing and ownership travel in the same epoch-numbered
+    record — before (or right after) spawning fleet workers."""
+    from avenir_tpu.stream.rebalance import read_assignment
+    deadline = time.monotonic() + timeout_s
+    while True:
+        rec = read_assignment(control)
+        if rec is not None and rec.routing:
+            return dict(rec.routing)
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                "no routed assignment record appeared on the control "
+                "shard; a broker-fleet worker needs the coordinator to "
+                "publish group->shard routing first")
+        time.sleep(0.05)
+
+
+def _fleet_and_group_client(host: str, port: int,
+                            brokers: Optional[str],
+                            broker_reconnect: bool):
+    """(control client, per-group client resolver, fleet or None): the
+    shared bring-up for fleet-capable worker mains. Without ``brokers``
+    this is exactly the single-broker path — one client for
+    everything."""
+    if not brokers:
+        client = MiniRedisClient(host, port, reconnect=broker_reconnect,
+                                 reconnect_timeout=30.0)
+        return client, (lambda g: client), None
+    from avenir_tpu.stream.fleet import BrokerFleet
+    fleet = BrokerFleet(brokers, reconnect=True, reconnect_timeout=30.0)
+    routing = _wait_for_routing(fleet.control)
+
+    def group_client(g: str):
+        return fleet.client(routing[g])
+
+    return fleet.control, group_client, fleet
+
+
+def _close_transport(client, fleet) -> int:
+    """Worker-shutdown epilogue shared by every fleet-capable main:
+    snapshot the reconnect count, then close whichever transport this
+    worker ran on (the fleet owns its clients, control included)."""
+    if fleet is not None:
+        reconnects = fleet.reconnects()
+        fleet.close()
+        return reconnects
+    reconnects = client.reconnects
+    client.close()
+    return reconnects
+
+
 def _lifecycle_client(lifecycle_dir: Optional[str]):
     """Registry subscription for a worker process (ISSUE 7): polled on
     the heartbeat-ish cadence, swapping every owned group's learner when
@@ -478,7 +557,8 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
                 engine: bool = False,
                 event_timestamps: bool = False,
                 lifecycle_dir: Optional[str] = None,
-                broker_reconnect: bool = False) -> Dict:
+                broker_reconnect: bool = False,
+                brokers: Optional[str] = None) -> Dict:
     """One serving process: loops for the owned groups until every group's
     stop sentinel arrives. Returns per-worker stats. ``replay`` implements
     ``replay.failed.message=true``: on startup, un-acked events a dead
@@ -499,27 +579,32 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
     dropped event or restart. ``broker_reconnect`` arms the failover
     transport (ISSUE 8): broker death surfaces as capped-backoff redials
     + at-least-once resend instead of a worker crash, and the queue layer
-    reconciles its pending ledger after every reconnect."""
-    client = MiniRedisClient(host, port, reconnect=broker_reconnect,
-                             reconnect_timeout=30.0)
+    reconciles its pending ledger after every reconnect. ``brokers``
+    (ISSUE 12) opts into a key-hashed broker FLEET: each owned group's
+    queue view binds to the shard the assignment record's routing map
+    names (heartbeats and the record itself stay on the control shard,
+    shard 0), with the failover transport armed per shard."""
+    client, group_client, fleet = _fleet_and_group_client(
+        host, port, brokers, broker_reconnect)
     replayed = 0
     if replay:
         for g in owned_groups(groups, worker_id, n_workers):
             replayed += reclaim_pending(
-                client, f"pendingQueue:{g}", f"eventQueue:{g}")
+                group_client(g), f"pendingQueue:{g}", f"eventQueue:{g}")
     lc = _lifecycle_client(lifecycle_dir)
     if engine:
         return _worker_main_engine(client, worker_id, n_workers, groups,
                                    learner_type, actions, config, seed,
                                    replayed, decision_io_ms,
-                                   event_timestamps, lc)
+                                   event_timestamps, lc,
+                                   group_client=group_client, fleet=fleet)
     loops = {}
     for g in owned_groups(groups, worker_id, n_workers):
         # per-group seed component: each group's learner must explore
         # independently (a shared seed correlates every group's RNG)
         loops[g] = OnlineLearnerLoop(
             learner_type, actions, dict(config),
-            _StoppableQueues(client, g),
+            _StoppableQueues(group_client(g), g),
             seed=seed + 1000 * worker_id + list(groups).index(g),
             event_timestamps=event_timestamps)
     if lc is not None:
@@ -570,8 +655,7 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
     events_total = sum(l.stats.events for l in loops.values())
     rewards_total = sum(l.stats.rewards for l in loops.values())
     push_heartbeat(client, worker_id, events_total, rewards_total)  # final
-    reconnects = client.reconnects
-    client.close()
+    reconnects = _close_transport(client, fleet)
     return {
         "worker": worker_id,
         "events": events_total,
@@ -587,7 +671,7 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
                         actions: Sequence[str], config: Dict, seed: int,
                         replayed: int, decision_io_ms: float,
                         event_timestamps: bool = False,
-                        lc=None) -> Dict:
+                        lc=None, group_client=None, fleet=None) -> Dict:
     """Engine-mode worker body: one pipelined ``ServingEngine`` per owned
     group over the same stoppable per-group queues. Each visit drains the
     group's current backlog in one ``run()`` (pipelined micro-batches);
@@ -607,10 +691,12 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
         if decision_io_ms > 0:
             time.sleep(decision_io_ms * n_events / 1e3)
 
+    if group_client is None:
+        group_client = (lambda g: client)
     for g in owned_groups(groups, worker_id, n_workers):
         engines[g] = ServingEngine(
             learner_type, actions, dict(config),
-            _StoppableQueues(client, g),
+            _StoppableQueues(group_client(g), g),
             seed=seed + 1000 * worker_id + list(groups).index(g),
             on_batch=on_batch, event_timestamps=event_timestamps)
     if lc is not None:
@@ -653,8 +739,7 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
     events_total = sum(e.stats.events for e in engines.values())
     rewards_total = sum(e.stats.rewards for e in engines.values())
     push_heartbeat(client, worker_id, events_total, rewards_total)  # final
-    reconnects = client.reconnects
-    client.close()
+    reconnects = _close_transport(client, fleet)
     return {
         "worker": worker_id,
         "events": events_total,
@@ -678,7 +763,8 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
                         handoff_dir: Optional[str] = None,
                         cadence_s: float = 0.5,
                         event_timestamps: bool = False,
-                        broker_reconnect: bool = True) -> Dict:
+                        broker_reconnect: bool = True,
+                        brokers: Optional[str] = None) -> Dict:
     """Rebalance-aware worker (ISSUE 8): ownership comes from the
     coordinator's epoch-numbered assignment record on the broker, not
     static mod-N. The worker announces itself with a heartbeat (the JOIN
@@ -692,11 +778,43 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
     cadence, so an idle worker still proves liveness — the signal the
     coordinator's death detection (age > 3x cadence) consumes. Exits
     when the assignment record says ``stop`` and every owned group's
-    sentinel has retired it."""
+    sentinel has retired it.
+
+    ``brokers`` (ISSUE 12) arms the key-hashed fleet: the record's
+    ``routing`` map binds each owned group's queue view to its shard,
+    and because routing rides the SAME epoch-numbered record as
+    ownership, a new epoch can move a group's owner AND its shard in
+    one atomic swap — the acquire then reclaims the ledger on the NEW
+    shard, and a group this worker KEEPS whose shard moved is re-bound
+    in place (reward cursor carried over; the coordinator migrated the
+    queues, so the cursor's consumed prefix is intact)."""
     from avenir_tpu.stream.engine import ServingEngine
     from avenir_tpu.stream.rebalance import WorkerRebalancer
-    client = MiniRedisClient(host, port, reconnect=broker_reconnect,
-                             reconnect_timeout=30.0)
+    fleet = None
+    routing_box: Dict[str, Dict[str, int]] = {"routing": {}}
+    if brokers:
+        from avenir_tpu.stream.fleet import BrokerFleet
+        fleet = BrokerFleet(brokers, reconnect=True,
+                            reconnect_timeout=30.0)
+        client = fleet.control
+    else:
+        client = MiniRedisClient(host, port, reconnect=broker_reconnect,
+                                 reconnect_timeout=30.0)
+
+    def group_client(g: str):
+        if fleet is None:
+            return client
+        shard = routing_box["routing"].get(g)
+        return client if shard is None else fleet.client(shard)
+
+    def on_record(rec) -> None:
+        # routing refresh BEFORE the epoch's release/acquire deltas:
+        # acquired groups must bind (and reclaim their ledgers) on the
+        # shard THIS epoch routes them to
+        if fleet is not None and rec.brokers:
+            fleet.ensure_endpoints(rec.brokers)
+        if rec.routing:
+            routing_box["routing"] = dict(rec.routing)
     # warm jax's shared dispatch/lowering infrastructure BEFORE the join
     # heartbeat (first-ever jit in a process costs ~1s of one-time setup
     # beyond the per-program compile): a worker that announces itself
@@ -734,16 +852,57 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
             push_heartbeat(client, worker_id, progress["served"],
                            rewards_total(), "elastic")
 
+    # group -> (shard id, endpoint) its queue view is bound to: the
+    # endpoint rides along so an in-place endpoint replacement (same
+    # shard id) still re-binds — the old client object was closed and
+    # would redial a dead address
+    bindings: Dict[str, tuple] = {}
+
+    def _binding(shard: Optional[int]) -> tuple:
+        if fleet is None or shard is None:
+            return (shard, "")
+        return (shard, fleet.endpoint_strings()[shard])
+
     def make_server(group: str) -> ServingEngine:
+        bindings[group] = _binding(routing_box["routing"].get(group, 0))
         return ServingEngine(
             learner_type, actions, dict(config),
-            _StoppableQueues(client, group),
+            _StoppableQueues(group_client(group), group),
             seed=seed + 1000 * worker_id + list(groups).index(group),
             on_batch=on_batch, event_timestamps=event_timestamps)
 
+    def rebind_moved() -> None:
+        """A kept group whose routing changed re-binds its queue view to
+        the new shard at this batch boundary: the ledger is empty here
+        (everything acked), and the reward cursor carries over — the
+        coordinator's migration preserved the consumed prefix at the
+        tail, so the cursor's position still names the first unread
+        reward."""
+        if fleet is None:
+            return
+        for g, server in list(rb.servers.items()):
+            shard = routing_box["routing"].get(g)
+            want = _binding(shard)
+            if shard is None or bindings.get(g) == want:
+                continue
+            old_q = server.queues
+            new_q = _StoppableQueues(fleet.client(shard), g)
+            new_q._reward_cursor = old_q._reward_cursor
+            new_q.reward_backlog = old_q.reward_backlog
+            new_q.stopped = old_q.stopped
+            # the carried cursor is valid only once the coordinator's
+            # migration lands: hold reward drains until the old shard's
+            # queue is observed empty (review finding — a pre-splice
+            # drain would misread fresh rewards through the old cursor)
+            new_q.hold_rewards_until_migrated(old_q._r)
+            server.queues = new_q
+            bindings[g] = want
+
     rb = WorkerRebalancer(client, worker_id, make_server,
                           registry=registry,
-                          min_poll_interval_s=min(cadence_s / 2, 0.25))
+                          min_poll_interval_s=min(cadence_s / 2, 0.25),
+                          client_for_group=group_client,
+                          on_record=on_record)
     rb_box["rb"] = rb
     # live health (ISSUE 11): an elastic worker's /healthz reports its
     # current epoch + owned groups — the ownership view an operator
@@ -766,6 +925,7 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
     idle_sleep = 0.001
     while True:
         rb.sync()
+        rebind_moved()     # routing-only moves for groups this worker kept
         if rb.stop and not rb.servers:
             break
         progressed = False
@@ -802,7 +962,7 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
     events_total = sum(e.stats.events for e in servers)
     rewards = sum(e.stats.rewards for e in servers)
     push_heartbeat(client, worker_id, events_total, rewards, "elastic")
-    client.close()
+    reconnects = _close_transport(client, fleet)
     return {
         "worker": worker_id,
         "events": events_total,
@@ -816,7 +976,157 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
         "acquired": rb.acquired,
         "handoff_swap_ms": [round(x, 3) for x in rb.handoff_swap_ms],
         "handoff_wait_ms": [round(x, 3) for x in rb.handoff_wait_ms],
-        "broker_reconnects": client.reconnects,
+        "broker_reconnects": reconnects,
+    }
+
+
+def fleet_worker_main(brokers: str, worker_id: int, learner_type: str,
+                      actions: Sequence[str], config: Dict, seed: int,
+                      cadence_s: float = 0.5,
+                      event_timestamps: bool = False) -> Dict:
+    """Broker-fleet worker (ISSUE 12): ALL owned groups served through
+    ONE wave-batched ``GroupedServingEngine`` over the fan-out
+    :class:`~avenir_tpu.stream.fleet.ShardedQueues` transport — per
+    engine iteration, one pipelined sweep per owned broker shard,
+    issued concurrently. This is the 1M-decisions/min worker shape: the
+    per-group engines pay one broker conversation per group per visit,
+    this one pays one per SHARD for the whole owned set and advances
+    every context in single vmapped dispatches.
+
+    Ownership AND routing come from the epoch-numbered assignment
+    record on the control shard. A new epoch that changes either
+    rebuilds the engine over the new group set/routing (stats fold
+    forward; learner state restarts fresh — the per-group elastic
+    worker remains the path with snapshot handoff). Exits when the
+    record says ``stop`` and every owned group's sentinel retired (or
+    its queues drained — the concurrent-owner sentinel guard)."""
+    from avenir_tpu.stream.engine import GroupedServingEngine
+    from avenir_tpu.stream.fleet import BrokerFleet, ShardedQueues
+    from avenir_tpu.stream.rebalance import read_assignment
+    fleet = BrokerFleet(brokers, reconnect=True, reconnect_timeout=30.0)
+    control = fleet.control
+    progress = {"served": 0, "hb_mark": 0}
+    totals = {"events": 0, "rewards": 0, "batches": 0}
+    engine = None
+    queues = None
+    binding = None
+    epoch = 0
+    stop = False
+    owned: List[str] = []
+
+    def rewards_now() -> int:
+        return totals["rewards"] + (engine.stats.rewards if engine else 0)
+
+    def on_batch(n_events: int) -> None:
+        progress["served"] += n_events
+        if (progress["served"] - progress["hb_mark"]) >= HEARTBEAT_EVERY:
+            progress["hb_mark"] = progress["served"]
+            push_heartbeat(control, worker_id, progress["served"],
+                           rewards_now(), "fleet")
+
+    def fold_engine() -> None:
+        nonlocal engine, queues
+        if engine is None:
+            return
+        totals["events"] += engine.stats.events
+        totals["rewards"] += engine.stats.rewards
+        totals["batches"] += engine.stats.batches
+        queues.close()
+        engine = queues = None
+
+    push_heartbeat(control, worker_id, 0, 0, "fleet")   # the JOIN signal
+    last_hb = time.monotonic()
+    last_poll = 0.0
+    idle_sleep = 0.001
+    while True:
+        now_m = time.monotonic()
+        if now_m - last_poll >= min(cadence_s / 2, 0.25):
+            last_poll = now_m
+            rec = read_assignment(control)
+            if rec is not None and rec.epoch > epoch:
+                epoch = rec.epoch
+                stop = rec.stop
+                if rec.brokers:
+                    fleet.ensure_endpoints(rec.brokers)
+                owned = rec.owned_by(worker_id)
+                # the binding key covers the broker LIST too: an
+                # in-place endpoint replacement (same shard id, new
+                # address) must rebuild the transport even though
+                # routing is unchanged — the old client is closed and
+                # dials a dead endpoint
+                want = (tuple(owned),
+                        tuple(sorted((g, rec.routing.get(g, 0))
+                                     for g in owned)),
+                        tuple(rec.brokers))
+                if want != binding:
+                    fold_engine()
+                    if owned and rec.routing:
+                        # a dead predecessor's un-acked pops replay to
+                        # this owner (the WorkerRebalancer._acquire
+                        # discipline, on each group's OWN shard); a
+                        # clean rebuild's ledgers are empty and this is
+                        # a no-op round trip per group
+                        for g in owned:
+                            reclaim_pending(
+                                fleet.client(rec.routing.get(g, 0)),
+                                f"pendingQueue:{g}", f"eventQueue:{g}")
+                        queues = ShardedQueues(
+                            fleet, owned, rec.routing,
+                            stop_sentinel=STOP_SENTINEL)
+                        engine = GroupedServingEngine(
+                            learner_type, owned, actions, dict(config),
+                            queues, seed=seed + 1000 * worker_id,
+                            on_batch=on_batch,
+                            event_timestamps=event_timestamps)
+                        # warm the vmapped select + masked-reward fold
+                        # BEFORE traffic: jit caches are per-instance,
+                        # so the first live wave/fold would otherwise
+                        # pay its compile inside a timed batch — an SLO
+                        # miss that has nothing to do with serving. The
+                        # all-False masked fold is a bit-exact no-op;
+                        # the warm select just advances exploration by
+                        # one pre-traffic step.
+                        gl = engine.gl
+                        gl.resolve_actions(gl.next_all_async())
+                        n_own = len(owned)
+                        gl.reward_masked([0] * n_own, [0.0] * n_own,
+                                         [False] * n_own)
+                    binding = want
+        if engine is None:
+            if stop:
+                break
+            time.sleep(0.01)
+            continue
+        before = engine.stats.events
+        engine.run(max_events=_ELASTIC_RUN_BUDGET)
+        progressed = engine.stats.events > before
+        if stop and (queues.stopped or queues.depth() == 0):
+            # every sentinel seen, or a concurrent owner ate one during
+            # a handoff overlap and the queues are drained — retire
+            break
+        if now_m - last_hb >= cadence_s:
+            push_heartbeat(control, worker_id, progress["served"],
+                           rewards_now(), "fleet")
+            last_hb = now_m
+        if progressed:
+            idle_sleep = 0.001
+        else:
+            time.sleep(idle_sleep)
+            idle_sleep = min(idle_sleep * 2, 0.016)
+    fold_engine()
+    push_heartbeat(control, worker_id, totals["events"],
+                   totals["rewards"], "fleet")
+    reconnects = _close_transport(control, fleet)
+    return {
+        "worker": worker_id,
+        "events": totals["events"],
+        "rewards": totals["rewards"],
+        "replayed": 0,
+        "groups": sorted(owned),
+        "fleet": True,
+        "batches": totals["batches"],
+        "epochs": epoch,
+        "broker_reconnects": reconnects,
     }
 
 
@@ -892,7 +1202,9 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
                   obs_port: Optional[int] = None,
                   obs_flight: Optional[str] = None,
                   obs_slo_ms: Optional[float] = None,
-                  trace: bool = False) -> subprocess.Popen:
+                  trace: bool = False,
+                  brokers: Optional[str] = None,
+                  fleet_engine: bool = False) -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
            "--host", host, "--port", str(port),
@@ -928,6 +1240,10 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
         cmd += ["--obs-slo-ms", str(obs_slo_ms)]
     if trace:
         cmd.append("--trace")
+    if brokers:
+        cmd += ["--brokers", brokers]
+    if fleet_engine:
+        cmd.append("--fleet-engine")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True)
 
@@ -1572,9 +1888,14 @@ def run_broker_chaos(n_workers: int = 2, *, n_groups: int = 4,
         aof = os.path.join(tmp, "broker.aof")
 
         def spawn_broker() -> subprocess.Popen:
+            # always-flush AOF: this scenario's zero-loss gate assumes a
+            # confirmed reply implies a durable log record, which the
+            # default batch policy trades away (bounded window — see
+            # miniredis.AOF_FLUSH_POLICIES)
             return subprocess.Popen(
                 [sys.executable, "-m", "avenir_tpu.stream.miniredis",
-                 "--host", host, "--port", str(port), "--aof", aof],
+                 "--host", host, "--port", str(port), "--aof", aof,
+                 "--aof-flush", "always"],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
         try:
@@ -1652,6 +1973,592 @@ def run_broker_chaos(n_workers: int = 2, *, n_groups: int = 4,
             if broker_proc is not None and broker_proc.poll() is None:
                 broker_proc.terminate()
                 broker_proc.wait(timeout=10)
+
+
+# --------------------------------------------------------------------------
+# broker-fleet harnesses (ISSUE 12)
+# --------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _broker_fleet(host: str, n_brokers: int, *,
+                  aof_dir: Optional[str] = None,
+                  aof_flush: str = "batch"):
+    """Spawn N miniredis broker subprocesses and yield
+    ``(BrokerFleet, endpoint strings, {shard: Popen})``. With
+    ``aof_dir`` each shard keeps its OWN append-only log
+    (``shard<i>.aof``) so a killed shard restarts on the same port over
+    the same file — the per-shard durability story."""
+    from avenir_tpu.stream.fleet import BrokerFleet
+    procs: Dict[int, subprocess.Popen] = {}
+    endpoints: List[str] = []
+
+    def spawn(shard: int, port: int) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "avenir_tpu.stream.miniredis",
+               "--host", host, "--port", str(port)]
+        if aof_dir:
+            cmd += ["--aof", os.path.join(aof_dir, f"shard{shard}.aof"),
+                    "--aof-flush", aof_flush]
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+
+    def broker_port(proc: subprocess.Popen) -> int:
+        # the broker binds port 0 itself and announces the result
+        # ("miniredis listening host:port") — parsing it instead of
+        # pre-reserving a port closes the reserve/rebind race where a
+        # concurrent test grabs the port between our probe bind and the
+        # subprocess's real bind (observed under full-suite load)
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"broker subprocess exited before announcing its port "
+                f"(rc={proc.poll()})")
+        return int(line.strip().rpartition(":")[2])
+
+    fleet = None
+    try:
+        for s in range(n_brokers):
+            procs[s] = spawn(s, 0)
+        for s in range(n_brokers):
+            endpoints.append(f"{host}:{broker_port(procs[s])}")
+        fleet = BrokerFleet(endpoints, reconnect=True,
+                            reconnect_timeout=30.0,
+                            connect_timeout=30.0)
+        fleet.flushall()           # dials every shard: fleet is up
+        yield fleet, endpoints, procs, spawn
+    finally:
+        if fleet is not None:
+            fleet.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            if p.poll() is None:
+                p.wait(timeout=10)
+
+
+def _write_static_fleet_record(fleet, groups: Sequence[str],
+                               n_workers: int, endpoints: Sequence[str],
+                               routing: Dict[str, int], epoch: int = 1,
+                               stop: bool = False):
+    """Publish ownership (mod-N) + routing as one epoch-numbered record
+    on the control shard — the static-fleet harness's stand-in for a
+    live Coordinator (routing still travels IN the record, never out of
+    band)."""
+    from avenir_tpu.stream.rebalance import (AssignmentRecord,
+                                             write_assignment)
+    rec = AssignmentRecord(
+        epoch=epoch,
+        groups={g: i % n_workers for i, g in enumerate(groups)},
+        members=list(range(n_workers)),
+        brokers=list(endpoints), routing=dict(routing), stop=stop)
+    write_assignment(fleet.control, rec)
+    return rec
+
+
+def _fleet_push_events(fleet, routing: Dict[str, int],
+                       groups: Sequence[str], start: int, n: int,
+                       chunk: int = 128, stamp: bool = False) -> int:
+    """Bulk producer: round-robin events over groups, one pipelined
+    multi-value LPUSH sweep per shard per chunk — the producer-side
+    twin of the workers' fan-out transport (a per-event lpush would
+    make the DRIVER the bottleneck the fleet exists to remove)."""
+    sent = 0
+    while sent < n:
+        batch = min(chunk, n - sent)
+        per_shard: Dict[int, Dict[str, List[str]]] = {}
+        now = time.time()
+        for i in range(batch):
+            seq = start + sent + i
+            g = groups[seq % len(groups)]
+            payload = f"{g}:{seq}|{now}" if stamp else f"{g}:{seq}"
+            per_shard.setdefault(routing[g], {}).setdefault(
+                g, []).append(payload)
+        for shard, by_group in per_shard.items():
+            p = fleet.client(shard).pipeline()
+            for g, payloads in by_group.items():
+                p.lpush(f"eventQueue:{g}", *payloads)
+            p.execute()
+        sent += batch
+    return sent
+
+
+def _fleet_consume(fleet, routing: Dict[str, int], ctr, rng,
+                   answered: set, n_expected: int, deadline: float,
+                   rewards: bool = True,
+                   on_kill_mark=None) -> int:
+    """Drain every shard's ``actionQueue`` until ``n_expected`` unique
+    answers landed (dedup by event id — at-least-once under failover),
+    issuing planted-CTR rewards in per-shard pipelined batches.
+    Returns the duplicate count. ``on_kill_mark(n_unique)`` fires once
+    per loop so chaos scenarios can trigger mid-drain."""
+    duplicates = 0
+    while len(answered) < n_expected:
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"fleet run stalled: {len(answered)}/{n_expected} "
+                f"answered, {duplicates} duplicates")
+        got = 0
+        reward_plan: Dict[int, List[Tuple[str, str]]] = {}
+        for s in range(fleet.n_shards):
+            raws = fleet.client(s).rpop("actionQueue", 256)
+            for raw in raws or []:
+                event_id, _, action = raw.decode().partition(",")
+                action = action.split(",")[0]
+                got += 1
+                if event_id in answered:
+                    duplicates += 1
+                    continue
+                answered.add(event_id)
+                if not rewards:
+                    continue
+                g = event_id.partition(":")[0]
+                reward = (1.0 if rng.random() < ctr[g][action] else 0.0)
+                reward_plan.setdefault(routing[g], []).append(
+                    (g, f"{action},{reward}"))
+        for shard, items in reward_plan.items():
+            p = fleet.client(shard).pipeline()
+            by_group: Dict[str, List[str]] = {}
+            for g, payload in items:
+                by_group.setdefault(g, []).append(payload)
+            for g, payloads in by_group.items():
+                p.lpush(f"rewardQueue:{g}", *payloads)
+            p.execute()
+        if on_kill_mark is not None:
+            on_kill_mark(len(answered))
+        if not got:
+            time.sleep(0.0005)
+    return duplicates
+
+
+def _fleet_pending_left(fleet, routing: Dict[str, int],
+                        groups: Sequence[str]) -> int:
+    return sum(int(fleet.client(routing[g]).llen(f"pendingQueue:{g}"))
+               for g in groups)
+
+
+@dataclass
+class FleetRunResult:
+    n_workers: int
+    n_brokers: int
+    n_events: int
+    unique_answered: int
+    duplicates: int
+    decisions_per_sec: float
+    pending_left: int
+    per_broker_commands: Dict[str, int] = field(default_factory=dict)
+    admitted_p99_ms: float = 0.0
+    admitted_p50_ms: float = 0.0
+    decision_latency_count: int = 0
+    worker_stats: List[Dict] = field(default_factory=list)
+    fleet_report: Optional[Dict] = None
+    worker_reconnects: int = 0
+
+
+def run_fleet(n_workers: int = 2, n_brokers: int = 2, *,
+              n_groups: int = 8, n_actions: int = 4,
+              n_events: int = 2000, learner_type: str = "softMax",
+              seed: int = 7, host: str = "localhost",
+              grouped: bool = True, metrics_out: Optional[str] = None,
+              telemetry: Optional[bool] = None,
+              aof: bool = False, aof_flush: str = "batch",
+              event_timestamps: bool = False,
+              timeout_s: float = 300.0) -> FleetRunResult:
+    """The sharded-fleet throughput demo (ISSUE 12 capstone shape): N
+    brokers, key-hashed routing published in an epoch-numbered record,
+    W workers serving through the fan-out transport (``grouped=True``:
+    one wave-batched GroupedServingEngine per worker over
+    ``ShardedQueues``; else one per-group ServingEngine on routed
+    clients), a pipelined bulk producer/consumer driver, and the
+    exactly-once + retired-ledger gates of every sibling harness.
+    ``telemetry`` (or ``metrics_out``) arms worker reports so
+    admitted-event decision-latency p50/p99 — the serving-SLO signal —
+    comes back in the result; the headline 1M/min recipe is this
+    harness scaled up in the driver environment
+    (scripts/broker_fleet_smoke.py --headline)."""
+    import numpy as np
+    from avenir_tpu.stream.fleet import consistent_route
+    import tempfile
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    ctr = {}
+    for g in groups:
+        best = int(rng.integers(n_actions))
+        ctr[g] = {a: (0.8 if i == best else 0.15)
+                  for i, a in enumerate(actions)}
+    # batch.size=1: the fleet demo is about BROKER throughput — the
+    # learner step must stay light so the queue tier is the bottleneck
+    # under test
+    config = {"current.decision.round": 1, "batch.size": 1}
+    want_tel = bool(metrics_out) if telemetry is None else telemetry
+    procs: List[subprocess.Popen] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        with _broker_fleet(host, n_brokers,
+                           aof_dir=tmp if aof else None,
+                           aof_flush=aof_flush) as (fleet, endpoints,
+                                                    brokers_p, _spawn):
+            routing = consistent_route(groups, range(n_brokers))
+            _write_static_fleet_record(fleet, groups, n_workers,
+                                       endpoints, routing)
+            try:
+                brokers_spec = ",".join(endpoints)
+                procs = [
+                    _spawn_worker(host, 0, w, n_workers, groups,
+                                  learner_type, actions, config, seed,
+                                  engine=not grouped,
+                                  telemetry=want_tel,
+                                  event_timestamps=event_timestamps,
+                                  brokers=brokers_spec,
+                                  fleet_engine=grouped)
+                    for w in range(n_workers)]
+                deadline = time.monotonic() + timeout_s
+                answered: set = set()
+                # warmup: first dispatches pay jit compile — outside the
+                # timed window, and never counted in the throughput
+                warm = 4 * n_groups
+                _fleet_push_events(fleet, routing, groups, 0, warm,
+                                   stamp=event_timestamps)
+                _fleet_consume(fleet, routing, ctr, rng, answered, warm,
+                               deadline)
+                t0 = time.perf_counter()
+                _fleet_push_events(fleet, routing, groups, warm,
+                                   n_events, stamp=event_timestamps)
+                duplicates = _fleet_consume(fleet, routing, ctr, rng,
+                                            answered, warm + n_events,
+                                            deadline)
+                throughput_s = time.perf_counter() - t0
+                for g in groups:
+                    fleet.client(routing[g]).lpush(f"eventQueue:{g}",
+                                                   STOP_SENTINEL)
+                _write_static_fleet_record(fleet, groups, n_workers,
+                                           endpoints, routing, epoch=2,
+                                           stop=True)
+                worker_stats = []
+                for p in procs:
+                    out, err = _collect_worker(p, timeout=120)
+                    if p.returncode != 0:
+                        raise RuntimeError(
+                            f"fleet worker failed: {err[-1500:]}")
+                    worker_stats.append(json.loads(out.splitlines()[-1]))
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+            total = sum(w["events"] for w in worker_stats)
+            expected = warm + n_events
+            if total != expected or len(answered) != expected:
+                raise RuntimeError(
+                    f"fleet workers answered {total} "
+                    f"(driver saw {len(answered)}), expected {expected}")
+            pending_left = _fleet_pending_left(fleet, routing, groups)
+            if pending_left:
+                raise RuntimeError(f"{pending_left} un-acked fleet "
+                                   f"ledger entries left behind")
+            per_broker = {}
+            for s in range(n_brokers):
+                try:
+                    per_broker[f"shard{s}"] = int(fleet.info(s).get(
+                        "total_commands_processed", 0))
+                except Exception:
+                    per_broker[f"shard{s}"] = -1
+            fleet_report = None
+            p50 = p99 = 0.0
+            dl_count = 0
+            worker_reports = read_worker_reports(fleet.control)
+            if worker_reports:
+                from avenir_tpu.obs import exporters as obs_exporters
+                fleet_report = obs_exporters.merge_reports(
+                    [worker_reports[w] for w in sorted(worker_reports)])
+                if metrics_out:
+                    obs_exporters.write_report(fleet_report, metrics_out)
+                dl = fleet_report["spans"].get(
+                    "engine.decision_latency", {})
+                p50 = float(dl.get("p50_ms", 0.0))
+                p99 = float(dl.get("p99_ms", 0.0))
+                dl_count = int(dl.get("count", 0))
+            return FleetRunResult(
+                n_workers=n_workers, n_brokers=n_brokers,
+                n_events=n_events,
+                unique_answered=len(answered), duplicates=duplicates,
+                decisions_per_sec=n_events / throughput_s,
+                pending_left=pending_left,
+                per_broker_commands=per_broker,
+                admitted_p99_ms=p99, admitted_p50_ms=p50,
+                decision_latency_count=dl_count,
+                worker_stats=worker_stats, fleet_report=fleet_report,
+                worker_reconnects=sum(w.get("broker_reconnects", 0)
+                                      for w in worker_stats))
+
+
+@dataclass
+class FleetChaosResult:
+    n_events: int
+    unique_answered: int
+    duplicates: int
+    shard_killed: int
+    killed_at: int
+    pending_left: int
+    worker_reconnects: int = 0
+    driver_reconnects: int = 0
+    worker_stats: List[Dict] = field(default_factory=list)
+
+
+def run_fleet_chaos(n_workers: int = 2, n_brokers: int = 2, *,
+                    n_groups: int = 4, n_actions: int = 4,
+                    n_events: int = 240, kill_at: int = 60,
+                    learner_type: str = "softMax", seed: int = 13,
+                    host: str = "localhost", grouped: bool = True,
+                    timeout_s: float = 300.0) -> FleetChaosResult:
+    """Shard-failover scenario (ISSUE 12): one NON-control broker shard
+    is SIGKILLed mid-run — fan-out sweeps in flight — and restarted on
+    the same port over its own per-shard AOF (always-flush: the
+    zero-loss gate's contract). The shard's clients redial + resend and
+    each affected group's ledger reconciles (``recover_in_flight``),
+    exactly the PR 8 machinery, now scoped to one shard while the rest
+    of the fleet keeps serving. After driver dedup every event is
+    answered exactly once: per-shard loss converts to bounded
+    duplicates, never loss."""
+    import signal as _signal
+    import tempfile
+    import numpy as np
+    from avenir_tpu.stream.fleet import consistent_route
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    ctr = {g: {a: (0.8 if i == int(rng.integers(n_actions)) else 0.15)
+               for i, a in enumerate(actions)} for g in groups}
+    config = {"current.decision.round": 1, "batch.size": 1}
+    if n_brokers < 2:
+        raise ValueError(
+            "run_fleet_chaos needs >= 2 brokers: the victim shard must "
+            "not be the control shard (shard 0 carries the assignment "
+            "record and heartbeats)")
+    victim = n_brokers - 1             # never the control shard
+    procs: List[subprocess.Popen] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        with _broker_fleet(host, n_brokers, aof_dir=tmp,
+                           aof_flush="always") as (fleet, endpoints,
+                                                   brokers_p, spawn):
+            routing = consistent_route(groups, range(n_brokers))
+            if victim not in set(routing.values()):
+                # the hash may have left the victim empty at tiny group
+                # counts; steer one group onto it so the kill tests a
+                # shard that actually carries traffic
+                routing[groups[0]] = victim
+            _write_static_fleet_record(fleet, groups, n_workers,
+                                       endpoints, routing)
+            victim_port = int(endpoints[victim].rpartition(":")[2])
+            state = {"killed_at": -1}
+
+            def maybe_kill(n_unique: int) -> None:
+                if state["killed_at"] < 0 and n_unique >= kill_at:
+                    state["killed_at"] = n_unique
+                    brokers_p[victim].send_signal(_signal.SIGKILL)
+                    brokers_p[victim].wait(timeout=30)
+                    brokers_p[victim] = spawn(victim, victim_port)
+
+            try:
+                brokers_spec = ",".join(endpoints)
+                procs = [
+                    _spawn_worker(host, 0, w, n_workers, groups,
+                                  learner_type, actions, config, seed,
+                                  engine=not grouped,
+                                  brokers=brokers_spec,
+                                  fleet_engine=grouped)
+                    for w in range(n_workers)]
+                deadline = time.monotonic() + timeout_s
+                answered: set = set()
+                _fleet_push_events(fleet, routing, groups, 0, n_events)
+                duplicates = _fleet_consume(
+                    fleet, routing, ctr, rng, answered, n_events,
+                    deadline, on_kill_mark=maybe_kill)
+                for g in groups:
+                    fleet.client(routing[g]).lpush(f"eventQueue:{g}",
+                                                   STOP_SENTINEL)
+                _write_static_fleet_record(fleet, groups, n_workers,
+                                           endpoints, routing, epoch=2,
+                                           stop=True)
+                worker_stats = []
+                for p in procs:
+                    out, err = _collect_worker(p, timeout=120)
+                    if p.returncode != 0:
+                        raise RuntimeError(
+                            f"fleet worker failed: {err[-1500:]}")
+                    worker_stats.append(json.loads(out.splitlines()[-1]))
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+            return FleetChaosResult(
+                n_events=n_events, unique_answered=len(answered),
+                duplicates=duplicates, shard_killed=victim,
+                killed_at=state["killed_at"],
+                pending_left=_fleet_pending_left(fleet, routing, groups),
+                worker_reconnects=sum(w.get("broker_reconnects", 0)
+                                      for w in worker_stats),
+                driver_reconnects=fleet.reconnects(),
+                worker_stats=worker_stats)
+
+
+@dataclass
+class FleetRebalanceResult:
+    n_events: int
+    unique_answered: int
+    duplicates: int
+    epochs: int
+    moved_groups: List[str] = field(default_factory=list)
+    released: int = 0
+    acquired: int = 0
+    pending_left: int = 0
+    worker_stats: List[Dict] = field(default_factory=list)
+
+
+def run_fleet_rebalance(*, n_groups: int = 6, n_actions: int = 4,
+                        n_events: int = 320,
+                        learner_type: str = "softMax", seed: int = 17,
+                        host: str = "localhost", cadence_s: float = 0.4,
+                        dead_after_factor: float = 100.0,
+                        timeout_s: float = 300.0
+                        ) -> FleetRebalanceResult:
+    """The ownership-AND-routing epoch (ISSUE 12 acceptance): two
+    elastic workers bootstrap on a ONE-shard fleet; mid-stream the
+    coordinator, in a single epoch, (a) removes worker 0 — its groups
+    hand off to worker 1 through the registry — and (b) grows the
+    fleet to TWO shards via ``set_brokers`` — consistent hashing
+    re-homes ~half the groups, the coordinator migrates their queues,
+    and the record carries the new brokers+routing beside the new
+    ownership. Traffic is held through the flip (the run_rebalance
+    hold discipline) and resumes on the NEW routing once the handoff
+    publishes commit. Gates: exactly-once after dedup, >=1 group
+    actually re-routed, ledgers clean on the final shards."""
+    import tempfile
+    import numpy as np
+    from avenir_tpu.stream.fleet import BrokerFleet
+    from avenir_tpu.stream.rebalance import Coordinator, HANDOFF_KIND
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    ctr = {g: {a: (0.8 if i == int(rng.integers(n_actions)) else 0.15)
+               for i, a in enumerate(actions)} for g in groups}
+    config = {"current.decision.round": 1, "batch.size": 4}
+    procs: Dict[int, subprocess.Popen] = {}
+    try:
+        with tempfile.TemporaryDirectory() as handoff_dir, \
+                _broker_fleet(host, 2) as (fleet2, endpoints, brokers_p,
+                                           _spawn):
+            from avenir_tpu.lifecycle.registry import SnapshotRegistry
+            registry = SnapshotRegistry(handoff_dir)
+            # phase 1: the fleet is ONE shard (the control); shard 1's
+            # broker is up but unrouted until the mid-run grow
+            fleet1 = BrokerFleet(endpoints[:1], reconnect=True,
+                                 reconnect_timeout=30.0)
+            coord = Coordinator(fleet1.control, groups,
+                                cadence_s=cadence_s,
+                                dead_after_factor=dead_after_factor,
+                                fleet=fleet1)
+
+            def spawn_worker(worker_id: int) -> subprocess.Popen:
+                return _spawn_worker(
+                    host, 0, worker_id, 0, groups, learner_type,
+                    actions, config, seed, elastic=True,
+                    handoff_dir=handoff_dir, cadence_s=cadence_s,
+                    brokers=endpoints[0])
+
+            procs[0] = spawn_worker(0)
+            procs[1] = spawn_worker(1)
+            deadline = time.monotonic() + timeout_s
+            while len(coord.alive_workers()) < 2:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet workers never joined")
+                coord.observe()
+                time.sleep(0.02)
+            assert coord.record.epoch >= 1
+            routing_before = dict(coord.routing)
+
+            answered: set = set()
+            duplicates = 0
+            sent = 0
+            flip_mark = n_events // 2
+            flipped = False
+            flip_settled = False
+            moved: List[str] = []
+            while len(answered) < n_events:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"fleet rebalance stalled: {len(answered)}/"
+                        f"{n_events} (epoch {coord.record.epoch})")
+                if flipped and not flip_settled:
+                    rec = coord.record
+                    # settle = worker 1 owns everything AND worker 0's
+                    # release-publishes for the flip epoch committed
+                    flip_settled = all(
+                        rec.groups.get(g) == 1 for g in groups) and all(
+                        (snap := registry.latest_where(
+                            kind=HANDOFF_KIND, group=g)) is not None
+                        and (snap.manifest.get("extra") or {}
+                             ).get("epoch") == rec.epoch
+                        for g in rec.owned_by(1)
+                        if g in rec.handoff)
+                inject = (not flipped) or flip_settled
+                if sent < n_events and inject:
+                    g = groups[sent % len(groups)]
+                    coord.fleet.client(coord.routing[g]).lpush(
+                        f"eventQueue:{g}", f"{g}:{sent}")
+                    sent += 1
+                for s in range(coord.fleet.n_shards):
+                    raw = coord.fleet.client(s).rpop("actionQueue")
+                    if raw is None:
+                        continue
+                    event_id, _, action = raw.decode().partition(",")
+                    action = action.split(",")[0]
+                    g = event_id.partition(":")[0]
+                    if event_id in answered:
+                        duplicates += 1
+                    else:
+                        answered.add(event_id)
+                        reward = (1.0 if rng.random() < ctr[g][action]
+                                  else 0.0)
+                        coord.fleet.client(coord.routing[g]).lpush(
+                            f"rewardQueue:{g}", f"{action},{reward}")
+                coord.observe()
+                if not flipped and len(answered) >= flip_mark:
+                    # ONE epoch, two changes: worker 0 leaves AND the
+                    # fleet grows a shard — ownership and routing move
+                    # together in the same record swap
+                    flipped = True
+                    coord.removed.add(0)
+                    coord.set_brokers(fleet2)
+                    moved = sorted(g for g in groups
+                                   if coord.routing[g]
+                                   != routing_before.get(g))
+                if not inject:
+                    time.sleep(0.002)
+
+            for g in groups:
+                coord.fleet.client(coord.routing[g]).lpush(
+                    f"eventQueue:{g}", STOP_SENTINEL)
+            coord.stop_fleet()
+            worker_stats = []
+            for worker_id in sorted(procs):
+                out, err = _collect_worker(procs[worker_id], timeout=120)
+                if procs[worker_id].returncode != 0:
+                    raise RuntimeError(
+                        f"worker {worker_id} failed: {err[-1500:]}")
+                worker_stats.append(json.loads(out.splitlines()[-1]))
+            pending_left = _fleet_pending_left(coord.fleet,
+                                               coord.routing, groups)
+            fleet1.close()
+            return FleetRebalanceResult(
+                n_events=n_events, unique_answered=len(answered),
+                duplicates=duplicates, epochs=coord.record.epoch,
+                moved_groups=moved,
+                released=sum(w.get("released", 0) for w in worker_stats),
+                acquired=sum(w.get("acquired", 0) for w in worker_stats),
+                pending_left=pending_left, worker_stats=worker_stats)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -1743,6 +2650,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--trace-sample", type=int, default=64,
                     help="driver mode: trace every Nth event "
                          "(default 64)")
+    ap.add_argument("--brokers", default=None, metavar="HOST:PORT,...",
+                    help="worker mode: key-hashed broker FLEET "
+                         "endpoints (ISSUE 12); shard 0 is the control "
+                         "shard. Each group's queues bind to the shard "
+                         "the assignment record's routing map names — "
+                         "the record carries routing and ownership "
+                         "together")
+    ap.add_argument("--fleet-engine", action="store_true",
+                    help="worker mode (with --brokers): serve ALL "
+                         "owned groups through one wave-batched "
+                         "GroupedServingEngine over the fan-out "
+                         "ShardedQueues transport — one pipelined "
+                         "sweep per owned shard per batch, "
+                         "concurrently (the 1M/min worker shape)")
     args = ap.parse_args(argv)
 
     if args.worker:
@@ -1785,7 +2706,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.trace:
             from avenir_tpu.obs import tracing as obs_tracing
             obs_tracing.context().enable()
-        if args.elastic:
+        if args.fleet_engine:
+            if not args.brokers:
+                ap.error("--fleet-engine needs --brokers")
+            stats = fleet_worker_main(
+                args.brokers, args.worker_id,
+                args.learner_type, args.actions.split(","),
+                json.loads(args.config), args.seed,
+                cadence_s=args.cadence_s,
+                event_timestamps=args.event_timestamps)
+        elif args.elastic:
             stats = elastic_worker_main(
                 args.host, args.port, args.worker_id,
                 args.groups.split(","),
@@ -1794,7 +2724,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 handoff_dir=args.handoff_dir,
                 cadence_s=args.cadence_s,
                 event_timestamps=args.event_timestamps,
-                broker_reconnect=True)
+                broker_reconnect=True,
+                brokers=args.brokers)
         elif args.grouping == "shuffle":
             stats = shuffle_worker_main(
                 args.host, args.port, args.worker_id,
@@ -1814,7 +2745,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 engine=args.engine,
                 event_timestamps=args.event_timestamps,
                 lifecycle_dir=args.lifecycle_dir,
-                broker_reconnect=args.broker_reconnect)
+                broker_reconnect=args.broker_reconnect,
+                brokers=args.brokers)
         if live_obs is not None:
             stats["obs_port"] = live_obs.port
             live_obs.stop()

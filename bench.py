@@ -360,6 +360,60 @@ def _online_serving_bench() -> dict:
     return out
 
 
+def _broker_fleet_bench() -> dict:
+    """ISSUE 12: the sharded-broker-fleet bench — aggregate decisions/sec
+    at 1 vs 2 broker shards plus the fleet serve/SLO numbers. Runs
+    scripts/broker_fleet_smoke.py in a CPU-pinned subprocess (the
+    serving-bench reasoning; brokers and workers are subprocesses of the
+    smoke itself). ``--skip-gates`` on a loaded bench host records the
+    measured ratio/latency instead of failing; the gates run in the
+    tier-1 smoke hook. The 1M decisions/min HEADLINE run is the same
+    script's ``--headline`` mode, sized for the driver environment
+    (BENCH_FLEET_HEADLINE=1 arms it here)."""
+    import subprocess
+    import sys as _sys
+    script = os.path.join(os.path.dirname(__file__), "scripts",
+                          "broker_fleet_smoke.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)     # no virtual-device carryover
+    events = os.environ.get("BENCH_FLEET_EVENTS", "400")
+    args = [_sys.executable, script, "--events", events, "--skip-gates"]
+    if os.environ.get("BENCH_FLEET_HEADLINE", "0").lower() in (
+            "1", "true", "yes", "on"):
+        args = [_sys.executable, script, "--headline",
+                "--workers", os.environ.get("BENCH_FLEET_WORKERS", "8"),
+                "--brokers", os.environ.get("BENCH_FLEET_BROKERS", "4"),
+                "--headline-events",
+                os.environ.get("BENCH_FLEET_HEADLINE_EVENTS", "200000")]
+    proc = subprocess.run(args, env=env, capture_output=True, text=True,
+                          timeout=2400)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"broker_fleet_smoke rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}")
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    if "headline" in report:
+        return report["headline"]
+    serve, scaling = report["serve"], report["scaling"]
+    return {
+        "decisions_per_sec_2x2": serve["decisions_per_sec"],
+        "decisions_per_min_2x2": round(
+            serve["decisions_per_sec"] * 60.0, 1),
+        "admitted_p50_ms": serve["admitted_p50_ms"],
+        "admitted_p99_ms": serve["admitted_p99_ms"],
+        "per_broker_commands": serve["per_broker_commands"],
+        "scaling_ratio_2_vs_1_brokers": scaling["scaling_ratio"],
+        "decisions_per_sec_1_broker":
+            scaling["decisions_per_sec_1_broker"],
+        "decisions_per_sec_2_brokers":
+            scaling["decisions_per_sec_2_brokers"],
+        "cores": scaling["cores"],
+        "shard_kill_zero_loss":
+            report["shard_kill"]["zero_lost_after_dedup"],
+        "shed_accounting_exact": report["overload"]["accounting_exact"],
+    }
+
+
 def _lifecycle_bench() -> dict:
     """ISSUE 7: the lifecycle bench — serve-while-retrain throughput and
     hot-swap latency. Runs scripts/lifecycle_smoke.py in a CPU-pinned
@@ -687,6 +741,32 @@ def main() -> None:
         except Exception as exc:
             print(f"lifecycle bench skipped: {exc!r}", file=sys.stderr)
             out["lifecycle"] = {"error": repr(exc)}
+    # ISSUE-12 BROKER FLEET: aggregate decisions/sec across 1 vs 2
+    # broker shards + fleet serve/SLO numbers (subprocess; fallback-safe
+    # like its siblings). BENCH_FLEET=0 disables; BENCH_FLEET_HEADLINE=1
+    # runs the 1M decisions/min capstone instead (driver env).
+    if os.environ.get("BENCH_FLEET", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        try:
+            out["broker_fleet"] = _broker_fleet_bench()
+            bf = out["broker_fleet"]
+            if "decisions_per_min" in bf:
+                print(f"broker fleet HEADLINE: "
+                      f"{bf['decisions_per_min']:,.0f} decisions/min "
+                      f"over {bf['n_brokers']} brokers x "
+                      f"{bf['n_workers']} workers "
+                      f"(p99 {bf['admitted_p99_ms']:.1f}ms)",
+                      file=sys.stderr)
+            else:
+                print(f"broker fleet: "
+                      f"{bf['decisions_per_sec_2x2']:.0f} decisions/s "
+                      f"(2 workers x 2 brokers, p99 "
+                      f"{bf['admitted_p99_ms']:.1f}ms), 2-vs-1-broker "
+                      f"ratio {bf['scaling_ratio_2_vs_1_brokers']:.2f} "
+                      f"at {bf['cores']} cores", file=sys.stderr)
+        except Exception as exc:
+            print(f"broker fleet bench skipped: {exc!r}", file=sys.stderr)
+            out["broker_fleet"] = {"error": repr(exc)}
     if legacy:
         base_elapsed = M_TEST * ITERS / legacy
         adj = M_TEST * ITERS / max(base_elapsed - 0.0993, 1e-9)

@@ -190,3 +190,82 @@ def test_oversample_widens_candidates():
                        for t, q in zip(truth, i4)])
     assert recall4 >= MIN_RECALL
     assert recall4 >= recall1
+
+
+class TestShardedQuantized:
+    """knn.sharded × knn.quantized lifted (ISSUE 12 satellite): each
+    shard runs the low-precision candidate scan + EXACT f32 re-rank over
+    its own train rows, then the per-shard candidates merge with the
+    all-gather + exact two-key top-k. The merge key is the exact metric,
+    so the single-device parity bars (recall >= 0.985 vs f64 truth, vote
+    agreement >= 0.99) must hold at EVERY shard count — and at 1 shard
+    the output must equal the single-device quantized path exactly."""
+
+    def _mesh(self, n_shards):
+        import jax
+        from avenir_tpu.parallel import collective
+        return collective.data_mesh((n_shards,),
+                                    devices=jax.devices()[:n_shards])
+
+    def _run(self, x, y, k, mesh, qdtype="int8", oversample=4):
+        from avenir_tpu.parallel import collective
+        (y_n, _), _, n_real = collective.shard_train_rows((y, None), mesh)
+        return map(np.asarray, collective.sharded_quantized_topk(
+            jnp.asarray(x), y_n, mesh=mesh, k=k, n_real=n_real,
+            qdtype=qdtype, oversample=oversample, block_size=64))
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 4])
+    @pytest.mark.parametrize("case", sorted(ADVERSARIAL))
+    def test_parity_at_shard_counts(self, n_shards, case):
+        rng = np.random.default_rng(17)
+        x, y = ADVERSARIAL[case](rng, 16, 192)
+        k = 5
+        _, truth = _f64_truth(x, y, k)
+        dq, iq = self._run(x, y, k, self._mesh(n_shards))
+        assert np.all((iq >= 0) & (iq < y.shape[0]))
+        assert np.all(np.diff(dq.astype(np.int64), axis=1) >= 0)
+        recall = np.mean([len(set(t.tolist()) & set(q.tolist())) / k
+                          for t, q in zip(truth, iq)])
+        assert recall >= MIN_RECALL, f"{case}@{n_shards}: {recall:.4f}"
+        labels = (y[:, 0] > np.median(y[:, 0])).astype(np.int64)
+        vote = lambda idx: (labels[idx].mean(axis=1) > 0.5).astype(
+            np.int64)
+        agree = float((vote(truth) == vote(iq)).mean())
+        assert agree >= MIN_VOTE_AGREEMENT, f"{case}@{n_shards}: {agree}"
+
+    @pytest.mark.parametrize("n", [7, 13, 64])
+    def test_one_shard_equals_single_device(self, n):
+        """At 1 shard the collective path is the single-device quantized
+        pass modulo the shard_map wrapper: identical ids and scaled
+        distances (same per-shard scale, same exact re-rank, same
+        two-key ordering)."""
+        rng = np.random.default_rng(29)
+        x = rng.random((9, 6), dtype=np.float32)
+        y = rng.random((n, 6), dtype=np.float32)
+        k = min(5, n)
+        dq, iq = self._run(x, y, k, self._mesh(1), oversample=4)
+        d1, i1 = map(np.asarray, quantized_topk(
+            jnp.asarray(x), jnp.asarray(y), k=k, oversample=4,
+            block_size=64))
+        np.testing.assert_array_equal(iq, i1)
+        np.testing.assert_array_equal(dq, d1)
+
+    def test_padding_never_wins(self):
+        """Prime train counts force edge-padding on the tail shard; the
+        padded copies (global id >= n_real) must never appear among the
+        returned ids even though they duplicate real rows."""
+        rng = np.random.default_rng(31)
+        x = rng.random((8, 5), dtype=np.float32)
+        y = rng.random((13, 5), dtype=np.float32)
+        _, iq = self._run(x, y, 5, self._mesh(4))
+        assert np.all(iq < 13)
+
+    def test_knn_config_dispatch_lifted(self):
+        """The KnnConfig-level refusal is gone: sharded+quantized routes
+        through the collective quantized program (and still refuses
+        non-euclidean)."""
+        from avenir_tpu.models.knn import KnnConfig, neighbors
+        cfg = KnnConfig(sharded=True, quantized=True,
+                        algorithm="manhattan")
+        with pytest.raises(ValueError, match="euclidean"):
+            neighbors(None, None, cfg)
