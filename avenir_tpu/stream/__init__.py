@@ -15,19 +15,25 @@
                   consistent-hash group->shard router, the BrokerFleet
                   client pool, and the ShardedQueues fan-out transport
                   (one pipelined sweep per owned shard, concurrently)
+- ``faultnet``  — deterministic network fault injection (ISSUE 13):
+                  seeded drop/drop-reply/delay/blackhole schedules and
+                  scripted partitions over the MiniRedis client socket
+                  layer — chaos beyond SIGKILL, bit-reproducible
 """
 
 from avenir_tpu.stream.engine import (
     EngineStats, GroupedServingEngine, ServingEngine,
 )
+from avenir_tpu.stream.faultnet import FaultNet
 from avenir_tpu.stream.fleet import (
     BrokerFleet, ShardedQueues, consistent_route,
 )
 from avenir_tpu.stream.loop import (
     GroupedLearner, InProcQueues, LoopStats, OnlineLearnerLoop, RedisQueues,
 )
+from avenir_tpu.stream.rebalance import CoordinatorLease
 
-__all__ = ["BrokerFleet", "EngineStats", "GroupedLearner",
-           "GroupedServingEngine", "InProcQueues", "LoopStats",
-           "OnlineLearnerLoop", "RedisQueues", "ServingEngine",
-           "ShardedQueues", "consistent_route"]
+__all__ = ["BrokerFleet", "CoordinatorLease", "EngineStats", "FaultNet",
+           "GroupedLearner", "GroupedServingEngine", "InProcQueues",
+           "LoopStats", "OnlineLearnerLoop", "RedisQueues",
+           "ServingEngine", "ShardedQueues", "consistent_route"]

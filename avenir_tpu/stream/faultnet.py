@@ -1,0 +1,253 @@
+"""Deterministic network fault injection for the MiniRedis client layer.
+
+Chaos so far was only SIGKILL (ISSUE 8 broker kill, ISSUE 12 shard
+kill): processes die cleanly from the network's point of view. Real
+fleets also see the OTHER failure family — connections dropped
+mid-command, replies that never arrive (the command executed!), one
+direction of a flow blackholed, a (client, shard) pair partitioned for
+a window while everything else flows. This module injects exactly those
+faults at the one place every broker byte passes: the
+:class:`~avenir_tpu.stream.miniredis.MiniRedisClient` socket layer.
+
+Two requirements shape the design:
+
+- **Deterministic**: a seeded schedule must reproduce bit-identically
+  across runs AND processes, so a failing soak is replayable. Decisions
+  are therefore a pure function of ``(seed, endpoint, op index)``
+  hashed through md5 (never ``hash()`` — Python salts it per process),
+  exactly the discipline ``fleet.consistent_route`` established.
+- **Faults surface as OSError**: the client's existing failover
+  machinery (capped-backoff redial + at-least-once resend, ISSUE 8) is
+  the system under test, not something to bypass. A ``drop`` raises
+  before the send (command never reached the broker); a ``drop_reply``
+  kills the connection AFTER the send (the command may have executed —
+  the at-least-once window the ledger + dedup discipline exists for);
+  a blackhole window rejects every op and every redial for a span of
+  attempts, which is what a partition looks like from one side.
+
+Arming is explicit (``attach(client_or_fleet, faultnet)``) for
+in-process harnesses, or by environment (``AVENIR_FAULTNET`` holding
+the JSON config) for subprocess workers — every client a worker dials
+then shares one process-global injector, so per-endpoint op counters
+advance coherently across that worker's shards.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+FAULTNET_ENV = "AVENIR_FAULTNET"
+
+#: decision kinds, in evaluation order (first match wins)
+KINDS = ("blackhole", "drop", "drop_reply", "delay")
+
+
+class _Disarmed:
+    """Sentinel distinguishing 'injection explicitly OFF' from 'unset'.
+    A client constructed with ``faults=None`` consults the env
+    (``AVENIR_FAULTNET``); ``faults=DISARMED`` forces injection off even
+    when the env is armed — what ``attach(target, None)`` resolves to,
+    so a disarm sticks for future lazily-dialed connections too."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "faultnet.DISARMED"
+
+
+DISARMED = _Disarmed()
+
+
+def _unit(seed: int, endpoint: str, op: int, salt: str) -> float:
+    """Uniform [0, 1) from md5 — the cross-process-stable coin."""
+    digest = hashlib.md5(
+        f"{seed}:{endpoint}:{op}:{salt}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / 2 ** 64
+
+
+class FaultNet:
+    """Seeded fault schedule over (endpoint, op index) plus manual
+    partition switches.
+
+    ``drop_rate`` / ``drop_reply_rate`` / ``delay_rate`` are per-op
+    probabilities; ``delay_ms`` the injected reply latency.
+    ``window_rate`` arms seeded blackhole windows: op indices are
+    bucketed ``window_ops`` wide and a selected bucket rejects every op
+    (and every redial) in it — a partition of that (client, endpoint)
+    pair lasting ~``window_ops`` attempts. ``block(endpoint)`` /
+    ``unblock(endpoint)`` are the scripted switches a directed scenario
+    uses (leader partitioned from its control shard while a standby
+    claims the lease).
+
+    Thread-safe; per-endpoint op counters are shared across every
+    client the injector is attached to in this process."""
+
+    def __init__(self, seed: int = 0, *, drop_rate: float = 0.0,
+                 drop_reply_rate: float = 0.0, delay_rate: float = 0.0,
+                 delay_ms: float = 10.0, window_rate: float = 0.0,
+                 window_ops: int = 6):
+        self.seed = int(seed)
+        self.drop_rate = float(drop_rate)
+        self.drop_reply_rate = float(drop_reply_rate)
+        self.delay_rate = float(delay_rate)
+        self.delay_ms = float(delay_ms)
+        self.window_rate = float(window_rate)
+        self.window_ops = max(int(window_ops), 1)
+        self._ops: Dict[str, int] = {}
+        self._blocked: set = set()
+        self._lock = threading.Lock()
+        # injected-fault counters by kind (telemetry + gate assertions)
+        self.injected: Dict[str, int] = {k: 0 for k in KINDS}
+
+    # -- configuration plumbing --------------------------------------------
+
+    def to_config(self) -> Dict:
+        return {"seed": self.seed, "drop_rate": self.drop_rate,
+                "drop_reply_rate": self.drop_reply_rate,
+                "delay_rate": self.delay_rate, "delay_ms": self.delay_ms,
+                "window_rate": self.window_rate,
+                "window_ops": self.window_ops}
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "FaultNet":
+        return cls(cfg.get("seed", 0),
+                   drop_rate=cfg.get("drop_rate", 0.0),
+                   drop_reply_rate=cfg.get("drop_reply_rate", 0.0),
+                   delay_rate=cfg.get("delay_rate", 0.0),
+                   delay_ms=cfg.get("delay_ms", 10.0),
+                   window_rate=cfg.get("window_rate", 0.0),
+                   window_ops=cfg.get("window_ops", 6))
+
+    def env(self) -> str:
+        """The ``AVENIR_FAULTNET``-style JSON a subprocess worker arms
+        itself from (sorted keys: the spec is part of reproducibility)."""
+        return json.dumps(self.to_config(), sort_keys=True)
+
+    # -- the schedule ------------------------------------------------------
+
+    def decide(self, endpoint: str, op: int) -> Optional[str]:
+        """The fault (or None) for this endpoint's ``op``-th operation —
+        a pure function of (seed, endpoint, op): the deterministic
+        schedule itself, with no side effects."""
+        if self.window_rate > 0.0:
+            bucket = op // self.window_ops
+            if _unit(self.seed, endpoint, bucket, "window") \
+                    < self.window_rate:
+                return "blackhole"
+        if self.drop_rate > 0.0 and \
+                _unit(self.seed, endpoint, op, "drop") < self.drop_rate:
+            return "drop"
+        if self.drop_reply_rate > 0.0 and \
+                _unit(self.seed, endpoint, op, "reply") \
+                < self.drop_reply_rate:
+            return "drop_reply"
+        if self.delay_rate > 0.0 and \
+                _unit(self.seed, endpoint, op, "delay") < self.delay_rate:
+            return "delay"
+        return None
+
+    def plan(self, endpoint: str, n_ops: int) -> List[Optional[str]]:
+        """The first ``n_ops`` decisions for ``endpoint`` — what the
+        bit-identical-reproduction gate serializes and compares across
+        two independent runs/processes."""
+        return [self.decide(endpoint, op) for op in range(n_ops)]
+
+    # -- scripted partitions ----------------------------------------------
+
+    def block(self, endpoint: str) -> None:
+        """Partition this process from ``endpoint``: every op and every
+        redial to it fails until :meth:`unblock` — one side of a network
+        partition, scripted."""
+        with self._lock:
+            self._blocked.add(endpoint)
+
+    def unblock(self, endpoint: str) -> None:
+        with self._lock:
+            self._blocked.discard(endpoint)
+
+    def blocked(self, endpoint: str) -> bool:
+        with self._lock:
+            return endpoint in self._blocked
+
+    # -- client hooks ------------------------------------------------------
+
+    def _next_op(self, endpoint: str) -> int:
+        with self._lock:
+            op = self._ops.get(endpoint, 0)
+            self._ops[endpoint] = op + 1
+            return op
+
+    def _count(self, kind: str) -> None:
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def on_connect(self, endpoint: str) -> None:
+        """Consulted from ``MiniRedisClient._connect``: a blocked
+        endpoint refuses the dial, so a partition also defeats the
+        redial loop (the client's reconnect deadline then converts it
+        into BrokerUnavailable, exactly like a real unreachable host)."""
+        if self.blocked(endpoint):
+            raise OSError(f"faultnet: {endpoint} partitioned (connect)")
+
+    def on_op(self, endpoint: str, client=None) -> None:
+        """Consulted once per command/pipeline send attempt, BEFORE the
+        bytes go out. Raises OSError for drop/blackhole (the command
+        never reaches the broker), sleeps for delay, and for drop_reply
+        arms the post-send reply kill via ``client``."""
+        if self.blocked(endpoint):
+            self._count("blackhole")
+            raise OSError(f"faultnet: {endpoint} partitioned")
+        op = self._next_op(endpoint)
+        fault = self.decide(endpoint, op)
+        if fault is None:
+            return
+        if fault == "blackhole":
+            self._count("blackhole")
+            raise OSError(f"faultnet: {endpoint} blackholed (op {op})")
+        if fault == "drop":
+            self._count("drop")
+            raise OSError(f"faultnet: {endpoint} dropped conn (op {op})")
+        if fault == "delay":
+            self._count("delay")
+            time.sleep(self.delay_ms / 1e3)
+            return
+        if fault == "drop_reply" and client is not None:
+            self._count("drop_reply")
+            client._arm_reply_drop()
+
+
+_GLOBAL: Optional[FaultNet] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def from_env() -> Optional[FaultNet]:
+    """The process-global injector armed by ``AVENIR_FAULTNET``
+    (JSON config) — one shared instance, so op counters advance
+    coherently across every client this process dials. None when the
+    env is unset or unparsable (fault injection must never be the
+    fault)."""
+    raw = os.environ.get(FAULTNET_ENV)
+    if not raw:
+        return None
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            try:
+                _GLOBAL = FaultNet.from_config(json.loads(raw))
+            except (ValueError, TypeError):
+                return None
+        return _GLOBAL
+
+
+def attach(target, faults: Optional[FaultNet]) -> None:
+    """Arm (or disarm, with None) fault injection on a
+    ``MiniRedisClient`` or a ``BrokerFleet`` (every current AND future
+    shard client). A disarm is sticky: it overrides ``AVENIR_FAULTNET``
+    for connections dialed later, via :data:`DISARMED`."""
+    if hasattr(target, "set_faults"):       # BrokerFleet
+        target.set_faults(faults)
+    else:
+        target._faults = faults

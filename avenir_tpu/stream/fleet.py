@@ -134,7 +134,8 @@ class BrokerFleet:
 
     def __init__(self, endpoints, *, timeout: float = DEFAULT_TIMEOUT,
                  reconnect: bool = False, reconnect_timeout: float = 10.0,
-                 connect_timeout: float = 10.0):
+                 connect_timeout: float = 10.0,
+                 control_shard: int = 0):
         self.endpoints = parse_endpoints(endpoints)
         self._client_kw = dict(reconnect=reconnect,
                                reconnect_timeout=reconnect_timeout)
@@ -142,6 +143,12 @@ class BrokerFleet:
         self._connect_timeout = float(connect_timeout)
         self._clients: Dict[int, MiniRedisClient] = {}
         self._lock = threading.Lock()
+        # which shard id hosts the control plane (assignment record,
+        # lease, heartbeat/telemetry/trace queues). 0 by convention;
+        # moves only through a control-shard failover (ISSUE 13) —
+        # adopted from the record's ``control`` field.
+        self.control_shard = int(control_shard)
+        self._faults = None
 
     @property
     def n_shards(self) -> int:
@@ -161,6 +168,7 @@ class BrokerFleet:
         host, port = self.endpoints[shard]
         c = connect_with_retry(host, port, timeout=self._connect_timeout,
                                socket_timeout=self._timeout,
+                               faults=self._faults,
                                **self._client_kw)
         with self._lock:
             # a concurrent dial may have won; keep ONE client per shard
@@ -169,10 +177,24 @@ class BrokerFleet:
             c.close()
         return live
 
+    def set_faults(self, faults) -> None:
+        """Arm (or disarm) deterministic fault injection on every
+        current and future shard client (stream/faultnet.py). An
+        explicit disarm (None) is sticky: future lazily-dialed clients
+        stay disarmed even when AVENIR_FAULTNET is set."""
+        from avenir_tpu.stream import faultnet as _faultnet
+        with self._lock:
+            self._faults = _faultnet.DISARMED if faults is None \
+                else faults
+            clients = list(self._clients.values())
+        for c in clients:
+            c._faults = faults
+
     @property
     def control(self) -> MiniRedisClient:
-        """Shard 0: the assignment/heartbeat/telemetry home."""
-        return self.client(0)
+        """The control shard's client: the assignment/lease/heartbeat/
+        telemetry home. Shard 0 until a control failover re-homes it."""
+        return self.client(self.control_shard)
 
     def client_for_group(self, group: str,
                          routing: Dict[str, int]) -> MiniRedisClient:
@@ -182,18 +204,13 @@ class BrokerFleet:
         """Adopt a (possibly resized) endpoint list from a newer
         assignment record: clients whose (shard id -> endpoint) binding
         is unchanged are kept, the rest are closed and re-dialed
-        lazily. Shard 0 — the control shard — is pinned by convention
-        and must never move; everything reading the record from it
-        would lose the record's own home otherwise. Returns True when
-        the fleet changed."""
+        lazily. The control HOME is no longer pinned to shard 0
+        (ISSUE 13 lifted the pin): it travels in the record's
+        ``control`` field — adopt it with :meth:`adopt_record`, which
+        calls this. Returns True when the fleet changed."""
         new = parse_endpoints(endpoints)
         if new == self.endpoints:
             return False
-        if new[0] != self.endpoints[0]:
-            raise ValueError(
-                f"control shard moved ({self.endpoints[0]} -> {new[0]}); "
-                "shard 0 is pinned — resize by appending/removing tail "
-                "shards")
         with self._lock:
             keep = {i: c for i, c in self._clients.items()
                     if i < len(new) and i < len(self.endpoints)
@@ -204,6 +221,21 @@ class BrokerFleet:
         for c in drop:
             c.close()
         return True
+
+    def adopt_record(self, record) -> bool:
+        """Adopt an assignment record's broker view: endpoint list AND
+        control home in one step — the worker-side half of a fleet
+        resize or a control-shard failover. Returns True when either
+        changed."""
+        changed = False
+        if record.brokers:
+            changed = self.ensure_endpoints(record.brokers)
+        control = int(record.control)
+        if 0 <= control < self.n_shards \
+                and control != self.control_shard:
+            self.control_shard = control
+            changed = True
+        return changed
 
     def reconnects(self) -> int:
         with self._lock:

@@ -23,6 +23,14 @@ write the kill interrupted, which the same at-least-once contract absorbs.
 ``SET``/``GET`` round out the subset with the single-key atomic record the
 ownership rebalancer swaps assignments through (stream/rebalance.py).
 
+Control-plane fault tolerance (ISSUE 13) adds the conditional-write
+family: ``SETNX`` (first-writer-wins creation), ``CAS`` (swap iff the
+stored bytes match — the lease renewal/takeover primitive), and the
+fencing pair ``FSET``/``FBUMP`` (a per-key monotone fence floor; writes
+carrying a token below the floor bounce with ``-FENCED``, surfacing
+client-side as :class:`FencedWrite`). Floors are AOF-logged and replay
+with the store, so a SIGKILLed control shard restarts still fencing.
+
 Single-process uses need none of this — ``InProcQueues`` stays the default.
 """
 
@@ -47,6 +55,13 @@ DEFAULT_TIMEOUT = 10.0
 class BrokerUnavailable(ConnectionError):
     """The broker cannot be reached: connect/send/reply timed out or was
     refused, and reconnection (when armed) exhausted its deadline."""
+
+
+class FencedWrite(RuntimeError):
+    """A fenced write (FSET/FBUMP) carried a token below the key's fence
+    floor: the writer has been deposed by a newer lease holder and must
+    stop publishing. Raised client-side from the broker's -FENCED reply
+    — the on-the-wire rejection the split-brain gate asserts."""
 
 
 # --------------------------------------------------------------------------
@@ -123,8 +138,14 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 
 # the commands the AOF must log: everything that changes store state.
 # Reads (LRANGE/LINDEX/LLEN/GET/PING) replay to the same answer for free.
+# SETNX/CAS/FSET/FBUMP are logged even when they decline the write: the
+# decline is a pure function of replayed state (and fence floors), so
+# replay reproduces exactly the same accept/reject sequence — and the
+# floors themselves MUST persist across a SIGKILL + AOF restart, or a
+# restarted control shard would forget it ever fenced a stale leader.
 _MUTATING = frozenset((b"LPUSH", b"RPUSH", b"RPOP", b"LPOP", b"RPOPLPUSH",
-                       b"LREM", b"DEL", b"FLUSHALL", b"SET"))
+                       b"LREM", b"DEL", b"FLUSHALL", b"SET",
+                       b"SETNX", b"CAS", b"FSET", b"FBUMP"))
 
 
 #: AOF flush policies (ISSUE 12 satellite). ``always`` = flush (one
@@ -174,6 +195,15 @@ class MiniRedisServer:
                              f"{AOF_FLUSH_POLICIES}")
         self._lists: Dict[bytes, deque] = {}
         self._strings: Dict[bytes, bytes] = {}
+        # per-key fence floor (ISSUE 13): the largest fencing token a
+        # FSET/FBUMP ever carried for the key. A fenced write below the
+        # floor is rejected — the broker-side half of the coordinator
+        # lease protocol, which makes a deposed leader's publish
+        # structurally impossible rather than merely epoch-ignored.
+        # Floors survive DEL (deleting a record must not re-admit a
+        # stale writer) and replay from the AOF; FLUSHALL clears them
+        # (the explicit full-reset a test harness uses).
+        self._fences: Dict[bytes, int] = {}
         self._lock = threading.Lock()
         self._aof = None
         self._aof_path = aof_path
@@ -311,6 +341,60 @@ class MiniRedisServer:
             # this: one epoch-numbered JSON blob swapped in one command)
             self._strings[args[0]] = args[1]
             return b"+OK\r\n"
+        if name == b"SETNX":
+            # first-writer-wins creation: the lease-acquisition
+            # primitive (a standby claiming an EMPTY lease key; exactly
+            # one of N racing claimants gets the 1 reply)
+            if args[0] in self._strings:
+                return b":0\r\n"
+            self._strings[args[0]] = args[1]
+            return b":1\r\n"
+        if name == b"CAS":
+            # conditional swap on the EXACT stored bytes (ISSUE 13):
+            # ``CAS key expected new`` installs ``new`` iff the current
+            # value is byte-equal to ``expected``. The lease record
+            # rides this — renewals and takeovers are CAS on the raw
+            # JSON blob, so a renewal that raced a takeover (or vice
+            # versa) loses cleanly instead of clobbering. A missing key
+            # never matches (creation is SETNX's job).
+            current = self._strings.get(args[0])
+            if current is None or current != args[1]:
+                return b":0\r\n"
+            self._strings[args[0]] = args[2]
+            return b":1\r\n"
+        if name == b"FSET":
+            # fenced SET: ``FSET key token value`` applies iff ``token``
+            # is >= the key's fence floor, and raises the floor to it.
+            # A deposed leader (holding a smaller token than the
+            # floor a takeover bumped) gets -FENCED on the wire — the
+            # split-brain guard enforced where it must be: at the
+            # single writer-ordering point, not in every reader.
+            token = int(args[1])
+            floor = self._fences.get(args[0], 0)
+            if token < floor:
+                return (b"-FENCED stale token %d < floor %d for '%s'\r\n"
+                        % (token, floor, args[0]))
+            self._fences[args[0]] = token
+            self._strings[args[0]] = args[2]
+            return b"+OK\r\n"
+        if name == b"FBUMP":
+            # raise the fence floor WITHOUT touching the value: the
+            # first thing a takeover does after winning the lease CAS.
+            # After the bump, no smaller-token FSET can land — so the
+            # GET that follows reads a record no stale leader can
+            # retroactively change (the takeover read-fence ordering).
+            token = int(args[1])
+            floor = self._fences.get(args[0], 0)
+            if token < floor:
+                return (b"-FENCED stale token %d < floor %d for '%s'\r\n"
+                        % (token, floor, args[0]))
+            self._fences[args[0]] = token
+            return b":%d\r\n" % token
+        if name == b"FGET":
+            # read the fence floor (0 when the key was never fenced):
+            # how a claimant that never observed the previous leader
+            # learns the token it must exceed. Read-only: not logged.
+            return b":%d\r\n" % self._fences.get(args[0], 0)
         if name == b"GET":
             return _encode_bulk(self._strings.get(args[0]))
         if name == b"LPUSH":
@@ -434,6 +518,7 @@ class MiniRedisServer:
         if name == b"FLUSHALL":
             self._lists.clear()
             self._strings.clear()
+            self._fences.clear()
             return b"+OK\r\n"
         return b"-ERR unknown command '%s'\r\n" % name
 
@@ -472,7 +557,8 @@ class MiniRedisClient:
     def __init__(self, host: str = "localhost", port: int = 6379,
                  timeout: float = DEFAULT_TIMEOUT,
                  reconnect: bool = False,
-                 reconnect_timeout: float = 10.0):
+                 reconnect_timeout: float = 10.0,
+                 faults=None):
         self.host, self.port = host, port
         self._timeout = timeout
         self._reconnect_armed = bool(reconnect)
@@ -480,9 +566,34 @@ class MiniRedisClient:
         self._lock = threading.Lock()
         self.calls = 0
         self.reconnects = 0
+        # deterministic network fault injection (ISSUE 13): explicit
+        # injector; or the process-global one AVENIR_FAULTNET arms in
+        # subprocess workers (faults=None = consult the env); or
+        # faultnet.DISARMED = explicitly off even under an armed env.
+        # Disarmed costs one attribute check per op.
+        from avenir_tpu.stream import faultnet as _faultnet
+        if faults is None:
+            faults = _faultnet.from_env()
+        elif faults is _faultnet.DISARMED:
+            faults = None
+        self._faults = faults
+        self._drop_reply = False
         self._connect()
 
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _arm_reply_drop(self) -> None:
+        """Faultnet hook: kill the connection AFTER the next send lands
+        — the command executes broker-side, its reply is lost, and the
+        resend path must absorb the duplicate (the at-least-once
+        window, injected on purpose)."""
+        self._drop_reply = True
+
     def _connect(self) -> None:
+        if self._faults is not None:
+            self._faults.on_connect(self.endpoint)
         self._sock = socket.create_connection((self.host, self.port),
                                               timeout=self._timeout)
         self._rfile = self._sock.makefile("rb")
@@ -552,7 +663,10 @@ class MiniRedisClient:
             state: Dict = {"attempt": 0}
             while True:
                 try:
+                    if self._faults is not None:
+                        self._faults.on_op(self.endpoint, self)
                     self._sock.sendall(msg)
+                    self._maybe_drop_reply()
                     return self._reply()
                 except RuntimeError:
                     raise             # -ERR reply: the stream is intact
@@ -575,7 +689,10 @@ class MiniRedisClient:
             state: Dict = {"attempt": 0}
             while True:
                 try:
+                    if self._faults is not None:
+                        self._faults.on_op(self.endpoint, self)
                     self._sock.sendall(msg)
+                    self._maybe_drop_reply()
                     replies, first_err = [], None
                     for _ in commands:
                         try:
@@ -590,6 +707,16 @@ class MiniRedisClient:
         if first_err is not None:
             raise first_err
         return replies
+
+    def _maybe_drop_reply(self) -> None:
+        """Second half of the faultnet ``drop_reply`` injection: the
+        send already landed (the broker will execute the batch); kill
+        the connection before reading, exactly what a broker-side
+        half-close at the wrong moment does."""
+        if self._drop_reply:
+            self._drop_reply = False
+            self.close()
+            raise OSError(f"faultnet: {self.endpoint} reply dropped")
 
     def _reply(self):
         line = _read_line(self._rfile)
@@ -652,6 +779,44 @@ class MiniRedisClient:
 
     def set(self, key, value):
         return self._call(b"SET", self._b(key), self._b(value))
+
+    def setnx(self, key, value) -> int:
+        """First-writer-wins SET: 1 if this call created the key."""
+        return self._call(b"SETNX", self._b(key), self._b(value))
+
+    def cas(self, key, expected, new) -> int:
+        """Compare-and-swap on the exact stored bytes: 1 if swapped.
+        A missing key never matches (use :meth:`setnx` to create)."""
+        return self._call(b"CAS", self._b(key), self._b(expected),
+                          self._b(new))
+
+    def fset(self, key, token: int, value):
+        """Fenced SET: applies iff ``token`` >= the key's fence floor
+        (raising the floor to it); raises :class:`FencedWrite` when the
+        broker rejects a stale token."""
+        try:
+            return self._call(b"FSET", self._b(key), self._b(int(token)),
+                              self._b(value))
+        except RuntimeError as exc:
+            if str(exc).startswith("FENCED"):
+                raise FencedWrite(str(exc)) from exc
+            raise
+
+    def fbump(self, key, token: int) -> int:
+        """Raise ``key``'s fence floor to ``token`` without changing the
+        value (the takeover read-fence); :class:`FencedWrite` if the
+        floor is already above ``token``."""
+        try:
+            return self._call(b"FBUMP", self._b(key),
+                              self._b(int(token)))
+        except RuntimeError as exc:
+            if str(exc).startswith("FENCED"):
+                raise FencedWrite(str(exc)) from exc
+            raise
+
+    def fget(self, key) -> int:
+        """The key's current fence floor (0 = never fenced)."""
+        return self._call(b"FGET", self._b(key))
 
     def get(self, key) -> Optional[bytes]:
         return self._call(b"GET", self._b(key))

@@ -11,6 +11,16 @@ This module supplies the missing control plane:
   key ``assignment`` (``SET`` — single-key, single-command atomic swap).
   The coordinator is its only writer; workers only read. Epochs are
   strictly increasing, so a worker can never act on a stale record twice.
+  With a **coordinator lease** armed (ISSUE 13) the sole-writer property
+  stops being an assumption and becomes enforced: exactly one
+  coordinator holds the lease (``CoordinatorLease`` — SETNX acquire,
+  CAS renew/takeover, observer-monotonic expiry) and every record write
+  is FENCED with the lease token (``FSET``), so a deposed or partitioned
+  leader's publish is rejected by the broker itself — split-brain is
+  structurally impossible, not merely epoch-ignored. The control home
+  itself can move: on control-shard death the leader re-homes the lease
+  + record to a surviving shard in one epoch (``control`` field), and
+  workers rediscover it via :func:`discover_assignment`'s bounded scan.
 
 - **Coordinator** (driver-side): consumes the same heartbeat stream the
   fleet already ships, maintains per-worker liveness
@@ -50,11 +60,236 @@ from avenir_tpu.obs.exporters import set_hub_gauges_if_live as _hub_gauges
 
 ASSIGNMENT_KEY = "assignment"
 HANDOFF_KIND = "learner-handoff"
+LEASE_KEY = "coordinatorLease"
 
 # how long an acquiring worker polls for the releasing owner's publish
 # before serving from a fresh learner: release rides the releaser's own
 # batch-boundary sync, so a couple of poll cadences covers it
 HANDOFF_WAIT_S = 5.0
+
+# the holder renews every lease_s / LEASE_RENEW_FRACTION — several
+# renewal chances per lease period, so one dropped renewal round trip
+# never costs the lease
+LEASE_RENEW_FRACTION = 3.0
+# an observer declares the lease expired once the record has sat
+# UNCHANGED for grace * lease_s on the OBSERVER'S monotonic clock —
+# expiry never compares clocks across processes (an NTP step on either
+# side cannot expire a healthy lease or keep a dead one alive)
+LEASE_GRACE = 1.5
+
+
+class StaleLeader(RuntimeError):
+    """This coordinator's fenced publish was rejected by the broker: a
+    newer lease holder exists. The only correct reaction is to stop
+    publishing (the lease bookkeeping has already been deposed when
+    this raises)."""
+
+
+@dataclass
+class LeaseRecord:
+    """The JSON blob under ``coordinatorLease`` on the control shard.
+    ``token`` is the fencing token (strictly increasing across
+    holders); ``renew`` increments on every renewal, so an observer can
+    see liveness without comparing wall clocks; ``lease_s`` tells the
+    observer how long an unchanged record means a dead holder."""
+
+    token: int
+    holder: str
+    renew: int = 0
+    lease_s: float = 2.0
+
+    def to_json(self) -> str:
+        return json.dumps({"token": self.token, "holder": self.holder,
+                           "renew": self.renew, "lease_s": self.lease_s},
+                          sort_keys=True)
+
+    @classmethod
+    def from_json(cls, raw) -> "LeaseRecord":
+        data = json.loads(raw.decode() if isinstance(raw, bytes)
+                          else raw)
+        return cls(token=int(data["token"]), holder=str(data["holder"]),
+                   renew=int(data.get("renew", 0)),
+                   lease_s=float(data.get("lease_s", 2.0)))
+
+
+class CoordinatorLease:
+    """Client-side half of the coordinator lease (ISSUE 13).
+
+    Protocol, entirely over the broker's conditional-write primitives:
+
+    - **Acquire** (empty key): ``SETNX`` — exactly one of N racing
+      claimants wins. The new token exceeds both the last token this
+      observer ever saw AND every fence floor it must write under
+      (``FGET``), so fencing stays monotone even across a deleted or
+      re-homed lease key.
+    - **Renew** (holder): ``CAS`` on the exact stored bytes, bumping
+      ``renew``. A renewal that raced a takeover loses the CAS and the
+      holder deposes itself — no clobbering, no split.
+    - **Take over** (observer): the record sat unchanged for
+      ``grace * lease_s`` on THIS process's monotonic clock, then
+      ``CAS(old raw, token+1 record)``. If the old holder renewed in
+      between, the CAS fails and the staleness clock restarts.
+    - **Read fence** (every win): ``FBUMP`` each fenced key to the new
+      token BEFORE reading state. After the bump no smaller-token FSET
+      can land, so what the new leader reads next is what the cluster
+      will keep — a paused old leader waking mid-takeover cannot
+      retroactively change it (the classic fencing-token ordering).
+
+    ``tick()`` drives all of it; transport errors propagate to the
+    caller (the Coordinator turns a dead control shard into a control
+    failover, not a crash)."""
+
+    def __init__(self, client, holder: str, lease_s: float = 2.0,
+                 grace: float = LEASE_GRACE,
+                 fence_keys: Sequence[str] = (ASSIGNMENT_KEY,)):
+        self.client = client
+        self.holder = str(holder)
+        self.lease_s = float(lease_s)
+        self.grace = float(grace)
+        self.fence_keys = tuple(fence_keys)
+        self.held = False
+        self.token = 0
+        self.acquisitions = 0
+        self.renewals = 0
+        self.losses = 0
+        self._mine_raw: Optional[bytes] = None
+        self._renew_at = 0.0
+        self._observed_raw: Optional[bytes] = None
+        self._observed_mono = 0.0
+        self._last_seen_token = 0
+
+    @staticmethod
+    def _raw(record: LeaseRecord) -> bytes:
+        return record.to_json().encode()
+
+    def _next_token(self, *candidates: int) -> int:
+        """A token strictly above everything this claimant knows about:
+        observed lease tokens, the floors on the keys it will publish
+        under, and any explicit candidates (a control failover passes
+        the old home's token)."""
+        floor = self._last_seen_token
+        for key in self.fence_keys:
+            try:
+                floor = max(floor, int(self.client.fget(key)))
+            except (AttributeError, RuntimeError):
+                pass           # a broker without FGET: floors start at 0
+        return max(floor, *candidates, 0) + 1
+
+    def _won(self, record: LeaseRecord, raw: bytes, now: float) -> bool:
+        """Post-win bookkeeping + the read fence. A lost FBUMP (an even
+        newer holder already fenced higher) deposes immediately."""
+        from avenir_tpu.stream.miniredis import FencedWrite
+        self.token = record.token
+        self._mine_raw = raw
+        self._renew_at = now + self.lease_s / LEASE_RENEW_FRACTION
+        self._last_seen_token = max(self._last_seen_token, record.token)
+        try:
+            for key in self.fence_keys:
+                self.client.fbump(key, self.token)
+        except FencedWrite:
+            self._depose()
+            return False
+        self.held = True
+        self.acquisitions += 1
+        self._observed_raw = None
+        return True
+
+    def _depose(self) -> None:
+        if self.held:
+            self.losses += 1
+        self.held = False
+        self._mine_raw = None
+        self._observed_raw = None
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        """Advance the protocol one step; returns whether this process
+        holds the lease after the step. ``now`` is monotonic-domain
+        (tests pass a fake clock; production passes nothing)."""
+        now = time.monotonic() if now is None else now
+        raw = self.client.get(LEASE_KEY)
+        if self.held:
+            if raw != self._mine_raw:
+                # someone else swapped the record (takeover) or it
+                # vanished: this process is no longer the leader
+                self._depose()
+            elif now >= self._renew_at:
+                rec = LeaseRecord.from_json(self._mine_raw)
+                rec.renew += 1
+                new_raw = self._raw(rec)
+                if self.client.cas(LEASE_KEY, self._mine_raw, new_raw):
+                    self._mine_raw = new_raw
+                    self.renewals += 1
+                    self._renew_at = (now
+                                      + self.lease_s / LEASE_RENEW_FRACTION)
+                else:
+                    self._depose()
+            if self.held or raw is None:
+                return self.held
+            # fall through: deposed but a rival record exists — start
+            # observing it this same tick
+        if raw is None:
+            rec = LeaseRecord(self._next_token(), self.holder,
+                              lease_s=self.lease_s)
+            new_raw = self._raw(rec)
+            if self.client.setnx(LEASE_KEY, new_raw):
+                return self._won(rec, new_raw, now)
+            return False
+        their = LeaseRecord.from_json(raw)
+        self._last_seen_token = max(self._last_seen_token, their.token)
+        if raw != self._observed_raw:
+            # record changed since last look: the holder is alive (or a
+            # new one exists) — restart the staleness clock
+            self._observed_raw = raw
+            self._observed_mono = now
+            return False
+        lease_s = max(their.lease_s, self.lease_s)
+        if now - self._observed_mono <= self.grace * lease_s:
+            return False
+        rec = LeaseRecord(self._next_token(their.token), self.holder,
+                          lease_s=self.lease_s)
+        new_raw = self._raw(rec)
+        if self.client.cas(LEASE_KEY, raw, new_raw):
+            return self._won(rec, new_raw, now)
+        self._observed_raw = None      # lost the race: re-observe
+        return False
+
+    def reseed(self, client, now: Optional[float] = None) -> bool:
+        """Force-claim the lease on a NEW control home (control-shard
+        failover): the old home — floors, lease record and all — is
+        unreachable, and this claimant carries its token forward so
+        fencing stays monotone across the move. First claimant wins
+        (SETNX / CAS against whatever stale record the new home holds);
+        the loser deposes and follows the winner's records."""
+        now = time.monotonic() if now is None else now
+        self.client = client
+        old_token = self.token
+        self._mine_raw = None
+        raw = client.get(LEASE_KEY)
+        rival = 0
+        if raw is not None:
+            # a rival reseeded here first: the new token must exceed
+            # ITS token too, not just our own history — two concurrent
+            # reseeds minting EQUAL tokens would both pass the >= floor
+            # fence and reopen the split this layer closes (the same
+            # their.token rule tick()'s takeover path applies)
+            try:
+                rival = LeaseRecord.from_json(raw).token
+            except (ValueError, KeyError):
+                pass
+        rec = LeaseRecord(self._next_token(old_token, rival),
+                          self.holder, lease_s=self.lease_s)
+        new_raw = self._raw(rec)
+        if raw is None:
+            won = bool(client.setnx(LEASE_KEY, new_raw))
+        else:
+            won = bool(client.cas(LEASE_KEY, raw, new_raw))
+        held_before, self.held = self.held, False
+        if won and self._won(rec, new_raw, now):
+            return True
+        if held_before:
+            self.losses += 1
+        self._observed_raw = None
+        return False
 
 
 @dataclass
@@ -89,6 +324,11 @@ class AssignmentRecord:
     # the wire format entirely)
     brokers: List[str] = field(default_factory=list)
     routing: Dict[str, int] = field(default_factory=dict)
+    # which shard id is the control home (record/lease/heartbeats/
+    # telemetry) — 0 by convention and omitted from the wire until a
+    # control-shard failover re-homes the control plane (ISSUE 13), so
+    # pre-failover records stay byte-identical to the PR 12 format
+    control: int = 0
 
     def owned_by(self, worker_id: int) -> List[str]:
         return sorted(g for g, w in self.groups.items() if w == worker_id)
@@ -104,6 +344,8 @@ class AssignmentRecord:
         if self.brokers:
             data["brokers"] = list(self.brokers)
             data["routing"] = self.routing
+        if self.control:
+            data["control"] = int(self.control)
         return json.dumps(data, sort_keys=True)
 
     @classmethod
@@ -117,7 +359,8 @@ class AssignmentRecord:
                    stop=bool(data.get("stop", False)),
                    brokers=list(data.get("brokers") or []),
                    routing={g: int(s) for g, s in
-                            (data.get("routing") or {}).items()})
+                            (data.get("routing") or {}).items()},
+                   control=int(data.get("control", 0)))
 
 
 def read_assignment(client) -> Optional[AssignmentRecord]:
@@ -128,10 +371,42 @@ def read_assignment(client) -> Optional[AssignmentRecord]:
         raw.decode() if isinstance(raw, bytes) else raw)
 
 
-def write_assignment(client, record: AssignmentRecord) -> None:
+def write_assignment(client, record: AssignmentRecord,
+                     token: Optional[int] = None) -> None:
     """One SET: readers observe the old record or the new one, never a
-    torn mix — the broker applies each command atomically."""
-    client.set(ASSIGNMENT_KEY, record.to_json())
+    torn mix — the broker applies each command atomically. With
+    ``token`` (a lease-armed coordinator) the write is FENCED: the
+    broker rejects it outright when a newer holder has published —
+    split-brain stops at the wire, not at each reader's epoch check."""
+    if token is None:
+        client.set(ASSIGNMENT_KEY, record.to_json())
+    else:
+        client.fset(ASSIGNMENT_KEY, int(token), record.to_json())
+
+
+def discover_assignment(fleet, exclude: Sequence[int] = ()
+                        ) -> Optional[AssignmentRecord]:
+    """Bounded scan for the newest assignment record across a broker
+    fleet: the worker-side fallback when the cached control home stops
+    answering (control-shard death, ISSUE 13). Probes every shard but
+    the excluded ones (pass the suspect shard — probing a dead endpoint
+    costs its full redial deadline), newest epoch wins; unreachable
+    shards are skipped, never raised. After a control re-home the OLD
+    home (restarted over its AOF) still holds a stale record, so the
+    epoch comparison — not shard order — picks the live control plane;
+    the winning record's ``control`` field names the new home."""
+    skip = set(int(s) for s in exclude)
+    best: Optional[AssignmentRecord] = None
+    for shard in range(fleet.n_shards):
+        if shard in skip:
+            continue
+        try:
+            rec = read_assignment(fleet.client(shard))
+        except (ConnectionError, OSError):
+            continue
+        if rec is not None and (best is None or rec.epoch > best.epoch):
+            best = rec
+    return best
 
 
 def rebalance_assignment(groups: Sequence[str], workers: Sequence[int],
@@ -186,7 +461,7 @@ class Coordinator:
     def __init__(self, client, groups: Sequence[str],
                  cadence_s: float = 0.5,
                  dead_after_factor: Optional[float] = None,
-                 fleet=None):
+                 fleet=None, lease: Optional[CoordinatorLease] = None):
         from avenir_tpu.stream.scaleout import DEAD_AFTER_FACTOR
         self.client = client
         self.groups = list(groups)
@@ -195,7 +470,25 @@ class Coordinator:
                                        or DEAD_AFTER_FACTOR)
         self.dead_after_s = self.dead_after_factor * self.cadence_s
         self.last_seen: Dict[int, float] = {}
+        # monotonic RECEIPT time per worker (ISSUE 13 satellite): the
+        # production liveness clock. Aging by receipt on this process's
+        # monotonic clock means an NTP step can never mass-declare
+        # worker death — heartbeat wall timestamps stay only for
+        # ordering and the explicit-clock test path.
+        self.last_seen_mono: Dict[int, float] = {}
         self.removed: set = set()
+        # coordinator lease (ISSUE 13): while armed, this instance only
+        # drains heartbeats / publishes records when it HOLDS the lease,
+        # and every publish is fenced with the lease token. A standby is
+        # just a second Coordinator whose lease.tick() keeps losing.
+        self.lease = lease
+        self.fenced_rejections = 0
+        # control-shard failover bookkeeping: shards that USED to be the
+        # control home get the current record mirrored to them (until
+        # one mirror lands) so a late reader of the old home learns
+        # where the control plane went
+        self._stale_homes: set = set()
+        self.control_failovers = 0
         self.record = read_assignment(client) or AssignmentRecord(0)
         # broker-fleet routing (ISSUE 12): with a BrokerFleet armed,
         # every record this coordinator writes carries the group->shard
@@ -243,6 +536,9 @@ class Coordinator:
         # would haunt every later merge of this accumulator
         self.worker_reports: Dict[int, Dict] = {}
         self._last_reports = 0.0
+        # monotonic receipt stamps for shipped reports (the aging
+        # clock, same NTP-immunity story as last_seen_mono)
+        self._report_seen: Dict[int, float] = {}
 
     # -- broker-fleet routing (ISSUE 12) -------------------------------------
 
@@ -254,8 +550,32 @@ class Coordinator:
         shard -> new shard right after the swap (then re-sweep per tick
         for stale-producer stragglers). Returns the new record, or None
         when no worker is alive yet (the re-route then lands with the
-        first join)."""
+        first join).
+
+        The CONTROL endpoint must survive a resize in place: replacing
+        it here would strand the record's own home — workers would
+        re-point shard ids to the new endpoint while this coordinator
+        kept publishing (and draining heartbeats) on the old one. The
+        control home moves ONLY through control failover (shard
+        death); resizes append/remove non-control shards."""
         from avenir_tpu.stream.fleet import consistent_route
+        if self.fleet is not None:
+            control = self.fleet.control_shard
+            old_ep = self.fleet.endpoints[control]
+            if (control >= fleet.n_shards
+                    or fleet.endpoints[control] != old_ep):
+                raise ValueError(
+                    f"control endpoint {old_ep} (shard {control}) "
+                    f"changed in a resize; the control home moves only "
+                    f"through control failover — resize by appending/"
+                    f"removing non-control shards")
+            # adopt the new fleet as the control transport too: keeping
+            # the OLD fleet's client would publish into an object the
+            # caller may close, even though the endpoint matches
+            fleet.control_shard = control
+            self.client = fleet.client(control)
+            if self.lease is not None:
+                self.lease.client = self.client
         self.fleet = fleet
         self.routing = consistent_route(self.groups,
                                         range(fleet.n_shards))
@@ -321,16 +641,31 @@ class Coordinator:
     # -- membership ----------------------------------------------------------
 
     def note_heartbeats(self, heartbeats: Sequence[Dict]) -> None:
+        now_mono = time.monotonic()
         for hb in heartbeats:
             worker = int(hb["worker"])
             self.last_seen[worker] = max(self.last_seen.get(worker, 0.0),
                                          float(hb["ts"]))
+            self.last_seen_mono[worker] = now_mono
 
     def _liveness(self, now: Optional[float] = None) -> Dict[int, Dict]:
         """Per-worker liveness over the latest-known heartbeats — the
         one stale-heartbeat rule, shared with the fleet report
-        (``scaleout.worker_liveness``), never a second copy."""
+        (``scaleout.worker_liveness``), never a second copy.
+
+        With no explicit clock (production) a worker ages by its
+        monotonic RECEIPT time on this process — wall-clock steps (NTP)
+        cannot mass-declare death, and a heartbeat backlog flushing
+        after an outage correctly reads as alive-now. An explicit
+        ``now`` selects the heartbeat-timestamp clock: the
+        deterministic path tests and simulations drive."""
         from avenir_tpu.stream.scaleout import worker_liveness
+        if now is None:
+            return worker_liveness(
+                [{"worker": w, "ts": ts}
+                 for w, ts in self.last_seen_mono.items()],
+                self.cadence_s, now=time.monotonic(),
+                dead_after_factor=self.dead_after_factor)
         return worker_liveness(
             [{"worker": w, "ts": ts} for w, ts in self.last_seen.items()],
             self.cadence_s, now=now,
@@ -353,13 +688,123 @@ class Coordinator:
     def observe(self, now: Optional[float] = None
                 ) -> Optional[AssignmentRecord]:
         """Drain pending heartbeats off the broker and advance: the one
-        call a driver loop needs per poll tick."""
+        call a driver loop needs per poll tick.
+
+        With a lease armed, only the HOLDER drains and publishes: a
+        standby's tick is just the lease observation (draining the
+        shared heartbeat queue from two processes would split the
+        stream and blind the leader). A control shard that stops
+        answering triggers control failover instead of raising — the
+        coordinator's availability must not be a function of one
+        broker's."""
         from avenir_tpu.stream.scaleout import read_heartbeats
-        self.note_heartbeats(read_heartbeats(self.client))
-        self.poll_broker_info(now)
-        self.poll_worker_reports(now)
-        self._migrate_moved()      # routing-change straggler sweep
-        return self.step(now)
+        try:
+            if self.lease is not None:
+                was_held = self.lease.held
+                if not self.lease.tick():
+                    return None
+                if not was_held:
+                    self._on_lease_acquired()
+            self.note_heartbeats(read_heartbeats(self.client))
+            self._mirror_stale_homes()
+            self.poll_broker_info(now)
+            self.poll_worker_reports(now)
+            self._migrate_moved()      # routing-change straggler sweep
+            return self.step(now)
+        except (ConnectionError, OSError):
+            # the control home died under us — mid-drain or mid-publish:
+            # re-home (fleet) or degrade to the next tick (single
+            # broker); a coordinator's availability must never be a
+            # function of one broker's
+            if self._control_failover():
+                return self.record
+            return None
+
+    def _on_lease_acquired(self) -> None:
+        """A takeover (or first acquisition): adopt the committed record
+        — the FBUMP read fence inside the lease win guarantees no
+        smaller-token write can land after this read — and continue its
+        epoch sequence. The membership view starts empty (a standby
+        never drained heartbeats) and refills within one heartbeat
+        cadence; until then step() refuses to write, so groups are
+        never orphaned by the handover itself."""
+        rec = read_assignment(self.client)
+        if rec is not None and rec.epoch >= self.record.epoch:
+            self.record = rec
+        if self.fleet is not None and self.record.routing:
+            # continue the committed routing (do not recompute: a
+            # resized fleet re-routes through set_brokers, never
+            # through a takeover)
+            self.routing = dict(self.record.routing)
+        self.last_seen.clear()
+        self.last_seen_mono.clear()
+
+    def _mirror_stale_homes(self) -> None:
+        """Best-effort: push the current record onto shards that used
+        to be the control home. A restarted old home replays its AOF to
+        a STALE record; one mirrored write turns it into a forwarding
+        pointer (its ``control`` field names the new home), after which
+        the shard drops off the mirror list."""
+        if self.fleet is None or not self._stale_homes:
+            return
+        token = self.lease.token if self.lease is not None else None
+        for shard in sorted(self._stale_homes):
+            try:
+                write_assignment(self.fleet.client(shard), self.record,
+                                 token=token)
+            except Exception:
+                continue           # still down: retry next tick
+            self._stale_homes.discard(shard)
+
+    def _control_failover(self) -> bool:
+        """The control home stopped answering: re-home the control
+        plane (lease + assignment record + the heartbeat/telemetry/
+        trace queue convention) to a surviving shard in ONE epoch.
+        Returns True when this coordinator is the (re-seeded) leader on
+        a new home. The epoch bump + ``control`` field in the record is
+        how workers re-point; their scan fallback finds it even while
+        the old home is dark. Queue contents on the dead shard are the
+        per-shard AOF-restart story (PR 12) — this moves the control
+        plane, not the data plane."""
+        if self.fleet is None or self.fleet.n_shards < 2:
+            return False
+        old = self.fleet.control_shard
+        new_shard = None
+        for shard in range(self.fleet.n_shards):
+            if shard == old:
+                continue
+            try:
+                self.fleet.client(shard).ping()
+            except (ConnectionError, OSError):
+                continue
+            new_shard = shard
+            break
+        if new_shard is None:
+            return False               # nothing alive to fail over to
+        self.fleet.control_shard = new_shard
+        self.client = self.fleet.client(new_shard)
+        self.control_failovers += 1
+        self._stale_homes.add(old)
+        if self.lease is not None:
+            try:
+                if not self.lease.reseed(self.client):
+                    return False       # a rival won the new home
+            except (ConnectionError, OSError):
+                return False
+        # publish the re-home: same assignment, new epoch, new control
+        # field — one atomic (fenced) swap, like every other epoch
+        self.record = AssignmentRecord(
+            self.record.epoch + 1, dict(self.record.groups),
+            handoff=[], members=list(self.record.members),
+            stop=self.record.stop, brokers=list(self.record.brokers),
+            routing=dict(self.record.routing), control=new_shard)
+        try:
+            self._publish(self.record)
+        except (ConnectionError, OSError, StaleLeader):
+            return False
+        _hub_gauges({"rebalance.control_failovers":
+                     float(self.control_failovers)})
+        return True
 
     def poll_worker_reports(self, now: Optional[float] = None
                             ) -> Dict[int, Dict]:
@@ -373,15 +818,22 @@ class Coordinator:
         per-tick rpop would just hammer the single-core broker with
         nils). Best-effort — a broker hiccup degrades to the previous
         view, never raises."""
-        t_now = time.time() if now is None else now
+        t_now = time.monotonic() if now is None else now
         if t_now - self._last_reports < self.cadence_s:
             return self.worker_reports
         self._last_reports = t_now
         from avenir_tpu.stream.scaleout import read_worker_reports
         try:
+            # production (now=None): seen= ages reports by monotonic
+            # RECEIPT time on this process instead of the report's own
+            # wall stamp — an NTP step on either host can no longer age
+            # out a live fleet's reports (ISSUE 13 satellite). An
+            # explicit ``now`` keeps the deterministic generated_at
+            # path tests drive.
             return read_worker_reports(
                 self.client, into=self.worker_reports,
-                max_age_s=self.dead_after_s, now=now)
+                max_age_s=self.dead_after_s, now=now,
+                seen=self._report_seen if now is None else None)
         except Exception:
             return self.worker_reports
 
@@ -417,7 +869,7 @@ class Coordinator:
         real redis-py INFO lacks them, so depths fall back to LLEN over
         this coordinator's per-group queues and AOF size to redis's own
         ``aof_current_size`` — the gauges stay live either way."""
-        t_now = time.time() if now is None else now
+        t_now = time.monotonic() if now is None else now
         if t_now - self._last_info < self.cadence_s:
             return None
         if self.fleet is not None:
@@ -545,15 +997,33 @@ class Coordinator:
         _hub_gauges(gauges)
         return self.broker_info
 
+    def _publish(self, record: AssignmentRecord) -> None:
+        """Every record write goes through here: fenced with the lease
+        token when a lease is armed (the broker rejects a deposed
+        leader's write on the wire), plain SET otherwise. A -FENCED
+        rejection deposes this coordinator and raises
+        :class:`StaleLeader`."""
+        from avenir_tpu.stream.miniredis import FencedWrite
+        token = self.lease.token if self.lease is not None else None
+        try:
+            write_assignment(self.client, record, token=token)
+        except FencedWrite as exc:
+            self.fenced_rejections += 1
+            if self.lease is not None:
+                self.lease._depose()
+            raise StaleLeader(str(exc)) from exc
+
     def step(self, now: Optional[float] = None
              ) -> Optional[AssignmentRecord]:
         """Rewrite the assignment iff the alive membership differs from
         the serving membership. Returns the new record when one was
         written. With every known worker dead/removed the current record
         stands — groups must never be left ownerless (events queue up
-        for the next join instead)."""
-        t_now = time.time() if now is None else now
-        liveness = self._liveness(t_now)
+        for the next join instead). A lease-armed coordinator that does
+        not hold the lease never writes."""
+        if self.lease is not None and not self.lease.held:
+            return None
+        liveness = self._liveness(now)
         members = sorted(w for w, info in liveness.items()
                          if w not in self.removed and not info["dead"])
         if not members:
@@ -578,14 +1048,23 @@ class Coordinator:
                    if self.record.groups.get(g) not in (None, w)
                    and self.record.groups[g] in fresh]
         prev_routing = dict(self.record.routing)
+        prev_record = self.record
         self.record = AssignmentRecord(
             self.record.epoch + 1, assign, handoff=handoff,
             members=members,
             brokers=(self.fleet.endpoint_strings()
                      if self.fleet is not None else []),
-            routing=dict(self.routing))
+            routing=dict(self.routing),
+            control=(self.fleet.control_shard
+                     if self.fleet is not None else 0))
         self._force_write = False
-        write_assignment(self.client, self.record)
+        try:
+            self._publish(self.record)
+        except StaleLeader:
+            # deposed mid-step: the broker kept the newer leader's
+            # record; this instance reverts and stops publishing
+            self.record = prev_record
+            return None
         if self.fleet is not None and prev_routing:
             # routing changed under this epoch: migrate each moved
             # group's key family old shard -> new shard, strictly AFTER
@@ -614,8 +1093,9 @@ class Coordinator:
             self.record.epoch + 1, dict(self.record.groups),
             handoff=[], members=list(self.record.members), stop=True,
             brokers=list(self.record.brokers),
-            routing=dict(self.record.routing))
-        write_assignment(self.client, self.record)
+            routing=dict(self.record.routing),
+            control=self.record.control)
+        self._publish(self.record)
         return self.record
 
 
@@ -651,11 +1131,20 @@ class WorkerRebalancer:
                  handoff_wait_s: float = HANDOFF_WAIT_S,
                  client_for_group: Optional[Callable[[str], Any]] = None,
                  on_record: Optional[Callable[[AssignmentRecord], None]]
-                 = None):
+                 = None,
+                 discover: Optional[
+                     Callable[[], Optional[AssignmentRecord]]] = None):
         self.client = client
         self.worker_id = int(worker_id)
         self.make_server = make_server
         self.registry = registry
+        # control-home loss fallback (ISSUE 13): when the record poll's
+        # transport fails, ``discover`` (a bounded scan over the other
+        # shards) supplies the newest record instead of the failure
+        # killing the serving loop; ``control_faults`` counts the
+        # degraded polls
+        self.discover = discover
+        self.control_faults = 0
         # broker-fleet seams (ISSUE 12): ``client`` stays the CONTROL
         # client (assignment record home); ``client_for_group`` resolves
         # the shard client a group's queues live on — the acquire-time
@@ -694,7 +1183,15 @@ class WorkerRebalancer:
             if now - self._last_poll < self.min_poll_interval_s:
                 return False
             self._last_poll = now
-        rec = read_assignment(self.client)
+        try:
+            rec = read_assignment(self.client)
+        except (ConnectionError, OSError):
+            # control home unreachable: a record poll must degrade, not
+            # take the serving loop down — fall back to the bounded
+            # scan (when armed), which also finds a re-homed control
+            # plane by its higher epoch
+            self.control_faults += 1
+            rec = self.discover() if self.discover is not None else None
         if rec is None or rec.epoch <= self.epoch:
             return False
         self.epoch = rec.epoch

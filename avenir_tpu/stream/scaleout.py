@@ -49,9 +49,11 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from avenir_tpu.stream.loop import (
     OnlineLearnerLoop, RedisQueues, reclaim_pending)
@@ -124,7 +126,9 @@ def report_max_age_s(cadence_s: float) -> float:
 
 def read_worker_reports(client, into: Optional[Dict[int, Dict]] = None,
                         max_age_s: Optional[float] = None,
-                        now: Optional[float] = None) -> Dict[int, Dict]:
+                        now: Optional[float] = None,
+                        seen: Optional[Dict[int, float]] = None
+                        ) -> Dict[int, Dict]:
     """Drain the telemetry queue (driver side): the LATEST report per
     worker wins — interim cadence pushes are superseded snapshots of the
     same monotone histograms, not increments to sum.
@@ -135,21 +139,36 @@ def read_worker_reports(client, into: Optional[Dict[int, Dict]] = None,
     straggler-detection p99) haunts every later fleet merge forever.
     Staleness keys on the report's own ``meta.generated_at`` (the hub
     stamps it at snapshot time), bar = 3x heartbeat cadence via
-    :func:`report_max_age_s`."""
+    :func:`report_max_age_s` — unless ``seen`` (a caller-owned
+    worker -> monotonic-receipt-time dict, updated here) is supplied,
+    in which case aging uses RECEIPT time on this process's monotonic
+    clock: cross-process wall stamps (and NTP steps on either side)
+    then can't age out a live fleet's reports (ISSUE 13 satellite)."""
     out: Dict[int, Dict] = {} if into is None else into
+    receipt_mono = time.monotonic()
     while True:
         raw = client.rpop(TELEMETRY_QUEUE)
         if raw is None:
             break
         entry = json.loads(raw.decode())
-        out[int(entry["worker"])] = entry["report"]
+        worker = int(entry["worker"])
+        out[worker] = entry["report"]
+        if seen is not None:
+            seen[worker] = receipt_mono
     if max_age_s is not None:
-        t_now = time.time() if now is None else now
-        for worker in list(out):
-            generated = (out[worker].get("meta") or {}).get(
-                "generated_at") or 0.0
-            if t_now - float(generated) > max_age_s:
-                del out[worker]
+        if seen is not None:
+            t_now = time.monotonic()
+            for worker in list(out):
+                if t_now - seen.get(worker, 0.0) > max_age_s:
+                    del out[worker]
+                    seen.pop(worker, None)
+        else:
+            t_now = time.time() if now is None else now
+            for worker in list(out):
+                generated = (out[worker].get("meta") or {}).get(
+                    "generated_at") or 0.0
+                if t_now - float(generated) > max_age_s:
+                    del out[worker]
     return out
 
 
@@ -174,6 +193,179 @@ def read_heartbeats(client) -> List[Dict]:
         if raw is None:
             return out
         out.append(json.loads(raw.decode()))
+
+
+class HeartbeatBuffer:
+    """Liveness-I/O decoupler (ISSUE 13 satellite): a drop-in ``lpush``/
+    ``lrem`` target for :func:`push_heartbeat` & friends that can never
+    raise into — or stall — the serving loop.
+
+    Every push lands in a bounded in-memory queue (drop-oldest; each
+    eviction counts into the ``heartbeat.dropped`` gauge) and a daemon
+    flusher ships it to the CURRENT control endpoint over its own
+    short-timeout client. During a broker outage the serving thread
+    keeps batching at full speed while heartbeats/telemetry/trace
+    stamps accumulate here; when the broker (or its failover
+    replacement — ``endpoint_fn`` re-resolves per dial, so a control
+    re-home redirects the flush) comes back, the backlog flushes in
+    order. The flusher never shares the serving path's client: a
+    blocking redial inside a shared client's lock was exactly the
+    stall this class exists to remove."""
+
+    def __init__(self, endpoint_fn: Callable[[], Tuple[str, int]],
+                 maxlen: int = 1024, retry_s: float = 0.5,
+                 timeout_s: float = 2.0):
+        self._endpoint_fn = endpoint_fn
+        self._maxlen = max(int(maxlen), 1)
+        self._retry_s = float(retry_s)
+        self._timeout_s = float(timeout_s)
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stopping = False
+        self._client: Optional[MiniRedisClient] = None
+        self._probe: Optional[MiniRedisClient] = None
+        self.dropped = 0
+        self.flushed = 0
+        self.failures = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="heartbeat-flush")
+        self._thread.start()
+
+    # -- the client-shaped surface push_heartbeat drives -------------------
+
+    def lpush(self, key, *values) -> int:
+        # one queued op per CALL, not per value: a multi-value push
+        # (push_stamps ships a whole trace-stamp batch in one lpush)
+        # stays one broker round trip and one eviction unit
+        self._enqueue([("lpush", key, tuple(values))])
+        return 0
+
+    def lrem(self, key, count, value) -> int:
+        # report supersede rides the same ordered queue; an evicted or
+        # failed lrem just leaves an extra stale report for the
+        # driver's latest-wins drain
+        self._enqueue([("lrem", key, count, value)])
+        return 0
+
+    def llen(self, key) -> int:
+        """Synchronous passthrough for the tracing layer's
+        TRACE_QUEUE_MAX backpressure probe (one call per heartbeat
+        cadence, the pre-buffer cost). Runs on the CALLER's own lazy
+        short-timeout client — never the flusher's (cross-thread) —
+        and raises on an unreachable broker, which push_stamps already
+        treats as skip-this-flush."""
+        if self._probe is None:
+            host, port = self._endpoint_fn()
+            self._probe = MiniRedisClient(host, port,
+                                          timeout=self._timeout_s)
+        try:
+            return int(self._probe.llen(key))
+        except (ConnectionError, OSError):
+            self._probe.close()
+            self._probe = None
+            raise
+
+    def _enqueue(self, ops: List[tuple]) -> None:
+        with self._lock:
+            for op in ops:
+                if len(self._q) >= self._maxlen:
+                    self._q.popleft()          # drop-oldest, counted
+                    self.dropped += 1
+                self._q.append(op)
+        if self.dropped:
+            _hub_gauges_safe({"heartbeat.dropped": float(self.dropped)})
+        self._wake.set()
+
+    # -- the flusher -------------------------------------------------------
+
+    def _dial(self) -> Optional[MiniRedisClient]:
+        if self._client is not None:
+            return self._client
+        try:
+            host, port = self._endpoint_fn()
+            self._client = MiniRedisClient(host, port,
+                                           timeout=self._timeout_s)
+        except (ConnectionError, OSError):
+            self._client = None
+        return self._client
+
+    def _drop_client(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def rebind(self) -> None:
+        """Force the next flush to re-resolve the endpoint (control
+        re-home adopted): drop the dialed clients."""
+        self._drop_client()
+        if self._probe is not None:
+            self._probe.close()
+            self._probe = None
+        self._wake.set()
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait(timeout=self._retry_s)
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    if not self._q:
+                        break
+                    op = self._q[0]
+                client = self._dial()
+                if client is None:
+                    self.failures += 1
+                    break                     # retry after retry_s
+                try:
+                    if op[0] == "lpush":
+                        client.lpush(op[1], *op[2])
+                    else:
+                        client.lrem(op[1], op[2], op[3])
+                except (ConnectionError, OSError):
+                    self.failures += 1
+                    self._drop_client()
+                    break
+                with self._lock:
+                    # pop the op we just shipped — unless eviction
+                    # already rotated it out under load
+                    if self._q and self._q[0] is op:
+                        self._q.popleft()
+                self.flushed += 1
+            if self._stopping:
+                with self._lock:
+                    empty = not self._q
+                if empty or self._client is None:
+                    return
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def close(self, flush_timeout_s: float = 5.0) -> None:
+        """Drain what the broker will accept, then stop the flusher —
+        the worker-exit path (the FINAL heartbeat must land before the
+        driver reads the stream)."""
+        deadline = time.monotonic() + float(flush_timeout_s)
+        while self.pending() and time.monotonic() < deadline:
+            self._wake.set()
+            time.sleep(0.01)
+        self._stopping = True
+        self._wake.set()
+        self._thread.join(timeout=2.0)
+        self._drop_client()
+        if self._probe is not None:
+            self._probe.close()
+            self._probe = None
+
+
+def _hub_gauges_safe(gauges: Dict) -> None:
+    """set_hub_gauges_if_live without making obs a hard import here."""
+    try:
+        from avenir_tpu.obs.exporters import set_hub_gauges_if_live
+        set_hub_gauges_if_live(gauges)
+    except Exception:
+        pass
 
 
 def worker_throughput(heartbeats: Sequence[Dict]) -> Dict[int, float]:
@@ -537,6 +729,59 @@ def _close_transport(client, fleet) -> int:
     return reconnects
 
 
+class _ControlPoller:
+    """Control-plane READS on a dedicated short-deadline client
+    (ISSUE 13). The record poll sits inline in the serving loop;
+    reading through the data plane's reconnect-armed client would
+    stall every owned group — healthy shards included — for the full
+    30s redial deadline before the scan fallback could even start. A
+    dead control home must surface in ~``timeout_s``. Duck-types
+    ``get`` (all a record read needs) and follows the fleet's
+    control shard/endpoint automatically, so a control re-home needs
+    no rebind call."""
+
+    def __init__(self, fleet, timeout_s: float = 2.0):
+        self._fleet = fleet
+        self._timeout = float(timeout_s)
+        self._client: Optional[MiniRedisClient] = None
+        self._bound: Optional[tuple] = None   # (shard, endpoint) dialed
+
+    def get(self, key):
+        shard = self._fleet.control_shard
+        want = (shard, self._fleet.endpoints[shard])
+        if self._client is None or self._bound != want:
+            self.close()
+            host, port = want[1]
+            self._client = MiniRedisClient(host, port,
+                                           timeout=self._timeout)
+            self._bound = want
+        try:
+            return self._client.get(key)
+        except (ConnectionError, OSError):
+            self.close()
+            raise
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+            self._bound = None
+
+
+def _heartbeat_buffer(client, fleet, host: str,
+                      port: int) -> HeartbeatBuffer:
+    """The liveness-I/O buffer every worker main pushes heartbeats/
+    telemetry/trace stamps through: endpoint re-resolved per dial, so a
+    control re-home (fleet.control_shard adopted from a record)
+    redirects buffered flushes without a rebind call site."""
+    if fleet is not None:
+        return HeartbeatBuffer(
+            lambda: fleet.endpoints[fleet.control_shard])
+    endpoint = (getattr(client, "host", host),
+                getattr(client, "port", port))
+    return HeartbeatBuffer(lambda: endpoint)
+
+
 def _lifecycle_client(lifecycle_dir: Optional[str]):
     """Registry subscription for a worker process (ISSUE 7): polled on
     the heartbeat-ish cadence, swapping every owned group's learner when
@@ -592,12 +837,14 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
             replayed += reclaim_pending(
                 group_client(g), f"pendingQueue:{g}", f"eventQueue:{g}")
     lc = _lifecycle_client(lifecycle_dir)
+    hb = _heartbeat_buffer(client, fleet, host, port)
     if engine:
         return _worker_main_engine(client, worker_id, n_workers, groups,
                                    learner_type, actions, config, seed,
                                    replayed, decision_io_ms,
                                    event_timestamps, lc,
-                                   group_client=group_client, fleet=fleet)
+                                   group_client=group_client, fleet=fleet,
+                                   hb=hb)
     loops = {}
     for g in owned_groups(groups, worker_id, n_workers):
         # per-group seed component: each group's learner must explore
@@ -614,7 +861,7 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
     active = set(loops)
     idle_sleep = 0.001
     served_total = 0
-    push_heartbeat(client, worker_id, 0, 0)  # alive, loops constructed
+    push_heartbeat(hb, worker_id, 0, 0)  # alive, loops constructed
     while active:
         if lc is not None:
             lc.poll_and_swap()   # throttled to the heartbeat-ish cadence
@@ -640,7 +887,7 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
                 served_total += 1
                 if served_total % HEARTBEAT_EVERY == 0:
                     push_heartbeat(
-                        client, worker_id, served_total,
+                        hb, worker_id, served_total,
                         sum(l.stats.rewards for l in loops.values()))
                 if decision_io_ms > 0:
                     time.sleep(decision_io_ms / 1e3)
@@ -654,7 +901,8 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
             idle_sleep = min(idle_sleep * 2, 0.016)
     events_total = sum(l.stats.events for l in loops.values())
     rewards_total = sum(l.stats.rewards for l in loops.values())
-    push_heartbeat(client, worker_id, events_total, rewards_total)  # final
+    push_heartbeat(hb, worker_id, events_total, rewards_total)  # final
+    hb.close()
     reconnects = _close_transport(client, fleet)
     return {
         "worker": worker_id,
@@ -662,6 +910,7 @@ def worker_main(host: str, port: int, worker_id: int, n_workers: int,
         "rewards": rewards_total,
         "replayed": replayed,
         "groups": sorted(loops),
+        "heartbeats_dropped": hb.dropped,
         "broker_reconnects": reconnects,
     }
 
@@ -671,7 +920,8 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
                         actions: Sequence[str], config: Dict, seed: int,
                         replayed: int, decision_io_ms: float,
                         event_timestamps: bool = False,
-                        lc=None, group_client=None, fleet=None) -> Dict:
+                        lc=None, group_client=None, fleet=None,
+                        hb=None) -> Dict:
     """Engine-mode worker body: one pipelined ``ServingEngine`` per owned
     group over the same stoppable per-group queues. Each visit drains the
     group's current backlog in one ``run()`` (pipelined micro-batches);
@@ -680,13 +930,15 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
     from avenir_tpu.stream.engine import ServingEngine
     progress = {"served": 0, "hb_mark": 0}
     engines: Dict[str, ServingEngine] = {}
+    if hb is None:
+        hb = _heartbeat_buffer(client, fleet, client.host, client.port)
 
     def on_batch(n_events: int) -> None:
         progress["served"] += n_events
         if (progress["served"] - progress["hb_mark"]) >= HEARTBEAT_EVERY:
             progress["hb_mark"] = progress["served"]
             push_heartbeat(
-                client, worker_id, progress["served"],
+                hb, worker_id, progress["served"],
                 sum(e.stats.rewards for e in engines.values()))
         if decision_io_ms > 0:
             time.sleep(decision_io_ms * n_events / 1e3)
@@ -716,7 +968,7 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
             "events": progress["served"]})
     active = set(engines)
     idle_sleep = 0.001
-    push_heartbeat(client, worker_id, 0, 0)  # alive, engines constructed
+    push_heartbeat(hb, worker_id, 0, 0)  # alive, engines constructed
     while active:
         if lc is not None:
             # between run() calls every engine is at a batch boundary;
@@ -738,7 +990,8 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
             idle_sleep = min(idle_sleep * 2, 0.016)
     events_total = sum(e.stats.events for e in engines.values())
     rewards_total = sum(e.stats.rewards for e in engines.values())
-    push_heartbeat(client, worker_id, events_total, rewards_total)  # final
+    push_heartbeat(hb, worker_id, events_total, rewards_total)  # final
+    hb.close()
     reconnects = _close_transport(client, fleet)
     return {
         "worker": worker_id,
@@ -747,6 +1000,7 @@ def _worker_main_engine(client, worker_id: int, n_workers: int,
         "replayed": replayed,
         "groups": sorted(engines),
         "engine": True,
+        "heartbeats_dropped": hb.dropped,
         "broker_reconnects": reconnects,
     }
 
@@ -810,9 +1064,17 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
     def on_record(rec) -> None:
         # routing refresh BEFORE the epoch's release/acquire deltas:
         # acquired groups must bind (and reclaim their ledgers) on the
-        # shard THIS epoch routes them to
+        # shard THIS epoch routes them to. adopt_record also re-points
+        # the control home when the record says it moved (control-shard
+        # failover, ISSUE 13) — the rebalancer and heartbeat flusher
+        # follow it below.
         if fleet is not None and rec.brokers:
-            fleet.ensure_endpoints(rec.brokers)
+            before = fleet.control_shard
+            fleet.adopt_record(rec)
+            if fleet.control_shard != before:
+                # the record poller follows the fleet's control shard
+                # by itself; only the heartbeat flusher needs a nudge
+                hb.rebind()
         if rec.routing:
             routing_box["routing"] = dict(rec.routing)
     # warm jax's shared dispatch/lowering infrastructure BEFORE the join
@@ -841,6 +1103,7 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
         install_state(scratch, warm.state)
     progress = {"served": 0, "hb_mark": 0}
     rb_box: Dict[str, WorkerRebalancer] = {}
+    hb = _heartbeat_buffer(client, fleet, host, port)
 
     def rewards_total() -> int:
         return sum(e.stats.rewards for e in rb_box["rb"].all_servers())
@@ -849,7 +1112,7 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
         progress["served"] += n_events
         if (progress["served"] - progress["hb_mark"]) >= HEARTBEAT_EVERY:
             progress["hb_mark"] = progress["served"]
-            push_heartbeat(client, worker_id, progress["served"],
+            push_heartbeat(hb, worker_id, progress["served"],
                            rewards_total(), "elastic")
 
     # group -> (shard id, endpoint) its queue view is bound to: the
@@ -898,11 +1161,21 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
             server.queues = new_q
             bindings[g] = want
 
-    rb = WorkerRebalancer(client, worker_id, make_server,
+    discover = None
+    rb_client = client
+    if fleet is not None:
+        from avenir_tpu.stream.rebalance import discover_assignment
+        discover = (lambda: discover_assignment(
+            fleet, exclude=(fleet.control_shard,)))
+        # record polls ride a short-deadline client: a dead control
+        # home must degrade to the scan in ~2s, never stall serving
+        # for the data plane's 30s redial deadline
+        rb_client = _ControlPoller(fleet)
+    rb = WorkerRebalancer(rb_client, worker_id, make_server,
                           registry=registry,
                           min_poll_interval_s=min(cadence_s / 2, 0.25),
                           client_for_group=group_client,
-                          on_record=on_record)
+                          on_record=on_record, discover=discover)
     rb_box["rb"] = rb
     # live health (ISSUE 11): an elastic worker's /healthz reports its
     # current epoch + owned groups — the ownership view an operator
@@ -920,7 +1193,7 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
             "groups": list(rb.owned_view),
             "stop": rb.stop,
             "events": progress["served"]})
-    push_heartbeat(client, worker_id, 0, 0, "elastic")   # the JOIN signal
+    push_heartbeat(hb, worker_id, 0, 0, "elastic")   # the JOIN signal
     last_hb = time.monotonic()
     idle_sleep = 0.001
     while True:
@@ -950,7 +1223,7 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
                     rb.retire(g)
         now_m = time.monotonic()
         if now_m - last_hb >= cadence_s:
-            push_heartbeat(client, worker_id, progress["served"],
+            push_heartbeat(hb, worker_id, progress["served"],
                            rewards_total(), "elastic")
             last_hb = now_m
         if progressed:
@@ -961,7 +1234,10 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
     servers = rb.all_servers()
     events_total = sum(e.stats.events for e in servers)
     rewards = sum(e.stats.rewards for e in servers)
-    push_heartbeat(client, worker_id, events_total, rewards, "elastic")
+    push_heartbeat(hb, worker_id, events_total, rewards, "elastic")
+    hb.close()
+    if isinstance(rb_client, _ControlPoller):
+        rb_client.close()
     reconnects = _close_transport(client, fleet)
     return {
         "worker": worker_id,
@@ -974,6 +1250,8 @@ def elastic_worker_main(host: str, port: int, worker_id: int,
         "epochs": rb.epoch,
         "released": rb.released,
         "acquired": rb.acquired,
+        "control_faults": rb.control_faults,
+        "heartbeats_dropped": hb.dropped,
         "handoff_swap_ms": [round(x, 3) for x in rb.handoff_swap_ms],
         "handoff_wait_ms": [round(x, 3) for x in rb.handoff_wait_ms],
         "broker_reconnects": reconnects,
@@ -1002,11 +1280,14 @@ def fleet_worker_main(brokers: str, worker_id: int, learner_type: str,
     its queues drained — the concurrent-owner sentinel guard)."""
     from avenir_tpu.stream.engine import GroupedServingEngine
     from avenir_tpu.stream.fleet import BrokerFleet, ShardedQueues
-    from avenir_tpu.stream.rebalance import read_assignment
+    from avenir_tpu.stream.rebalance import (discover_assignment,
+                                             read_assignment)
     fleet = BrokerFleet(brokers, reconnect=True, reconnect_timeout=30.0)
-    control = fleet.control
+    hb = _heartbeat_buffer(None, fleet, "", 0)
+    poller = _ControlPoller(fleet)
     progress = {"served": 0, "hb_mark": 0}
     totals = {"events": 0, "rewards": 0, "batches": 0}
+    control_faults = 0
     engine = None
     queues = None
     binding = None
@@ -1021,7 +1302,7 @@ def fleet_worker_main(brokers: str, worker_id: int, learner_type: str,
         progress["served"] += n_events
         if (progress["served"] - progress["hb_mark"]) >= HEARTBEAT_EVERY:
             progress["hb_mark"] = progress["served"]
-            push_heartbeat(control, worker_id, progress["served"],
+            push_heartbeat(hb, worker_id, progress["served"],
                            rewards_now(), "fleet")
 
     def fold_engine() -> None:
@@ -1034,7 +1315,7 @@ def fleet_worker_main(brokers: str, worker_id: int, learner_type: str,
         queues.close()
         engine = queues = None
 
-    push_heartbeat(control, worker_id, 0, 0, "fleet")   # the JOIN signal
+    push_heartbeat(hb, worker_id, 0, 0, "fleet")   # the JOIN signal
     last_hb = time.monotonic()
     last_poll = 0.0
     idle_sleep = 0.001
@@ -1042,12 +1323,25 @@ def fleet_worker_main(brokers: str, worker_id: int, learner_type: str,
         now_m = time.monotonic()
         if now_m - last_poll >= min(cadence_s / 2, 0.25):
             last_poll = now_m
-            rec = read_assignment(control)
+            try:
+                rec = read_assignment(poller)
+            except (ConnectionError, OSError):
+                # control home dark (ISSUE 13): the poll degrades to a
+                # bounded scan of the OTHER shards — a re-homed control
+                # plane announces itself there with a higher epoch —
+                # and must never kill (or 30s-stall) the serving loop;
+                # the poller's own ~2s deadline is the detection clock
+                control_faults += 1
+                rec = discover_assignment(
+                    fleet, exclude=(fleet.control_shard,))
             if rec is not None and rec.epoch > epoch:
                 epoch = rec.epoch
                 stop = rec.stop
                 if rec.brokers:
-                    fleet.ensure_endpoints(rec.brokers)
+                    before = fleet.control_shard
+                    fleet.adopt_record(rec)
+                    if fleet.control_shard != before:
+                        hb.rebind()    # heartbeats follow the control home
                 owned = rec.owned_by(worker_id)
                 # the binding key covers the broker LIST too: an
                 # in-place endpoint replacement (same shard id, new
@@ -1105,7 +1399,7 @@ def fleet_worker_main(brokers: str, worker_id: int, learner_type: str,
             # a handoff overlap and the queues are drained — retire
             break
         if now_m - last_hb >= cadence_s:
-            push_heartbeat(control, worker_id, progress["served"],
+            push_heartbeat(hb, worker_id, progress["served"],
                            rewards_now(), "fleet")
             last_hb = now_m
         if progressed:
@@ -1114,9 +1408,11 @@ def fleet_worker_main(brokers: str, worker_id: int, learner_type: str,
             time.sleep(idle_sleep)
             idle_sleep = min(idle_sleep * 2, 0.016)
     fold_engine()
-    push_heartbeat(control, worker_id, totals["events"],
+    push_heartbeat(hb, worker_id, totals["events"],
                    totals["rewards"], "fleet")
-    reconnects = _close_transport(control, fleet)
+    hb.close()
+    poller.close()
+    reconnects = _close_transport(None, fleet)
     return {
         "worker": worker_id,
         "events": totals["events"],
@@ -1126,8 +1422,130 @@ def fleet_worker_main(brokers: str, worker_id: int, learner_type: str,
         "fleet": True,
         "batches": totals["batches"],
         "epochs": epoch,
+        "control_faults": control_faults,
+        "heartbeats_dropped": hb.dropped,
         "broker_reconnects": reconnects,
     }
+
+
+# the driver flips this key on the control shard to end a
+# coordinator-subprocess run: the LEASE HOLDER reacts by writing the
+# stop record (fenced, like every record), followers exit on observing
+# ``stop`` — the driver itself never writes the record (it holds no
+# lease and must not bypass the fencing discipline)
+FLEET_STOP_KEY = "fleetStop"
+
+
+def coordinator_main(brokers: str, coordinator_id: str,
+                     groups: Sequence[str], cadence_s: float = 0.5,
+                     lease_s: float = 1.5,
+                     dead_after_factor: Optional[float] = None,
+                     reconnect_timeout: float = 2.0) -> Dict:
+    """A lease-armed coordinator as its own PROCESS (ISSUE 13): the
+    deployment shape where the control plane itself is a chaos target.
+    Run two of these and exactly one holds the lease and publishes;
+    SIGKILL the holder and the standby takes over within ~2 lease
+    periods (observer-side expiry + CAS), continuing the epoch sequence
+    behind the same fencing tokens. The short ``reconnect_timeout``
+    bounds control-shard death DETECTION — a coordinator that waits 30s
+    to notice its control shard died is 30s of frozen control plane.
+
+    Exits once the assignment record says ``stop``: the driver flips
+    :data:`FLEET_STOP_KEY`, the current holder converts that into the
+    fenced stop record, and followers observe it."""
+    from avenir_tpu.stream.fleet import BrokerFleet
+    from avenir_tpu.stream.rebalance import (
+        Coordinator, CoordinatorLease, StaleLeader, discover_assignment,
+        read_assignment)
+    fleet = BrokerFleet(brokers, reconnect=True,
+                        reconnect_timeout=reconnect_timeout)
+    lease = CoordinatorLease(fleet.control, coordinator_id,
+                             lease_s=lease_s)
+    coord = Coordinator(fleet.control, list(groups),
+                        cadence_s=cadence_s,
+                        dead_after_factor=dead_after_factor,
+                        fleet=fleet, lease=lease)
+    last_stop_scan = 0.0
+
+    def follow(rec) -> bool:
+        """Adopt a newer record's broker view (follower path — shared
+        by the healthy poll and the dark-control-home scan): re-point
+        the fleet, lease and coordinator at its control home; returns
+        whether it says stop."""
+        if rec is None:
+            return False
+        fleet.adopt_record(rec)
+        lease.client = fleet.control
+        coord.client = fleet.control
+        return rec.stop
+
+    def stop_flagged() -> bool:
+        """The driver's stop switch, control-failover-aware: the
+        CURRENT home answers every tick; the other shards are scanned
+        on a throttle — the driver may have flipped the key on a home
+        this leader has since re-homed away from, and a dead-shard
+        probe costs a redial deadline, so not every tick."""
+        nonlocal last_stop_scan
+        try:
+            if fleet.control.get(FLEET_STOP_KEY) is not None:
+                return True
+        except (ConnectionError, OSError):
+            pass
+        now_m = time.monotonic()
+        if now_m - last_stop_scan < 1.0:
+            return False
+        last_stop_scan = now_m
+        for shard in range(fleet.n_shards):
+            if shard == fleet.control_shard:
+                continue
+            try:
+                if fleet.client(shard).get(FLEET_STOP_KEY) is not None:
+                    return True
+            except (ConnectionError, OSError):
+                continue
+        return False
+
+    stopped = False
+    while not stopped:
+        coord.observe()
+        try:
+            if lease.held:
+                # lease/client may have re-homed inside observe()
+                if stop_flagged() and not coord.record.stop \
+                        and coord.record.epoch > 0:
+                    try:
+                        coord.stop_fleet()
+                    except StaleLeader:
+                        # a takeover landed between our tick and this
+                        # publish: the fence did its job — demote to
+                        # follower (the new holder will write the stop
+                        # record when IT sees the switch)
+                        pass
+                stopped = coord.record.stop
+            else:
+                rec = read_assignment(fleet.control)
+                if rec is None or rec.epoch < coord.record.epoch:
+                    rec = coord.record
+                stopped = follow(rec)
+        except (ConnectionError, OSError):
+            # follower with a dark control home: scan for the re-homed
+            # record (the leader's failover publishes it elsewhere)
+            stopped = follow(discover_assignment(
+                fleet, exclude=(fleet.control_shard,)))
+        time.sleep(max(cadence_s / 4, 0.05))
+    stats = {
+        "coordinator": coordinator_id,
+        "held": lease.held,
+        "token": lease.token,
+        "acquisitions": lease.acquisitions,
+        "renewals": lease.renewals,
+        "losses": lease.losses,
+        "epochs": coord.record.epoch,
+        "fenced_rejections": coord.fenced_rejections,
+        "control_failovers": coord.control_failovers,
+    }
+    fleet.close()
+    return stats
 
 
 @dataclass
@@ -1204,8 +1622,12 @@ def _spawn_worker(host: str, port: int, worker_id: int, n_workers: int,
                   obs_slo_ms: Optional[float] = None,
                   trace: bool = False,
                   brokers: Optional[str] = None,
-                  fleet_engine: bool = False) -> subprocess.Popen:
+                  fleet_engine: bool = False,
+                  extra_env: Optional[Dict[str, str]] = None
+                  ) -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if extra_env:
+        env.update(extra_env)
     cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout", "--worker",
            "--host", host, "--port", str(port),
            "--worker-id", str(worker_id),
@@ -1397,6 +1819,12 @@ def run_scaleout(n_workers: int, *, n_groups: int = 8, n_actions: int = 4,
     their producer/broker-pop/dispatch/resolve/reward-fold stamps over
     the broker on the heartbeat cadence, and the merged Chrome-trace
     JSON (Perfetto-viewable) lands at that path."""
+    _require(n_workers >= 1, f"need >= 1 worker, got {n_workers}")
+    _require(n_groups >= 1, f"need >= 1 group, got {n_groups}")
+    _require(throughput_events >= 0 and paced_events >= 0,
+             "event counts must be non-negative")
+    _require(paced_rate > 0, f"paced_rate must be positive, "
+                             f"got {paced_rate}")
     if engine and grouping == "shuffle":
         raise ValueError("engine workers support fields grouping only")
     if trace_out:
@@ -1599,6 +2027,11 @@ def run_chaos(n_workers: int = 2, *, n_groups: int = 4, n_actions: int = 4,
     still exactly-once after dedup."""
     import numpy as np
     import signal as _signal
+    _require(n_workers >= 1, f"need >= 1 worker, got {n_workers}")
+    _require(n_groups >= 1, f"need >= 1 group, got {n_groups}")
+    _require(0 < kill_after < n_events,
+             f"kill_after={kill_after} must fire inside the stream "
+             f"(0 < kill_after < n_events={n_events})")
     rng = np.random.default_rng(seed)
     groups = [f"g{i}" for i in range(n_groups)]
     actions = [f"a{i}" for i in range(n_actions)]
@@ -1715,6 +2148,9 @@ def run_rebalance(*, n_groups: int = 6, n_actions: int = 4,
     import tempfile
     import numpy as np
     from avenir_tpu.stream.rebalance import Coordinator
+    _require(n_groups >= 1, f"need >= 1 group, got {n_groups}")
+    _require(n_events >= 8, f"the leave/join/hold marks need >= 8 "
+                            f"events, got {n_events}")
     rng = np.random.default_rng(seed)
     groups = [f"g{i}" for i in range(n_groups)]
     actions = [f"a{i}" for i in range(n_actions)]
@@ -1870,6 +2306,11 @@ def run_broker_chaos(n_workers: int = 2, *, n_groups: int = 4,
     import signal as _signal
     import tempfile
     import numpy as np
+    _require(n_workers >= 1, f"need >= 1 worker, got {n_workers}")
+    _require(n_groups >= 1, f"need >= 1 group, got {n_groups}")
+    _require(0 < kill_at < n_events,
+             f"kill_at={kill_at} must fire inside the stream "
+             f"(0 < kill_at < n_events={n_events})")
     rng = np.random.default_rng(seed)
     groups = [f"g{i}" for i in range(n_groups)]
     actions = [f"a{i}" for i in range(n_actions)]
@@ -2178,6 +2619,10 @@ def run_fleet(n_workers: int = 2, n_brokers: int = 2, *,
     import numpy as np
     from avenir_tpu.stream.fleet import consistent_route
     import tempfile
+    _require(n_workers >= 1, f"need >= 1 worker, got {n_workers}")
+    _require(n_brokers >= 1, f"need >= 1 broker, got {n_brokers}")
+    _require(n_groups >= 1, f"need >= 1 group, got {n_groups}")
+    _require(n_events >= 1, f"need >= 1 event, got {n_events}")
     rng = np.random.default_rng(seed)
     groups = [f"g{i}" for i in range(n_groups)]
     actions = [f"a{i}" for i in range(n_actions)]
@@ -2328,11 +2773,14 @@ def run_fleet_chaos(n_workers: int = 2, n_brokers: int = 2, *,
     ctr = {g: {a: (0.8 if i == int(rng.integers(n_actions)) else 0.15)
                for i, a in enumerate(actions)} for g in groups}
     config = {"current.decision.round": 1, "batch.size": 1}
-    if n_brokers < 2:
-        raise ValueError(
-            "run_fleet_chaos needs >= 2 brokers: the victim shard must "
-            "not be the control shard (shard 0 carries the assignment "
-            "record and heartbeats)")
+    _require(n_brokers >= 2,
+             "run_fleet_chaos needs >= 2 brokers: the victim shard "
+             "must not be the control shard (it carries the assignment "
+             "record and heartbeats)")
+    _require(n_workers >= 1, f"need >= 1 worker, got {n_workers}")
+    _require(0 < kill_at < n_events,
+             f"kill_at={kill_at} must fire inside the stream "
+             f"(0 < kill_at < n_events={n_events})")
     victim = n_brokers - 1             # never the control shard
     procs: List[subprocess.Popen] = []
     with tempfile.TemporaryDirectory() as tmp:
@@ -2435,6 +2883,9 @@ def run_fleet_rebalance(*, n_groups: int = 6, n_actions: int = 4,
     import numpy as np
     from avenir_tpu.stream.fleet import BrokerFleet
     from avenir_tpu.stream.rebalance import Coordinator, HANDOFF_KIND
+    _require(n_groups >= 1, f"need >= 1 group, got {n_groups}")
+    _require(n_events >= 2, f"the flip mark needs >= 2 events, "
+                            f"got {n_events}")
     rng = np.random.default_rng(seed)
     groups = [f"g{i}" for i in range(n_groups)]
     actions = [f"a{i}" for i in range(n_actions)]
@@ -2561,6 +3012,693 @@ def run_fleet_rebalance(*, n_groups: int = 6, n_actions: int = 4,
                 p.kill()
 
 
+# --------------------------------------------------------------------------
+# control-plane chaos harnesses (ISSUE 13 — chaos harness v3)
+# --------------------------------------------------------------------------
+
+def _require(cond: bool, msg: str) -> None:
+    """Harness precondition (ISSUE 13 satellite): a topology that cannot
+    support the scenario fails in microseconds with a clear ValueError,
+    never minutes later with a stall, an IndexError mid-chaos, or a
+    kill mark that silently never fires."""
+    if not cond:
+        raise ValueError(msg)
+
+
+def _spawn_coordinator(brokers_spec: str, coordinator_id: str,
+                       groups: Sequence[str], cadence_s: float,
+                       lease_s: float,
+                       dead_after_factor: Optional[float] = None
+                       ) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "avenir_tpu.stream.scaleout",
+           "--coordinator", "--brokers", brokers_spec,
+           "--coordinator-id", coordinator_id,
+           "--groups", ",".join(groups),
+           "--cadence-s", str(cadence_s), "--lease-s", str(lease_s)]
+    if dead_after_factor is not None:
+        cmd += ["--dead-after-factor", str(dead_after_factor)]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _read_lease(client):
+    from avenir_tpu.stream.rebalance import LEASE_KEY, LeaseRecord
+    raw = client.get(LEASE_KEY)
+    return None if raw is None else LeaseRecord.from_json(raw)
+
+
+class _EpochWatch:
+    """Driver-side epoch-monotonicity witness: fold in every record
+    observation; ``monotone`` stays True iff epochs never went
+    backwards — the invariant every chaos scenario gates."""
+
+    def __init__(self):
+        self.epochs: List[int] = []
+        self.monotone = True
+
+    def note(self, rec) -> None:
+        if rec is None:
+            return
+        if self.epochs and rec.epoch < self.epochs[-1]:
+            self.monotone = False
+        if not self.epochs or rec.epoch != self.epochs[-1]:
+            self.epochs.append(rec.epoch)
+
+
+@dataclass
+class CoordinatorChaosResult:
+    n_events: int
+    unique_answered: int
+    duplicates: int
+    killed_leader: str                # lease holder id that was SIGKILLed
+    killed_at: int                    # unique answers at the kill
+    takeover_s: float                 # SIGKILL -> standby holds the lease
+    lease_s: float
+    old_token: int
+    new_token: int
+    epochs_monotone: bool
+    final_epoch: int
+    joined_after_kill: bool           # the mid-rebalance join completed
+    pending_left: int
+    worker_stats: List[Dict] = field(default_factory=list)
+    coordinator_stats: List[Dict] = field(default_factory=list)
+
+
+def run_coordinator_chaos(n_workers: int = 2, n_brokers: int = 2, *,
+                          n_groups: int = 4, n_actions: int = 4,
+                          n_events: int = 160, kill_at: int = 40,
+                          lease_s: float = 1.0, cadence_s: float = 0.3,
+                          learner_type: str = "softMax", seed: int = 23,
+                          host: str = "localhost",
+                          timeout_s: float = 300.0
+                          ) -> CoordinatorChaosResult:
+    """Coordinator SIGKILL mid-rebalance with standby takeover (chaos
+    harness v3, scenario 1). Two lease-armed coordinator PROCESSES run
+    against the fleet; the driver kills whichever one holds the lease —
+    immediately after spawning a brand-new worker, so a JOIN is
+    in flight when the control plane dies. The standby must claim the
+    lease within 2 lease periods (observer-monotonic expiry + CAS),
+    continue the epoch sequence under a strictly larger fencing token,
+    complete the pending join, and the stream must finish exactly-once
+    after dedup with every ledger retired."""
+    import numpy as np
+    import signal as _signal
+    from avenir_tpu.stream.rebalance import read_assignment
+    _require(n_workers >= 1, f"need >= 1 worker, got {n_workers}")
+    _require(n_brokers >= 1, f"need >= 1 broker, got {n_brokers}")
+    _require(n_groups >= 1, f"need >= 1 group, got {n_groups}")
+    _require(0 < kill_at < n_events,
+             f"kill_at={kill_at} must fire inside the stream "
+             f"(0 < kill_at < n_events={n_events})")
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    ctr = {g: {a: (0.8 if i == int(rng.integers(n_actions)) else 0.15)
+               for i, a in enumerate(actions)} for g in groups}
+    config = {"current.decision.round": 1, "batch.size": 1}
+    coords: Dict[str, subprocess.Popen] = {}
+    workers: List[subprocess.Popen] = []
+    with _broker_fleet(host, n_brokers) as (fleet, endpoints, _bp, _sp):
+        spec = ",".join(endpoints)
+        watch = _EpochWatch()
+        try:
+            coords["A"] = _spawn_coordinator(spec, "A", groups,
+                                             cadence_s, lease_s)
+            coords["B"] = _spawn_coordinator(spec, "B", groups,
+                                             cadence_s, lease_s)
+            workers = [
+                _spawn_worker(host, 0, w, 0, groups, learner_type,
+                              actions, config, seed, brokers=spec,
+                              fleet_engine=True, cadence_s=cadence_s)
+                for w in range(n_workers)]
+            deadline = time.monotonic() + timeout_s
+            # the leader's first owned epoch (joins observed, routing
+            # published) is the traffic green light
+            while True:
+                _require_alive(coords, workers)
+                rec = read_assignment(fleet.control)
+                watch.note(rec)
+                if rec is not None and rec.epoch >= 1 and rec.routing \
+                        and rec.groups:
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError("no coordinator published an "
+                                       "owned epoch")
+                time.sleep(0.05)
+            routing = dict(rec.routing)
+            answered: set = set()
+            duplicates = 0
+            sent = 0
+            state = {"killed": None, "killed_at": -1, "t_kill": 0.0,
+                     "takeover_s": -1.0, "old_token": 0, "new_token": 0}
+            while len(answered) < n_events:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"coordinator chaos stalled: {len(answered)}/"
+                        f"{n_events} answered")
+                if sent < n_events:
+                    burst = min(16, n_events - sent)
+                    _fleet_push_events(fleet, routing, groups, sent,
+                                       burst)
+                    sent += burst
+                got = 0
+                reward_plan: Dict[int, List[Tuple[str, str]]] = {}
+                for s in range(fleet.n_shards):
+                    raws = fleet.client(s).rpop("actionQueue", 256)
+                    for raw in raws or []:
+                        event_id, _, action = raw.decode().partition(",")
+                        action = action.split(",")[0]
+                        got += 1
+                        if event_id in answered:
+                            duplicates += 1
+                            continue
+                        answered.add(event_id)
+                        g = event_id.partition(":")[0]
+                        reward = (1.0 if rng.random() < ctr[g][action]
+                                  else 0.0)
+                        reward_plan.setdefault(routing[g], []).append(
+                            (g, f"{action},{reward}"))
+                for shard, items in reward_plan.items():
+                    p = fleet.client(shard).pipeline()
+                    for g, payload in items:
+                        p.lpush(f"rewardQueue:{g}", payload)
+                    p.execute()
+                watch.note(read_assignment(fleet.control))
+                lease = _read_lease(fleet.control)
+                if state["killed"] is None \
+                        and len(answered) >= kill_at and lease is not None:
+                    # mid-rebalance: a brand-new worker joins...
+                    workers.append(_spawn_worker(
+                        host, 0, n_workers, 0, groups, learner_type,
+                        actions, config, seed + 991, brokers=spec,
+                        fleet_engine=True, cadence_s=cadence_s))
+                    # ...and the leader dies before it can finish the
+                    # epoch that admits it
+                    victim = coords[lease.holder]
+                    victim.send_signal(_signal.SIGKILL)
+                    victim.wait(timeout=30)
+                    state.update(killed=lease.holder,
+                                 killed_at=len(answered),
+                                 t_kill=time.monotonic(),
+                                 old_token=lease.token)
+                if state["killed"] is not None \
+                        and state["takeover_s"] < 0 and lease is not None \
+                        and lease.holder != state["killed"]:
+                    state["takeover_s"] = (time.monotonic()
+                                           - state["t_kill"])
+                    state["new_token"] = lease.token
+                if not got:
+                    time.sleep(0.01)
+            # wait for the standby to claim the lease AND admit the
+            # late joiner (the mid-rebalance join must complete under
+            # the NEW leader) — the drain can outrun the takeover, so
+            # the measurement continues here
+            while True:
+                lease = _read_lease(fleet.control)
+                if state["takeover_s"] < 0 and lease is not None \
+                        and lease.holder != state["killed"]:
+                    state["takeover_s"] = (time.monotonic()
+                                           - state["t_kill"])
+                    state["new_token"] = lease.token
+                rec = read_assignment(fleet.control)
+                watch.note(rec)
+                if rec is not None and n_workers in rec.members \
+                        and state["takeover_s"] >= 0:
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError("the post-takeover join never "
+                                       "landed")
+                time.sleep(0.05)
+            for g in groups:
+                fleet.client(routing[g]).lpush(f"eventQueue:{g}",
+                                               STOP_SENTINEL)
+            fleet.control.set(FLEET_STOP_KEY, "1")
+            coordinator_stats = []
+            survivor = "B" if state["killed"] == "A" else "A"
+            out, err = _collect_worker(coords[survivor], timeout=60)
+            if coords[survivor].returncode != 0:
+                raise RuntimeError(
+                    f"surviving coordinator failed: {err[-1500:]}")
+            coordinator_stats.append(json.loads(out.splitlines()[-1]))
+            worker_stats = []
+            for p in workers:
+                out, err = _collect_worker(p, timeout=120)
+                if p.returncode != 0:
+                    raise RuntimeError(f"worker failed: {err[-1500:]}")
+                worker_stats.append(json.loads(out.splitlines()[-1]))
+            final = read_assignment(fleet.control)
+            watch.note(final)
+            return CoordinatorChaosResult(
+                n_events=n_events, unique_answered=len(answered),
+                duplicates=duplicates,
+                killed_leader=state["killed"] or "",
+                killed_at=state["killed_at"],
+                takeover_s=state["takeover_s"], lease_s=lease_s,
+                old_token=state["old_token"],
+                new_token=state["new_token"],
+                epochs_monotone=watch.monotone,
+                final_epoch=final.epoch if final else -1,
+                joined_after_kill=(final is not None
+                                   and n_workers in final.members),
+                pending_left=_fleet_pending_left(fleet, routing, groups),
+                worker_stats=worker_stats,
+                coordinator_stats=coordinator_stats)
+        finally:
+            for p in list(coords.values()) + workers:
+                if p.poll() is None:
+                    p.kill()
+
+
+def _require_alive(coords: Dict[str, subprocess.Popen],
+                   workers: List[subprocess.Popen]) -> None:
+    """Fail fast when a subprocess died during bring-up — its stderr is
+    the diagnosis, not a later stall."""
+    for name, p in coords.items():
+        if p.poll() is not None:
+            _, err = p.communicate()
+            raise RuntimeError(f"coordinator {name} died during "
+                               f"bring-up: {(err or '')[-1500:]}")
+    for p in workers:
+        if p.poll() is not None:
+            _, err = p.communicate()
+            raise RuntimeError(f"worker died during bring-up: "
+                               f"{(err or '')[-1500:]}")
+
+
+@dataclass
+class PartitionFencingResult:
+    takeover_s: float
+    lease_s: float
+    old_token: int
+    new_token: int
+    fenced_rejections: int          # the stale leader's rejected writes
+    stale_write_rejected_on_wire: bool
+    epochs_monotone: bool
+    final_epoch: int
+    leader_deposed: bool
+
+
+def run_partition_fencing(*, lease_s: float = 0.4,
+                          cadence_s: float = 0.1,
+                          host: str = "localhost",
+                          timeout_s: float = 60.0
+                          ) -> PartitionFencingResult:
+    """Leader partitioned from the control shard while a standby claims
+    the lease (chaos harness v3, scenario 2). In-process and fast: the
+    partition is a scripted faultnet block on the leader's client only.
+    The standby takes over through observer-monotonic expiry + CAS;
+    when the partition heals, the old leader still locally believes it
+    holds the lease and re-publishes — and the broker rejects that
+    write ON THE WIRE (-FENCED, the fence floor the takeover bumped),
+    which is the split-brain guard this scenario exists to pin: no
+    reader ever depended on noticing the stale epoch."""
+    _require(lease_s > 0, f"lease_s must be positive, got {lease_s}")
+    from avenir_tpu.stream.faultnet import FaultNet
+    from avenir_tpu.stream.rebalance import (
+        Coordinator, CoordinatorLease, read_assignment)
+    groups = ["g0", "g1"]
+    watch = _EpochWatch()
+    with MiniRedisServer(host=host) as srv:
+        fn = FaultNet(0)
+        leader_c = MiniRedisClient(srv.host, srv.port, reconnect=True,
+                                   reconnect_timeout=0.3, faults=fn)
+        standby_c = MiniRedisClient(srv.host, srv.port)
+        driver = MiniRedisClient(srv.host, srv.port)
+        try:
+            leader = Coordinator(
+                leader_c, groups, cadence_s=cadence_s,
+                lease=CoordinatorLease(leader_c, "L", lease_s=lease_s))
+            standby = Coordinator(
+                standby_c, groups, cadence_s=cadence_s,
+                lease=CoordinatorLease(standby_c, "S", lease_s=lease_s))
+            deadline = time.monotonic() + timeout_s
+            push_heartbeat(driver, 0, 0, 0)
+            while leader.record.epoch < 1:
+                leader.observe()
+                standby.observe()
+                watch.note(read_assignment(driver))
+                if time.monotonic() > deadline:
+                    raise RuntimeError("leader never published epoch 1")
+                time.sleep(0.02)
+            assert leader.lease.held and not standby.lease.held
+            old_token = leader.lease.token
+            epoch_before = leader.record.epoch
+            # the partition: leader <-/-> control shard, one direction
+            # pair blocked; standby and the (simulated) workers flow
+            fn.block(leader_c.endpoint)
+            t_cut = time.monotonic()
+            takeover_s = -1.0
+            while standby.record.epoch <= epoch_before:
+                # workers stay alive AND a join lands mid-partition: a
+                # membership change only the standby can commit — its
+                # epoch-2 record is the proof it owns the control plane
+                push_heartbeat(driver, 0, 5, 0)
+                push_heartbeat(driver, 1, 0, 0)
+                leader.observe()      # degrades internally, never raises
+                standby.observe()
+                watch.note(read_assignment(driver))
+                if standby.lease.held and takeover_s < 0:
+                    takeover_s = time.monotonic() - t_cut
+                if time.monotonic() > deadline:
+                    raise RuntimeError("standby never took over")
+                time.sleep(0.02)
+            # heal: the stale leader still believes it leads (its ticks
+            # never completed) and tries to publish — the broker must
+            # reject it at the fence, independent of any reader
+            fn.unblock(leader_c.endpoint)
+            assert leader.lease.held          # stale local belief
+            leader._force_write = True
+            # explicit clock pinned to its own last-seen heartbeat: the
+            # stale leader's (frozen) worker view reads as fresh, so the
+            # ONLY thing stopping its publish is the broker's fence
+            rec = leader.step(now=max(leader.last_seen.values()))
+            watch.note(read_assignment(driver))
+            final = read_assignment(driver)
+            return PartitionFencingResult(
+                takeover_s=takeover_s, lease_s=lease_s,
+                old_token=old_token, new_token=standby.lease.token,
+                fenced_rejections=leader.fenced_rejections,
+                stale_write_rejected_on_wire=(
+                    rec is None and leader.fenced_rejections >= 1
+                    and final is not None
+                    and final.epoch == standby.record.epoch),
+                epochs_monotone=watch.monotone,
+                final_epoch=final.epoch if final else -1,
+                leader_deposed=not leader.lease.held)
+        finally:
+            for c in (leader_c, standby_c, driver):
+                c.close()
+
+
+@dataclass
+class ControlRehomeResult:
+    n_events: int
+    unique_answered: int
+    duplicates: int
+    killed_at: int
+    control_failovers: int
+    rehomed_to: int                  # the new control shard id
+    rehome_s: float                  # SIGKILL -> re-home record written
+    epochs_monotone: bool
+    final_epoch: int
+    final_members: List[int] = field(default_factory=list)
+    heartbeats_dropped: int = 0
+    pending_left: int = 0
+    worker_stats: List[Dict] = field(default_factory=list)
+
+
+def run_control_rehome(n_workers: int = 2, *, n_groups: int = 4,
+                       n_actions: int = 4, n_events: int = 160,
+                       kill_at: int = 40, learner_type: str = "softMax",
+                       seed: int = 29, host: str = "localhost",
+                       cadence_s: float = 0.3, lease_s: float = 1.0,
+                       dead_after_factor: float = 100.0,
+                       timeout_s: float = 300.0) -> ControlRehomeResult:
+    """Control-shard SIGKILL + control re-home under live traffic
+    (chaos harness v3, scenario 3). Shard 0 — carrying the assignment
+    record, the lease, heartbeats AND a slice of the group queues — is
+    SIGKILLed mid-run. The (lease-armed, short-detection) coordinator
+    re-homes the control plane to shard 1 in one fenced epoch; workers
+    rediscover it (scan fallback or the mirrored forwarding record once
+    shard 0 restarts over its AOF); worker heartbeats buffer through
+    the outage and flush to the NEW home (zero drops); shard 0 then
+    restarts on the same port over its always-flush AOF and its queue
+    slice rides through exactly like the PR 12 shard-kill story. Gates:
+    exactly-once after dedup, ledgers clean, exactly one control
+    failover, final record homed on shard 1, epochs monotone, both
+    workers alive in the final membership."""
+    import numpy as np
+    import signal as _signal
+    import tempfile
+    from avenir_tpu.stream.fleet import BrokerFleet
+    from avenir_tpu.stream.rebalance import (
+        Coordinator, CoordinatorLease, read_assignment)
+    _require(n_workers >= 1, f"need >= 1 worker, got {n_workers}")
+    _require(n_groups >= 1, f"need >= 1 group, got {n_groups}")
+    _require(0 < kill_at < n_events,
+             f"kill_at={kill_at} must fire inside the stream "
+             f"(0 < kill_at < n_events={n_events})")
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    ctr = {g: {a: (0.8 if i == int(rng.integers(n_actions)) else 0.15)
+               for i, a in enumerate(actions)} for g in groups}
+    config = {"current.decision.round": 1, "batch.size": 1}
+    procs: List[subprocess.Popen] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        with _broker_fleet(host, 2, aof_dir=tmp,
+                           aof_flush="always") as (fleet, endpoints,
+                                                   brokers_p, spawn):
+            # the coordinator detects control death on ITS OWN short
+            # deadline — a 30s redial before noticing would freeze the
+            # control plane for 30s
+            coord_fleet = BrokerFleet(endpoints, reconnect=True,
+                                      reconnect_timeout=1.0)
+            lease = CoordinatorLease(coord_fleet.control, "C",
+                                     lease_s=lease_s)
+            coord = Coordinator(coord_fleet.control, groups,
+                                cadence_s=cadence_s,
+                                dead_after_factor=dead_after_factor,
+                                fleet=coord_fleet, lease=lease)
+            watch = _EpochWatch()
+            victim_port = int(endpoints[0].rpartition(":")[2])
+            try:
+                spec = ",".join(endpoints)
+                procs = [
+                    _spawn_worker(host, 0, w, 0, groups, learner_type,
+                                  actions, config, seed, brokers=spec,
+                                  fleet_engine=True, cadence_s=cadence_s)
+                    for w in range(n_workers)]
+                deadline = time.monotonic() + timeout_s
+                while len(coord.alive_workers()) < n_workers:
+                    coord.observe()
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("fleet workers never joined")
+                    time.sleep(0.02)
+                routing = dict(coord.routing)
+                answered: set = set()
+                duplicates = 0
+                sent = 0
+                held_back: List[Tuple[str, str]] = []
+                state = {"killed_at": -1, "t_kill": 0.0,
+                         "rehome_s": -1.0, "restarted": False}
+
+                def shard_ok(shard: int) -> bool:
+                    return shard != 0 or state["killed_at"] < 0 \
+                        or state["restarted"]
+
+                while len(answered) < n_events:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"control re-home stalled: {len(answered)}/"
+                            f"{n_events} answered (failovers="
+                            f"{coord.control_failovers})")
+                    while sent < n_events:
+                        g = groups[sent % len(groups)]
+                        payload = f"{g}:{sent}"
+                        if not shard_ok(routing[g]):
+                            # producer backpressure during the shard
+                            # outage: hold, flush after restart — the
+                            # driver must not burn its events against a
+                            # dead socket
+                            held_back.append((g, payload))
+                            sent += 1
+                            continue
+                        fleet.client(routing[g]).lpush(
+                            f"eventQueue:{g}", payload)
+                        sent += 1
+                        if sent % 16 == 0:
+                            break
+                    if state["restarted"] and held_back:
+                        for g, payload in held_back:
+                            fleet.client(routing[g]).lpush(
+                                f"eventQueue:{g}", payload)
+                        held_back = []
+                    for s in range(fleet.n_shards):
+                        if not shard_ok(s):
+                            continue
+                        raws = fleet.client(s).rpop("actionQueue", 256)
+                        for raw in raws or []:
+                            event_id, _, action = \
+                                raw.decode().partition(",")
+                            action = action.split(",")[0]
+                            if event_id in answered:
+                                duplicates += 1
+                                continue
+                            answered.add(event_id)
+                            g = event_id.partition(":")[0]
+                            if not shard_ok(routing[g]):
+                                continue
+                            reward = (1.0 if rng.random()
+                                      < ctr[g][action] else 0.0)
+                            fleet.client(routing[g]).lpush(
+                                f"rewardQueue:{g}", f"{action},{reward}")
+                    coord.observe()
+                    watch.note(coord.record if coord.record.epoch
+                               else None)
+                    if state["killed_at"] < 0 \
+                            and len(answered) >= kill_at:
+                        state["killed_at"] = len(answered)
+                        state["t_kill"] = time.monotonic()
+                        brokers_p[0].send_signal(_signal.SIGKILL)
+                        brokers_p[0].wait(timeout=30)
+                    if state["killed_at"] >= 0 and state["rehome_s"] < 0 \
+                            and coord.control_failovers >= 1:
+                        state["rehome_s"] = (time.monotonic()
+                                             - state["t_kill"])
+                    if state["rehome_s"] >= 0 and not state["restarted"]:
+                        # the re-home is committed: bring shard 0 back
+                        # on the same port over its AOF (the PR 12
+                        # same-port restart story for its queue slice)
+                        brokers_p[0] = spawn(0, victim_port)
+                        try:
+                            fleet.client(0).ping()
+                            state["restarted"] = True
+                        except (ConnectionError, OSError):
+                            pass
+                    time.sleep(0.002)
+                # drain: sentinels on every group's CURRENT shard, stop
+                # record through the (re-homed, fenced) coordinator
+                for g in groups:
+                    fleet.client(routing[g]).lpush(f"eventQueue:{g}",
+                                                   STOP_SENTINEL)
+                # final membership must show both workers alive on the
+                # NEW control home (their heartbeats re-pointed)
+                mem_deadline = min(deadline, time.monotonic() + 30.0)
+                while True:
+                    coord.observe()
+                    alive = coord.alive_workers()
+                    if len(alive) >= n_workers:
+                        break
+                    if time.monotonic() > mem_deadline:
+                        break
+                    time.sleep(0.05)
+                coord.stop_fleet()
+                watch.note(coord.record)
+                worker_stats = []
+                for p in procs:
+                    out, err = _collect_worker(p, timeout=120)
+                    if p.returncode != 0:
+                        raise RuntimeError(
+                            f"worker failed: {err[-1500:]}")
+                    worker_stats.append(json.loads(out.splitlines()[-1]))
+                final = read_assignment(coord_fleet.control)
+                return ControlRehomeResult(
+                    n_events=n_events, unique_answered=len(answered),
+                    duplicates=duplicates,
+                    killed_at=state["killed_at"],
+                    control_failovers=coord.control_failovers,
+                    rehomed_to=coord_fleet.control_shard,
+                    rehome_s=state["rehome_s"],
+                    epochs_monotone=watch.monotone,
+                    final_epoch=final.epoch if final else -1,
+                    final_members=list(final.members) if final else [],
+                    heartbeats_dropped=sum(
+                        w.get("heartbeats_dropped", 0)
+                        for w in worker_stats),
+                    pending_left=_fleet_pending_left(fleet, routing,
+                                                     groups),
+                    worker_stats=worker_stats)
+            finally:
+                coord_fleet.close()
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+
+
+@dataclass
+class FaultnetSoakResult:
+    n_events: int
+    unique_answered: int
+    duplicates: int
+    faults_injected_workers: int
+    faultnet_seed: int
+    schedule_digest: str             # md5 of the seeded plan (repro id)
+    pending_left: int = 0
+    worker_stats: List[Dict] = field(default_factory=list)
+
+
+def run_faultnet_soak(n_workers: int = 2, n_brokers: int = 2, *,
+                      n_groups: int = 4, n_actions: int = 4,
+                      n_events: int = 160, learner_type: str = "softMax",
+                      seed: int = 31, faultnet_seed: int = 101,
+                      host: str = "localhost",
+                      timeout_s: float = 300.0) -> FaultnetSoakResult:
+    """Seeded random network-fault soak (chaos harness v3, scenario 4):
+    every WORKER process runs with a deterministic faultnet schedule
+    (dropped connections, dropped replies — the command executed! —
+    and injected delays) armed over its whole client layer via
+    ``AVENIR_FAULTNET``, while the driver stays clean so the
+    accounting is exact. The serving invariants must hold under the
+    schedule: exactly-once after dedup and fully retired ledgers. The
+    schedule digest identifies the run — the same seed reproduces the
+    same fault plan bit-identically (gated separately by the smoke's
+    cross-process determinism check)."""
+    import hashlib
+    import numpy as np
+    from avenir_tpu.stream.faultnet import FaultNet
+    from avenir_tpu.stream.fleet import consistent_route
+    _require(n_workers >= 1, f"need >= 1 worker, got {n_workers}")
+    _require(n_brokers >= 1, f"need >= 1 broker, got {n_brokers}")
+    _require(n_groups >= 1, f"need >= 1 group, got {n_groups}")
+    _require(n_events >= 1, f"need >= 1 event, got {n_events}")
+    rng = np.random.default_rng(seed)
+    groups = [f"g{i}" for i in range(n_groups)]
+    actions = [f"a{i}" for i in range(n_actions)]
+    ctr = {g: {a: (0.8 if i == int(rng.integers(n_actions)) else 0.15)
+               for i, a in enumerate(actions)} for g in groups}
+    config = {"current.decision.round": 1, "batch.size": 1}
+    fn = FaultNet(faultnet_seed, drop_rate=0.02, drop_reply_rate=0.02,
+                  delay_rate=0.05, delay_ms=4.0)
+    digest = hashlib.md5(json.dumps(
+        [fn.env(), fn.plan("schedule:probe", 256)]).encode()).hexdigest()
+    procs: List[subprocess.Popen] = []
+    with _broker_fleet(host, n_brokers) as (fleet, endpoints, _bp, _sp):
+        routing = consistent_route(groups, range(n_brokers))
+        _write_static_fleet_record(fleet, groups, n_workers, endpoints,
+                                   routing)
+        try:
+            spec = ",".join(endpoints)
+            procs = [
+                _spawn_worker(host, 0, w, n_workers, groups,
+                              learner_type, actions, config, seed,
+                              brokers=spec, fleet_engine=True,
+                              extra_env={"AVENIR_FAULTNET": fn.env()})
+                for w in range(n_workers)]
+            deadline = time.monotonic() + timeout_s
+            answered: set = set()
+            _fleet_push_events(fleet, routing, groups, 0, n_events)
+            duplicates = _fleet_consume(fleet, routing, ctr, rng,
+                                        answered, n_events, deadline)
+            for g in groups:
+                fleet.client(routing[g]).lpush(f"eventQueue:{g}",
+                                               STOP_SENTINEL)
+            _write_static_fleet_record(fleet, groups, n_workers,
+                                       endpoints, routing, epoch=2,
+                                       stop=True)
+            worker_stats = []
+            for p in procs:
+                out, err = _collect_worker(p, timeout=120)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"soak worker failed: {err[-1500:]}")
+                worker_stats.append(json.loads(out.splitlines()[-1]))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return FaultnetSoakResult(
+            n_events=n_events, unique_answered=len(answered),
+            duplicates=duplicates,
+            faults_injected_workers=sum(
+                w.get("faults_injected", 0) for w in worker_stats),
+            faultnet_seed=faultnet_seed,
+            schedule_digest=digest,
+            pending_left=_fleet_pending_left(fleet, routing, groups),
+            worker_stats=worker_stats)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--worker", action="store_true")
@@ -2664,7 +3802,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "ShardedQueues transport — one pipelined "
                          "sweep per owned shard per batch, "
                          "concurrently (the 1M/min worker shape)")
+    ap.add_argument("--coordinator", action="store_true",
+                    help="run a lease-armed Coordinator process "
+                         "(ISSUE 13): exactly one of N such processes "
+                         "holds the lease and publishes fenced "
+                         "assignment records; the rest are hot "
+                         "standbys that take over on holder death")
+    ap.add_argument("--coordinator-id", default="coord",
+                    help="coordinator mode: lease holder identity")
+    ap.add_argument("--lease-s", type=float, default=1.5,
+                    help="coordinator mode: lease period (renew every "
+                         "1/3; an observer takes over after 1.5x "
+                         "unchanged on ITS monotonic clock)")
+    ap.add_argument("--dead-after-factor", type=float, default=None,
+                    help="coordinator mode: liveness bar override "
+                         "(heartbeat age > factor x cadence = dead)")
     args = ap.parse_args(argv)
+
+    if args.coordinator:
+        if not args.brokers:
+            ap.error("--coordinator needs --brokers")
+        stats = coordinator_main(
+            args.brokers, args.coordinator_id, args.groups.split(","),
+            cadence_s=args.cadence_s, lease_s=args.lease_s,
+            dead_after_factor=args.dead_after_factor)
+        print(json.dumps(stats), flush=True)
+        return 0
 
     if args.worker:
         # stuck-worker debugging: SIGUSR1 dumps every thread's stack to
@@ -2750,6 +3913,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if live_obs is not None:
             stats["obs_port"] = live_obs.port
             live_obs.stop()
+        from avenir_tpu.stream import faultnet as _faultnet
+        injector = _faultnet.from_env()
+        if injector is not None:
+            # the soak gate needs proof faults actually hit the workers
+            stats["faults_injected"] = sum(injector.injected.values())
         print(json.dumps(stats), flush=True)
         return 0
 

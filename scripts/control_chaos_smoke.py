@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Control-plane chaos smoke gate (ISSUE 13 CI guard) — chaos harness v3.
+
+Five scenarios over the coordinator lease / fencing / control-failover
+/ faultnet stack, each with hard functional gates (non-zero exit on any
+failure); the takeover-latency bound gets one retry (the PR 12
+load-tolerance discipline — co-tenant CPU starvation must not fail a
+functional CI gate):
+
+1. **Faultnet determinism**: the seeded fault schedule serializes
+   bit-identically across two fresh PROCESSES with different
+   PYTHONHASHSEED values — a failing soak is replayable, by contract.
+
+2. **Leader partition + fenced stale publish**
+   (``run_partition_fencing``, in-process): the leader is partitioned
+   from the control shard, a standby claims the lease through
+   observer-monotonic expiry + CAS and commits a mid-partition join;
+   after the heal the stale leader's re-publish is rejected by the
+   BROKER (-FENCED on the wire — not merely epoch-ignored by readers).
+
+3. **Coordinator SIGKILL + standby takeover**
+   (``run_coordinator_chaos``): two coordinator processes, the lease
+   holder SIGKILLed right after a brand-new worker joins. Gates:
+   standby holds the lease within 2 lease periods, strictly larger
+   fencing token, the pending join completes under the new leader,
+   exactly-once after dedup, ledgers retired, epochs monotone.
+
+4. **Control-shard SIGKILL + re-home under live traffic**
+   (``run_control_rehome``): shard 0 (record + lease + heartbeats + a
+   queue slice) dies; the coordinator re-homes the control plane to
+   shard 1 in one fenced epoch; workers rediscover it (scan fallback /
+   mirrored forwarding record); heartbeats buffer through the outage
+   with zero drops; shard 0 restarts same-port over its AOF. Gates:
+   exactly-once, ledgers clean, exactly one failover, record homed on
+   shard 1, both workers alive in the final membership, epochs
+   monotone.
+
+5. **Seeded faultnet soak** (``run_faultnet_soak``): every worker runs
+   under a deterministic schedule of dropped connections, dropped
+   replies (command executed, reply lost) and delays. Gates:
+   exactly-once after dedup, ledgers retired, faults actually injected.
+
+Prints ONE JSON line consumed by bench.py / CI.
+
+Usage: python scripts/control_chaos_smoke.py [--events N] [--skip-gates]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu":  # pragma: no cover - TPU-pinned hosts
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+
+LEARNER = "softMax"
+SEED = 37
+
+
+def fail(msg: str) -> None:
+    print(f"control_chaos_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+# --------------------------------------------------------------------------
+# gate 1: the seeded schedule reproduces bit-identically across processes
+# --------------------------------------------------------------------------
+
+def gate_determinism() -> dict:
+    code = (
+        "from avenir_tpu.stream.faultnet import FaultNet;"
+        "import json;"
+        "fn = FaultNet(101, drop_rate=0.05, drop_reply_rate=0.05,"
+        "              delay_rate=0.1, window_rate=0.02);"
+        "print(json.dumps([fn.env(),"
+        "                  fn.plan('h:1', 400), fn.plan('h:2', 400)]))")
+    outs = []
+    for hash_seed in ("1", "2"):
+        env = dict(os.environ, PYTHONHASHSEED=hash_seed,
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            fail(f"determinism probe died: {proc.stderr[-500:]}")
+        outs.append(proc.stdout.strip().splitlines()[-1])
+    if outs[0] != outs[1]:
+        fail("seeded faultnet schedule is NOT bit-identical across "
+             "processes — a failing soak would be unreplayable")
+    plan = json.loads(outs[0])[1]
+    return {
+        "bit_identical_across_processes": True,
+        "plan_ops": len(plan),
+        "plan_faults": sum(1 for p in plan if p),
+    }
+
+
+# --------------------------------------------------------------------------
+# gate 2: leader partition -> standby lease takeover -> fenced stale write
+# --------------------------------------------------------------------------
+
+def gate_partition_fencing() -> dict:
+    from avenir_tpu.stream.scaleout import run_partition_fencing
+    r = run_partition_fencing()
+    if not r.stale_write_rejected_on_wire:
+        fail("the stale leader's publish was NOT rejected on the wire")
+    if r.fenced_rejections != 1:
+        fail(f"expected exactly 1 fenced rejection, "
+             f"saw {r.fenced_rejections}")
+    if r.new_token <= r.old_token:
+        fail(f"fencing token did not advance: {r.old_token} -> "
+             f"{r.new_token}")
+    if not r.leader_deposed:
+        fail("the fenced leader did not depose itself")
+    if not r.epochs_monotone:
+        fail("record epochs went backwards under the partition")
+    return {
+        "takeover_s": round(r.takeover_s, 3),
+        "lease_s": r.lease_s,
+        "old_token": r.old_token,
+        "new_token": r.new_token,
+        "fenced_on_the_wire": True,
+        "final_epoch": r.final_epoch,
+    }
+
+
+# --------------------------------------------------------------------------
+# gate 3: coordinator SIGKILL, standby takes over within 2 lease periods
+# --------------------------------------------------------------------------
+
+def gate_coordinator_kill(events: int, skip_gates: bool) -> dict:
+    from avenir_tpu.stream.scaleout import run_coordinator_chaos
+
+    def once(seed):
+        return run_coordinator_chaos(
+            2, 2, n_events=events, kill_at=events // 4,
+            learner_type=LEARNER, seed=seed)
+
+    r = once(SEED)
+    # functional gates: HARD, no retry
+    if r.unique_answered != r.n_events:
+        fail(f"coordinator kill lost events: "
+             f"{r.unique_answered}/{r.n_events}")
+    if r.pending_left != 0:
+        fail(f"coordinator kill left {r.pending_left} ledger entries")
+    if r.new_token <= r.old_token:
+        fail(f"takeover token did not advance: {r.old_token} -> "
+             f"{r.new_token}")
+    if not r.epochs_monotone:
+        fail("epochs went backwards across the takeover")
+    if not r.joined_after_kill:
+        fail("the mid-rebalance join never completed under the "
+             "new leader")
+    # the latency bound is load-sensitive: one retry before failing
+    bound = 2.0 * r.lease_s
+    if (r.takeover_s < 0 or r.takeover_s > bound) and not skip_gates:
+        retry = once(SEED + 1)
+        if 0 < retry.takeover_s < r.takeover_s \
+                and retry.unique_answered == retry.n_events:
+            r = retry
+    if (r.takeover_s < 0 or r.takeover_s > bound) and not skip_gates:
+        fail(f"standby takeover took {r.takeover_s:.2f}s "
+             f"> 2 lease periods ({bound:.2f}s)")
+    return {
+        "events": r.n_events,
+        "duplicates": r.duplicates,
+        "killed_leader": r.killed_leader,
+        "takeover_s": round(r.takeover_s, 3),
+        "takeover_bound_s": bound,
+        "old_token": r.old_token,
+        "new_token": r.new_token,
+        "final_epoch": r.final_epoch,
+        "joined_after_kill": True,
+        "zero_lost_after_dedup": True,
+    }
+
+
+# --------------------------------------------------------------------------
+# gate 4: control-shard SIGKILL + re-home under live traffic
+# --------------------------------------------------------------------------
+
+def gate_control_rehome(events: int) -> dict:
+    from avenir_tpu.stream.scaleout import run_control_rehome
+    r = run_control_rehome(2, n_events=events, kill_at=events // 4,
+                           learner_type=LEARNER, seed=SEED + 2)
+    if r.unique_answered != r.n_events:
+        fail(f"control re-home lost events: "
+             f"{r.unique_answered}/{r.n_events}")
+    if r.pending_left != 0:
+        fail(f"control re-home left {r.pending_left} ledger entries")
+    if r.control_failovers != 1:
+        fail(f"expected exactly 1 control failover, "
+             f"saw {r.control_failovers}")
+    if r.rehomed_to == 0:
+        fail("the control plane did not move off the killed shard")
+    if not r.epochs_monotone:
+        fail("epochs went backwards across the re-home")
+    if sorted(r.final_members) != [0, 1]:
+        fail(f"liveness broke across the re-home: final members "
+             f"{r.final_members}")
+    if r.heartbeats_dropped != 0:
+        fail(f"{r.heartbeats_dropped} heartbeats dropped — the outage "
+             f"buffer overflowed or never flushed")
+    return {
+        "events": r.n_events,
+        "duplicates": r.duplicates,
+        "rehomed_to": r.rehomed_to,
+        "rehome_s": round(r.rehome_s, 3),
+        "final_epoch": r.final_epoch,
+        "heartbeats_dropped": 0,
+        "zero_lost_after_dedup": True,
+    }
+
+
+# --------------------------------------------------------------------------
+# gate 5: seeded faultnet soak
+# --------------------------------------------------------------------------
+
+def gate_soak(events: int) -> dict:
+    from avenir_tpu.stream.scaleout import run_faultnet_soak
+    r = run_faultnet_soak(2, 2, n_events=events, learner_type=LEARNER,
+                          seed=SEED + 3)
+    if r.unique_answered != r.n_events:
+        fail(f"faultnet soak lost events: "
+             f"{r.unique_answered}/{r.n_events}")
+    if r.pending_left != 0:
+        fail(f"faultnet soak left {r.pending_left} ledger entries")
+    if r.faults_injected_workers < 1:
+        fail("no fault was injected — the soak tested nothing")
+    return {
+        "events": r.n_events,
+        "duplicates": r.duplicates,
+        "faults_injected": r.faults_injected_workers,
+        "faultnet_seed": r.faultnet_seed,
+        "schedule_digest": r.schedule_digest,
+        "zero_lost_after_dedup": True,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=160,
+                    help="events per scenario (CPU-sized default)")
+    ap.add_argument("--skip-gates", action="store_true",
+                    help="measure and report without failing the "
+                         "takeover-latency gate (bench mode); the "
+                         "functional gates stay hard")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    determinism = gate_determinism()
+    fencing = gate_partition_fencing()
+    takeover = gate_coordinator_kill(max(args.events, 120),
+                                     args.skip_gates)
+    rehome = gate_control_rehome(max(args.events, 120))
+    soak = gate_soak(max(args.events, 120))
+
+    print("control_chaos_smoke OK", file=sys.stderr)
+    print(json.dumps({
+        "control_chaos_smoke": "ok",
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+        "determinism": determinism,
+        "partition_fencing": fencing,
+        "coordinator_kill": takeover,
+        "control_rehome": rehome,
+        "faultnet_soak": soak,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -205,11 +205,25 @@ class TestRecordRouting:
             assert rec.routing == coord.routing
             fleet.close()
 
-    def test_control_shard_is_pinned(self):
-        with MiniRedisServer() as s0:
-            fleet = BrokerFleet([f"{s0.host}:{s0.port}"])
-            with pytest.raises(ValueError, match="control shard"):
-                fleet.ensure_endpoints(["other:1", f"{s0.host}:{s0.port}"])
+    def test_control_home_travels_in_the_record(self):
+        """ISSUE 13 lifted the shard-0 pin: the control home is the
+        record's ``control`` field, adopted (with the endpoint list) in
+        one step — and omitted from the wire while it is still 0, so
+        pre-failover records stay byte-identical to the PR 12 format."""
+        from avenir_tpu.stream.rebalance import AssignmentRecord
+        with MiniRedisServer() as s0, MiniRedisServer() as s1:
+            ep = [f"{s0.host}:{s0.port}", f"{s1.host}:{s1.port}"]
+            fleet = BrokerFleet(ep)
+            assert fleet.control_shard == 0
+            rec = AssignmentRecord(3, {"g0": 0}, brokers=ep, control=1)
+            assert fleet.adopt_record(rec) is True
+            assert fleet.control_shard == 1
+            assert fleet.control.port == s1.port
+            # round trip preserves the field; control=0 stays off the wire
+            back = AssignmentRecord.from_json(rec.to_json())
+            assert back.control == 1
+            assert "control" not in AssignmentRecord(
+                2, {"g0": 0}, brokers=ep).to_json()
             fleet.close()
 
 
