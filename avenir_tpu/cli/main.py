@@ -906,7 +906,19 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
         fused=conf.get_bool("knn.fused", True),
         quantized=conf.get_bool("knn.quantized", False),
         quantized_oversample=conf.get_int("knn.quantized.oversample", 4),
-        quantized_dtype=conf.get("knn.quantized.dtype", "int8"))
+        quantized_dtype=conf.get("knn.quantized.dtype", "int8"),
+        # knn.ann opts into the IVF index (ops/ivf.py): device k-means
+        # coarse quantizer + inverted lists, queries probe knn.ann.nprobe
+        # lists and rerun the two-stage quantized scan over just their
+        # rows — O(N/nlist·nprobe) per query. nlist/nprobe 0 = auto
+        # (~sqrt(N) lists, quarter probed); nprobe = nlist reproduces
+        # the quantized brute force exactly. Composes with knn.sharded
+        # (shards hold list partitions) and the feed.
+        ann=conf.get_bool("knn.ann", False),
+        ann_nlist=conf.get_int("knn.ann.nlist", 0),
+        ann_nprobe=conf.get_int("knn.ann.nprobe", 0),
+        ann_iters=conf.get_int("knn.ann.iters", 15),
+        ann_seed=conf.get_int("knn.ann.seed", 0))
     delim = conf.get("field.delim.out", ",")
 
     if not regression:

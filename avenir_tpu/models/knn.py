@@ -91,6 +91,22 @@ class KnnConfig:
     quantized: bool = False                  # knn.quantized
     quantized_oversample: int = 4            # knn.quantized.oversample
     quantized_dtype: str = "int8"            # knn.quantized.dtype int8|bf16
+    # knn.ann: the IVF index (ops/ivf.py) — device k-means coarse
+    # quantizer + bucket-padded inverted lists; queries probe the
+    # knn.ann.nprobe nearest lists and rerun the two-stage quantized
+    # scan (candidate pass at knn.quantized.dtype/oversample settings +
+    # exact f32 re-rank) over just those lists' rows. O(N/nlist·nprobe)
+    # per query instead of O(N); nprobe = nlist reproduces the
+    # brute-force quantized results exactly (int8). Euclidean only;
+    # subsumes knn.quantized (setting both is refused). Composes with
+    # knn.sharded (each mesh shard holds a partition of the lists) and
+    # the feed. nlist/nprobe of 0 auto-size (~√N lists of ≥64 rows,
+    # probe a quarter with a floor of 8 — recall-favoring).
+    ann: bool = False                        # knn.ann
+    ann_nlist: int = 0                       # knn.ann.nlist (0 = auto)
+    ann_nprobe: int = 0                      # knn.ann.nprobe (0 = auto)
+    ann_iters: int = 15                      # knn.ann.iters (k-means)
+    ann_seed: int = 0                        # knn.ann.seed (build determinism)
 
 
 def _split_features(table: EncodedTable
@@ -160,6 +176,76 @@ def _on_tpu() -> bool:
     return jax.devices()[0].platform == "tpu"
 
 
+def validate_config(config: KnnConfig) -> None:
+    """The mode-matrix gate (ISSUE 14 satellite): every invalid
+    combination of ``knn.mode`` / ``knn.fused`` / ``knn.quantized`` /
+    ``knn.sharded`` / ``knn.ann`` and their parameter keys raises a
+    ValueError NAMING the config key and the accepted values, before any
+    table is touched. Called by :func:`neighbors` (and transitively by
+    every classify/regress entry)."""
+    from avenir_tpu.ops.quantized import QDTYPES
+    if config.top_match_count < 1:
+        raise ValueError(
+            f"top.match.count must be >= 1, got {config.top_match_count}")
+    if config.mode not in ("fast", "exact"):
+        raise ValueError(
+            f"knn.mode must be 'fast' or 'exact', got {config.mode!r}")
+    if config.algorithm not in ("euclidean", "manhattan"):
+        raise ValueError(
+            "schema distAlgorithm must be 'euclidean' or 'manhattan', "
+            f"got {config.algorithm!r}")
+    if config.quantized or config.ann:
+        if config.quantized_dtype not in QDTYPES:
+            raise ValueError(
+                f"knn.quantized.dtype must be one of {QDTYPES}, got "
+                f"{config.quantized_dtype!r}")
+        if config.quantized_oversample < 1:
+            raise ValueError(
+                "knn.quantized.oversample must be >= 1, got "
+                f"{config.quantized_oversample}")
+    if config.quantized and config.algorithm != "euclidean":
+        raise ValueError("knn.quantized supports euclidean only; got "
+                         f"distAlgorithm {config.algorithm!r}")
+    if config.ann:
+        if config.quantized:
+            raise ValueError(
+                "knn.ann and knn.quantized conflict: the ANN query path "
+                "already runs the quantized candidate scan + exact f32 "
+                "re-rank over the probed lists (knn.quantized.dtype / "
+                "knn.quantized.oversample still apply); drop "
+                "knn.quantized")
+        if config.algorithm != "euclidean":
+            raise ValueError("knn.ann supports euclidean only; got "
+                             f"distAlgorithm {config.algorithm!r}")
+        if config.mode == "exact":
+            raise ValueError(
+                "knn.ann is approximate by construction (unprobed lists "
+                "are never scanned); knn.mode=exact requires the "
+                "brute-force path — drop knn.ann or use knn.mode=fast")
+        if config.ann_nlist < 0:
+            raise ValueError(
+                f"knn.ann.nlist must be >= 0 (0 = auto ~sqrt(N)), got "
+                f"{config.ann_nlist}")
+        if config.ann_nprobe < 0:
+            raise ValueError(
+                f"knn.ann.nprobe must be >= 0 (0 = auto), got "
+                f"{config.ann_nprobe}")
+        if (config.ann_nlist > 0 and config.ann_nprobe > 0
+                and config.ann_nprobe > config.ann_nlist):
+            raise ValueError(
+                f"knn.ann.nprobe ({config.ann_nprobe}) cannot exceed "
+                f"knn.ann.nlist ({config.ann_nlist}); accepted values "
+                "are 1..nlist (nlist probes everything = brute-force "
+                "parity)")
+        if config.ann_iters < 0:
+            raise ValueError(
+                f"knn.ann.iters must be >= 0, got {config.ann_iters}")
+    elif config.ann_nlist or config.ann_nprobe:
+        raise ValueError(
+            "knn.ann.nlist/knn.ann.nprobe are set but knn.ann=false; "
+            "set knn.ann=true (or drop the index parameters)")
+
+
 def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(distances [M, k] scaled int32, train indices [M, k]).
@@ -175,11 +261,15 @@ def neighbors(train: EncodedTable, test: EncodedTable, config: KnnConfig
     candidate pass + exact f32 re-rank (any backend, euclidean only).
     ``config.sharded`` scales the whole computation out over the device
     mesh (train rows sharded, distributed top-k merge) — see
-    :func:`_neighbors_sharded`."""
-    if config.quantized and config.algorithm != "euclidean":
-        raise ValueError("knn.quantized supports euclidean only")
+    :func:`_neighbors_sharded`. ``config.ann`` queries the IVF index
+    (``ops/ivf.py``) instead of scanning every train row — see
+    :func:`_neighbors_ann`; combined with ``sharded`` each mesh shard
+    holds a partition of the inverted lists."""
+    validate_config(config)
     if config.sharded:
         return _neighbors_sharded(train, test, config)
+    if config.ann:
+        return _neighbors_ann(train, test, config)
     tr_num, tr_cat, n_bins = _split_features(train)
     m = int(test.binned.shape[0])
     feed_active = 0 < config.feed_chunk_rows < m
@@ -262,6 +352,87 @@ def _staged_sharded_train(train: EncodedTable, mesh):
     return staged
 
 
+# one-slot staged-IVF cache, same contract as _SHARD_TRAIN_CACHE: the
+# CLI part-file loop scores many test shards against ONE train table —
+# rebuilding the coarse quantizer per shard would put a k-means on every
+# shard's critical path. Keyed on (table identity, build params, mesh).
+_ANN_INDEX_CACHE: dict = {}
+
+
+def _resolved_ann_params(train: EncodedTable, config: KnnConfig
+                         ) -> Tuple[int, int]:
+    """(nlist, n_probe) with 0s auto-sized from the train row count."""
+    from avenir_tpu.ops import ivf
+    n = int(train.binned.shape[0])
+    nlist = config.ann_nlist or ivf.default_nlist(n)
+    n_probe = config.ann_nprobe or ivf.default_nprobe(nlist)
+    if n_probe > nlist:
+        raise ValueError(
+            f"knn.ann.nprobe ({n_probe}) cannot exceed the index's nlist "
+            f"({nlist}); accepted values are 1..nlist")
+    return nlist, n_probe
+
+
+def _staged_ann_index(train: EncodedTable, config: KnnConfig, mesh=None):
+    """Build (or reuse) the IVF index for this train table: single-device
+    ``IvfIndex`` when ``mesh`` is None, else the list-partitioned
+    ``ShardedIvfIndex``."""
+    from avenir_tpu.ops import ivf
+    nlist, _ = _resolved_ann_params(train, config)
+    key = (id(train), nlist, config.ann_iters, config.ann_seed, mesh)
+    hit = _ANN_INDEX_CACHE.get(key)
+    if hit is not None and hit[0] is train:
+        return hit[1]
+    tr_num, tr_cat = _split_features_host(train)
+    cat_idx = [i for i, f in enumerate(train.feature_fields)
+               if f.is_categorical]
+    n_bins = max((train.bins_per_feature[i] for i in cat_idx), default=0)
+    with telemetry.span("knn.ann.build"):
+        if mesh is None:
+            index = ivf.build_ivf(
+                None if tr_num is None else jnp.asarray(tr_num),
+                None if tr_cat is None else jnp.asarray(tr_cat),
+                n_cat_bins=n_bins, nlist=nlist, n_iters=config.ann_iters,
+                seed=config.ann_seed)
+        else:
+            index = ivf.build_sharded_ivf(
+                None if tr_num is None else jnp.asarray(tr_num),
+                None if tr_cat is None else jnp.asarray(tr_cat),
+                mesh=mesh, n_cat_bins=n_bins, nlist=nlist,
+                n_iters=config.ann_iters, seed=config.ann_seed)
+    _ANN_INDEX_CACHE.clear()
+    _ANN_INDEX_CACHE[key] = (train, index)
+    return index
+
+
+def _neighbors_ann(train: EncodedTable, test: EncodedTable,
+                   config: KnnConfig) -> Tuple[np.ndarray, np.ndarray]:
+    """IVF-indexed scoring (ISSUE 14): build/reuse the coarse quantizer +
+    inverted lists over the train table, then each test chunk probes its
+    ``n_probe`` nearest lists and reruns the two-stage quantized scan
+    over just those candidates. Composes with the DeviceFeed exactly
+    like the brute-force paths (bucket-padded chunks, dispatch-then-
+    fetch, one epoch-end sweep)."""
+    from avenir_tpu.ops import ivf
+    _, n_probe = _resolved_ann_params(train, config)
+    index = _staged_ann_index(train, config)
+
+    def run(xn, xc):
+        return ivf.ann_topk(
+            index, xn, xc, k=config.top_match_count, n_probe=n_probe,
+            oversample=config.quantized_oversample,
+            qdtype=config.quantized_dtype,
+            distance_scale=config.distance_scale)
+
+    m = int(test.binned.shape[0])
+    if 0 < config.feed_chunk_rows < m:
+        # chunking needs host arrays (the feed pads + stages per chunk)
+        te_num, te_cat = _split_features_host(test)
+        return _neighbors_feed(run, te_num, te_cat, config)
+    te_num, te_cat, _ = _split_features(test)
+    return run(te_num, te_cat)
+
+
 def _neighbors_sharded(train: EncodedTable, test: EncodedTable,
                        config: KnnConfig
                        ) -> Tuple[np.ndarray, np.ndarray]:
@@ -281,6 +452,22 @@ def _neighbors_sharded(train: EncodedTable, test: EncodedTable,
     cat_idx = [i for i, f in enumerate(train.feature_fields)
                if f.is_categorical]
     n_bins = max((train.bins_per_feature[i] for i in cat_idx), default=0)
+    if config.ann:
+        # knn.sharded × knn.ann (ISSUE 14): one global k-means, its
+        # inverted lists partitioned across the mesh; each shard probes
+        # its own lists and the per-shard exact-f32 top-k candidates
+        # merge with the all-gather + exact two-key sort
+        index = _staged_ann_index(train, config, mesh=mesh)
+        _, n_probe = _resolved_ann_params(train, config)
+
+        def run(xn, xc):
+            return collective.sharded_ann_topk(
+                xn, xc, index=index, mesh=mesh, k=config.top_match_count,
+                n_probe=n_probe, oversample=config.quantized_oversample,
+                qdtype=config.quantized_dtype,
+                distance_scale=config.distance_scale)
+
+        return _finish_sharded(run, test, config, mesh)
     if not config.quantized and _on_tpu() and config.mode == "fast":
         # the sharded path runs the XLA streaming core per shard; the
         # hand-scheduled Pallas kernel is single-chip only (its own jit/
@@ -330,6 +517,15 @@ def _neighbors_sharded(train: EncodedTable, test: EncodedTable,
                 distance_scale=config.distance_scale, mode=config.mode,
                 recall_target=config.recall_target)
 
+    return _finish_sharded(run, test, config, mesh)
+
+
+def _finish_sharded(run, test: EncodedTable, config: KnnConfig, mesh
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Shared test-side tail of every sharded variant: host split, then
+    either the chunked feed (staged DIRECTLY into the mesh-replicated
+    sharding) or one replicated device_put."""
+    from avenir_tpu.parallel import collective
     te_num, te_cat = _split_features_host(test)
     m = int(test.binned.shape[0])
     if 0 < config.feed_chunk_rows < m:
@@ -523,6 +719,24 @@ def classify(train: EncodedTable, test: EncodedTable, config: KnnConfig,
         from avenir_tpu.parallel.pipeline import bucket_rows, pad_rows
         b = bucket_rows(m)
         dist_v, idx_v = pad_rows(dist, b), pad_rows(idx, b)
+    valid = None
+    if config.ann:
+        # a sparse probe can return FEWER than k real neighbors (probed
+        # lists held too few rows) as (-1, INT_BIG) sentinel slots — a
+        # state no brute-force path produces with N >= k. Mask them out
+        # of the vote (weight 0) and clamp the gathers; without this the
+        # -1 gather reads a junk train row and votes at full weight. A
+        # query with NO real neighbor at all has no sound vote — refuse
+        # (the regress contract) instead of fabricating class 0.
+        idx_np = np.asarray(idx)
+        if bool(np.any(~np.any(idx_np >= 0, axis=1))):
+            raise ValueError(
+                "knn.ann found no neighbors at all for some queries "
+                "(every probed list was empty); raise knn.ann.nprobe or "
+                "lower knn.ann.nlist")
+        idx_v = jnp.asarray(idx_v)
+        valid = (idx_v >= 0).astype(jnp.float32)
+        idx_v = jnp.maximum(idx_v, 0)
     nbr_labels = train.labels[idx_v]                            # [M, k]
     nbr_post = None
     if config.class_cond_weighted and feature_post is not None:
@@ -535,7 +749,7 @@ def classify(train: EncodedTable, test: EncodedTable, config: KnnConfig,
         dist_v, nbr_labels, nbr_post,
         config.kernel_function, config.kernel_param, train.n_classes,
         config.class_cond_weighted and feature_post is not None,
-        config.inverse_distance_weighted)
+        config.inverse_distance_weighted, valid=valid)
     votes_np = np.asarray(votes)[:m]
     predicted, prob = _decide(votes_np, config, train.class_values)
     return KnnPrediction(predicted=predicted,
@@ -559,6 +773,16 @@ def regress(train: EncodedTable, test: EncodedTable, config: KnnConfig,
     multi-linear mode.
     """
     dist, idx = neighbors(train, test, config)
+    if config.ann and bool(np.any(np.asarray(idx) < 0)):
+        # regression folds every neighbor slot into a mean/median/fit —
+        # there is no weight-0 escape hatch like the vote kernel's, so a
+        # short neighbor list must refuse rather than silently average a
+        # junk gather
+        raise ValueError(
+            "knn.ann returned fewer than top.match.count neighbors for "
+            "some queries (the probed lists held too few rows); raise "
+            "knn.ann.nprobe, lower knn.ann.nlist, or lower "
+            "top.match.count for regression")
     nbr_y = train_targets[idx].astype(jnp.float32)              # [M, k]
 
     if config.regression_method == "average":

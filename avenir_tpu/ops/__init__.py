@@ -12,6 +12,9 @@
   staged chunks in, [M, k] out; nothing between touches HBM)
 - ``quantized``        — int8/bf16 candidate distance pass + exact f32
   re-rank of the survivors
+- ``ivf``              — the IVF approximate-nearest-neighbor index:
+  device k-means coarse quantizer + bucket-padded inverted lists +
+  probe-bounded two-stage scan (KNN past the brute-force wall)
 - ``infotheory``       — entropy/gini/Hellinger/class-confidence split
   stats, mutual information, gain-ratio pieces
 - ``scanops``          — Viterbi as lax.scan + max-plus associative form
@@ -36,6 +39,8 @@ import jax.numpy as jnp
 from avenir_tpu.ops.distance import (  # noqa: F401
     TOPK_BIG, finalize_topk, fused_topk_xla, pairwise_full, pairwise_topk,
     pairwise_topk_donated, pairwise_topk_raw)
+from avenir_tpu.ops.ivf import (  # noqa: F401
+    IvfIndex, ShardedIvfIndex, ann_topk, build_ivf, build_sharded_ivf)
 from avenir_tpu.ops.quantized import quantized_topk  # noqa: F401
 
 try:

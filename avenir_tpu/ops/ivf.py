@@ -1,0 +1,560 @@
+"""TPU-native IVF approximate nearest neighbor: KNN past the brute-force
+wall (ISSUE 14 / ROADMAP item 3).
+
+Exact KNN is O(N) per query — at "millions of users" train sets the bulk
+rows/s number stops mattering. The classic answer (Jégou et al.'s IVF,
+the FAISS billion-scale design) is to cluster the train set once and
+probe only a few inverted lists per query. This module is that index,
+built end to end from the kernel family PR 10 established:
+
+- **Coarse quantizer** (:func:`build_ivf`): device k-means over the
+  encoded feature space — k-means++ seeding from a fixed host seed (so
+  two processes build bit-identical indexes), Lloyd iterations as ONE
+  jitted step whose per-centroid assignment counts run through the
+  existing Pallas histogram dispatch (``histogram.class_feature_bin_
+  counts`` with the combined-index pattern: one class, ``nlist`` bins)
+  and whose per-centroid sums are a single one-hot MXU contraction.
+  Empty clusters keep their previous centroid (the standard Lloyd
+  degeneracy rule), which is also what makes ``nlist > N`` legal: the
+  surplus centroids simply own empty lists.
+
+- **Inverted-list layout**: the train table reordered by centroid into
+  one flat ``[N_pad, D]`` staged table with per-list offsets. Each
+  list's span is bucket-padded to a power-of-two row count
+  (``pipeline.bucket_rows`` — the established discipline), padding rows
+  carrying global id −1, and the probe gather width ``probe_pad`` is
+  the bucketed maximum list length — so however ragged the clustering,
+  the query program compiles for a SMALL set of static shapes and the
+  jit cache stays flat across index builds.
+
+- **Query path** (:func:`ann_topk`): centroid distances pick the
+  ``n_probe`` nearest lists (deferred ``c²−2xc`` metric, ties to the
+  lowest centroid id), then a ``lax.scan`` over probes gathers each
+  list's bucket-padded candidate block and reruns the PR 10 two-stage
+  scan UNCHANGED in spirit and shared in code: the low-precision
+  int8/bf16 candidate metric (``quantized.gathered_candidate_metric`` —
+  the batched twin of the brute-force block metric, bit-equal per pair
+  for int8) feeds a running top-k′ merge keyed two-level on
+  ``(metric, global row id)``, and the survivors re-rank in exact f32
+  (``quantized.exact_candidate_metric`` + the same two-key sort) before
+  ``quantized.finalize_quantized`` emits the reference's scaled ints.
+
+**Why ``n_probe = nlist`` reproduces the quantized brute force exactly
+(int8):** the joint quantization scale is the same expression over the
+same operands (``127 / max(|x|, |y|)`` — the index stores ``max|y|`` at
+build and joins the query chunk's ``max|x|``), int8 metric arithmetic is
+exact integer math (order-free), and BOTH candidate selections are the
+top-k′ of that metric under the same tie rule (lowest global row id:
+the brute-force running merge inherits it from ``lax.top_k`` stability
+over row-ordered blocks; the IVF merge enforces it with an explicit
+two-key sort). Identical candidate sets then re-rank through identical
+f32 expressions and identical two-key ordering — so full probing IS the
+brute-force result, and ``n_probe < nlist`` differs only by rows in
+unprobed lists (the recall knob, gated at ≥ 0.985 like every sibling).
+
+Scale-out: :func:`build_sharded_ivf` partitions the LISTS of one global
+k-means across the mesh's ``data`` axis (the FAISS multi-GPU shape:
+each shard holds an IVF over its partition, queries replicate, per-shard
+top-k candidates all-gather into the exact two-key merge —
+``parallel.collective.sharded_ann_topk``). Each shard probes its own
+``n_probe`` nearest lists, so any globally-nearest list is probed by
+the shard that owns it and recall can only improve on the single-device
+index at equal ``n_probe``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from avenir_tpu.ops import histogram
+from avenir_tpu.ops.distance import INT_BIG, encode_mixed
+from avenir_tpu.ops.quantized import (QDTYPES, _BIG, _q8,
+                                      exact_candidate_metric,
+                                      finalize_quantized,
+                                      gathered_candidate_metric, int8_scale)
+
+#: per-list bucket floor — lists pad to bucket_rows(len, _LIST_FLOOR), so
+#: tiny/ragged lists share a handful of power-of-two span shapes instead
+#: of minting one jit entry per clustering outcome
+_LIST_FLOOR = 8
+
+
+def default_nlist(n: int) -> int:
+    """Auto ``nlist``: ~√N (the IVF textbook rule) capped so lists hold
+    ≥ 64 rows. The cap is what keeps tiny tables honest: below ~4k rows
+    it collapses the index toward few lists (and with the default
+    ``n_probe`` floor, toward full probing ≡ brute force), because IVF
+    recall on small uniform tables is structurally poor and an index
+    that small saves nothing anyway."""
+    n = max(int(n), 1)
+    root = int(round(float(np.sqrt(n))))
+    return max(1, min(root, max(1, n // 64)))
+
+
+def default_nprobe(nlist: int) -> int:
+    """Auto ``n_probe``: a quarter of the lists with a floor of 8 —
+    recall-favoring by design (the default must clear the ≥ 0.985 bar on
+    the adversarial parity matrix, where small uniform tables are the
+    worst case; the bench grid explores sharper speed/recall points for
+    callers who want them)."""
+    return max(1, min(nlist, max(8, nlist // 4)))
+
+
+# ---------------------------------------------------------------------------
+# coarse quantizer: k-means++ seeding + jitted Lloyd steps
+# ---------------------------------------------------------------------------
+
+def _seed_centroids(y: np.ndarray, nlist: int,
+                    rng: np.random.Generator) -> np.ndarray:
+    """k-means++ seeding on the host (fixed-seed ``rng`` → bit-identical
+    across processes): each next seed is drawn ∝ squared distance to the
+    nearest chosen one. When fewer than ``nlist`` distinct rows exist the
+    surplus seeds duplicate (ties assign to the lowest centroid id, so
+    duplicates own empty lists — the degenerate-clustering contract)."""
+    n = y.shape[0]
+    y64 = y.astype(np.float64)
+    first = int(rng.integers(n))
+    cents = [y[first]]
+    d2 = ((y64 - y64[first]) ** 2).sum(axis=1)
+    for _ in range(1, nlist):
+        total = float(d2.sum())
+        if total <= 0.0:
+            idx = int(rng.integers(n))
+        else:
+            idx = int(rng.choice(n, p=d2 / total))
+        cents.append(y[idx])
+        d2 = np.minimum(d2, ((y64 - y64[idx]) ** 2).sum(axis=1))
+    return np.stack(cents).astype(np.float32)
+
+
+@jax.jit
+def _lloyd_step(y: jnp.ndarray, cents: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One Lloyd iteration as one device program: assign every row to its
+    nearest centroid (deferred ``c²−2yc`` metric — per-row constants
+    cancel under argmin; ties take the lowest centroid id), fold the
+    per-centroid assignment counts through the histogram dispatch (the
+    Pallas combined-index kernel on TPU, the jnp one-hot elsewhere —
+    bit-identical integer counts either way), and close the mean update
+    with a one-hot MXU contraction. Returns (new centroids, assignment,
+    max squared centroid shift)."""
+    nlist = cents.shape[0]
+    c2 = jnp.sum(cents * cents, axis=1)[None, :]            # [1, L]
+    metric = c2 - 2.0 * (y @ cents.T)                       # [N, L]
+    assign = jnp.argmin(metric, axis=1).astype(jnp.int32)
+    counts = histogram.class_feature_bin_counts(
+        assign[:, None], jnp.zeros((y.shape[0],), jnp.int32),
+        n_classes=1, n_bins=nlist).reshape(nlist)           # [L]
+    sums = jax.nn.one_hot(assign, nlist, dtype=jnp.float32).T @ y
+    new = jnp.where((counts > 0)[:, None],
+                    sums / jnp.maximum(counts, 1.0)[:, None], cents)
+    shift = jnp.max(jnp.sum((new - cents) ** 2, axis=1))
+    return new, assign, shift
+
+
+@jax.jit
+def _assign_rows(y: jnp.ndarray, cents: jnp.ndarray) -> jnp.ndarray:
+    """Nearest-centroid assignment (argmin ties → lowest centroid id) —
+    the FINAL pass after Lloyd stops, so the inverted lists agree with
+    the centroids queries will actually probe (a row filed under its
+    pre-update-nearest list would be invisible to a sparse probe of its
+    post-update-nearest one)."""
+    c2 = jnp.sum(cents * cents, axis=1)[None, :]
+    return jnp.argmin(c2 - 2.0 * (y @ cents.T), axis=1).astype(jnp.int32)
+
+
+def train_coarse_quantizer(y: jnp.ndarray, nlist: int, *, n_iters: int = 15,
+                           seed: int = 0, seed_sample: int = 64,
+                           tol: float = 1e-12
+                           ) -> Tuple[jnp.ndarray, np.ndarray]:
+    """Device k-means over the encoded rows ``y`` [N, D]: host k-means++
+    seeding (on a deterministic sample of ≤ ``seed_sample·nlist`` rows —
+    the FAISS training-subsample discipline, sized so seeding never
+    dominates the build) + ``n_iters`` jitted Lloyd steps with an early
+    stop once the largest centroid move drops under ``tol``. Returns
+    (centroids [nlist, D] device, final assignment [N] host int32)."""
+    n = int(y.shape[0])
+    if nlist < 1:
+        raise ValueError(f"nlist must be >= 1, got {nlist}")
+    if n_iters < 0:
+        raise ValueError(f"n_iters must be >= 0, got {n_iters}")
+    rng = np.random.default_rng(seed)
+    y_host = np.asarray(y, np.float32)
+    cap = max(nlist, min(n, seed_sample * nlist))
+    sample = (y_host if cap >= n
+              else y_host[rng.choice(n, cap, replace=False)])
+    cents = jnp.asarray(_seed_centroids(sample, nlist, rng))
+    for _ in range(n_iters):
+        cents, _, shift = _lloyd_step(y, cents)
+        if float(shift) < tol:
+            break
+    # the returned assignment must be computed against the RETURNED
+    # centroids (the Lloyd step's assignment is one update behind its
+    # output) — n_iters=0 is the pure k-means++ seeding
+    return cents, np.asarray(_assign_rows(y, cents), np.int32)
+
+
+# ---------------------------------------------------------------------------
+# inverted-list layout
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class IvfIndex:
+    """One staged IVF index: the reordered flat table plus probe metadata.
+    All arrays are device-resident; the dataclass is what the one-slot
+    train cache in ``models/knn.py`` pins."""
+
+    centroids: jax.Array      # [L, D] f32 (encoded space)
+    cent_valid: jax.Array     # [L] bool — False for structural pad lists
+    flat: jax.Array           # [N_pad, D] f32, rows grouped by list
+    qflat: jax.Array          # [N_pad, D] int8 at the BUILD scale (amax)
+    gids: jax.Array           # [N_pad] int32 original row ids, -1 padding
+    offsets: jax.Array        # [L] int32 list start in ``flat``
+    lengths: jax.Array        # [L] int32 real rows per list
+    amax: jax.Array           # [] f32 max |y| over real rows (int8 scale)
+    nlist: int
+    probe_pad: int            # bucketed max list length (static gather width)
+    n_real: int
+    n_attrs: int
+    n_cat_bins: int
+    seed: int
+
+    @property
+    def d(self) -> int:
+        return int(self.flat.shape[1])
+
+
+def _build_lists(encoded: np.ndarray, assign: np.ndarray, nlist: int
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+                            int]:
+    """Host assembly of the bucket-padded flat layout. Returns
+    (flat [N_pad, D], gids [N_pad], offsets [L], lengths [L], probe_pad).
+    Rows keep their original-id order WITHIN each list (stable argsort),
+    so per-list candidate blocks enumerate ids ascending — one of the
+    pieces the brute-force tie-rule equivalence leans on."""
+    from avenir_tpu.parallel.pipeline import bucket_rows
+    n, d = encoded.shape
+    order = np.argsort(assign, kind="stable")
+    lengths = np.bincount(assign, minlength=nlist).astype(np.int32)
+    padded = np.asarray([bucket_rows(int(c), _LIST_FLOOR) for c in lengths],
+                        np.int64)
+    offsets = np.zeros(nlist, np.int64)
+    offsets[1:] = np.cumsum(padded)[:-1]
+    n_pad = int(padded.sum())
+    flat = np.zeros((n_pad, d), np.float32)
+    gids = np.full(n_pad, -1, np.int32)
+    starts = np.zeros(nlist, np.int64)
+    starts[1:] = np.cumsum(lengths.astype(np.int64))[:-1]
+    for li in range(nlist):
+        c = int(lengths[li])
+        if c == 0:
+            continue
+        rows = order[starts[li]:starts[li] + c]
+        flat[offsets[li]:offsets[li] + c] = encoded[rows]
+        gids[offsets[li]:offsets[li] + c] = rows
+    probe_pad = int(padded.max()) if nlist else _LIST_FLOOR
+    return (flat, gids, offsets.astype(np.int32), lengths,
+            probe_pad)
+
+
+def build_ivf(y_num: Optional[jnp.ndarray],
+              y_cat: Optional[jnp.ndarray] = None, *, n_cat_bins: int = 0,
+              nlist: int = 0, n_iters: int = 15, seed: int = 0) -> IvfIndex:
+    """Build the IVF index over already-normalized train features (the
+    same input contract as every kernel sibling). ``nlist=0`` auto-sizes
+    to ~√N. Deterministic for a fixed ``seed`` across processes."""
+    y = encode_mixed(y_num, y_cat, n_cat_bins)
+    n = int(y.shape[0])
+    if n == 0:
+        raise ValueError("cannot build an IVF index over an empty train "
+                         "table")
+    if nlist == 0:
+        nlist = default_nlist(n)
+    cents, assign = train_coarse_quantizer(y, nlist, n_iters=n_iters,
+                                           seed=seed)
+    encoded = np.asarray(y, np.float32)
+    flat, gids, offsets, lengths, probe_pad = _build_lists(
+        encoded, assign, nlist)
+    amax = float(np.max(np.abs(encoded))) if n else 0.0
+    n_attrs = ((y_num.shape[1] if y_num is not None else 0) +
+               (y_cat.shape[1] if y_cat is not None else 0))
+    flat_dev = jnp.asarray(flat)
+    return IvfIndex(
+        centroids=cents, cent_valid=jnp.ones((nlist,), bool),
+        flat=flat_dev,
+        qflat=_q8(flat_dev, int8_scale(jnp.float32(amax))),
+        gids=jnp.asarray(gids),
+        offsets=jnp.asarray(offsets), lengths=jnp.asarray(lengths),
+        amax=jnp.float32(amax), nlist=nlist, probe_pad=probe_pad,
+        n_real=n, n_attrs=n_attrs, n_cat_bins=n_cat_bins, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# query path: probe -> gathered candidate scan -> exact re-rank
+# ---------------------------------------------------------------------------
+
+def ann_core(x: jnp.ndarray, cents: jnp.ndarray, cvalid: jnp.ndarray,
+             flat: jnp.ndarray, build_qflat: jnp.ndarray,
+             gids: jnp.ndarray, offsets: jnp.ndarray,
+             lengths: jnp.ndarray, amax: jnp.ndarray, *, n_probe: int,
+             probe_pad: int, kprime: int, k_out: int, n_attrs: int,
+             qdtype: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The trace-level query core, shared verbatim by the single-device
+    jit and the per-shard ``shard_map`` body: probe selection, the
+    per-probe gathered candidate scan with the two-key running merge,
+    and the exact f32 re-rank. Returns the PRE-finalize sorted key
+    (exact f32 metric with ``_BIG`` sentinels, global row ids with
+    ``INT_BIG`` sentinels) — exactly the contract
+    ``quantized.finalize_quantized`` and the cross-shard merge consume."""
+    m = x.shape[0]
+    n_pad_rows = flat.shape[0]
+    big = jnp.float32(_BIG)
+
+    # 1. probe selection: deferred centroid metric, invalid (structural
+    # pad) centroids pushed past every real one; stable top_k breaks
+    # distance ties toward the lowest centroid id
+    c2 = jnp.sum(cents * cents, axis=1)[None, :]
+    cd = c2 - 2.0 * (x @ cents.T)                           # [M, L]
+    cd = jnp.where(cvalid[None, :], cd, big)
+    _, probe_ids = lax.top_k(-cd, n_probe)                  # [M, P]
+
+    # 2. candidate scan: one probed list per scan step; quantization at
+    # the JOINT scale (stored train amax ∨ this chunk's amax) so int8
+    # metrics are bit-equal to the brute-force scan's. The int8 table is
+    # prebuilt at the BUILD scale: whenever the chunk stays within the
+    # train magnitude range (max|x| ≤ amax — the normalized-feature
+    # norm) the joint scale IS the build scale and the prebuilt bytes
+    # are exactly _q8(flat, s), so the scan gathers 1-byte rows with no
+    # per-chunk table work at all; only an out-of-range chunk pays one
+    # O(N_pad·D) re-quantize (lax.cond — _q8 commutes with the gather
+    # either way, which is what keeps full-probe parity exact).
+    if qdtype == "int8":
+        amax_x = jnp.max(jnp.abs(x))
+        s = int8_scale(jnp.maximum(amax, amax_x))
+        xq = _q8(x, s)
+        qflat = lax.cond(amax_x <= amax,
+                         lambda: build_qflat,
+                         lambda: _q8(flat, s))
+    else:
+        xq, qflat = x, flat          # bf16 casts inside the metric
+
+    def body(carry, pid):
+        best_d, best_g, best_p = carry
+        off = offsets[pid]                                  # [M]
+        iota = jnp.arange(probe_pad, dtype=jnp.int32)[None, :]
+        pos = jnp.clip(off[:, None] + iota, 0, max(n_pad_rows - 1, 0))
+        g = gids[pos]                                       # [M, LP]
+        yq = qflat[pos]                                     # [M, LP, D]
+        metric = gathered_candidate_metric(xq, yq, qdtype)
+        # a slot is a candidate only within ITS list's real rows: the
+        # gather width is the bucketed MAX list length, so past a short
+        # list's own span it reads (bucket padding, gid -1, or) the NEXT
+        # list's rows — unmasked those would enter twice when their own
+        # list is probed and crowd real neighbors out of the merge
+        found = (iota < lengths[pid][:, None]) & (g >= 0)
+        metric = jnp.where(found, metric, big)
+        gkey = jnp.where(found, g, INT_BIG)
+        all_d = jnp.concatenate([best_d, metric], axis=1)
+        all_g = jnp.concatenate([best_g, gkey], axis=1)
+        all_p = jnp.concatenate([best_p, pos], axis=1)
+        # two-key merge: global top-k' by (metric, lowest global row id)
+        # — the brute-force scan's tie rule, enforced explicitly
+        d_s, g_s, p_s = lax.sort((all_d, all_g, all_p), dimension=1,
+                                 num_keys=2)
+        return (d_s[:, :kprime], g_s[:, :kprime], p_s[:, :kprime]), None
+
+    init = (jnp.full((m, kprime), big, jnp.float32),
+            jnp.full((m, kprime), INT_BIG, jnp.int32),
+            jnp.zeros((m, kprime), jnp.int32))
+    (cand_d, cand_g, cand_p), _ = lax.scan(body, init, probe_ids.T)
+
+    # 3. exact f32 re-rank of the survivors: the elementwise metric +
+    # two-key (metric, global id) sort — identical expressions and
+    # ordering rule to quantized._rerank_metric, with the flat-table
+    # position riding as a passenger so the gather needs no id->row map
+    found = cand_g < INT_BIG
+    yc = flat[jnp.clip(cand_p, 0, max(n_pad_rows - 1, 0))]  # [M, K', D]
+    em = exact_candidate_metric(x, yc, n_attrs)
+    em = jnp.where(found, em, big)
+    m_s, g_s, _ = lax.sort((em, jnp.where(found, cand_g, INT_BIG), cand_p),
+                           dimension=1, num_keys=2)
+    return m_s[:, :k_out], g_s[:, :k_out]
+
+
+_ANN_STATICS = ("n_probe", "probe_pad", "kprime", "k_out", "n_attrs",
+                "qdtype", "distance_scale")
+
+
+@partial(jax.jit, static_argnames=_ANN_STATICS)
+def _ann_query(x, cents, cvalid, flat, qflat, gids, offsets, lengths,
+               amax, *, n_probe, probe_pad, kprime, k_out, n_attrs,
+               qdtype, distance_scale):
+    return finalize_quantized(
+        *ann_core(x, cents, cvalid, flat, qflat, gids, offsets, lengths,
+                  amax, n_probe=n_probe, probe_pad=probe_pad,
+                  kprime=kprime, k_out=k_out, n_attrs=n_attrs,
+                  qdtype=qdtype),
+        distance_scale)
+
+
+def ann_topk(index: IvfIndex, x_num: Optional[jnp.ndarray],
+             x_cat: Optional[jnp.ndarray] = None, *, k: int,
+             n_probe: int = 0, oversample: int = 4, qdtype: str = "int8",
+             distance_scale: int = 1000) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Query the IVF index: drop-in for ``quantized_topk`` over the same
+    normalized features — (scaled-int distances [M, min(k, N)], ORIGINAL
+    train-row indices). ``n_probe=0`` auto-selects
+    :func:`default_nprobe`; ``n_probe == nlist`` probes everything and
+    reproduces the brute-force quantized path exactly (int8)."""
+    if qdtype not in QDTYPES:
+        raise ValueError(f"qdtype {qdtype!r} not one of {QDTYPES}")
+    if oversample < 1:
+        raise ValueError("oversample must be >= 1")
+    if n_probe == 0:
+        n_probe = default_nprobe(index.nlist)
+    if not 1 <= n_probe <= index.nlist:
+        raise ValueError(
+            f"n_probe must be in [1, nlist={index.nlist}], got {n_probe}")
+    x = encode_mixed(x_num, x_cat, index.n_cat_bins)
+    n = index.n_real
+    k_eff = max(min(k, n), 1)
+    kprime = min(max(oversample * k_eff, k_eff), max(n, 1))
+    return _ann_query(
+        x, index.centroids, index.cent_valid, index.flat, index.qflat,
+        index.gids, index.offsets, index.lengths, index.amax,
+        n_probe=n_probe, probe_pad=index.probe_pad, kprime=kprime,
+        k_out=k_eff, n_attrs=index.n_attrs, qdtype=qdtype,
+        distance_scale=distance_scale)
+
+
+# ---------------------------------------------------------------------------
+# sharded layout: one global k-means, lists partitioned across the mesh
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ShardedIvfIndex:
+    """Per-shard index arrays stacked on a row-sharded leading axis:
+    shard ``s`` owns lists ``[s·lists_per, (s+1)·lists_per)`` of the
+    global k-means (structural pad lists fill the tail — ``cent_valid``
+    False, zero-length). Offsets are LOCAL to each shard's flat block;
+    ``gids`` stay GLOBAL original row ids, so the cross-shard merge key
+    is exactly the single-device ordering rule."""
+
+    centroids: jax.Array      # [S*Lp, D] row-sharded
+    cent_valid: jax.Array     # [S*Lp] bool
+    flat: jax.Array           # [S*Fp, D] row-sharded
+    qflat: jax.Array          # [S*Fp, D] int8 at each shard's build scale
+    gids: jax.Array           # [S*Fp] int32 global ids, -1 padding
+    offsets: jax.Array        # [S*Lp] int32 local to the shard block
+    lengths: jax.Array        # [S*Lp] int32
+    amax: jax.Array           # [S] f32 per-shard max |y| over real rows
+    n_shards: int
+    lists_per: int
+    flat_per: int
+    nlist: int                # total real lists across the fleet
+    probe_pad: int
+    n_real: int
+    n_attrs: int
+    n_cat_bins: int
+    seed: int
+
+
+def build_sharded_ivf(y_num: Optional[jnp.ndarray],
+                      y_cat: Optional[jnp.ndarray] = None, *, mesh,
+                      n_cat_bins: int = 0, nlist: int = 0, n_iters: int = 15,
+                      seed: int = 0) -> ShardedIvfIndex:
+    """One global k-means, lists partitioned contiguously across the
+    mesh's ``data`` axis, each shard's block bucket-padded to the common
+    maxima so the stacked arrays row-shard evenly. Queries replicate;
+    ``parallel.collective.sharded_ann_topk`` runs the probe core per
+    shard and closes with the all-gather + exact two-key merge."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from avenir_tpu.parallel.mesh import DATA_AXIS
+    from avenir_tpu.parallel.pipeline import bucket_rows
+    n_shards = mesh.shape[DATA_AXIS]
+    y = encode_mixed(y_num, y_cat, n_cat_bins)
+    n = int(y.shape[0])
+    if nlist == 0:
+        nlist = default_nlist(n)
+    if nlist < n_shards:
+        raise ValueError(
+            f"nlist={nlist} < {n_shards} shards: every shard must hold at "
+            "least one list (raise knn.ann.nlist or shrink the mesh)")
+    cents, assign = train_coarse_quantizer(y, nlist, n_iters=n_iters,
+                                           seed=seed)
+    encoded = np.asarray(y, np.float32)
+    cents_np = np.asarray(cents, np.float32)
+    lists_per = (nlist + n_shards - 1) // n_shards
+    d = encoded.shape[1]
+
+    shard_parts = []
+    for s in range(n_shards):
+        lo, hi = s * lists_per, min((s + 1) * lists_per, nlist)
+        own = np.arange(lo, hi)
+        member_mask = np.isin(assign, own)
+        rows = np.nonzero(member_mask)[0]
+        local_assign = np.searchsorted(own, assign[rows]) if len(own) \
+            else np.zeros(0, np.int64)
+        flat, gids, offsets, lengths, ppad = _build_lists(
+            encoded[rows], local_assign.astype(np.int32), max(len(own), 1))
+        # _build_lists numbers rows 0..len(rows)-1; lift to GLOBAL ids.
+        # A shard can own zero rows (uneven ceil-division leaves the
+        # tail shard listless, or every owned list came out empty) —
+        # np.where evaluates the gather eagerly, so guard the empty case
+        # instead of indexing an empty array
+        if len(rows):
+            gids = np.where(gids >= 0, rows[np.clip(gids, 0, None)], -1)
+            gids = gids.astype(np.int32)
+        else:
+            gids = np.full(gids.shape, -1, np.int32)
+        shard_parts.append((cents_np[lo:hi], flat, gids, offsets, lengths,
+                            ppad, len(own)))
+
+    probe_pad = max(bucket_rows(p[5], _LIST_FLOOR) for p in shard_parts)
+    flat_per = max(bucket_rows(p[1].shape[0], _LIST_FLOOR)
+                   for p in shard_parts)
+    c_all = np.zeros((n_shards * lists_per, d), np.float32)
+    v_all = np.zeros(n_shards * lists_per, bool)
+    f_all = np.zeros((n_shards * flat_per, d), np.float32)
+    g_all = np.full(n_shards * flat_per, -1, np.int32)
+    o_all = np.zeros(n_shards * lists_per, np.int32)
+    l_all = np.zeros(n_shards * lists_per, np.int32)
+    a_all = np.zeros(n_shards, np.float32)
+    q_all = np.zeros((n_shards * flat_per, d), np.int8)
+    for s, (c, flat, gids, offsets, lengths, _, n_own) in enumerate(
+            shard_parts):
+        c_all[s * lists_per:s * lists_per + n_own] = c
+        v_all[s * lists_per:s * lists_per + n_own] = True
+        f_all[s * flat_per:s * flat_per + flat.shape[0]] = flat
+        g_all[s * flat_per:s * flat_per + flat.shape[0]] = gids
+        o_all[s * lists_per:s * lists_per + n_own] = offsets[:n_own]
+        l_all[s * lists_per:s * lists_per + n_own] = lengths[:n_own]
+        real = gids >= 0
+        a_all[s] = float(np.max(np.abs(flat[real]))) if real.any() else 0.0
+        # the shard's prebuilt int8 table at ITS build scale (the same
+        # _q8 expression the query core applies, so the bytes are
+        # exactly the in-range-chunk quantization)
+        q_all[s * flat_per:s * flat_per + flat.shape[0]] = np.asarray(
+            _q8(jnp.asarray(flat), int8_scale(jnp.float32(a_all[s]))))
+
+    def put(a):
+        spec = P(*((DATA_AXIS,) + (None,) * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return ShardedIvfIndex(
+        centroids=put(c_all), cent_valid=put(v_all), flat=put(f_all),
+        qflat=put(q_all), gids=put(g_all), offsets=put(o_all),
+        lengths=put(l_all),
+        amax=put(a_all), n_shards=n_shards, lists_per=lists_per,
+        flat_per=flat_per, nlist=nlist, probe_pad=probe_pad, n_real=n,
+        n_attrs=((y_num.shape[1] if y_num is not None else 0) +
+                 (y_cat.shape[1] if y_cat is not None else 0)),
+        n_cat_bins=n_cat_bins, seed=seed)

@@ -45,15 +45,25 @@ _BIG = 3.4e38
 QDTYPES = ("int8", "bf16")
 
 
+def _q8(v: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Symmetric fixed-point int8 of ``v`` at scale ``s`` — the one
+    quantization expression every int8 consumer (brute-force scan, IVF
+    gathered scan) shares, so "same scale" implies "same bytes"."""
+    return jnp.clip(jnp.round(v * s), -127, 127).astype(jnp.int8)
+
+
+def int8_scale(amax: jnp.ndarray) -> jnp.ndarray:
+    """The global symmetric scale for a joint magnitude bound."""
+    return 127.0 / jnp.maximum(amax, jnp.float32(1e-30))
+
+
 def _quantize_int8(x: jnp.ndarray, y: jnp.ndarray
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Global symmetric int8 quantization of both operands (shared scale —
     ranking survives only a uniform transform)."""
     amax = jnp.maximum(jnp.max(jnp.abs(x)), jnp.max(jnp.abs(y)))
-    s = 127.0 / jnp.maximum(amax, jnp.float32(1e-30))
-    qx = jnp.clip(jnp.round(x * s), -127, 127).astype(jnp.int8)
-    qy = jnp.clip(jnp.round(y * s), -127, 127).astype(jnp.int8)
-    return qx, qy
+    s = int8_scale(amax)
+    return _q8(x, s), _q8(y, s)
 
 
 def _candidate_metric(xq, yq_block, qdtype: str) -> jnp.ndarray:
@@ -69,6 +79,29 @@ def _candidate_metric(xq, yq_block, qdtype: str) -> jnp.ndarray:
                             (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     y2 = jnp.sum(yq_block * yq_block, axis=1)[None, :]
+    return y2 - 2.0 * cross
+
+
+def gathered_candidate_metric(xq: jnp.ndarray, yq: jnp.ndarray,
+                              qdtype: str) -> jnp.ndarray:
+    """[M, D] × [M, C, D] per-query gathered candidates -> [M, C]
+    low-precision metric — the batched twin of :func:`_candidate_metric`
+    for candidate sets that differ per query (the IVF probe scan,
+    ``ops/ivf.py``). int8 arithmetic is exact integer math, so each
+    (query, row) pair's metric is bit-equal to the brute-force scan's —
+    the property the ``n_probe = nlist`` ≡ brute-force parity rides on.
+    bf16 accumulates in f32 with a shape-dependent reduction order, so it
+    carries no bit-equality claim (recall bounds only)."""
+    if qdtype == "int8":
+        cross = lax.dot_general(yq, xq, (((2,), (1,)), ((0,), (0,))),
+                                preferred_element_type=jnp.int32)   # [M, C]
+        y2 = jnp.sum(yq.astype(jnp.int32) ** 2, axis=2)
+        return (y2 - 2 * cross).astype(jnp.float32)
+    cross = lax.dot_general(yq.astype(jnp.bfloat16),
+                            xq.astype(jnp.bfloat16),
+                            (((2,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+    y2 = jnp.sum(yq * yq, axis=2)
     return y2 - 2.0 * cross
 
 
@@ -113,6 +146,16 @@ def _candidate_topk(x: jnp.ndarray, y: jnp.ndarray, kprime: int,
     return best_i
 
 
+def exact_candidate_metric(x: jnp.ndarray, yc: jnp.ndarray, n_attrs: int
+                           ) -> jnp.ndarray:
+    """[M, D] × [M, K', D] gathered candidates -> [M, K'] exact f32
+    re-rank metric: ELEMENTWISE ``Σ(x−y)²/n_attrs`` (no cancellation —
+    see :func:`_rerank_metric`). Shared by the brute-force re-rank and
+    the IVF probe path so "same survivors" implies "same f32 metrics"."""
+    diff = x[:, None, :] - yc
+    return jnp.sum(diff * diff, axis=2) / max(n_attrs, 1)
+
+
 def _rerank_metric(x: jnp.ndarray, y: jnp.ndarray, cand_i: jnp.ndarray,
                    k: int, n_attrs: int
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -132,8 +175,7 @@ def _rerank_metric(x: jnp.ndarray, y: jnp.ndarray, cand_i: jnp.ndarray,
     adversarial parity matrix pins."""
     found = cand_i >= 0
     yc = y[jnp.maximum(cand_i, 0)]                     # [M, K', D]
-    diff = x[:, None, :] - yc
-    metric = jnp.sum(diff * diff, axis=2) / max(n_attrs, 1)
+    metric = exact_candidate_metric(x, yc, n_attrs)
     metric = jnp.where(found, metric, jnp.float32(_BIG))
     idx_key = jnp.where(found, cand_i, INT_BIG)
     metric_s, idx_s = lax.sort((metric, idx_key), dimension=1, num_keys=2)

@@ -442,6 +442,123 @@ def sharded_quantized_topk(x_num: Optional[jnp.ndarray],
 
 
 # ---------------------------------------------------------------------------
+# sharded IVF ANN: per-shard list probe + exact re-rank + two-key merge
+# ---------------------------------------------------------------------------
+
+_ANN_PROGRAMS: Dict[tuple, object] = {}
+
+
+def sharded_ann_topk(x_num: Optional[jnp.ndarray],
+                     x_cat: Optional[jnp.ndarray] = None, *, index,
+                     mesh: Mesh, k: int, n_probe: int = 0,
+                     oversample: int = 4, qdtype: str = "int8",
+                     distance_scale: int = 1000
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """``knn.sharded`` × ``knn.ann`` composed (ISSUE 14): ``index`` is an
+    ``ops.ivf.ShardedIvfIndex`` — ONE global k-means whose inverted lists
+    partition contiguously across the mesh's ``data`` axis (the FAISS
+    multi-GPU shape). Each shard probes the ``n_probe`` nearest of ITS
+    lists (any globally-nearest list is therefore probed by the shard
+    that owns it — recall can only improve on one device at equal
+    ``n_probe``), runs the gathered quantized candidate scan + EXACT f32
+    re-rank over its own rows (``ops.ivf.ann_core`` — the identical
+    trace the single-device jit runs), and only then do the per-shard
+    top-k candidates all-gather into the second exact two-key
+    (f32 metric, global row id) merge — the ``sharded_topk`` /
+    ``sharded_quantized_topk`` order/tie-break semantics verbatim.
+
+    Per-shard int8 scales are computed from (test, LOCAL rows) exactly
+    like ``sharded_quantized_topk``: scales may differ per shard, which
+    only moves each shard's RECALL, never the cross-shard ordering —
+    the merge key is the exact re-rank metric."""
+    from avenir_tpu.ops.distance import INT_BIG as _AINT_BIG
+    from avenir_tpu.ops.ivf import ShardedIvfIndex, ann_core
+    from avenir_tpu.ops.quantized import (QDTYPES, _BIG as _ABIG,
+                                          finalize_quantized)
+    if not isinstance(index, ShardedIvfIndex):
+        raise ValueError("sharded_ann_topk needs a ShardedIvfIndex "
+                         "(ops.ivf.build_sharded_ivf)")
+    if qdtype not in QDTYPES:
+        raise ValueError(f"qdtype {qdtype!r} not one of {QDTYPES}")
+    if oversample < 1:
+        raise ValueError("oversample must be >= 1")
+    axis = DATA_AXIS
+    n_shards = mesh.shape[axis]
+    if n_shards != index.n_shards:
+        raise ValueError(
+            f"index built for {index.n_shards} shards, mesh has {n_shards}")
+    if n_probe == 0:
+        from avenir_tpu.ops.ivf import default_nprobe
+        n_probe = default_nprobe(index.nlist)
+    if not 1 <= n_probe <= index.nlist:
+        raise ValueError(
+            f"n_probe must be in [1, nlist={index.nlist}], got {n_probe}")
+    from avenir_tpu.ops.distance import encode_mixed
+    x = encode_mixed(x_num, x_cat, index.n_cat_bins)
+    n_real = index.n_real
+    k_out = max(min(k, n_real), 1)
+    # each shard probes the n_probe nearest of its OWN lists (capped at
+    # what it holds); k' sized like the single-device path (the n_real
+    # cap keeps the 1-shard full-probe program the single-device
+    # truncation exactly) with the shard's probe capacity as a ceiling
+    n_probe_local = min(n_probe, index.lists_per)
+    kprime = min(max(oversample * k_out, k_out), max(n_real, 1),
+                 max(n_probe_local * index.probe_pad, 1))
+    k_local = min(k_out, kprime)
+
+    key = (mesh, index.lists_per, index.flat_per, index.probe_pad,
+           n_probe_local, kprime, k_local, k_out, index.n_attrs, qdtype,
+           distance_scale, n_real)
+    prog = _ANN_PROGRAMS.get(key)
+    if prog is None:
+        in_specs = (P(None, None), _row_spec(2), P(axis), _row_spec(2),
+                    _row_spec(2), P(axis), P(axis), P(axis), P(axis))
+
+        def shard_body(sx, scents, svalid, sflat, sqflat, sgids, soff,
+                       slen, samax):
+            md, gd = ann_core(
+                sx, scents, svalid, sflat, sqflat, sgids, soff, slen,
+                samax[0], n_probe=n_probe_local,
+                probe_pad=index.probe_pad, kprime=kprime, k_out=k_local,
+                n_attrs=index.n_attrs, qdtype=qdtype)
+            m_all = lax.all_gather(md, axis, axis=1, tiled=True)
+            i_all = lax.all_gather(gd, axis, axis=1, tiled=True)
+            if m_all.shape[1] < k_out:
+                # probe capacity can cap k_local below k_out (tiny
+                # lists, sparse probe) — pad with sentinel columns so
+                # the output keeps the [M, min(k, n_real)] contract
+                # every sibling honors (finalize turns them into -1)
+                pad = k_out - m_all.shape[1]
+                mrows = m_all.shape[0]
+                m_all = jnp.concatenate(
+                    [m_all, jnp.full((mrows, pad), jnp.float32(_ABIG))],
+                    axis=1)
+                i_all = jnp.concatenate(
+                    [i_all, jnp.full((mrows, pad), _AINT_BIG, jnp.int32)],
+                    axis=1)
+            # exact two-key merge over k_local × n_shards candidates —
+            # the single-device ordering rule applied across shards
+            m_s, i_s = lax.sort((m_all, i_all), dimension=1, num_keys=2)
+            return m_s[:, :k_out], i_s[:, :k_out]
+
+        # check_rep=False: outputs ARE replicated (all_gather + identical
+        # merge per shard) but the checker cannot see that through the
+        # probe scan — the sharded_topk discipline
+        sm = shard_map(shard_body, mesh=mesh, in_specs=in_specs,
+                       out_specs=(P(), P()), check_rep=False)
+
+        @jax.jit
+        def fused(fx, c, v, f, q, g, o, ln, a):
+            return finalize_quantized(*sm(fx, c, v, f, q, g, o, ln, a),
+                                      distance_scale)
+
+        prog = _ANN_PROGRAMS[key] = fused
+    return prog(x, index.centroids, index.cent_valid, index.flat,
+                index.qflat, index.gids, index.offsets, index.lengths,
+                index.amax)
+
+
+# ---------------------------------------------------------------------------
 # psum-reduced accumulation: the shuffle+reduce analogue for count kernels
 # ---------------------------------------------------------------------------
 

@@ -318,6 +318,131 @@ def _timed_multi(chain, x, y, yv) -> float:
     return time.perf_counter() - t0
 
 
+def _autotune_key_ann(nlist: int, nprobe: int, oversample: int) -> str:
+    """ANN winner-cache key (ISSUE 14 satellite): the ``/ann/`` namespace
+    segment plus the index parameters guarantee an ANN entry can never
+    collide with a fused/quantized arm's entry (whose keys join plain
+    impl names) — a cache hit for one (nlist, n_probe, oversample) can
+    only ever restrict the ANN grid, never masquerade as a kernel-arm
+    winner or vice versa."""
+    return (_autotune_key(("ann",))
+            + f"/ann/nl{nlist}-np{nprobe}-os{oversample}")
+
+
+def _ann_bench(train, test, rng) -> dict:
+    """ISSUE 14: the ``ann`` sweep arm — an (nlist, n_probe) grid over
+    the IVF index (``ops/ivf.py``), each point recall/vote-gated against
+    the exact path on a 512-row slice and timed with the same chained
+    harness as the kernel arms, against a quantized brute-force arm
+    timed in-section (so ``vs_quantized`` is like-for-like). The grid
+    winner (fastest point passing recall ≥ 0.985 and vote ≥ 0.99)
+    persists in the autotune cache under the ``/ann/`` namespace; a hit
+    re-times only the winner. Fallback-safe: the caller records an
+    error instead of sinking the round."""
+    from avenir_tpu.ops import ivf
+    import sys as _sys
+    grid_env = os.environ.get("BENCH_ANN_GRID", "")
+    if grid_env:
+        grid = [tuple(int(v) for v in p.split(":")) for p in
+                grid_env.split(",") if p]
+    else:
+        nl = max(1, min(N_TRAIN, int(round(N_TRAIN ** 0.5))))
+        grid = sorted({(nl, max(1, nl // 16)), (nl, max(1, nl // 8)),
+                       (nl, max(1, nl // 4))})
+    oversample = int(os.environ.get("BENCH_ANN_OVERSAMPLE", 4))
+    iters = int(os.environ.get("BENCH_ANN_ITERS", ITERS))
+    reps = int(os.environ.get("BENCH_ANN_REPEATS", max(2, REPEATS // 3)))
+
+    # ground truth + quantized baseline, shared across the grid
+    from avenir_tpu.ops.distance import pairwise_topk as xla_topk
+    d_ex, i_ex = map(np.asarray,
+                     xla_topk(test[:512], train, k=K, mode="exact"))
+    labels = (np.asarray(train[:, 0]) > 0.5).astype(np.int64)
+    vote = lambda idx: (labels[idx].mean(axis=1) > 0.5).astype(np.int64)
+
+    def gates(topk) -> dict:
+        d, i = map(np.asarray, topk(test[:512], train))
+        recall = float(np.mean([len(set(i_ex[r]) & set(i[r])) / K
+                                for r in range(i_ex.shape[0])]))
+        # -1 sentinel slots (a probe that found < K rows) must not wrap
+        # into the label gather and vote as the LAST train row — a row
+        # carrying any sentinel counts as a disagreement, so a
+        # sentinel-laden grid point fails the gate instead of caching a
+        # fake winner
+        short = (i < 0).any(axis=1)
+        agree = float(((vote(i_ex) == vote(np.maximum(i, 0)))
+                       & ~short).mean())
+        return {"recall": round(recall, 4),
+                "vote_agreement": round(agree, 4)}
+
+    def timed_rate(topk) -> float:
+        chain = _chain_for_iters(topk, iters)
+        np.asarray(chain(test, train))              # compile + warm
+        best = min(_timed(chain, test, train) for _ in range(reps))
+        return M_TEST * iters / best
+
+    q_topk = lambda t, tr: quantized_topk(t, tr, k=K,
+                                          oversample=oversample)
+    q_rate = timed_rate(q_topk)
+
+    def measure(nlist: int, nprobe: int) -> dict:
+        t0 = time.perf_counter()
+        index = ivf.build_ivf(train, nlist=nlist, seed=0)
+        build_s = time.perf_counter() - t0
+        topk = lambda t, tr: ivf.ann_topk(index, t, k=K, n_probe=nprobe,
+                                          oversample=oversample)
+        point = {"nlist": index.nlist, "nprobe": nprobe,
+                 "oversample": oversample,
+                 "build_s": round(build_s, 3)}
+        point.update(gates(topk))
+        rate = timed_rate(topk)
+        point["rows_per_sec"] = round(rate, 1)
+        point["vs_quantized"] = round(rate / q_rate, 3) if q_rate else 0.0
+        return point
+
+    sweep_grid, cache_mode = list(grid), "off"
+    if AUTOTUNE:
+        cache_mode = "miss"
+        for nlist, nprobe in grid:
+            hit = _autotune_load(_autotune_key_ann(nlist, nprobe,
+                                                   oversample))
+            if hit and hit.get("winner") == "ann":
+                sweep_grid, cache_mode = [(nlist, nprobe)], "hit"
+                print(f"ann autotune cache hit: nl{nlist}-np{nprobe} "
+                      "(grid sweep skipped; BENCH_AUTOTUNE=0 to re-sweep)",
+                      file=_sys.stderr)
+                break
+
+    points, errors = [], []
+    for nlist, nprobe in sweep_grid:
+        try:
+            points.append(measure(nlist, nprobe))
+        except Exception as exc:   # one bad point must not lose the grid
+            errors.append({"nlist": nlist, "nprobe": nprobe,
+                           "error": repr(exc)})
+            print(f"ann point nl{nlist}-np{nprobe} dropped: {exc!r}",
+                  file=_sys.stderr)
+    passing = [p for p in points if p["recall"] >= MIN_RECALL
+               and p["vote_agreement"] >= 0.99]
+    best = max(passing, key=lambda p: p["rows_per_sec"]) if passing else None
+    if best is not None and cache_mode == "miss":
+        _autotune_store(
+            _autotune_key_ann(best["nlist"], best["nprobe"], oversample),
+            "ann", M_TEST * iters / best["rows_per_sec"] * 1e3)
+    out = {"grid": points, "quantized_rows_per_sec": round(q_rate, 1),
+           "n_train": N_TRAIN, "iters": iters,
+           "autotune": {"cache": cache_mode}}
+    if errors:
+        out["errors"] = errors
+    if best is not None:
+        out["best"] = best
+        out["speedup_vs_quantized"] = best["vs_quantized"]
+    else:
+        out["note"] = ("no grid point passed the recall/vote gates — "
+                       "ANN params need retuning for this shape")
+    return out
+
+
 def _online_serving_bench() -> dict:
     """ISSUE 5: the serving-engine bench — decisions/sec of the pipelined
     ``stream.engine.ServingEngine`` vs the synchronous ``run()`` loop over
@@ -702,6 +827,27 @@ def main() -> None:
             print(f"multichip bench skipped: {exc!r}", file=sys.stderr)
             out["multichip"] = {"n_devices": len(jax.devices()),
                                 "error": repr(exc)}
+    # ISSUE-14 ANN: the IVF index's own sweep arm — (nlist, n_probe)
+    # grid, recall/vote-gated per point, vs_quantized like-for-like
+    # (fallback-safe: an ANN failure must not sink the KNN headline).
+    # The driver gate: best point > 1.5x the quantized arm at
+    # N_TRAIN >= 64k while holding recall >= 0.985.
+    if os.environ.get("BENCH_ANN", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        try:
+            out["ann"] = _ann_bench(train, test, rng)
+            ann = out["ann"]
+            if "best" in ann:
+                b = ann["best"]
+                print(f"ann: {b['rows_per_sec'] / 1e6:.2f}M rows/s at "
+                      f"nlist={b['nlist']} nprobe={b['nprobe']} "
+                      f"(recall={b['recall']:.4f}, "
+                      f"{b['vs_quantized']:.2f}x vs quantized "
+                      f"{ann['quantized_rows_per_sec'] / 1e6:.2f}M)",
+                      file=sys.stderr)
+        except Exception as exc:
+            print(f"ann bench skipped: {exc!r}", file=sys.stderr)
+            out["ann"] = {"error": repr(exc)}
     # ISSUE-5 ONLINE SERVING: the always-on path's own headline —
     # engine-vs-sync decisions/sec on CPU over MiniRedis (subprocess;
     # fallback-safe: a serving failure must not sink the KNN headline)

@@ -1,0 +1,216 @@
+"""IVF-ANN smoke (ISSUE 14, tier-1 via tests/test_ann.py): build-index +
+query + recall gate + brute-force parity + sharded composition + build
+determinism in one lean in-process run.
+
+Five gates, one JSON line on stdout, non-zero exit on any failure:
+
+1. RECALL: ``knn.ann`` at default nlist/n_probe over clustered data
+   holds recall ≥ 0.985 and vote agreement ≥ 0.99 vs the f64 ground
+   truth (the PR 10 parity bars).
+2. BRUTE PARITY: ``n_probe = nlist`` reproduces the brute-force
+   ``quantized_topk`` results EXACTLY (int8 — same joint scale, same
+   integer metric, same two-key tie rule; ops/ivf.py docstring).
+3. SHARDED: the ``knn.sharded × knn.ann`` composition (2-shard list
+   partition, all-gather + exact two-key merge) holds the same recall
+   bar, returns only real row ids, and at 1 shard with full probing
+   equals the single-device brute-force quantized results exactly.
+4. EDGE CASES: ``nlist > N`` (degenerate clustering → empty lists)
+   still answers with the parity bars intact.
+5. DETERMINISM: two pristine subprocesses (``--dump``) build the index
+   from the same seed and print per-array sha256 hashes — byte-equal
+   across processes (the k-means++ seeding is host-rng-fixed, Lloyd is
+   one jitted step; chaos-smoke discipline: each build gets its own
+   process so no jit cache can mask a divergence).
+
+The whole run is CPU-sized (a few thousand rows) and must stay well
+under a minute — the tier-1 suite is near its kill budget.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the sharded gate needs 2 virtual devices; harmless for the others
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2"
+                               ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+MIN_RECALL = 0.985
+MIN_VOTE = 0.99
+
+
+def _clustered(rng, n, m, d=8, n_clusters=64):
+    """Cluster-structured data — the workload IVF exists for."""
+    centers = rng.random((n_clusters, d), dtype=np.float32) * 4.0
+    ca = rng.integers(0, n_clusters, n)
+    y = (centers[ca] + rng.normal(0, 0.08, (n, d))).astype(np.float32)
+    cq = rng.integers(0, n_clusters, m)
+    x = (centers[cq] + rng.normal(0, 0.08, (m, d))).astype(np.float32)
+    return x, y
+
+
+def _truth(x, y, k):
+    dd = ((x[:, None, :].astype(np.float64) -
+           y[None].astype(np.float64)) ** 2).sum(-1)
+    m, n = dd.shape
+    order = np.lexsort((np.broadcast_to(np.arange(n), (m, n)), dd), axis=1)
+    return order[:, :min(k, n)]
+
+
+def _recall_vote(truth, ia, y):
+    k = truth.shape[1]
+    recall = float(np.mean([len(set(t.tolist()) & set(q.tolist())) / k
+                            for t, q in zip(truth, ia)]))
+    labels = (y[:, 0] > np.median(y[:, 0])).astype(np.int64)
+    vote = lambda idx: (labels[idx].mean(axis=1) > 0.5).astype(np.int64)
+    return recall, float((vote(truth) == vote(ia)).mean())
+
+
+def _index_hashes() -> dict:
+    """Deterministic build -> {array name: sha256} (the --dump half)."""
+    import jax.numpy as jnp
+    from avenir_tpu.ops import ivf
+    rng = np.random.default_rng(1234)
+    _, y = _clustered(rng, 1024, 1, n_clusters=24)
+    index = ivf.build_ivf(jnp.asarray(y), nlist=16, n_iters=8, seed=7)
+    out = {}
+    for name in ("centroids", "flat", "gids", "offsets", "lengths"):
+        out[name] = hashlib.sha256(
+            np.asarray(getattr(index, name)).tobytes()).hexdigest()
+    x = np.asarray(rng.random((32, y.shape[1]), dtype=np.float32))
+    d, i = ivf.ann_topk(index, jnp.asarray(x), k=5, n_probe=4)
+    out["query"] = hashlib.sha256(
+        np.asarray(d).tobytes() + np.asarray(i).tobytes()).hexdigest()
+    return out
+
+
+def _check_recall() -> dict:
+    import jax.numpy as jnp
+    from avenir_tpu.ops import ivf
+    rng = np.random.default_rng(0)
+    x, y = _clustered(rng, 4096, 128)
+    index = ivf.build_ivf(jnp.asarray(y), seed=0)
+    truth = _truth(x, y, 5)
+    d, i = map(np.asarray, ivf.ann_topk(index, jnp.asarray(x), k=5))
+    recall, vote = _recall_vote(truth, i, y)
+    return {"nlist": index.nlist,
+            "nprobe": ivf.default_nprobe(index.nlist),
+            "recall": round(recall, 4), "vote_agreement": round(vote, 4)}
+
+
+def _check_brute_parity() -> dict:
+    import jax.numpy as jnp
+    from avenir_tpu.ops import ivf
+    from avenir_tpu.ops.quantized import quantized_topk
+    rng = np.random.default_rng(3)
+    x, y = _clustered(rng, 2048, 64)
+    index = ivf.build_ivf(jnp.asarray(y), seed=1)
+    da, ia = map(np.asarray, ivf.ann_topk(index, jnp.asarray(x), k=5,
+                                          n_probe=index.nlist))
+    dq, iq = map(np.asarray, quantized_topk(jnp.asarray(x),
+                                            jnp.asarray(y), k=5))
+    return {"ids_equal": bool(np.array_equal(ia, iq)),
+            "dists_equal": bool(np.array_equal(da, dq))}
+
+
+def _check_sharded() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from avenir_tpu.ops import ivf
+    from avenir_tpu.ops.quantized import quantized_topk
+    from avenir_tpu.parallel import collective
+    rng = np.random.default_rng(5)
+    x, y = _clustered(rng, 2048, 64)
+    truth = _truth(x, y, 5)
+    mesh2 = collective.data_mesh((2,), devices=jax.devices()[:2])
+    idx2 = ivf.build_sharded_ivf(jnp.asarray(y), mesh=mesh2, seed=0)
+    d2, i2 = map(np.asarray, collective.sharded_ann_topk(
+        jnp.asarray(x), index=idx2, mesh=mesh2, k=5))
+    recall, vote = _recall_vote(truth, i2, y)
+    ids_valid = bool(np.all((i2 >= 0) & (i2 < y.shape[0])))
+    mesh1 = collective.data_mesh((1,), devices=jax.devices()[:1])
+    idx1 = ivf.build_sharded_ivf(jnp.asarray(y), mesh=mesh1, seed=0)
+    ds, is_ = map(np.asarray, collective.sharded_ann_topk(
+        jnp.asarray(x), index=idx1, mesh=mesh1, k=5, n_probe=idx1.nlist))
+    dq, iq = map(np.asarray, quantized_topk(jnp.asarray(x),
+                                            jnp.asarray(y), k=5))
+    return {"recall_2shard": round(recall, 4),
+            "vote_2shard": round(vote, 4), "ids_valid": ids_valid,
+            "one_shard_full_probe_equals_brute": bool(
+                np.array_equal(is_, iq) and np.array_equal(ds, dq))}
+
+
+def _check_degenerate() -> dict:
+    import jax.numpy as jnp
+    from avenir_tpu.ops import ivf
+    rng = np.random.default_rng(9)
+    y = rng.random((48, 6), dtype=np.float32)
+    x = rng.random((16, 6), dtype=np.float32)
+    index = ivf.build_ivf(jnp.asarray(y), nlist=64, n_iters=6, seed=0)
+    empty = int(np.sum(np.asarray(index.lengths) == 0))
+    truth = _truth(x, y, 5)
+    d, i = map(np.asarray, ivf.ann_topk(index, jnp.asarray(x), k=5,
+                                        n_probe=64))
+    recall, _ = _recall_vote(truth, i, y)
+    return {"nlist": index.nlist, "empty_lists": empty,
+            "recall": round(recall, 4),
+            "ids_valid": bool(np.all((i >= 0) & (i < 48)))}
+
+
+def _check_determinism() -> dict:
+    results = []
+    for _ in range(2):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--dump"],
+            env=env, capture_output=True, text=True, timeout=240)
+        if proc.returncode != 0:
+            raise RuntimeError(f"--dump rc={proc.returncode}: "
+                               f"{proc.stderr[-400:]}")
+        results.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    mism = sorted(n for n in results[0] if results[0][n] != results[1][n])
+    return {"identical": not mism, "mismatched": mism}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dump", action="store_true",
+                        help="print index/query hashes and exit (the "
+                             "subprocess half of the determinism gate)")
+    args = parser.parse_args()
+    if args.dump:
+        print(json.dumps(_index_hashes(), sort_keys=True))
+        return 0
+    report = {"recall": _check_recall(),
+              "brute_parity": _check_brute_parity(),
+              "sharded": _check_sharded(),
+              "degenerate": _check_degenerate(),
+              "determinism": _check_determinism()}
+    ok = (report["recall"]["recall"] >= MIN_RECALL and
+          report["recall"]["vote_agreement"] >= MIN_VOTE and
+          report["brute_parity"]["ids_equal"] and
+          report["brute_parity"]["dists_equal"] and
+          report["sharded"]["recall_2shard"] >= MIN_RECALL and
+          report["sharded"]["vote_2shard"] >= MIN_VOTE and
+          report["sharded"]["ids_valid"] and
+          report["sharded"]["one_shard_full_probe_equals_brute"] and
+          report["degenerate"]["recall"] >= MIN_RECALL and
+          report["degenerate"]["ids_valid"] and
+          report["determinism"]["identical"])
+    report["ok"] = bool(ok)
+    print(json.dumps(report, sort_keys=True))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -393,6 +393,12 @@ class TestCliWireThrough:
         """mesh.shape=2 runs a 2-device sub-mesh (and warns about the 6
         idle devices — the satellite's signal, end to end)."""
         from avenir_tpu.cli.main import main as cli
+        from avenir_tpu.parallel import collective
+        # the idle warning fires in MeshSpec.resolve, which only runs on
+        # a data_mesh cache MISS — any earlier test that built the (2,)
+        # all-devices mesh (e.g. test_ann's sharded dispatch) would
+        # otherwise swallow the signal this test asserts on
+        collective._cached_mesh.cache_clear()
         props = self._knn_props(tmp_path)
         with caplog.at_level(logging.WARNING,
                              logger="avenir_tpu.parallel.mesh"):
