@@ -1034,9 +1034,11 @@ def run_tree_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
     else:
         rng = np.random.default_rng(conf.get_int("random.seed", 0))
         tree = T.grow_tree(table, cfg, rng=rng)
-    with open(out_path, "w") as fh:
-        json.dump({"classValues": table.class_values,
-                   "root": tree.to_dict()}, fh)
+    # rename-atomic model dump (the save_forest discipline): a crash
+    # mid-write must not leave a truncated artifact for TreePredictor
+    from avenir_tpu.utils.atomicio import atomic_json_dump
+    atomic_json_dump({"classValues": table.class_values,
+                      "root": tree.to_dict()}, out_path)
     def depth_of(n) -> int:
         return 0 if not n.children else 1 + max(
             depth_of(c) for c in n.children.values())
@@ -1088,8 +1090,11 @@ def run_forest_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
     """Grow a random forest (composes the reference's `random`
     attribute-selection strategy + BaggingSampler bootstrap into the
     ensemble it never shipped). Keys: ``num.trees``,
-    ``random.split.set.size``, ``bagging`` plus the TreeBuilder keys; the
-    artifact stacks TreeBuilder's JSON tree format."""
+    ``random.split.set.size``, ``bagging``, ``forest.growth``
+    (auto|batched|serial — auto grows the whole ensemble as ONE batched
+    device program for `best` selection) plus the TreeBuilder keys; the
+    artifact stacks TreeBuilder's JSON tree format, written
+    rename-atomically."""
     import json
     from avenir_tpu.models import forest as F
     from avenir_tpu.models.tree import TreeConfig
@@ -1100,6 +1105,9 @@ def run_forest_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
         attrs_per_tree=conf.get_int("random.split.set.size", 3),
         bagging=conf.get_bool("bagging", True),
         seed=conf.get_int("random.seed", 0),
+        # auto = the ISSUE-15 batched whole-forest program for `best`
+        # selection (serial fallback on frontier-budget overflow)
+        growth=conf.get("forest.growth", "auto"),
         tree=TreeConfig(
             algorithm=_split_algorithm(conf),
             max_depth=conf.get_int("max.depth", 3),

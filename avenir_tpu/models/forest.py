@@ -1,4 +1,5 @@
-"""Random forest over the device-resident tree growth.
+"""Random forest over the device-resident tree growth — batched,
+sharded, and out-of-core (ISSUE 15).
 
 The reference gestures at forests without shipping one: its
 ClassPartitionGenerator offers a ``random`` attribute-selection strategy
@@ -12,29 +13,56 @@ completed tree assembly:
   expressed as per-row multiplicity WEIGHTS, so no resampled table is ever
   materialized: weighting a row c is exactly repeating it c times in every
   count the growth computes (asserted in tests);
-- every tree grows via :func:`tree.grow_tree_device` — one device dispatch
-  + one readback per tree, so a K-tree forest costs K dispatches, not
-  K × levels × 2 MR jobs;
-- prediction is a majority vote over the trees' routed leaves.
+- **batched growth** (the default for ``best`` selection): the K-tree
+  loop is ONE jitted level program vmapped over the tree axis — bootstrap
+  weights and attribute-subset candidate masks ride as leading batch
+  operands over the shared candidate catalog, every level's split stats
+  come from the histogram kernel path (``tree._level_hist`` →
+  ``ops.histogram.node_class_bin_counts``), and a K-tree forest costs
+  ``max_depth`` level dispatches TOTAL plus one readback, not K × each.
+  The tree axis is padded to power-of-two buckets (zero-weight trees grow
+  leaf roots and are dropped) so ragged forest sizes reuse a handful of
+  compiled programs. Byte-identical trees to the serial per-tree path
+  (test-pinned): the catalog is attr-sorted, so masked argmax over the
+  full catalog selects exactly what subset-only argmax would;
+- **sharded growth** (:func:`grow_forest_sharded`): rows partitioned over
+  the ``data`` mesh axis, each shard computing its local histogram
+  payload, folded with one ``psum`` per level — counts are exact-in-f32
+  integers, so the fold is byte-identical to single-device growth at any
+  shard count (the PR 9 NB/MI discipline);
+- **out-of-core growth** (:func:`grow_forest_streaming`): ``max_depth``
+  passes over part-file shards through the resilient ``PrefetchLoader``,
+  each chunk replaying the frontier routing and contributing an additive
+  histogram payload; selection runs once per level on the folded counts.
+  Chunk rows are host-padded to power-of-two buckets so ragged shard
+  files never leak jit cache entries;
+- prediction is a majority vote over the trees' routed leaves; the
+  ``device=True`` path routes EVERY tree in one stacked dispatch.
 
 Artifact: JSON ``{"classValues": [...], "trees": [root dicts]}`` —
-TreePredictor's single-tree format, stacked.
+TreePredictor's single-tree format, stacked, written rename-atomically.
 """
 
 from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field, replace
-from typing import List, Optional, Sequence
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from avenir_tpu.ops import histogram as hg
+from avenir_tpu.models import tree as T
 from avenir_tpu.models.tree import (
-    TreeConfig, TreeNode, _predict_device_raw, grow_tree, grow_tree_device,
+    TreeConfig, TreeNode, grow_tree, grow_tree_device,
     predict as predict_tree, splittable_ordinals)
+from avenir_tpu.utils.atomicio import atomic_json_dump
 from avenir_tpu.utils.dataset import EncodedTable
+
+_GROWTH_MODES = ("auto", "batched", "serial")
 
 
 @dataclass(frozen=True)
@@ -43,35 +71,123 @@ class ForestConfig:
     attrs_per_tree: int = 3               # random.split.set.size
     bagging: bool = True                  # bootstrap rows per tree
     seed: int = 0                         # random.seed
+    # "auto" grows the whole forest as ONE batched device program when the
+    # tree strategy is `best` (falling back to the serial per-tree loop on
+    # frontier-budget overflow); "batched"/"serial" pin a path
+    growth: str = "auto"                  # forest.growth
     tree: TreeConfig = field(default_factory=TreeConfig)
 
 
-def grow_forest(table: EncodedTable, config: ForestConfig
-                ) -> List[TreeNode]:
-    """K trees, each on a random attribute subset + row bootstrap."""
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _validate_forest_config(table_or_none, config: ForestConfig
+                            ) -> List[int]:
     if config.n_trees < 1:
         raise ValueError("n_trees must be >= 1")
     if config.attrs_per_tree < 1:
         # an empty split_attributes tuple means "all" to the growers —
         # a zero subset must not silently invert into full-attribute trees
         raise ValueError("attrs_per_tree must be >= 1")
-    splittable = splittable_ordinals(table)
-    if not splittable:
+    if config.growth not in _GROWTH_MODES:
+        # a typo'd mode must not silently pick a path (the same
+        # silent-misconfiguration class as the dropped-config forest bug)
+        raise ValueError(f"unknown forest growth mode {config.growth!r} "
+                         f"(expected one of {_GROWTH_MODES})")
+    splittable = (sorted(splittable_ordinals(table_or_none))
+                  if table_or_none is not None else [])
+    if table_or_none is not None and not splittable:
         raise ValueError("no splittable attributes for a forest")
-    rng = np.random.default_rng(config.seed)
+    return splittable
+
+
+def _draw_tree_plans(rng: np.random.Generator, splittable: Sequence[int],
+                     config: ForestConfig, n_rows: int
+                     ) -> List[Tuple[Tuple[int, ...],
+                                     Optional[np.ndarray]]]:
+    """Per-tree (attribute subset, bootstrap multiplicities) — THE one rng
+    consumption order (choice, then multinomial, per tree), shared by the
+    serial and batched growers so a fallback re-grows the identical
+    forest from the same seed."""
     size = min(config.attrs_per_tree, len(splittable))
-    trees = []
+    plans = []
     for _ in range(config.n_trees):
         attrs = tuple(sorted(
             int(a) for a in rng.choice(splittable, size=size,
                                        replace=False)))
-        host_weights = None
+        weights = None
         if config.bagging:
             # bootstrap as multiplicities: multinomial over rows (kept on
             # host; converted per path so no transfer runs unless needed)
-            host_weights = rng.multinomial(
-                table.n_rows,
-                np.full(table.n_rows, 1.0 / table.n_rows)).astype(np.float32)
+            weights = rng.multinomial(
+                n_rows, np.full(n_rows, 1.0 / n_rows)).astype(np.float32)
+        plans.append((attrs, weights))
+    return plans
+
+
+def grow_forest(table: EncodedTable, config: ForestConfig
+                ) -> List[TreeNode]:
+    """K trees, each on a random attribute subset + row bootstrap.
+
+    ``best`` selection grows the whole ensemble as ONE batched device
+    program (``config.growth`` pins a path); randomFromTop consumes host
+    randomness per node and always runs the serial loop."""
+    _validate_forest_config(table, config)
+    hist_on = T.tree_histograms_active()
+    if config.growth == "batched" and not hist_on:
+        # the batched program is histogram-only; a pinned batched request
+        # under the einsum kill switch is a config conflict, not a silent
+        # override of whichever flag loses
+        raise ValueError(
+            "forest growth='batched' requires the histogram split search "
+            f"({T._TREE_HIST_ENV}=off pins the einsum path — use "
+            "growth='auto' or 'serial')")
+    batched_ok = (config.tree.split_selection_strategy == "best"
+                  and config.growth in ("auto", "batched")
+                  # the documented kill switch must reach forests too:
+                  # with the histogram path disabled, auto degrades to
+                  # the serial loop (whose trees honor the env)
+                  and hist_on)
+    if batched_ok:
+        try:
+            return grow_forest_batched(table, config)
+        except ValueError as exc:
+            if config.growth == "batched" or "use grow_tree" not in str(
+                    exc):
+                raise
+            # a tree's live frontier overflowed the device node budget —
+            # the serial loop re-draws the SAME subsets/bootstraps (shared
+            # rng order) and re-grows per tree, falling back further to
+            # the masked host loop only for the overflowing trees
+        except Exception as exc:
+            if config.growth == "batched":
+                raise
+            # auto mode must never sink a train job the serial loop can
+            # still finish (the histogram-dispatch discipline): a device
+            # OOM/compile failure on the whole-forest program — whose
+            # peak memory exceeds the per-tree path's — degrades to the
+            # serial loop, which grows the IDENTICAL forest
+            from avenir_tpu.utils.profiling import get_logger
+            get_logger("models.forest").warning(
+                "batched forest growth failed, using the serial "
+                "per-tree loop: %r", exc)
+    return _grow_forest_serial(table, config)
+
+
+def _grow_forest_serial(table: EncodedTable, config: ForestConfig
+                        ) -> List[TreeNode]:
+    """The per-tree loop: one device dispatch + one readback per tree —
+    the batched grower's baseline (bench ``forest`` arm) and the
+    randomFromTop / budget-overflow path."""
+    splittable = _validate_forest_config(table, config)
+    rng = np.random.default_rng(config.seed)
+    trees = []
+    for attrs, host_weights in _draw_tree_plans(rng, splittable, config,
+                                                table.n_rows):
         # replace() carries EVERY TreeConfig field through — a configured
         # split_selection_strategy/num_top_splits must not silently revert
         # to the defaults (round-2 verdict item)
@@ -100,28 +216,490 @@ def grow_forest(table: EncodedTable, config: ForestConfig
     return trees
 
 
+# ---------------------------------------------------------------------------
+# batched whole-forest growth: one level program vmapped over trees
+# ---------------------------------------------------------------------------
+
+#: compiled forest programs keyed on (statics, mesh) — minting
+#: jit(vmap(...)) per call would defeat the executable cache
+_FOREST_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _forest_program(statics: tuple, mesh):
+    key = (statics, mesh)
+    prog = _FOREST_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    impl = partial(T._forest_levels_impl, **dict(statics))
+
+    if mesh is None:
+        prog = jax.jit(impl)
+    else:
+        from jax.sharding import PartitionSpec as P
+        from avenir_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+        def body(labels, bins_rows, seg_of_bin, col_of_t, row_w0,
+                 cand_mask):
+            return impl(labels, bins_rows, seg_of_bin, col_of_t, row_w0,
+                        cand_mask, psum_axis=DATA_AXIS)
+        # check_rep=False: outputs ARE replicated (every shard psum-folds
+        # the same totals and runs the identical selection) but the
+        # checker cannot see that — the sharded_topk discipline
+        prog = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS, None), P(), P(),
+                      P(None, DATA_AXIS), P()),
+            out_specs=P(), check_rep=False))
+    _FOREST_PROGRAMS[key] = prog
+    return prog
+
+
+def _forest_statics(cand, config: ForestConfig, n_classes: int) -> tuple:
+    cfg = config.tree
+    return (("plan_slices", tuple(cand.plan_slices)),
+            ("depth", cfg.max_depth),
+            ("s_max", cand.s_max),
+            ("b_max", cand.b_max),
+            ("n_classes", n_classes),
+            ("algorithm", cfg.algorithm),
+            ("min_node_size", cfg.min_node_size),
+            ("min_gain", cfg.min_gain),
+            ("node_budget", cfg.device_node_budget))
+
+
+def _tree_batch_operands(cand, plans_rt, n_rows: int):
+    """(cand_mask [Kt_pad, T], row_w0 [Kt_pad, N]) with the tree axis
+    padded to a power of two — padding trees carry weight 0 everywhere,
+    grow bare leaf roots for free, and are dropped at build time."""
+    attr_of_t = np.asarray([k[0] for k in cand.keys])
+    kt = len(plans_rt)
+    kt_pad = _pow2(kt)
+    cand_mask = np.ones((kt_pad, len(cand.keys)), bool)
+    row_w0 = np.zeros((kt_pad, n_rows), np.float32)
+    for i, (attrs, weights) in enumerate(plans_rt):
+        cand_mask[i] = np.isin(attr_of_t, attrs)
+        row_w0[i] = 1.0 if weights is None else weights
+    return cand_mask, row_w0
+
+
+def _check_forest_budget(records, kt: int, widths, node_budget: int
+                         ) -> None:
+    """Per-tree frontier-budget check over the batched records (leading
+    tree axis) — same invariant and same ``use grow_tree`` fallback hint
+    as the single-tree grower."""
+    for i in range(kt):
+        T._check_frontier_budget(
+            [{"n_live": rec["n_live"][i]} for rec in records], widths,
+            node_budget,
+            "raise the budget or use grow_tree (masked, per-level)")
+
+
+def _build_forest(records, kt: int, keys, class_values: List[str],
+                  n_classes: int) -> List[TreeNode]:
+    return [T._build_tree(
+        [{k: v[i] for k, v in rec.items()} for rec in records],
+        keys, class_values, n_classes) for i in range(kt)]
+
+
+def grow_forest_batched(table: EncodedTable, config: ForestConfig,
+                        mesh=None) -> List[TreeNode]:
+    """The K-tree loop as ONE batched device program: every level of
+    every tree is a single vmapped histogram + selection + routing step
+    over the shared (attr-sorted) candidate catalog — ``max_depth``
+    dispatches and ONE readback for the whole ensemble. Byte-identical
+    trees to :func:`_grow_forest_serial` from the same config/seed
+    (test-pinned). With ``mesh``, rows shard over the ``data`` axis and
+    each level's histogram payload folds with one psum (exact-integer
+    counts → byte-identical at any shard count)."""
+    splittable = _validate_forest_config(table, config)
+    if config.tree.split_selection_strategy != "best":
+        raise ValueError("batched forest growth supports the 'best' "
+                         "strategy; use growth='serial' for randomFromTop")
+    if config.tree.max_depth < 1:
+        # zero-depth trees are bare leaf roots — the serial loop already
+        # handles that shape (grow_tree_device's leaf_root), identically
+        return _grow_forest_serial(table, config)
+    rng = np.random.default_rng(config.seed)
+    plans_rt = _draw_tree_plans(rng, splittable, config, table.n_rows)
+    plans = T._attr_plans(table, tuple(splittable),
+                          config.tree.max_cat_attr_split_groups)
+    cand = T._device_candidates(table, plans)
+    cand_mask, row_w0 = _tree_batch_operands(cand, plans_rt, table.n_rows)
+
+    labels = table.labels
+    bins_rows = cand.bins_rows
+    if mesh is not None:
+        # pad rows to a whole number per shard; weight-0 padding rows
+        # contribute exactly zero to every count
+        from avenir_tpu.parallel.mesh import DATA_AXIS
+        n_shards = int(mesh.shape[DATA_AXIS])
+        n = table.n_rows
+        g = -(-n // n_shards) * n_shards
+        if g != n:
+            labels = jnp.pad(jnp.asarray(labels, jnp.int32), (0, g - n))
+            bins_rows = jnp.pad(bins_rows, ((0, g - n), (0, 0)))
+            row_w0 = np.pad(row_w0, ((0, 0), (0, g - n)))
+
+    prog = _forest_program(_forest_statics(cand, config, table.n_classes),
+                           mesh)
+    records = jax.device_get(prog(
+        labels, bins_rows, cand.seg_of_bin, cand.col_of_t,
+        jnp.asarray(row_w0), jnp.asarray(cand_mask)))
+    kt = len(plans_rt)
+    widths = T._level_widths(config.tree.max_depth, cand.s_max,
+                             config.tree.device_node_budget)
+    _check_forest_budget(records, kt, widths,
+                         config.tree.device_node_budget)
+    return _build_forest(records, kt, cand.keys, table.class_values,
+                         table.n_classes)
+
+
+def grow_forest_sharded(table: EncodedTable, config: ForestConfig,
+                        mesh=None) -> List[TreeNode]:
+    """:func:`grow_forest_batched` with rows partitioned over the
+    ``data`` mesh axis — per-shard additive histogram payloads psum-fold
+    into the identical exact-integer totals, so the grown forest is
+    byte-identical to single-device growth (test-pinned at 1/2/4
+    shards)."""
+    if mesh is None:
+        from avenir_tpu.parallel import collective
+        mesh = collective.data_mesh()
+    return grow_forest_batched(table, config, mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# out-of-core growth: level passes over part-file shards
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("widths", "s_max", "b_max",
+                                   "n_classes", "node_budget", "pallas"))
+def _stream_chunk_hist(labels, bins_rows, row_w_b, prior_best,
+                       prior_slots, seg_of_bin, col_of_t, *,
+                       widths, s_max: int, b_max: int, n_classes: int,
+                       node_budget: int, pallas: bool = False):
+    """One chunk's contribution to the current level: replay the
+    already-selected levels' routing (``tree._route_level_hist``, the
+    SAME function the in-core step runs) to recover each row's frontier
+    node, then emit the chunk's [Kt, A, K, B, C] histogram payload —
+    additive across chunks because every cell is an exact-in-f32
+    integer."""
+    def one_tree(row_w, best_l, slot_l):
+        node = jnp.zeros(labels.shape[0], jnp.int32)
+        rw = row_w
+        for lvl in range(len(best_l)):
+            k_next = min(widths[lvl] * s_max, node_budget)
+            node, rw = T._route_level_hist(
+                node, rw, best_l[lvl], slot_l[lvl].reshape(-1), bins_rows,
+                seg_of_bin, col_of_t, s_max=s_max, b_max=b_max,
+                k_next=k_next)
+        return T._level_hist(node, rw, labels, bins_rows,
+                             k_nodes=widths[len(best_l)], b_max=b_max,
+                             n_classes=n_classes, pallas=pallas)
+    return jax.vmap(one_tree)(row_w_b, prior_best, prior_slots)
+
+
+@partial(jax.jit, static_argnames=("plan_slices", "k_nodes", "s_max",
+                                   "b_max", "n_classes", "algorithm",
+                                   "min_node_size", "min_gain"))
+def _stream_select(hist_b, seg_of_bin, cand_mask_b, *, plan_slices,
+                   k_nodes: int, s_max: int, b_max: int,
+                   n_classes: int, algorithm: str, min_node_size: int,
+                   min_gain: float):
+    """Level selection from the FOLDED histogram — the same
+    ``_counts_from_hist`` → ``_level_select`` graph the in-core step
+    traces, on the same exact-integer inputs, so streamed and resident
+    growth pick identical splits."""
+    def one(hist, mask):
+        counts = T._counts_from_hist(
+            hist, seg_of_bin, plan_slices=plan_slices, k_nodes=k_nodes,
+            s_max=s_max, b_max=b_max, n_classes=n_classes)
+        return T._level_select(
+            counts, k_nodes=k_nodes, s_max=s_max, n_classes=n_classes,
+            algorithm=algorithm, min_node_size=min_node_size,
+            min_gain=min_gain, cand_mask=mask)
+    return jax.vmap(one)(hist_b, cand_mask_b)
+
+
+def _chunk_bin_specs(table: EncodedTable, plans) -> List[tuple]:
+    """Per-plan (column position, is_categorical, numeric grid) — the
+    catalog-level metadata streamed chunks need to bin THEIR rows,
+    extracted once so the per-chunk loop never re-enumerates candidate
+    splits or re-uploads full columns."""
+    ord_to_pos = {f.ordinal: i for i, f in enumerate(table.feature_fields)}
+    specs = []
+    for attr, _keys, is_cat, _column, _aux, _n_seg in plans:
+        pos = ord_to_pos[attr]
+        grid = (None if is_cat else np.asarray(
+            T.numeric_grid(table.feature_fields[pos]), np.float32))
+        specs.append((pos, is_cat, grid))
+    return specs
+
+
+def _chunk_bins_host(chunk: EncodedTable, specs) -> np.ndarray:
+    """[n, A] per-feature bin ids in HOST numpy — the streaming twin of
+    ``tree._plan_bins`` (same strict-``>`` grid counting, identical int
+    results). Host-side on purpose: eager jnp ops on ragged chunk shapes
+    would mint one executable per shard file; the single device transfer
+    happens after power-of-two padding, inside the jitted chunk step."""
+    cols = []
+    for pos, is_cat, grid in specs:
+        if is_cat:
+            cols.append(np.asarray(chunk.binned[:, pos], np.int32))
+        else:
+            col = np.asarray(chunk.numeric[:, pos], np.float32)
+            cols.append(np.sum(col[:, None] > grid[None, :],
+                               axis=1).astype(np.int32))
+    return np.stack(cols, axis=1)
+
+
+def _chunk_weights(config: ForestConfig, kt_pad: int, kt: int,
+                   chunk_index: int, n_rows: int) -> np.ndarray:
+    """Per-(tree, chunk) bootstrap multiplicities, seeded from
+    (seed, tree, chunk index) so every level pass re-draws the IDENTICAL
+    weights for the same chunk. The out-of-core bootstrap resamples
+    within each chunk (the global multinomial would need all rows in
+    memory — the thing streaming exists to avoid); with ``bagging=False``
+    streamed growth is byte-identical to in-core batched growth."""
+    w = np.zeros((kt_pad, n_rows), np.float32)
+    for i in range(kt):
+        if config.bagging:
+            rng = np.random.default_rng((config.seed, i, chunk_index))
+            w[i] = rng.multinomial(
+                n_rows, np.full(n_rows, 1.0 / n_rows)).astype(np.float32)
+        else:
+            w[i] = 1.0
+    return w
+
+
+def grow_forest_streaming(fz, paths: Sequence[str], config: ForestConfig,
+                          *, delim_regex: str = ",",
+                          loader_kwargs: Optional[dict] = None
+                          ) -> List[TreeNode]:
+    """Out-of-core batched forest growth: ``max_depth`` passes over the
+    part files through the resilient ``PrefetchLoader`` (retries,
+    deadlines, speculation — the PR 9 substrate), each pass folding
+    per-chunk histogram payloads additively and selecting once per level.
+    No chunk's rows ever need to be resident together; chunk rows are
+    host-padded to power-of-two buckets so ragged shard files share a
+    handful of compiled programs.
+
+    ``fz`` must be a FITTED Featurizer (the loader's contract): the
+    candidate catalog comes from fit-level schema/vocabulary, so every
+    chunk sees the identical catalog. With ``bagging=False`` the grown
+    forest is byte-identical to :func:`grow_forest_batched` over the
+    concatenated rows (test-pinned); with bagging, bootstraps are drawn
+    per (tree, chunk) — see :func:`_chunk_weights`."""
+    from avenir_tpu.native.prefetch import PrefetchLoader
+    if config.tree.split_selection_strategy != "best":
+        raise ValueError("streaming forest growth supports the 'best' "
+                         "strategy only")
+    if config.tree.max_depth < 1:
+        raise ValueError("streaming forest growth needs max_depth >= 1")
+    _validate_forest_config(None, config)
+    if not paths:
+        raise ValueError("no part files to stream")
+    loader_kwargs = dict(loader_kwargs or {})
+
+    def chunks():
+        return PrefetchLoader(fz, list(paths), delim_regex=delim_regex,
+                              **loader_kwargs)
+
+    # catalog probe over ONE shard at a time (a full loader here would
+    # launch depth-ahead parses whose results get thrown away), advancing
+    # past empty part files — empty reducer partitions are routine in
+    # MR-style output dirs; the catalog is fit-level metadata, so any
+    # non-empty chunk defines it
+    first = None
+    for path in paths:
+        first = next(iter(PrefetchLoader(
+            fz, [path], delim_regex=delim_regex, **loader_kwargs)), None)
+        if first is not None and first.n_rows > 0:
+            break
+    if first is None or first.n_rows == 0:
+        raise ValueError("streamed part files produced no rows")
+    splittable = sorted(splittable_ordinals(first))
+    if not splittable:
+        raise ValueError("no splittable attributes for a forest")
+    rng = np.random.default_rng(config.seed)
+    size = min(config.attrs_per_tree, len(splittable))
+    subsets = [tuple(sorted(int(a) for a in rng.choice(
+        splittable, size=size, replace=False)))
+        for _ in range(config.n_trees)]
+    cfg = config.tree
+    plans = T._attr_plans(first, tuple(splittable),
+                          cfg.max_cat_attr_split_groups)
+    cand = T._device_candidates(first, plans)
+    bin_specs = _chunk_bin_specs(first, plans)
+    kt = config.n_trees
+    kt_pad = _pow2(kt)
+    attr_of_t = np.asarray([k[0] for k in cand.keys])
+    cand_mask = np.ones((kt_pad, len(cand.keys)), bool)
+    for i, attrs in enumerate(subsets):
+        cand_mask[i] = np.isin(attr_of_t, attrs)
+    cand_mask_d = jnp.asarray(cand_mask)
+
+    widths = tuple(T._level_widths(cfg.max_depth, cand.s_max,
+                                   cfg.device_node_budget))
+    records: List[dict] = []
+    for d in range(cfg.max_depth):
+        k_nodes = widths[d]
+        prior_best = tuple(jnp.asarray(rec["best_t"]) for rec in records)
+        prior_slots = tuple(jnp.asarray(rec["child_slot"])
+                            for rec in records)
+        hist_acc: Optional[np.ndarray] = None
+        for ci, chunk in enumerate(chunks()):
+            if chunk.n_rows == 0:
+                continue
+            w = _chunk_weights(config, kt_pad, kt, ci, chunk.n_rows)
+            # bin + pad in HOST numpy, THEN cross to device at the
+            # bucketed shape: the floored power-of-two rule
+            # (pipeline.bucket_rows — tiny tail shards share the 512
+            # bucket instead of minting per-size programs); weight-0
+            # padding rows count zero
+            from avenir_tpu.parallel.pipeline import bucket_rows
+            n_pad = bucket_rows(chunk.n_rows) - chunk.n_rows
+            bins_c = np.pad(_chunk_bins_host(chunk, bin_specs),
+                            ((0, n_pad), (0, 0)))
+            labels_c = np.pad(np.asarray(chunk.labels, np.int32),
+                              (0, n_pad))
+            w = np.pad(w, ((0, 0), (0, n_pad)))
+            h = _stream_chunk_hist(
+                jnp.asarray(labels_c), jnp.asarray(bins_c),
+                jnp.asarray(w), prior_best, prior_slots,
+                cand.seg_of_bin, cand.col_of_t, widths=widths,
+                s_max=cand.s_max, b_max=cand.b_max,
+                n_classes=first.n_classes,
+                node_budget=cfg.device_node_budget,
+                pallas=hg.pallas_histograms_active())
+            h = np.asarray(h)
+            hist_acc = h if hist_acc is None else hist_acc + h
+        rec = jax.device_get(_stream_select(
+            jnp.asarray(hist_acc), cand.seg_of_bin, cand_mask_d,
+            plan_slices=tuple(cand.plan_slices), k_nodes=k_nodes,
+            s_max=cand.s_max, b_max=cand.b_max,
+            n_classes=first.n_classes, algorithm=cfg.algorithm,
+            min_node_size=cfg.min_node_size, min_gain=cfg.min_gain))
+        records.append(rec)
+    _check_forest_budget(records, kt, widths, cfg.device_node_budget)
+    return _build_forest(records, kt, cand.keys, first.class_values,
+                         first.n_classes)
+
+
+# ---------------------------------------------------------------------------
+# prediction + artifact
+# ---------------------------------------------------------------------------
+
+def _validate_trees(trees: Sequence[TreeNode]) -> List[str]:
+    """The shared forest-shape contract: at least one tree, every tree on
+    the same class vocabulary (a mixed-model vote would be meaningless —
+    class INDEX i means a different label per tree)."""
+    if not len(trees):
+        raise ValueError(
+            "empty forest: no trees to predict with (grow or load a "
+            "forest first)")
+    class_values = trees[0].class_values
+    for i, tree in enumerate(trees):
+        if tree.class_values != class_values:
+            raise ValueError(
+                f"forest trees disagree on class_values: tree 0 has "
+                f"{class_values}, tree {i} has {tree.class_values}")
+    return class_values
+
+
+@partial(jax.jit, static_argnames=("depth", "s_width", "n_classes"))
+def _route_forest(flat_segs: jnp.ndarray, oks: jnp.ndarray,
+                  split_of_b: jnp.ndarray, child_b: jnp.ndarray,
+                  pred_b: jnp.ndarray, valid: jnp.ndarray, *, depth: int,
+                  s_width: int, n_classes: int):
+    """Every tree's leaf routing + the ensemble vote in ONE dispatch:
+    vmap of the per-tree gather chain over the stacked flattened-tree
+    tables, int one-hot votes weighted by per-tree validity (power-of-two
+    tree padding must not vote), argmax on device."""
+    n = flat_segs.shape[1]
+    fs = flat_segs.reshape(-1).astype(jnp.int32)
+    idx = jnp.arange(n)
+
+    def one_tree(split_of, child_flat, pred_of):
+        node = jnp.zeros(n, jnp.int32)
+        for _ in range(depth):
+            seg = fs[split_of[node] * n + idx]
+            ch = child_flat[node * s_width + seg]
+            node = jnp.where(ch >= 0, ch, node)
+        return pred_of[node]
+
+    preds = jax.vmap(one_tree)(split_of_b, child_b, pred_b)   # [Kt, N]
+    votes = jnp.sum(
+        jax.nn.one_hot(preds, n_classes, dtype=jnp.int32)
+        * valid[:, None, None], axis=0)                       # [N, C]
+    return jnp.argmax(votes, axis=1), jnp.all(oks)
+
+
+def _predict_forest_device(trees: Sequence[TreeNode], table: EncodedTable
+                           ) -> np.ndarray:
+    """The stacked device vote: each (attr, key) segmentation is computed
+    once across ALL trees, every tree's routing and the majority vote run
+    as one jitted dispatch, one readback total — vs the per-tree dispatch
+    loop this replaced (ISSUE 15 satellite). Identical predictions to the
+    host walk (asserted in tests)."""
+    n_classes = len(trees[0].class_values)
+    flats = [T._flatten_tree(tree) for tree in trees]
+    depth = max(f[4] for f in flats)
+    if depth == 0:
+        # every tree is a leaf: a constant vote, no routing to dispatch
+        votes = np.zeros(n_classes, np.int64)
+        for tree in trees:
+            votes[tree.prediction] += 1
+        return np.full(table.n_rows, votes.argmax(), np.int64)
+    seg_cache: Dict = {}
+    global_slot: Dict[Tuple[int, str], int] = {}
+    for *_rest, splits in flats:
+        for key in splits:
+            if key not in seg_cache:
+                seg_cache[key] = T._device_segments(table, *key)
+            global_slot.setdefault(key, len(global_slot))
+    ordered = sorted(global_slot, key=global_slot.get)
+    segs = jnp.stack([seg_cache[k][0] for k in ordered])
+    oks = jnp.stack([seg_cache[k][1] for k in ordered])
+
+    s_w = max(f[2] for f in flats)
+    nn = _pow2(max(len(f[3]) for f in flats))
+    kt = _pow2(len(trees))
+    split_of_b = np.zeros((kt, nn), np.int32)
+    child_b = np.full((kt, nn * s_w), -1, np.int32)
+    pred_b = np.zeros((kt, nn), np.int32)
+    valid = np.zeros(kt, np.int32)
+    for i, (split_of, child_flat, s_width, pred, _d, splits) in enumerate(
+            flats):
+        n_nodes = len(pred)
+        remap = (np.asarray([global_slot[k] for k in splits], np.int32)
+                 if splits else np.zeros(1, np.int32))
+        split_of_b[i, :n_nodes] = remap[split_of]
+        child = np.full((nn, s_w), -1, np.int32)
+        child[:n_nodes, :s_width] = child_flat.reshape(n_nodes, s_width)
+        child_b[i] = child.reshape(-1)
+        pred_b[i, :n_nodes] = pred
+        valid[i] = 1
+    out, ok = jax.device_get(_route_forest(
+        segs, oks, jnp.asarray(split_of_b), jnp.asarray(child_b),
+        jnp.asarray(pred_b), jnp.asarray(valid), depth=depth,
+        s_width=int(s_w), n_classes=n_classes))
+    if not ok:
+        raise ValueError("split segment not found for some value")
+    return np.asarray(out, np.int64)
+
+
 def predict_forest(trees: Sequence[TreeNode], table: EncodedTable,
                    device: bool = False) -> np.ndarray:
     """Majority vote of the trees' per-row leaf predictions; the
     (attr, key) row segmentations are computed once across all trees.
-    ``device=True`` routes every tree on device (tree.predict_device —
-    the batch-inference path for large tables); identical predictions
-    either way (asserted in tests)."""
+    ``device=True`` routes EVERY tree and takes the vote in one stacked
+    dispatch + one readback (the batch-inference path for large tables);
+    identical predictions either way (asserted in tests)."""
+    _validate_trees(trees)
     n_classes = len(trees[0].class_values)
-    seg_cache: dict = {}
     if device:
-        # votes accumulate ON device; one readback for the whole ensemble
-        votes_d = jnp.zeros((table.n_rows, n_classes), jnp.int32)
-        all_ok = jnp.ones((1,), bool)
-        for tree in trees:
-            pred_d, oks = _predict_device_raw(tree, table, seg_cache)
-            votes_d = votes_d + jax.nn.one_hot(pred_d, n_classes,
-                                               dtype=jnp.int32)
-            all_ok = all_ok & jnp.all(oks)[None]
-        out, ok = jax.device_get((jnp.argmax(votes_d, axis=1), all_ok))
-        if not ok.all():
-            raise ValueError("split segment not found for some value")
-        return np.asarray(out, np.int64)
+        return _predict_forest_device(trees, table)
+    seg_cache: dict = {}
     votes = np.zeros((table.n_rows, n_classes), np.int64)
     for tree in trees:
         pred = predict_tree(tree, table, seg_cache=seg_cache)
@@ -130,9 +708,13 @@ def predict_forest(trees: Sequence[TreeNode], table: EncodedTable,
 
 
 def save_forest(trees: Sequence[TreeNode], path: str) -> None:
-    with open(path, "w") as fh:
-        json.dump({"classValues": trees[0].class_values,
-                   "trees": [t.to_dict() for t in trees]}, fh)
+    """Rename-atomic model dump: a crash (or a tree that fails to
+    serialize) mid-write leaves any previous artifact intact instead of a
+    truncated JSON for ``load_forest`` to choke on."""
+    class_values = _validate_trees(trees)
+    atomic_json_dump(
+        {"classValues": class_values,
+         "trees": [t.to_dict() for t in trees]}, path)
 
 
 def load_forest(path: str) -> List[TreeNode]:

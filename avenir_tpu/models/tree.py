@@ -29,6 +29,7 @@ Re-designs the reference's driver-iterated tree growth:
 from __future__ import annotations
 
 import itertools
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -36,25 +37,52 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
+from avenir_tpu.ops import histogram as hg
 from avenir_tpu.ops import infotheory as it
 from avenir_tpu.utils.dataset import EncodedTable
 from avenir_tpu.utils.schema import FeatureField, FeatureSchema
 
 SPLIT_SEP = ":"
 
+#: AVENIR_TPU_TREE_HIST: ``on`` (default) computes level-wise split stats
+#: from ONE binned (node, feature, bin, class) histogram per level
+#: (``ops.histogram.node_class_bin_counts`` — the LightGBM/XGBoost
+#: histogram split-finding shape, ISSUE 15); ``off`` pins the legacy
+#: per-candidate one-hot einsum path. Counts are exact-in-f32 integers on
+#: both, so the grown trees are byte-identical (test-pinned); the flag
+#: exists as the A/B + kill switch. Read host-side at call time and passed
+#: as a static jit arg, so flipping it mid-process can never serve a stale
+#: compiled program.
+_TREE_HIST_ENV = "AVENIR_TPU_TREE_HIST"
+
+
+def tree_histograms_active() -> bool:
+    return os.environ.get(_TREE_HIST_ENV, "on").lower() not in (
+        "off", "0", "false", "no")
+
 
 # --------------------------------------------------------------------------
 # candidate-split enumeration (host side)
 # --------------------------------------------------------------------------
 
-def enumerate_numeric_splits(f: FeatureField) -> List[Tuple[int, ...]]:
-    """All increasing split-point tuples on the bucket grid, sizes 1 to
-    maxSplit-1 (createNumPartitions semantics: points from min+bw to max-bw)."""
+def numeric_grid(f: FeatureField) -> List[int]:
+    """The bucket grid every candidate split point of a numeric attribute
+    comes from (createNumPartitions: points from min+bw to max-bw) — THE
+    one definition shared by candidate enumeration and the histogram
+    binning (a row's bin id = #grid points strictly below its value, so a
+    bin determines the segment of every grid-point split exactly)."""
     if f.min is None or f.max is None or f.bucket_width is None:
         raise ValueError(f"numeric split attr {f.name} needs min/max/bucketWidth")
     lo, hi, bw = int(f.min + 0.01), int(f.max + 0.01), int(f.bucket_width)
-    grid = list(range(lo + bw, hi, bw))
+    return list(range(lo + bw, hi, bw))
+
+
+def enumerate_numeric_splits(f: FeatureField) -> List[Tuple[int, ...]]:
+    """All increasing split-point tuples on the bucket grid, sizes 1 to
+    maxSplit-1 (createNumPartitions semantics: points from min+bw to max-bw)."""
+    grid = numeric_grid(f)
     max_points = max((f.max_split or 2) - 1, 1)
     splits: List[Tuple[int, ...]] = []
     for size in range(1, max_points + 1):
@@ -704,7 +732,15 @@ def grow_tree(table: EncodedTable, config: TreeConfig,
 class _DeviceCandidates:
     """Dense device-side candidate catalog: every (attr, split) of every
     plan stacked on one T axis so a whole level evaluates, selects, and
-    routes without leaving the device."""
+    routes without leaving the device.
+
+    ``bins_rows``/``seg_of_bin``/``b_max`` are the histogram split-search
+    operands (ISSUE 15): a row's per-feature bin id determines the segment
+    of EVERY candidate split of that feature (numeric candidate points
+    come off the same bucket grid the bins do; categorical bins are the
+    vocab codes the group lookup keys on), so one binned
+    (node, feature, bin, class) count pass per level replaces the
+    per-candidate one-hot contraction."""
     keys: List[Tuple[int, str, int]]      # (attr_ordinal, key, n_seg) per t
     plan_slices: List[Tuple[int, int, bool, int]]  # (t0, t1, is_cat, col)
     columns_num: jnp.ndarray              # [A, N] f32 (0 where categorical)
@@ -714,6 +750,57 @@ class _DeviceCandidates:
     is_cat: jnp.ndarray                   # [T] bool
     col_of_t: jnp.ndarray                 # [T] i32 index into columns_*
     s_max: int
+    bins_rows: jnp.ndarray                # [N, A] i32 per-feature bin ids
+    seg_of_bin: jnp.ndarray               # [T, b_max] i32 segment per bin
+    b_max: int                            # max bins over the plan features
+
+
+def _plan_bins(table: EncodedTable, plans) -> Tuple[jnp.ndarray, List[int]]:
+    """Per-feature histogram bin ids for every row: ([N, A] i32 device
+    array, bins-per-plan list). Numeric bin = #grid points strictly below
+    the value (so every grid-point split's segment is a pure function of
+    the bin); categorical bin = the vocab code. Shared by the in-core
+    catalog build and the out-of-core per-chunk passes."""
+    ord_to_pos = {f.ordinal: i for i, f in enumerate(table.feature_fields)}
+    cols, n_bins = [], []
+    for attr, _keys, is_cat, column, _aux, _n_seg in plans:
+        f = table.feature_fields[ord_to_pos[attr]]
+        if is_cat:
+            cols.append(jnp.asarray(column, jnp.int32))
+            n_bins.append(len(table.bin_labels[ord_to_pos[attr]]))
+        else:
+            grid = jnp.asarray(np.asarray(numeric_grid(f), np.float32))
+            cols.append(jnp.sum(
+                jnp.asarray(column, jnp.float32)[:, None] > grid[None, :],
+                axis=1).astype(jnp.int32))
+            n_bins.append(int(grid.shape[0]) + 1)
+    return jnp.stack(cols, axis=1), n_bins
+
+
+def _plan_seg_of_bin(table: EncodedTable, plans,
+                     n_bins: List[int]) -> np.ndarray:
+    """[T, b_max] segment of every (candidate, bin): numeric — #candidate
+    points at or below the bin's lower edge (value > point iff the point
+    sits below the bin, the IntegerSplit rule expressed per bin);
+    categorical — the group-of-code lookup verbatim. Bins a feature never
+    produces (the b_max padding) carry 0; their histogram cells are
+    structurally zero, so they contribute nothing to any count."""
+    ord_to_pos = {f.ordinal: i for i, f in enumerate(table.feature_fields)}
+    b_max = max(n_bins)
+    rows = []
+    for (attr, keys, is_cat, _column, aux, _n_seg), n_b in zip(plans, n_bins):
+        sob = np.zeros((len(keys), b_max), np.int32)
+        if is_cat:
+            sob[:, :aux.shape[1]] = aux
+        else:
+            f = table.feature_fields[ord_to_pos[attr]]
+            edges = np.concatenate(
+                [[-np.inf], np.asarray(numeric_grid(f), np.float64)])
+            # [S, P] points vs [B] lower edges; +inf padding never counts
+            sob[:, :n_b] = np.sum(
+                aux[:, None, :] <= edges[None, :n_b, None], axis=2)
+        rows.append(sob)
+    return np.concatenate(rows)
 
 
 def _device_candidates(table: EncodedTable, plans) -> _DeviceCandidates:
@@ -767,6 +854,8 @@ def _device_candidates(table: EncodedTable, plans) -> _DeviceCandidates:
         is_cat_l.extend([is_cat] * len(ks))
         col_l.extend([a] * len(ks))
         plan_slices.append((t0, len(keys), is_cat, a))
+    bins_rows, n_bins = _plan_bins(table, plans)
+    seg_of_bin = _plan_seg_of_bin(table, plans, n_bins)
     return _DeviceCandidates(
         keys=keys, plan_slices=plan_slices,
         columns_num=jnp.stack(num_cols),
@@ -775,7 +864,10 @@ def _device_candidates(table: EncodedTable, plans) -> _DeviceCandidates:
         lookup=jnp.asarray(np.concatenate(lut_l)),
         is_cat=jnp.asarray(np.asarray(is_cat_l)),
         col_of_t=jnp.asarray(np.asarray(col_l, np.int32)),
-        s_max=s_max)
+        s_max=s_max,
+        bins_rows=bins_rows,
+        seg_of_bin=jnp.asarray(seg_of_bin),
+        b_max=int(max(n_bins)))
 
 
 # chunk of candidates whose [chunk*s_max, N] one-hot slab is materialized at
@@ -787,31 +879,16 @@ _LEVEL_CHUNK_T = 16
 _NODE_COLS_CHUNK = 128
 
 
-def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
-                labels: jnp.ndarray, columns_num: jnp.ndarray,
-                columns_cat: jnp.ndarray, points: jnp.ndarray,
-                lookup: jnp.ndarray, is_cat_t: jnp.ndarray,
-                col_of_t: jnp.ndarray, *, plan_slices, k_nodes: int,
-                k_next: int, s_max: int, n_classes: int, algorithm: str,
-                min_node_size: int, min_gain: float,
-                with_ratio: bool = False):
-    """One growth level fully on device: per-node candidate stats → best
-    split selection → SPARSE FRONTIER COMPACTION → row routing. The node
-    axis holds only live (still-splittable) nodes: each level's record
-    carries every child's class counts, the children that can split again
-    are assigned compact slots (cumsum over the liveness mask), and rows
-    routed to leaf children get weight 0 — so the node axis grows with the
-    LIVE frontier, not s_max^depth (the round-2 dense axis hit a 4GB wall
-    at depth ~6 on 1M rows). ``k_next`` caps next level's slots; overflow
-    is detected host-side from the recorded ``n_live``. Returns the next
-    (node_id, row_w) plus the level record. Traced inside
-    :func:`_grow_levels` — never dispatched alone."""
+def _level_counts_einsum(node_id, row_w, labels, columns_num, columns_cat,
+                         points, lookup, *, plan_slices, k_nodes: int,
+                         s_max: int, n_classes: int) -> jnp.ndarray:
+    """[T, S, K, C] candidate-segment class counts, legacy formulation:
+    per-candidate segment one-hots contracted against the node-class
+    one-hot — O(T·S·N) compares plus a [T·S, N] × [N, K·C] contraction."""
     n = node_id.shape[0]
     kc = k_nodes * n_classes
     nc_id = node_id * n_classes + labels                   # [N]
     w_col = row_w[:, None].astype(jnp.bfloat16)
-
-    t_total = points.shape[0]
     counts_l = []
     for t0p, t1p, is_cat, a in plan_slices:
         col_num = columns_num[a]
@@ -843,8 +920,49 @@ def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
             chunk = jnp.concatenate(cols, axis=1) if len(cols) > 1 else (
                 cols[0])
             counts_l.append(chunk.reshape(tc, s_max, k_nodes, n_classes))
-    counts = jnp.concatenate(counts_l)                     # [T, S, K, C]
+    return jnp.concatenate(counts_l)                       # [T, S, K, C]
 
+
+def _counts_from_hist(hist: jnp.ndarray, seg_of_bin: jnp.ndarray, *,
+                      plan_slices, k_nodes: int, s_max: int, b_max: int,
+                      n_classes: int) -> jnp.ndarray:
+    """[T, S, K, C] candidate-segment counts AGGREGATED from the level's
+    binned histogram ``hist`` [A, K, B, C] — N-free work (T·S·B·K·C MACs
+    against B-wide operands) instead of the einsum path's N-wide
+    contraction per candidate. Bin counts are exact-in-f32 integers, so
+    grouping bins into segments reproduces the direct per-candidate counts
+    bit for bit regardless of summation order."""
+    kc = k_nodes * n_classes
+    counts_l = []
+    for t0p, t1p, _is_cat, a in plan_slices:
+        # [K, B, C] -> [B, K·C] once per plan
+        h_a = hist[a].transpose(1, 0, 2).reshape(b_max, kc)
+        for t0 in range(t0p, t1p, _LEVEL_CHUNK_T):
+            t1 = min(t0 + _LEVEL_CHUNK_T, t1p)
+            tc = t1 - t0
+            oh = (seg_of_bin[t0:t1, None, :] ==
+                  jnp.arange(s_max)[None, :, None]).astype(jnp.float32)
+            chunk = jax.lax.dot_general(
+                oh.reshape(tc * s_max, b_max), h_a,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            counts_l.append(chunk.reshape(tc, s_max, k_nodes, n_classes))
+    return jnp.concatenate(counts_l)
+
+
+def _level_select(counts: jnp.ndarray, *, k_nodes: int, s_max: int,
+                  n_classes: int, algorithm: str, min_node_size: int,
+                  min_gain: float, cand_mask: Optional[jnp.ndarray] = None,
+                  with_ratio: bool = False):
+    """Best-split selection + SPARSE FRONTIER COMPACTION from the level's
+    [T, S, K, C] counts: per-(candidate, node) stats, the per-node argmax,
+    every child's class counts through the chosen candidate, and compact
+    next-level slots (cumsum over the liveness mask). ``cand_mask`` [T]
+    (batched forests: each tree's random attribute subset) sinks the
+    ratios of out-of-subset candidates to −inf — selection over the
+    masked full catalog equals selection over the subset-only catalog
+    because the catalog is attr-sorted, so restriction preserves order."""
+    t_total = counts.shape[0]
     node_counts = jnp.sum(counts[0], axis=0)               # [K, C]
     flat_sgc = counts.transpose(0, 2, 1, 3).reshape(
         t_total * k_nodes, s_max, n_classes)
@@ -858,6 +976,8 @@ def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
                           0.0)
     else:
         ratio = stat
+    if cand_mask is not None:
+        ratio = jnp.where(cand_mask[:, None], ratio, -jnp.inf)
     best_t = jnp.argmax(ratio, axis=0).astype(jnp.int32)   # [K]
     best_ratio = jnp.take_along_axis(ratio, best_t[None, :], axis=0)[0]
 
@@ -880,21 +1000,6 @@ def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
     slot = jnp.cumsum(ls.astype(jnp.int32)) - 1            # dense→compact
     child_slot = jnp.where(ls, slot, -1)                   # [K*S]
     n_live = jnp.sum(ls.astype(jnp.int32))
-
-    # routing: evaluate ONLY each row's chosen candidate
-    t_row = best_t[node_id]                                # [N]
-    col_row = col_of_t[t_row]
-    val_row = jnp.take_along_axis(columns_num, col_row[None, :], axis=0)[0]
-    code_row = jnp.take_along_axis(columns_cat, col_row[None, :], axis=0)[0]
-    num_seg_row = jnp.sum(val_row[:, None] > points[t_row],
-                          axis=1).astype(jnp.int32)
-    cat_seg_row = lookup.reshape(-1)[t_row * lookup.shape[1] + code_row]
-    seg_row = jnp.where(is_cat_t[t_row], cat_seg_row, num_seg_row)
-
-    cs_row = child_slot[node_id * s_max + seg_row]         # [N]
-    in_budget = (cs_row >= 0) & (cs_row < k_next)
-    new_node_id = jnp.clip(cs_row, 0, k_next - 1)
-    new_row_w = row_w * in_budget.astype(row_w.dtype)
     rec = {"best_t": best_t, "split": split_k,
            "child_counts": child_counts,
            "child_slot": child_slot.reshape(k_nodes, s_max),
@@ -905,6 +1010,151 @@ def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
         # the batched DataPartitioner needs it, and grow_tree_device's
         # one-fetch readback must not pay ~T*K floats per level for it
         rec["ratio"] = ratio
+    return rec
+
+
+def _route_level_einsum(node_id, row_w, best_t, child_slot_flat,
+                        columns_num, columns_cat, points, lookup, is_cat_t,
+                        col_of_t, *, s_max: int, k_next: int):
+    """Row routing by re-evaluating each row's chosen candidate against
+    its raw column value (the legacy formulation)."""
+    t_row = best_t[node_id]                                # [N]
+    col_row = col_of_t[t_row]
+    val_row = jnp.take_along_axis(columns_num, col_row[None, :], axis=0)[0]
+    code_row = jnp.take_along_axis(columns_cat, col_row[None, :], axis=0)[0]
+    num_seg_row = jnp.sum(val_row[:, None] > points[t_row],
+                          axis=1).astype(jnp.int32)
+    cat_seg_row = lookup.reshape(-1)[t_row * lookup.shape[1] + code_row]
+    seg_row = jnp.where(is_cat_t[t_row], cat_seg_row, num_seg_row)
+    cs_row = child_slot_flat[node_id * s_max + seg_row]    # [N]
+    in_budget = (cs_row >= 0) & (cs_row < k_next)
+    return (jnp.clip(cs_row, 0, k_next - 1),
+            row_w * in_budget.astype(row_w.dtype))
+
+
+def _route_level_hist(node_id, row_w, best_t, child_slot_flat, bins_rows,
+                      seg_of_bin, col_of_t, *, s_max: int, b_max: int,
+                      k_next: int):
+    """Row routing through the bin tables: a row's segment under its
+    node's chosen candidate is ``seg_of_bin[t, bin]`` — one gather, no
+    per-row point compares, and provably equal to the raw-value evaluation
+    (the bin id determines the count of grid points below the value).
+    Shared verbatim by the in-core level step and the out-of-core replay,
+    so streamed growth can never route differently than resident growth."""
+    t_row = best_t[node_id]                                # [N]
+    col_row = col_of_t[t_row]
+    bin_row = jnp.take_along_axis(bins_rows, col_row[:, None], axis=1)[:, 0]
+    seg_row = seg_of_bin.reshape(-1)[t_row * b_max + bin_row]
+    cs_row = child_slot_flat[node_id * s_max + seg_row]    # [N]
+    in_budget = (cs_row >= 0) & (cs_row < k_next)
+    return (jnp.clip(cs_row, 0, k_next - 1),
+            row_w * in_budget.astype(row_w.dtype))
+
+
+def _blc_onehot(bins_rows: jnp.ndarray, labels: jnp.ndarray, b_max: int,
+                n_classes: int) -> jnp.ndarray:
+    """The SHARED (feature, bin, class) one-hot [N, A·B·C] every level's
+    histogram matmul contracts against — node/tree/level independent, so
+    growers build it once and XLA CSEs the per-level copies."""
+    n, n_a = bins_rows.shape
+    blc_id = bins_rows * n_classes + labels[:, None]       # [N, A]
+    return (blc_id[:, :, None] ==
+            jnp.arange(b_max * n_classes)[None, None, :]
+            ).astype(jnp.bfloat16).reshape(n, n_a * b_max * n_classes)
+
+
+def _level_hist(node_id, row_w, labels, bins_rows, *, k_nodes: int,
+                b_max: int, n_classes: int, pallas: bool = False,
+                psum_axis: Optional[str] = None) -> jnp.ndarray:
+    """The level's binned (feature, node, bin, class) counts [A, K, B, C].
+
+    With ``pallas`` (the histogram family is active: TPU / forced /
+    interpret) this is the ``class_feature_bin_counts`` dispatch with
+    node ids folded into the combined index — the streamed-VMEM kernel
+    shape. On the jnp fallback backends the same cells come from the
+    narrow one-matmul formulation (:func:`_forest_level_hist` at tree
+    batch 1): the combined-index one-hot the jnp path would materialize
+    is [N, A, K·B]-wide, measured SLOWER than the legacy einsum on CPU
+    at 16k rows. Either way every cell is the identical exact-in-f32
+    integer, and weights pass through bf16 exactly as the einsum path's
+    one-hot multiply does — so all formulations are bit-equal
+    (test-pinned). ``pallas`` rides the callers' STATIC jit args (the
+    env is read host-side per call), so flipping the dispatch env can
+    never serve a stale compiled program. Under a sharded row axis,
+    ``psum_axis`` closes the per-shard additive payloads with one psum —
+    the exact-integer fold PR 9 proved byte-identical."""
+    w = row_w.astype(jnp.bfloat16).astype(jnp.float32)
+    if pallas:
+        hist = hg.node_class_bin_counts(
+            bins_rows, node_id, labels, k_nodes, b_max, n_classes, w)
+    else:
+        oh_blc = _blc_onehot(bins_rows, labels, b_max, n_classes)
+        hist = _forest_level_hist(
+            node_id[None], w[None], oh_blc, k_nodes=k_nodes,
+            n_a=bins_rows.shape[1], b_max=b_max, n_classes=n_classes)[0]
+    if psum_axis is not None:
+        hist = lax.psum(hist, psum_axis)
+    return hist
+
+
+def _level_body(node_id: jnp.ndarray, row_w: jnp.ndarray,
+                labels: jnp.ndarray, columns_num: jnp.ndarray,
+                columns_cat: jnp.ndarray, points: jnp.ndarray,
+                lookup: jnp.ndarray, is_cat_t: jnp.ndarray,
+                col_of_t: jnp.ndarray, bins_rows: jnp.ndarray,
+                seg_of_bin: jnp.ndarray, *, plan_slices, k_nodes: int,
+                k_next: int, s_max: int, b_max: int, n_classes: int,
+                algorithm: str, min_node_size: int, min_gain: float,
+                with_ratio: bool = False, use_hist: bool = True,
+                hist_pallas: bool = False,
+                psum_axis: Optional[str] = None,
+                cand_mask: Optional[jnp.ndarray] = None):
+    """One growth level fully on device: per-node candidate stats → best
+    split selection → SPARSE FRONTIER COMPACTION → row routing. The node
+    axis holds only live (still-splittable) nodes: each level's record
+    carries every child's class counts, the children that can split again
+    are assigned compact slots (cumsum over the liveness mask), and rows
+    routed to leaf children get weight 0 — so the node axis grows with the
+    LIVE frontier, not s_max^depth (the round-2 dense axis hit a 4GB wall
+    at depth ~6 on 1M rows). ``k_next`` caps next level's slots; overflow
+    is detected host-side from the recorded ``n_live``. Returns the next
+    (node_id, row_w) plus the level record. Traced inside
+    :func:`_grow_levels` — never dispatched alone.
+
+    ``use_hist`` selects the ISSUE-15 histogram formulation (ONE binned
+    count pass + N-free aggregation — byte-identical trees, test-pinned)
+    vs the legacy per-candidate einsum; ``psum_axis`` (histogram path
+    only) folds per-shard counts across a mesh axis; ``cand_mask``
+    restricts selection to a candidate subset (batched forests)."""
+    if use_hist:
+        hist = _level_hist(node_id, row_w, labels, bins_rows,
+                           k_nodes=k_nodes, b_max=b_max,
+                           n_classes=n_classes, pallas=hist_pallas,
+                           psum_axis=psum_axis)
+        counts = _counts_from_hist(
+            hist, seg_of_bin, plan_slices=plan_slices, k_nodes=k_nodes,
+            s_max=s_max, b_max=b_max, n_classes=n_classes)
+    else:
+        if psum_axis is not None:
+            raise ValueError("sharded growth requires the histogram path")
+        counts = _level_counts_einsum(
+            node_id, row_w, labels, columns_num, columns_cat, points,
+            lookup, plan_slices=plan_slices, k_nodes=k_nodes, s_max=s_max,
+            n_classes=n_classes)
+    rec = _level_select(counts, k_nodes=k_nodes, s_max=s_max,
+                        n_classes=n_classes, algorithm=algorithm,
+                        min_node_size=min_node_size, min_gain=min_gain,
+                        cand_mask=cand_mask, with_ratio=with_ratio)
+    child_slot_flat = rec["child_slot"].reshape(-1)
+    if use_hist:
+        new_node_id, new_row_w = _route_level_hist(
+            node_id, row_w, rec["best_t"], child_slot_flat, bins_rows,
+            seg_of_bin, col_of_t, s_max=s_max, b_max=b_max, k_next=k_next)
+    else:
+        new_node_id, new_row_w = _route_level_einsum(
+            node_id, row_w, rec["best_t"], child_slot_flat, columns_num,
+            columns_cat, points, lookup, is_cat_t, col_of_t, s_max=s_max,
+            k_next=k_next)
     return new_node_id, new_row_w, rec
 
 
@@ -919,17 +1169,20 @@ def _level_widths(depth: int, s_max: int, budget: int):
 
 
 @partial(jax.jit, static_argnames=("plan_slices", "depth", "s_max",
-                                   "n_classes", "algorithm",
+                                   "b_max", "n_classes", "algorithm",
                                    "min_node_size", "min_gain",
-                                   "node_budget", "with_ratio"))
+                                   "node_budget", "with_ratio",
+                                   "use_hist", "hist_pallas"))
 def _grow_levels(labels: jnp.ndarray, columns_num: jnp.ndarray,
                  columns_cat: jnp.ndarray, points: jnp.ndarray,
                  lookup: jnp.ndarray, is_cat_t: jnp.ndarray,
-                 col_of_t: jnp.ndarray, row_w0: jnp.ndarray, *,
+                 col_of_t: jnp.ndarray, bins_rows: jnp.ndarray,
+                 seg_of_bin: jnp.ndarray, row_w0: jnp.ndarray, *,
                  plan_slices, depth: int,
-                 s_max: int, n_classes: int, algorithm: str,
+                 s_max: int, b_max: int, n_classes: int, algorithm: str,
                  min_node_size: int, min_gain: float, node_budget: int,
-                 with_ratio: bool = False):
+                 with_ratio: bool = False, use_hist: bool = True,
+                 hist_pallas: bool = False):
     """The WHOLE depth-D growth as one dispatch: levels are python-unrolled
     inside the jit (the compacted node axis differs per level, so shapes
     differ and lax.scan cannot carry them), so the host pays one launch +
@@ -949,11 +1202,103 @@ def _grow_levels(labels: jnp.ndarray, columns_num: jnp.ndarray,
         k_next = min(widths[d] * s_max, node_budget)
         node_id, row_w, rec = _level_body(
             node_id, row_w, labels, columns_num, columns_cat, points,
-            lookup, is_cat_t, col_of_t, plan_slices=plan_slices,
-            k_nodes=widths[d], k_next=k_next, s_max=s_max,
+            lookup, is_cat_t, col_of_t, bins_rows, seg_of_bin,
+            plan_slices=plan_slices,
+            k_nodes=widths[d], k_next=k_next, s_max=s_max, b_max=b_max,
             n_classes=n_classes, algorithm=algorithm,
             min_node_size=min_node_size, min_gain=min_gain,
-            with_ratio=with_ratio)
+            with_ratio=with_ratio, use_hist=use_hist,
+            hist_pallas=hist_pallas)
+        records.append(rec)
+    return records
+
+
+#: tree·node rows of the whole-forest histogram matmul materialized at
+#: once — bounds the [Kt·K, N] weight slab at deep (budget-capped) levels
+_FOREST_NODE_CHUNK = 256
+
+
+def _forest_level_hist(node_id_b, row_w_b, oh_blc, *, k_nodes: int,
+                       n_a: int, b_max: int, n_classes: int,
+                       psum_axis: Optional[str] = None) -> jnp.ndarray:
+    """The whole forest's level histogram [Kt, A, K, B, C] as ONE matmul:
+    per-(tree, node) masked weights [Kt·K, N] against the SHARED
+    (feature, bin, class) one-hot ``oh_blc`` [N, A·B·C] built once per
+    forest — the tree and node axes ride the LHS rows (bagging weights
+    already enter the counts, so bootstraps are free), the binned layout
+    rides the RHS columns. Every product is an exact-in-f32 integer
+    (bf16-quantized weights × 0/1 one-hots, f32 accumulation), so the
+    cells are bit-equal to the per-tree ``node_class_bin_counts`` pass
+    the serial grower runs — vmapping that kernel over trees instead
+    re-materializes a [Kt, N, A, K·B] one-hot per level (measured 0.8×
+    SERIAL on CPU at 16 trees; this formulation is what makes batched
+    growth win)."""
+    kt, n = row_w_b.shape
+    w16 = row_w_b.astype(jnp.bfloat16)
+    chunks = []
+    # the bound is on tree·node LHS rows, so the node chunk shrinks as the
+    # tree batch grows — a wide forest at a deep level must not slab
+    # [Kt·256, N] at once
+    node_chunk = max(1, _FOREST_NODE_CHUNK // kt)
+    for k0 in range(0, k_nodes, node_chunk):
+        k1 = min(k0 + node_chunk, k_nodes)
+        wk = ((node_id_b[:, None, :] ==
+               jnp.arange(k0, k1)[None, :, None]).astype(jnp.bfloat16)
+              * w16[:, None, :])                     # [Kt, kc, N]
+        chunks.append(jax.lax.dot_general(
+            wk.reshape(kt * (k1 - k0), n), oh_blc,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
+        ).reshape(kt, k1 - k0, n_a, b_max, n_classes))
+    flat = (chunks[0] if len(chunks) == 1
+            else jnp.concatenate(chunks, axis=1))    # [Kt, K, A, B, C]
+    hist = flat.transpose(0, 2, 1, 3, 4)             # [Kt, A, K, B, C]
+    if psum_axis is not None:
+        hist = lax.psum(hist, psum_axis)
+    return hist
+
+
+def _forest_levels_impl(labels, bins_rows, seg_of_bin, col_of_t, row_w0_b,
+                        cand_mask_b, *, plan_slices, depth: int,
+                        s_max: int, b_max: int, n_classes: int,
+                        algorithm: str, min_node_size: int,
+                        min_gain: float, node_budget: int,
+                        psum_axis: Optional[str] = None):
+    """The WHOLE forest's depth-D level records, histogram path only —
+    the body the batched growers (models/forest.py) jit (and shard_map
+    over the row axis: ``psum_axis`` folds the per-shard histogram
+    payloads). Bootstrap weights ``row_w0_b`` [Kt, N] and attribute-subset
+    masks ``cand_mask_b`` [Kt, T] ride a leading tree axis; each level is
+    one shared-one-hot histogram matmul (:func:`_forest_level_hist`) plus
+    the per-tree selection/routing vmapped over trees. Records carry the
+    tree axis first."""
+    n = labels.shape[0]
+    kt = row_w0_b.shape[0]
+    n_a = bins_rows.shape[1]
+    # the (feature, bin, class) one-hot every level's matmul shares
+    oh_blc = _blc_onehot(bins_rows, labels, b_max, n_classes)
+    node_id_b = jnp.zeros((kt, n), jnp.int32)
+    row_w_b = row_w0_b
+    records = []
+    widths = _level_widths(depth, s_max, node_budget)
+    for d in range(depth):
+        k_nodes = widths[d]
+        k_next = min(k_nodes * s_max, node_budget)
+        hist = _forest_level_hist(
+            node_id_b, row_w_b, oh_blc, k_nodes=k_nodes, n_a=n_a,
+            b_max=b_max, n_classes=n_classes, psum_axis=psum_axis)
+        rec = jax.vmap(lambda h, m: _level_select(
+            _counts_from_hist(h, seg_of_bin, plan_slices=plan_slices,
+                              k_nodes=k_nodes, s_max=s_max, b_max=b_max,
+                              n_classes=n_classes),
+            k_nodes=k_nodes, s_max=s_max, n_classes=n_classes,
+            algorithm=algorithm, min_node_size=min_node_size,
+            min_gain=min_gain, cand_mask=m))(hist, cand_mask_b)
+        node_id_b, row_w_b = jax.vmap(
+            lambda nid, rw, bt, cs: _route_level_hist(
+                nid, rw, bt, cs.reshape(-1), bins_rows, seg_of_bin,
+                col_of_t, s_max=s_max, b_max=b_max, k_next=k_next)
+        )(node_id_b, row_w_b, rec["best_t"], rec["child_slot"])
         records.append(rec)
     return records
 
@@ -1012,11 +1357,14 @@ def grow_tree_device(table: EncodedTable, config: TreeConfig,
               else jnp.asarray(row_weights, jnp.float32))
     records = _grow_levels(
         table.labels, cand.columns_num, cand.columns_cat, cand.points,
-        cand.lookup, cand.is_cat, cand.col_of_t, row_w0,
+        cand.lookup, cand.is_cat, cand.col_of_t, cand.bins_rows,
+        cand.seg_of_bin, row_w0,
         plan_slices=tuple(cand.plan_slices), depth=config.max_depth,
-        s_max=s_max, n_classes=table.n_classes,
+        s_max=s_max, b_max=cand.b_max, n_classes=table.n_classes,
         algorithm=config.algorithm, min_node_size=config.min_node_size,
-        min_gain=config.min_gain, node_budget=config.device_node_budget)
+        min_gain=config.min_gain, node_budget=config.device_node_budget,
+        use_hist=tree_histograms_active(),
+        hist_pallas=hg.pallas_histograms_active())
     # ONE readback for the whole tree
     records = jax.device_get(records)
 
@@ -1025,20 +1373,29 @@ def grow_tree_device(table: EncodedTable, config: TreeConfig,
                                config.device_node_budget),
         config.device_node_budget,
         "raise the budget or use grow_tree (masked, per-level)")
+    return _build_tree(records, cand.keys, table.class_values,
+                       table.n_classes)
+
+
+def _build_tree(records, keys, class_values: List[str],
+                n_classes: int) -> TreeNode:
+    """Host reconstruction of ONE tree from its fetched level records —
+    shared by :func:`grow_tree_device` and the batched forest growers
+    (which slice their per-tree records off the leading tree axis)."""
 
     def build(level: int, slot: int, counts: np.ndarray
               ) -> Optional[TreeNode]:
         if counts.sum() <= 0:
             return None
         node = TreeNode(class_counts=counts,
-                        class_values=table.class_values)
+                        class_values=class_values)
         if slot < 0 or level >= len(records):
             return node                       # leaf: counts came from the
         rec = records[level]                  # parent's child_counts row
         if not bool(rec["split"][slot]):
             return node
         t = int(rec["best_t"][slot])
-        attr, key, n_seg = cand.keys[t]
+        attr, key, n_seg = keys[t]
         node.attr_ordinal, node.split_key = attr, key
         for s in range(n_seg):
             child = build(level + 1, int(rec["child_slot"][slot, s]),
@@ -1051,8 +1408,8 @@ def grow_tree_device(table: EncodedTable, config: TreeConfig,
     root = build(0, 0, root_counts)
     if root is None:
         # zero-row table: a leaf root with empty counts, like grow_tree
-        root = TreeNode(class_counts=np.zeros(table.n_classes),
-                        class_values=table.class_values)
+        root = TreeNode(class_counts=np.zeros(n_classes),
+                        class_values=class_values)
     return root
 
 
@@ -1084,11 +1441,15 @@ def grow_levels_batched(table: EncodedTable, attr_ordinals: Sequence[int],
     row_w = jnp.ones(table.n_rows, jnp.float32)
     records = _grow_levels(
         table.labels, cand.columns_num, cand.columns_cat, cand.points,
-        cand.lookup, cand.is_cat, cand.col_of_t, row_w,
+        cand.lookup, cand.is_cat, cand.col_of_t, cand.bins_rows,
+        cand.seg_of_bin, row_w,
         plan_slices=tuple(cand.plan_slices), depth=depth,
-        s_max=cand.s_max, n_classes=table.n_classes, algorithm=algorithm,
+        s_max=cand.s_max, b_max=cand.b_max, n_classes=table.n_classes,
+        algorithm=algorithm,
         min_node_size=min_node_size, min_gain=float("-inf"),
-        node_budget=node_budget, with_ratio=True)
+        node_budget=node_budget, with_ratio=True,
+        use_hist=tree_histograms_active(),
+        hist_pallas=hg.pallas_histograms_active())
     records = jax.device_get(records)
     _check_frontier_budget(
         records, _level_widths(depth, cand.s_max, node_budget),

@@ -145,6 +145,57 @@ def _class_feature_bin_counts_jnp(bins: jnp.ndarray, labels: jnp.ndarray,
     return flat.reshape(bins.shape[1], n_classes, n_bins).transpose(1, 0, 2)
 
 
+#: max combined (node·bin) width per class_feature_bin_counts dispatch in
+#: node_class_bin_counts — bounds the one-hot/accumulator width whatever the
+#: caller's frontier size (a deep tree level can carry thousands of nodes)
+_NODE_CHUNK_CB = 8192
+
+
+def node_class_bin_counts(bins: jnp.ndarray, node_id: jnp.ndarray,
+                          labels: jnp.ndarray, n_nodes: int, n_bins: int,
+                          n_classes: int,
+                          weights: Optional[jnp.ndarray] = None
+                          ) -> jnp.ndarray:
+    """[N, A] bins × [N] node ids × [N] labels -> [A, n_nodes, n_bins,
+    n_classes] counts — the histogram split-finding reduction (ISSUE 15).
+
+    One tree level's split statistics for EVERY (node, feature, bin,
+    class) cell in one pass: the node id is folded into the combined
+    index (``node · n_bins + bin``) riding the F axis of
+    :func:`class_feature_bin_counts` — exactly the PR 10/14 combined-index
+    pattern, so the whole thing inherits that function's Pallas/jnp
+    dispatch (``AVENIR_TPU_PALLAS_HIST``) and its exactness contract:
+    integer-weight count families are bit-identical across paths and
+    across any summation order (exact-in-f32 integers).
+
+    The node axis is processed in chunks of ``_NODE_CHUNK_CB // n_bins``
+    so the combined one-hot width stays bounded however wide the frontier
+    grows; rows outside a chunk take combined id −1 and DROP (the
+    one-hot/compare semantics), which partitions the rows exactly —
+    chunked totals are byte-identical to an unchunked pass. Out-of-range
+    bins or nodes likewise drop rather than aliasing a neighbor's slot.
+    """
+    n, n_a = bins.shape
+    bins = jnp.asarray(bins, jnp.int32)
+    node_id = jnp.asarray(node_id, jnp.int32)
+    bin_ok = (bins >= 0) & (bins < n_bins)
+    node_ok = (node_id >= 0) & (node_id < n_nodes)
+    chunk = max(1, _NODE_CHUNK_CB // max(n_bins, 1))
+    parts = []
+    for k0 in range(0, n_nodes, chunk):
+        k1 = min(k0 + chunk, n_nodes)
+        in_chunk = node_ok & (node_id >= k0) & (node_id < k1)
+        combined = jnp.where(
+            bin_ok & in_chunk[:, None],
+            (node_id[:, None] - k0) * n_bins + bins, -1)
+        flat = class_feature_bin_counts(
+            combined, labels, n_classes, (k1 - k0) * n_bins, weights)
+        # [C, A, (k1-k0)·B] -> [A, k1-k0, B, C]
+        parts.append(flat.reshape(n_classes, n_a, k1 - k0, n_bins)
+                     .transpose(1, 2, 3, 0))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
 def per_class_moments(values: jnp.ndarray, labels: jnp.ndarray,
                       n_classes: int,
                       weights: Optional[jnp.ndarray] = None

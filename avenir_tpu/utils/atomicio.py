@@ -1,0 +1,59 @@
+"""Rename-atomic file writes — the repo-standard temp + ``os.replace``
+idiom (lifecycle registry, obs exporters, resume journals) as ONE shared
+helper, so model writers stop hand-rolling it: a crash or serialization
+error mid-dump leaves the previous artifact intact instead of a truncated
+file for a loader to mis-parse. Same-filesystem rename is atomic on
+POSIX; the pid suffix keeps concurrent same-host writers off each other's
+temp files."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable
+
+
+def atomic_write_text(path: str, emit: Callable, mode: str = "w") -> None:
+    """Run ``emit(fh)`` against a same-directory temp file, then
+    ``os.replace`` it over ``path``. On ANY failure the temp file is
+    removed and the original is untouched. ``mode`` opens the temp file
+    (``"wb"`` for binary emitters)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as fh:
+            emit(fh)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+
+
+def atomic_json_dump(obj, path: str, **dump_kwargs) -> None:
+    """``json.dump`` through :func:`atomic_write_text`. Serialization runs
+    INSIDE the temp write (objects that fail mid-serialization — the
+    crash-sim class — can never tear the destination)."""
+    atomic_write_text(path, lambda fh: json.dump(obj, fh, **dump_kwargs))
+
+
+def atomic_write_data(path: str, data) -> None:
+    """Pre-serialized ``str`` or ``bytes`` through the same temp +
+    ``os.replace`` + cleanup-on-failure discipline (the shape
+    ``utils.resume`` journals need)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        if isinstance(data, bytes):
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+        else:
+            with open(tmp, "w") as fh:
+                fh.write(data)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)

@@ -57,14 +57,10 @@ def run_nonce() -> str:
 
 
 def _atomic_write(path: str, data) -> None:
-    tmp = f"{path}.tmp-{os.getpid()}"
-    if isinstance(data, bytes):
-        with open(tmp, "wb") as fh:
-            fh.write(data)
-    else:
-        with open(tmp, "w") as fh:
-            fh.write(data)
-    os.replace(tmp, path)
+    # the shared rename-atomic helper (utils/atomicio) — one idiom, one
+    # cleanup-on-failure behavior, instead of a per-module copy
+    from avenir_tpu.utils.atomicio import atomic_write_data
+    atomic_write_data(path, data)
 
 
 class ShardJournal:
@@ -173,9 +169,11 @@ class ShardJournal:
         (atomically) — byte-identical to a direct streaming write of the
         same shards."""
         n = self.n_shards if n_shards is None else n_shards
-        tmp = f"{out_path}.tmp-{os.getpid()}"
-        with open(tmp, "wb") as out:
+        from avenir_tpu.utils.atomicio import atomic_write_text
+
+        def emit(out):
             for i in range(n):
                 with open(self.fragment_path(i), "rb") as frag:
                     shutil.copyfileobj(frag, out)
-        os.replace(tmp, out_path)
+
+        atomic_write_text(out_path, emit, mode="wb")

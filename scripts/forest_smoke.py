@@ -1,0 +1,157 @@
+"""Forest smoke (ISSUE 15, tier-1 via tests/test_forest.py): histogram
+split-search parity + batched whole-forest growth + sharded fold +
+out-of-core streaming + atomic artifact discipline in one lean in-process
+run.
+
+Six gates, one JSON line on stdout, non-zero exit on any failure:
+
+1. HIST PARITY: ``grow_tree_device`` grows the byte-identical tree
+   (``canonical_tree``) on the histogram path, the legacy einsum path
+   (``AVENIR_TPU_TREE_HIST=off``) and the Pallas interpret-mode
+   combined-index kernel (``AVENIR_TPU_PALLAS_HIST=interpret``).
+2. BATCHED == SERIAL: a bagged random-subset forest grown as ONE batched
+   device program equals the serial per-tree loop tree for tree.
+3. SHARDED FOLD: 1-shard and 2-shard ``grow_forest_sharded`` (per-shard
+   additive histogram payloads, one psum per level) reproduce the
+   single-device forest byte for byte.
+4. STREAMING: ``grow_forest_streaming`` over 3 ragged part files
+   (bagging off) equals in-core batched growth; with bagging it still
+   grows a working ensemble.
+5. ATOMIC SAVE: a tree that fails mid-serialization leaves the previous
+   artifact intact and no temp leftovers (the crash-sim half of the
+   rename-atomic contract).
+6. DEVICE PREDICT: the stacked single-dispatch forest vote equals the
+   host walk exactly.
+
+CPU-sized (700 rows, depth 2 — the deep/ragged parity matrix lives in
+tests/test_tree.py) — tier-1 is near its kill budget, so everything runs
+in this one process.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the sharded gate needs 2 virtual devices; harmless for the others
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2"
+                               ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    from avenir_tpu.datagen.generators import retarget_rows, retarget_schema
+    from avenir_tpu.models import forest as F
+    from avenir_tpu.models import tree as T
+    from avenir_tpu.parallel import collective
+    from avenir_tpu.utils.dataset import Featurizer
+
+    report = {}
+    rows = retarget_rows(700, seed=13)
+    fz = Featurizer(retarget_schema())
+    table = fz.fit_transform(rows)
+
+    # 1. hist / einsum / pallas-interpret tree parity
+    cfg_t = T.TreeConfig(max_depth=2)
+    canon = {}
+    for name, env in (("hist", {}),
+                      ("einsum", {"AVENIR_TPU_TREE_HIST": "off"}),
+                      ("pallas", {"AVENIR_TPU_PALLAS_HIST": "interpret"})):
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            canon[name] = T.canonical_tree(T.grow_tree_device(table, cfg_t))
+        finally:
+            for k, v in saved.items():
+                os.environ.pop(k, None) if v is None else os.environ.update(
+                    {k: v})
+    assert canon["hist"] == canon["einsum"] == canon["pallas"], \
+        "histogram/einsum/pallas trees diverged"
+    report["hist_parity"] = True
+
+    # 2. batched == serial, bagged subsets
+    cfg = F.ForestConfig(n_trees=5, attrs_per_tree=2, seed=4,
+                         tree=T.TreeConfig(max_depth=2))
+    serial = F._grow_forest_serial(table, cfg)
+    batched = F.grow_forest_batched(table, cfg)
+    assert len(serial) == len(batched) == 5
+    assert all(T.canonical_tree(a) == T.canonical_tree(b)
+               for a, b in zip(serial, batched)), "batched != serial"
+    report["batched_eq_serial"] = True
+
+    # 3. sharded fold at 1 and 2 shards
+    for n_shards in (1, 2):
+        mesh = collective.data_mesh((n_shards,),
+                                    devices=jax.devices()[:n_shards])
+        sharded = F.grow_forest_sharded(table, cfg, mesh=mesh)
+        assert all(T.canonical_tree(a) == T.canonical_tree(b)
+                   for a, b in zip(batched, sharded)), \
+            f"sharded fold diverged at {n_shards} shards"
+    report["sharded_fold"] = True
+
+    # 4. streaming over ragged part files
+    cfg_s = F.ForestConfig(n_trees=4, attrs_per_tree=2, bagging=False,
+                           seed=9, tree=T.TreeConfig(max_depth=2))
+    incore = F.grow_forest_batched(table, cfg_s)
+    with tempfile.TemporaryDirectory() as td:
+        paths, bounds = [], [0, 220, 460, 700]
+        for i in range(3):
+            p = os.path.join(td, f"part-{i}.txt")
+            with open(p, "w") as fh:
+                for r in rows[bounds[i]:bounds[i + 1]]:
+                    fh.write(",".join(r) + "\n")
+            paths.append(p)
+        streamed = F.grow_forest_streaming(fz, paths, cfg_s)
+        assert all(T.canonical_tree(a) == T.canonical_tree(b)
+                   for a, b in zip(incore, streamed)), \
+            "streaming != in-core"
+        bagged = F.grow_forest_streaming(
+            fz, paths, F.ForestConfig(n_trees=3, seed=2,
+                                      tree=T.TreeConfig(max_depth=2)))
+        acc = (F.predict_forest(bagged, table)
+               == np.asarray(table.labels)).mean()
+        assert acc > 0.6, f"streamed bagged forest accuracy {acc}"
+    report["streaming"] = True
+
+    # 5. atomic save crash sim
+    class _Poison(T.TreeNode):
+        def to_dict(self):
+            raise RuntimeError("boom mid-serialize")
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "forest.json")
+        F.save_forest(batched, path)
+        before = open(path).read()
+        bad = _Poison(class_counts=np.asarray([1.0, 1.0]),
+                      class_values=batched[0].class_values)
+        try:
+            F.save_forest(list(batched) + [bad], path)
+            raise AssertionError("poisoned save did not raise")
+        except RuntimeError:
+            pass
+        assert open(path).read() == before, "artifact torn by failed save"
+        assert os.listdir(td) == ["forest.json"], \
+            f"temp leftovers: {os.listdir(td)}"
+        assert len(F.load_forest(path)) == len(batched)
+    report["atomic_save"] = True
+
+    # 6. stacked device vote == host walk
+    pred_host = F.predict_forest(batched, table)
+    pred_dev = F.predict_forest(batched, table, device=True)
+    assert (pred_host == pred_dev).all(), "device vote != host vote"
+    report["device_predict"] = True
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
