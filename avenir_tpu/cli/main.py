@@ -1140,6 +1140,85 @@ def run_forest_predictor(conf: JobConfig, in_path: str,
     _write_predictions(conf, out_path, table, pred, trees[0].class_values)
 
 
+def _boost_config(conf: JobConfig):
+    """The ``forest.boost.*`` key family on top of the shared TreeBuilder
+    keys (ISSUE 16) — every validation error out of BoostConfig names the
+    offending key and its accepted values."""
+    from avenir_tpu.models import boost as B
+    from avenir_tpu.models.tree import TreeConfig
+    return B.BoostConfig(
+        n_rounds=conf.get_int("forest.boost.num.rounds", 10),
+        learning_rate=conf.get_float("forest.boost.learning.rate", 0.3),
+        base_score=conf.get_float("forest.boost.base.score", 0.0),
+        reg_lambda=conf.get_float("forest.boost.reg.lambda", 1.0),
+        tree=TreeConfig(
+            algorithm=_split_algorithm(conf),
+            max_depth=conf.get_int("max.depth", 3),
+            min_node_size=conf.get_int("min.node.size", 10),
+            max_cat_attr_split_groups=conf.get_int(
+                "max.cat.attr.split.groups", 3),
+            min_gain=conf.get_float("min.gain", 1e-6),
+            device_node_budget=conf.get_int("device.node.budget", 2048)))
+
+
+def run_boost_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
+    """Train a gradient-boosted forest (ISSUE 16): K device-resident
+    Newton rounds over the one binned catalog, ``kind: "boosted"``
+    artifact. Keys: ``forest.boost.num.rounds``,
+    ``forest.boost.learning.rate``, ``forest.boost.base.score``,
+    ``forest.boost.reg.lambda`` plus the shared TreeBuilder split keys;
+    ``streaming.train=true`` boosts out-of-core over an MR part-file dir
+    via the cached-chunk fold (byte-identical model)."""
+    import json
+    from avenir_tpu.models import boost as B
+    cfg = _boost_config(conf)
+    if conf.get_bool("streaming.train", False):
+        from avenir_tpu.utils.dataset import part_file_paths
+        schema = FeatureSchema.from_file(
+            conf.get_required("feature.schema.file.path"))
+        fz = Featurizer(schema,
+                        unseen=conf.get("unseen.value.handling", "error"))
+        if fz.schema_data_dependent:
+            fit_path = conf.get("featurizer.fit.data.path")
+            if fit_path is None:
+                raise ValueError(
+                    "streaming.train needs a fully-specified schema "
+                    "(cardinalities + min/max) or featurizer.fit.data.path "
+                    "pointing at a bounded sample — fitting vocabularies "
+                    "from the stream would materialize it")
+            fz.fit(read_csv_lines(fit_path,
+                                  conf.get("field.delim.regex", ",")))
+        else:
+            fz.fit([])
+        model = B.grow_boosted_streaming(
+            fz, part_file_paths(in_path), cfg,
+            delim_regex=conf.get("field.delim.regex", ","))
+    else:
+        fz, rows = _load_table(conf, in_path)
+        table = fz.transform(rows)
+        model = B.grow_boosted(table, cfg)
+    B.save_boosted(model, out_path)
+    print(json.dumps({"Boost.Rounds": len(model.trees),
+                      "Boost.LearningRate": model.learning_rate}))
+
+
+def run_boost_predictor(conf: JobConfig, in_path: str,
+                        out_path: str) -> None:
+    """Classify rows down a GradientBoostBuilder model
+    (``forest.boost.model.file.path``): summed leaf margins + base score,
+    class 1 on positive log-odds. Refuses a bagged artifact by kind."""
+    from avenir_tpu.models import boost as B
+    validation = conf.get_bool("validation.mode", False)
+    fz, rows = _load_table(conf, in_path, for_predict=True)
+    table = fz.transform(rows, with_labels=validation)
+    model = B.load_boosted(
+        conf.get_required("forest.boost.model.file.path"))
+    device = conf.get_bool("device.predict",
+                           table.n_rows >= _DEVICE_PREDICT_ROWS)
+    pred = model.predict(table, device=device)
+    _write_predictions(conf, out_path, table, pred, model.class_values)
+
+
 USED_ATTRS_SIDECAR = "_used.attributes"
 
 
@@ -2228,6 +2307,8 @@ VERBS: Dict[str, Callable[[JobConfig, str, str], None]] = {
     "TreePredictor": run_tree_predictor,
     "RandomForestBuilder": run_forest_builder,
     "RandomForestPredictor": run_forest_predictor,
+    "GradientBoostBuilder": run_boost_builder,
+    "GradientBoostPredictor": run_boost_predictor,
     "MarkovStateTransitionModel": run_markov_state_transition_model,
     "MarkovModelClassifier": run_markov_model_classifier,
     "HiddenMarkovModelBuilder": run_hmm_builder,

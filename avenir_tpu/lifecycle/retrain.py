@@ -61,6 +61,35 @@ def bandit_refit_train_fn(learner_type: str, actions, config: Dict[str, Any],
     return train
 
 
+def boost_refit_train_fn(table_source: Callable[[], Any],
+                         config) -> Callable[[], Dict[str, Any]]:
+    """A retrain wave for boosted-forest serving (ISSUE 16): grow a
+    fresh gradient-boosted ensemble over whatever ``table_source()``
+    hands back (the accumulated/refreshed ``EncodedTable`` — a feature
+    store read, a re-featurized ledger, a fixture in smoke) and publish
+    its :func:`~avenir_tpu.models.boost.serving_tables` pytree. Budgets
+    are pinned to the config's own bounds (the round count, and
+    ``(max_depth + 1) × device_node_budget`` — an upper bound on any
+    grown tree's BFS node count, since the level program caps every
+    level at the node budget), so every wave's snapshot has IDENTICAL
+    leaf shapes — the ``install_state`` tree-def + shape gate passes no
+    matter how the retrained trees differ from the serving ones."""
+    from avenir_tpu.models import boost as _boost
+
+    def train() -> Dict[str, Any]:
+        table = table_source()
+        model = _boost.grow_boosted(table, config)
+        tables = _boost.serving_tables(
+            model, table, rounds_budget=config.n_rounds,
+            node_budget=((config.tree.max_depth + 1)
+                         * config.tree.device_node_budget))
+        return {"pytree": tables, "train_rows": int(table.n_rows),
+                "kind": "boost-serving-tables",
+                "extra": {"rounds": len(model.trees),
+                          "depth": config.tree.max_depth}}
+    return train
+
+
 class RetrainDaemon:
     """Background retrain waves publishing to a registry.
 

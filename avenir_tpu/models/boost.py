@@ -1,0 +1,819 @@
+"""Gradient-boosted histogram trees — device-resident boosting rounds on
+the PR 15 level program (ISSUE 16).
+
+The reference's tree tier stops at bagged ensembles; this module adds the
+histogram-GBDT shape (XGBoost/LightGBM) on the machinery PR 15 built,
+without a second histogram sweep or a per-round re-bin:
+
+- **second-order channels on the SAME dispatch**: each level's split
+  statistics come from ONE combined-index histogram pass
+  (``ops.histogram.node_channel_bin_sums``) whose trailing axis carries
+  C hessian-weighted class channels plus a gradient channel. Gradients
+  and hessians are FIXED-POINT quanta (× 2^10, rounded): every histogram
+  cell is an exact integer in f32, so chunked/sharded/streamed partial
+  sums fold byte-identically — the count fold's additive-exactness
+  contract, extended to second-order stats. The channel matmuls run f32
+  end to end (a gradient quantum reaches ±2^10; bf16 is exact only to
+  2^8 — see the kernel's docstring);
+- **structure selection = the bagged selector on hessian-weighted
+  counts**: the exact class channels divided by the quantum scale ARE
+  weighted class counts, so ``tree._level_select`` runs unchanged
+  (LogitBoost-style structure search; ``min_node_size`` gates on hessian
+  mass, the ``min_child_weight`` analogue). The regression anchor falls
+  out: one round from a constant base score is EXACTLY a grow_tree with
+  constant row weights p·(1−p) (test-pinned byte-identical);
+- **Newton leaf values beside the structure**: per level, the selected
+  split's child channel sums give every leaf's −G/(H+λ) score; a shared
+  per-level value-tracking step (:func:`_value_level_step`) assigns each
+  row the value of the node its route stops at — traced by BOTH the
+  in-core round and the streamed replay, so the two can never diverge;
+- **rounds chain device-resident**: K rounds are K calls of ONE jitted
+  round program (same operand shapes → one compile); the per-row score
+  update ``score += lr · value`` happens inside the program and the
+  level records stay on device until a single ``device_get`` fetches all
+  K rounds' records for host tree assembly — no per-round readback,
+  which is what keeps a boosting round within the bagged round's cost;
+- **the binned catalog is built ONCE** (``tree._plan_bins`` row→bin ids
+  via ``tree._device_candidates``) and reused by every level of every
+  round — residuals change per round, bins never do;
+- **out-of-core** (:func:`grow_boosted_streaming`): one streaming pass
+  caches each chunk's COMPACT binned catalog (bins + labels, the
+  XGBoost binned-DMatrix move — raw features stream, ~bytes/row state
+  stays), then every level folds per-chunk exact-integer channel
+  payloads additively on the host and every round replays the value
+  step per chunk to advance its score slice. Byte-identical to in-core
+  growth (test-pinned, leaf values included);
+- **inference is the stacked forest router**: boosted trees flatten into
+  the SAME single-dispatch gather chain as the bagged vote
+  (``forest._route_forest``), with ``mode="sum"`` reducing routed leaf
+  VALUES instead of votes — margin = base + lr · Σ trees. Binary only
+  (log-odds for the churn label); class 1 iff margin > 0.
+
+Artifact: the forest JSON family with ``kind: "boosted"`` (format-
+versioned; loaders refuse cross-kind loads — see
+``forest.check_artifact_kind``). Serving: :func:`serving_tables` packs
+the ensemble into a fixed-shape, schema-stable pytree the engine scores
+with :func:`_serve_margins` and the lifecycle loop hot-swaps across
+retrains (tree-def and leaf shapes depend only on schema + budgets).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.ops import histogram as hg
+from avenir_tpu.models import forest as F
+from avenir_tpu.models import tree as T
+from avenir_tpu.models.tree import TreeConfig, TreeNode
+from avenir_tpu.utils.atomicio import atomic_json_dump
+from avenir_tpu.utils.dataset import EncodedTable
+
+#: fixed-point quantum scale for gradient/hessian channels: quanta are
+#: round(x · 2^10), carried as integer-valued f32. |grad| ≤ 1 → |gq| ≤
+#: 2^10; hess ≤ 1/4 → hq ≤ 2^8. Power of two, so the unscale (× 2^-10)
+#: after aggregation is exact; cell sums stay exact below 2^24.
+_Q = 1024.0
+
+
+@dataclass(frozen=True)
+class BoostConfig:
+    n_rounds: int = 10                    # forest.boost.num.rounds
+    learning_rate: float = 0.3            # forest.boost.learning.rate
+    base_score: float = 0.0               # forest.boost.base.score
+    reg_lambda: float = 1.0               # forest.boost.reg.lambda
+    tree: TreeConfig = field(default_factory=TreeConfig)
+
+
+def _validate_boost_config(config: BoostConfig) -> None:
+    """Every invalid combination raises naming the offending key and the
+    accepted values — the validation-matrix contract (a silently clamped
+    learning rate is the same bug class as the dropped forest strategy)."""
+    if not isinstance(config.n_rounds, int) or isinstance(
+            config.n_rounds, bool) or config.n_rounds < 1:
+        raise ValueError(
+            f"n_rounds must be an int >= 1, got {config.n_rounds!r}")
+    lr = config.learning_rate
+    if not isinstance(lr, (int, float)) or isinstance(lr, bool) or not (
+            np.isfinite(lr) and 0.0 < lr <= 1.0):
+        raise ValueError(
+            f"learning_rate must be a finite number in (0, 1], got {lr!r}")
+    bs = config.base_score
+    if not isinstance(bs, (int, float)) or isinstance(
+            bs, bool) or not np.isfinite(bs):
+        raise ValueError(
+            f"base_score must be a finite number (a log-odds margin), "
+            f"got {bs!r}")
+    rl = config.reg_lambda
+    if not isinstance(rl, (int, float)) or isinstance(rl, bool) or not (
+            np.isfinite(rl) and rl >= 0.0):
+        raise ValueError(
+            f"reg_lambda must be a finite number >= 0, got {rl!r}")
+    if config.tree.split_selection_strategy != "best":
+        raise ValueError(
+            "tree.split_selection_strategy must be 'best' for boosting "
+            f"(got {config.tree.split_selection_strategy!r}; randomFromTop "
+            "consumes host randomness per node, which a device-resident "
+            "round cannot)")
+    if config.tree.max_depth < 1:
+        raise ValueError(
+            f"tree.max_depth must be >= 1, got {config.tree.max_depth}")
+
+
+def _require_binary(n_classes: int) -> None:
+    if n_classes != 2:
+        raise ValueError(
+            f"boosting supports binary classification (2 classes) only, "
+            f"got {n_classes}: the leaf values are log-odds margins for "
+            "the positive class (class index 1)")
+
+
+# ---------------------------------------------------------------------------
+# the round program: channels → histogram → selection → Newton values
+# ---------------------------------------------------------------------------
+
+def _channels(labels: jnp.ndarray, score: jnp.ndarray,
+              n_classes: int) -> jnp.ndarray:
+    """[N, C+1] fixed-point channel matrix for the logistic objective:
+    C hessian-weighted class channels (``onehot(label) · hq`` — their
+    per-cell sums ARE hessian-weighted class counts after the exact
+    unscale) plus the gradient channel ``gq``. ``p = σ(score)``,
+    ``grad = p − y``, ``hess = p(1−p)``, quantized × 2^10 and rounded —
+    every downstream sum an exact integer in f32."""
+    p = jax.nn.sigmoid(score)
+    y01 = (labels == 1).astype(jnp.float32)
+    gq = jnp.round((p - y01) * _Q)
+    hq = jnp.round(p * (1.0 - p) * _Q)
+    oh = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
+    return jnp.concatenate([oh * hq[:, None], gq[:, None]], axis=1)
+
+
+def _newton_values(g: jnp.ndarray, h: jnp.ndarray,
+                   reg_lambda: jnp.ndarray) -> jnp.ndarray:
+    """−G/(H+λ) with an empty-cell guard (H = 0 and λ = 0 means no rows:
+    value 0, never NaN)."""
+    denom = h + reg_lambda
+    return jnp.where(denom > 0, -g / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def _boost_level_select(hist_cc: jnp.ndarray, seg_of_bin: jnp.ndarray,
+                        reg_lambda: jnp.ndarray, *, plan_slices,
+                        k_nodes: int, s_max: int, b_max: int,
+                        n_classes: int, algorithm: str, min_node_size: int,
+                        min_gain: float) -> dict:
+    """One level's selection + Newton values from the folded channel
+    histogram [A, K, B, C+1]: the class channels (unscaled by the exact
+    × 2^-10) feed the UNCHANGED bagged selector — structure search on
+    hessian-weighted class counts — and the same per-candidate
+    aggregation's gradient/hessian sums give every node's and every
+    selected child's −G/(H+λ). Returns the bagged level record plus
+    ``node_val`` [K] and ``child_val`` [K, S]."""
+    d_chan = n_classes + 1
+    cc = T._counts_from_hist(
+        hist_cc, seg_of_bin, plan_slices=plan_slices, k_nodes=k_nodes,
+        s_max=s_max, b_max=b_max, n_classes=d_chan)        # [T, S, K, D]
+    rec = T._level_select(
+        cc[..., :n_classes] * (1.0 / _Q), k_nodes=k_nodes, s_max=s_max,
+        n_classes=n_classes, algorithm=algorithm,
+        min_node_size=min_node_size, min_gain=min_gain)
+    node_tot = jnp.sum(cc[0], axis=0)                      # [K, D]
+    rec["node_val"] = _newton_values(
+        node_tot[:, n_classes] * (1.0 / _Q),
+        jnp.sum(node_tot[:, :n_classes], axis=1) * (1.0 / _Q), reg_lambda)
+    child_chan = jnp.take_along_axis(
+        cc.transpose(2, 0, 1, 3),                          # [K, T, S, D]
+        rec["best_t"][:, None, None, None], axis=1)[:, 0]  # [K, S, D]
+    rec["child_val"] = _newton_values(
+        child_chan[:, :, n_classes] * (1.0 / _Q),
+        jnp.sum(child_chan[:, :, :n_classes], axis=-1) * (1.0 / _Q),
+        reg_lambda)
+    return rec
+
+
+def _value_level_step(node_id, row_w, value_row, rec, bins_rows,
+                      seg_of_bin, col_of_t, *, s_max: int, b_max: int,
+                      k_next: int, is_last: bool):
+    """Advance one level of the per-row VALUE tracking beside the routing:
+    a row whose route STOPS at this level takes the value of the node it
+    stops at — its node's own Newton value when the node didn't split,
+    the CHILD's value when it split but the child is a leaf (the built
+    tree's leaf IS the child), and at the last level every still-live row
+    takes its child's value (depth exhausts there). Routing is the shared
+    ``tree._route_level_hist``; this helper is traced by BOTH the in-core
+    round program and the streamed per-chunk replay, so streamed scores
+    can never diverge from resident scores. Returns the next (node_id,
+    row_w, value_row)."""
+    alive = row_w > 0
+    val_here = rec["node_val"][node_id]
+    t_row = rec["best_t"][node_id]
+    col_row = col_of_t[t_row]
+    bin_row = jnp.take_along_axis(bins_rows, col_row[:, None], axis=1)[:, 0]
+    seg_row = seg_of_bin.reshape(-1)[t_row * b_max + bin_row]
+    child_val_row = rec["child_val"].reshape(-1)[node_id * s_max + seg_row]
+    split_row = rec["split"][node_id]
+    new_node, new_w = T._route_level_hist(
+        node_id, row_w, rec["best_t"], rec["child_slot"].reshape(-1),
+        bins_rows, seg_of_bin, col_of_t, s_max=s_max, b_max=b_max,
+        k_next=k_next)
+    stopped = alive & (new_w <= 0)
+    value_row = jnp.where(
+        stopped, jnp.where(split_row, child_val_row, val_here), value_row)
+    if is_last:
+        value_row = jnp.where(alive & (new_w > 0), child_val_row, value_row)
+    return new_node, new_w, value_row
+
+
+@partial(jax.jit, static_argnames=("plan_slices", "depth", "s_max",
+                                   "b_max", "n_classes", "algorithm",
+                                   "min_node_size", "min_gain",
+                                   "node_budget"))
+def _boost_round(labels, bins_rows, seg_of_bin, col_of_t, row_w0, score,
+                 reg_lambda, learning_rate, *, plan_slices, depth: int,
+                 s_max: int, b_max: int, n_classes: int, algorithm: str,
+                 min_node_size: int, min_gain: float, node_budget: int):
+    """ONE boosting round as ONE dispatch: channels from the current
+    score, ``depth`` levels of channel-histogram → selection → Newton
+    values → value-tracked routing, then the device-resident score update
+    ``score + lr · value``. K rounds call this SAME compiled program (the
+    operand shapes never change), and the returned records stay on device
+    until the caller's single fetch — no host readback inside the
+    training loop. Returns (new_score, level records)."""
+    n = labels.shape[0]
+    chan = _channels(labels, score, n_classes)             # [N, C+1]
+    node_id = jnp.zeros(n, jnp.int32)
+    row_w = row_w0
+    value_row = jnp.zeros(n, jnp.float32)
+    records = []
+    widths = T._level_widths(depth, s_max, node_budget)
+    for d in range(depth):
+        k_next = min(widths[d] * s_max, node_budget)
+        rec = _boost_level_select(
+            hg.node_channel_bin_sums(bins_rows, node_id,
+                                     chan * row_w[:, None], widths[d],
+                                     b_max),
+            seg_of_bin, reg_lambda, plan_slices=plan_slices,
+            k_nodes=widths[d], s_max=s_max, b_max=b_max,
+            n_classes=n_classes, algorithm=algorithm,
+            min_node_size=min_node_size, min_gain=min_gain)
+        node_id, row_w, value_row = _value_level_step(
+            node_id, row_w, value_row, rec, bins_rows, seg_of_bin,
+            col_of_t, s_max=s_max, b_max=b_max, k_next=k_next,
+            is_last=(d == depth - 1))
+        records.append(rec)
+    return score + learning_rate * value_row, records
+
+# ---------------------------------------------------------------------------
+# host assembly + the model type
+# ---------------------------------------------------------------------------
+
+def _build_boost_tree(records, keys, class_values: List[str],
+                      n_classes: int) -> TreeNode:
+    """``tree._build_tree`` with Newton values attached: an interior/live
+    node carries its own level's ``node_val`` (the value rows take when a
+    segment routes past training data — the host walk's majority
+    fallback, regression-scored), a leaf CHILD carries its parent
+    record's ``child_val`` (exactly what :func:`_value_level_step`
+    assigned the rows that stopped there). ``class_counts`` are the
+    hessian-weighted counts structure selection ran on."""
+
+    def build(level: int, slot: int, counts: np.ndarray,
+              value: float) -> Optional[TreeNode]:
+        if counts.sum() <= 0:
+            return None
+        node = TreeNode(class_counts=counts, class_values=class_values,
+                        leaf_value=float(np.float32(value)))
+        if slot < 0 or level >= len(records):
+            return node
+        rec = records[level]
+        node.leaf_value = float(np.float32(rec["node_val"][slot]))
+        if not bool(rec["split"][slot]):
+            return node
+        t = int(rec["best_t"][slot])
+        attr, key, n_seg = keys[t]
+        node.attr_ordinal, node.split_key = attr, key
+        for s in range(n_seg):
+            child = build(level + 1, int(rec["child_slot"][slot, s]),
+                          np.asarray(rec["child_counts"][slot, s]),
+                          float(rec["child_val"][slot, s]))
+            if child is not None:
+                node.children[s] = child
+        return node
+
+    root_counts = np.asarray(records[0]["child_counts"][0]).sum(axis=0)
+    root = build(0, 0, root_counts, float(records[0]["node_val"][0]))
+    if root is None:
+        root = TreeNode(class_counts=np.zeros(n_classes),
+                        class_values=class_values, leaf_value=0.0)
+    return root
+
+
+@dataclass
+class BoostedModel:
+    """The boosted ensemble: margin(x) = base_score + learning_rate ·
+    Σ trees' routed leaf values; class 1 (the positive class) iff the
+    margin is positive."""
+    trees: List[TreeNode]
+    class_values: List[str]
+    base_score: float
+    learning_rate: float
+    reg_lambda: float = 1.0
+
+    def margins(self, table: EncodedTable,
+                device: bool = False) -> np.ndarray:
+        """[N] f32 log-odds margins; ``device=True`` routes every tree
+        through the stacked single-dispatch ``forest._route_forest``
+        kernel in ``mode="sum"`` (classes identical to the host walk;
+        margins agree to f32 summation order)."""
+        F._validate_trees(self.trees)
+        if device:
+            return self._margins_device(table)
+        acc = np.zeros(table.n_rows, np.float32)
+        seg_cache: Dict = {}
+        for tree in self.trees:
+            acc += _tree_values_host(tree, table, seg_cache)
+        return (np.float32(self.base_score)
+                + np.float32(self.learning_rate) * acc)
+
+    def _margins_device(self, table: EncodedTable) -> np.ndarray:
+        (segs, oks, split_of_b, child_b, _pred_b, val_b, valid, depth,
+         s_w) = F._stack_route_tables(self.trees, table)
+        out, ok = jax.device_get(F._route_forest(
+            segs, oks, jnp.asarray(split_of_b), jnp.asarray(child_b),
+            jnp.asarray(val_b), jnp.asarray(valid), depth=depth,
+            s_width=s_w, n_classes=len(self.class_values), mode="sum"))
+        if not ok:
+            raise ValueError("split segment not found for some value")
+        return (np.float32(self.base_score)
+                + np.float32(self.learning_rate)
+                * np.asarray(out, np.float32))
+
+    def predict(self, table: EncodedTable,
+                device: bool = False) -> np.ndarray:
+        """[N] class indices (0/1): thresholded margins."""
+        return (self.margins(table, device=device) > 0).astype(np.int64)
+
+
+def _tree_values_host(tree: TreeNode, table: EncodedTable,
+                      seg_cache: Dict) -> np.ndarray:
+    """One tree's routed leaf value per row — the host walk twin of the
+    device ``mode="sum"`` routing: a segment with no trained child takes
+    the node's OWN value (the device child=−1 stay-put produces the
+    same node)."""
+    out = np.zeros(table.n_rows, np.float32)
+
+    def val(n: TreeNode) -> np.float32:
+        return np.float32(0.0 if n.leaf_value is None else n.leaf_value)
+
+    def walk(node: TreeNode, rows: np.ndarray):
+        if node.is_leaf or not node.children:
+            out[rows] = val(node)
+            return
+        key = (node.attr_ordinal, node.split_key)
+        if key not in seg_cache:
+            seg_cache[key] = T.segment_of_rows(table, *key)
+        segs = seg_cache[key][rows]
+        known = np.isin(segs, list(node.children.keys()))
+        out[rows[~known]] = val(node)
+        for seg, child in node.children.items():
+            sel = rows[segs == seg]
+            if sel.size:
+                walk(child, sel)
+
+    walk(tree, np.arange(table.n_rows))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# in-core training
+# ---------------------------------------------------------------------------
+
+def grow_boosted(table: EncodedTable, config: BoostConfig) -> BoostedModel:
+    """K boosting rounds, device-resident: the binned candidate catalog
+    is built ONCE, every round is one call of the single compiled
+    :func:`_boost_round` program chained through the on-device score
+    vector, and ONE ``device_get`` at the end fetches all K rounds'
+    level records for host tree assembly."""
+    _validate_boost_config(config)
+    _require_binary(table.n_classes)
+    cfg = config.tree
+    attrs = list(cfg.split_attributes) or T.splittable_ordinals(table)
+    plans = T._attr_plans(table, attrs, cfg.max_cat_attr_split_groups)
+    if not plans:
+        raise ValueError("no splittable attributes for boosting")
+    cand = T._device_candidates(table, plans)
+
+    score = jnp.full(table.n_rows, np.float32(config.base_score),
+                     jnp.float32)
+    row_w0 = jnp.ones(table.n_rows, jnp.float32)
+    reg = jnp.float32(config.reg_lambda)
+    lr = jnp.float32(config.learning_rate)
+    all_records = []
+    for _ in range(config.n_rounds):
+        score, records = _boost_round(
+            table.labels, cand.bins_rows, cand.seg_of_bin, cand.col_of_t,
+            row_w0, score, reg, lr, plan_slices=tuple(cand.plan_slices),
+            depth=cfg.max_depth, s_max=cand.s_max, b_max=cand.b_max,
+            n_classes=table.n_classes, algorithm=cfg.algorithm,
+            min_node_size=cfg.min_node_size, min_gain=cfg.min_gain,
+            node_budget=cfg.device_node_budget)
+        all_records.append(records)
+    all_records = jax.device_get(all_records)    # ONE readback, K rounds
+
+    widths = T._level_widths(cfg.max_depth, cand.s_max,
+                             cfg.device_node_budget)
+    trees = []
+    for records in all_records:
+        T._check_frontier_budget(
+            records, widths, cfg.device_node_budget,
+            "raise the budget or lower max_depth")
+        trees.append(_build_boost_tree(records, cand.keys,
+                                       table.class_values,
+                                       table.n_classes))
+    return BoostedModel(trees=trees,
+                        class_values=list(table.class_values),
+                        base_score=float(config.base_score),
+                        learning_rate=float(config.learning_rate),
+                        reg_lambda=float(config.reg_lambda))
+
+# ---------------------------------------------------------------------------
+# out-of-core training: cached binned chunks, additive channel fold
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("widths", "s_max", "b_max",
+                                   "n_classes", "node_budget"))
+def _stream_boost_hist(labels, bins_rows, row_w0, score, prior_best,
+                       prior_slots, seg_of_bin, col_of_t, *, widths,
+                       s_max: int, b_max: int, n_classes: int,
+                       node_budget: int):
+    """One chunk's channel-histogram contribution to the current level:
+    channels recomputed from the chunk's score INSIDE the jit (the same
+    elementwise graph the in-core round traces), the round's
+    already-selected levels replayed through the shared routing, then the
+    [A, K, B, C+1] payload — additive across chunks because every cell is
+    an exact fixed-point integer."""
+    chan = _channels(labels, score, n_classes)
+    node = jnp.zeros(labels.shape[0], jnp.int32)
+    rw = row_w0
+    for lvl in range(len(prior_best)):
+        k_next = min(widths[lvl] * s_max, node_budget)
+        node, rw = T._route_level_hist(
+            node, rw, prior_best[lvl], prior_slots[lvl].reshape(-1),
+            bins_rows, seg_of_bin, col_of_t, s_max=s_max, b_max=b_max,
+            k_next=k_next)
+    return hg.node_channel_bin_sums(bins_rows, node, chan * rw[:, None],
+                                    widths[len(prior_best)], b_max)
+
+
+@partial(jax.jit, static_argnames=("plan_slices", "k_nodes", "s_max",
+                                   "b_max", "n_classes", "algorithm",
+                                   "min_node_size", "min_gain"))
+def _stream_boost_select(hist_cc, seg_of_bin, reg_lambda, *, plan_slices,
+                         k_nodes: int, s_max: int, b_max: int,
+                         n_classes: int, algorithm: str,
+                         min_node_size: int, min_gain: float):
+    """Level selection + Newton values from the FOLDED channel histogram
+    — the same :func:`_boost_level_select` graph the in-core round
+    traces, on the same exact-integer inputs, so streamed and resident
+    boosting pick identical splits and values."""
+    return _boost_level_select(
+        hist_cc, seg_of_bin, reg_lambda, plan_slices=plan_slices,
+        k_nodes=k_nodes, s_max=s_max, b_max=b_max, n_classes=n_classes,
+        algorithm=algorithm, min_node_size=min_node_size,
+        min_gain=min_gain)
+
+
+@partial(jax.jit, static_argnames=("widths", "s_max", "b_max",
+                                   "node_budget"))
+def _stream_boost_update(bins_rows, row_w0, score, rec_best, rec_slots,
+                         rec_split, rec_node_val, rec_child_val,
+                         seg_of_bin, col_of_t, learning_rate, *, widths,
+                         s_max: int, b_max: int, node_budget: int):
+    """End-of-round score advance for one chunk: replay the round's
+    levels through the SAME :func:`_value_level_step` the in-core program
+    traces and fold ``lr · value`` into the chunk's resident score
+    slice."""
+    n = bins_rows.shape[0]
+    node = jnp.zeros(n, jnp.int32)
+    rw = row_w0
+    value = jnp.zeros(n, jnp.float32)
+    depth = len(rec_best)
+    for d in range(depth):
+        k_next = min(widths[d] * s_max, node_budget)
+        rec = {"best_t": rec_best[d], "child_slot": rec_slots[d],
+               "split": rec_split[d], "node_val": rec_node_val[d],
+               "child_val": rec_child_val[d]}
+        node, rw, value = _value_level_step(
+            node, rw, value, rec, bins_rows, seg_of_bin, col_of_t,
+            s_max=s_max, b_max=b_max, k_next=k_next,
+            is_last=(d == depth - 1))
+    return score + learning_rate * value
+
+
+def grow_boosted_streaming(fz, paths: Sequence[str], config: BoostConfig,
+                           *, delim_regex: str = ",",
+                           loader_kwargs: Optional[dict] = None
+                           ) -> BoostedModel:
+    """Out-of-core boosting: ONE pass over the part files through the
+    resilient ``PrefetchLoader`` caches each chunk's COMPACT binned
+    catalog (bin ids + labels, padded to power-of-two row buckets — the
+    binned-DMatrix move: raw feature text streams once, a few bytes/row
+    of binned state stay resident); every subsequent level folds
+    per-chunk channel payloads additively on the host (exact fixed-point
+    integers → byte-identical to the in-core fold) and every round ends
+    by replaying the value step per chunk to advance its device score
+    slice. Byte-identical trees AND leaf values to :func:`grow_boosted`
+    over the concatenated rows (test-pinned). Boosting has no bagging,
+    so there is no per-chunk bootstrap caveat."""
+    from avenir_tpu.native.prefetch import PrefetchLoader
+    from avenir_tpu.parallel.pipeline import bucket_rows
+    _validate_boost_config(config)
+    if not paths:
+        raise ValueError("no part files to stream")
+    loader_kwargs = dict(loader_kwargs or {})
+    cfg = config.tree
+
+    # catalog probe over ONE shard at a time, advancing past empty part
+    # files (the grow_forest_streaming idiom — the catalog is fit-level
+    # metadata, so any non-empty chunk defines it)
+    first = None
+    for path in paths:
+        first = next(iter(PrefetchLoader(
+            fz, [path], delim_regex=delim_regex, **loader_kwargs)), None)
+        if first is not None and first.n_rows > 0:
+            break
+    if first is None or first.n_rows == 0:
+        raise ValueError("streamed part files produced no rows")
+    _require_binary(first.n_classes)
+    attrs = (list(cfg.split_attributes)
+             or sorted(T.splittable_ordinals(first)))
+    plans = T._attr_plans(first, tuple(attrs),
+                          cfg.max_cat_attr_split_groups)
+    if not plans:
+        raise ValueError("no splittable attributes for boosting")
+    cand = T._device_candidates(first, plans)
+    specs = F._chunk_bin_specs(first, plans)
+
+    # the ONE streaming pass: compact per-chunk state (bins, labels,
+    # row mask, score), host-binned then padded to bucketed shapes so
+    # ragged shard files share compiled programs
+    chunks: List[list] = []
+    for chunk in PrefetchLoader(fz, list(paths), delim_regex=delim_regex,
+                                **loader_kwargs):
+        if chunk.n_rows == 0:
+            continue
+        m = bucket_rows(chunk.n_rows)
+        pad = m - chunk.n_rows
+        bins_c = np.pad(F._chunk_bins_host(chunk, specs),
+                        ((0, pad), (0, 0)))
+        labels_c = np.pad(np.asarray(chunk.labels, np.int32), (0, pad))
+        w0 = np.zeros(m, np.float32)
+        w0[:chunk.n_rows] = 1.0
+        chunks.append([jnp.asarray(bins_c), jnp.asarray(labels_c),
+                       jnp.asarray(w0),
+                       jnp.full(m, np.float32(config.base_score),
+                                jnp.float32)])
+    if not chunks:
+        raise ValueError("streamed part files produced no rows")
+
+    widths = tuple(T._level_widths(cfg.max_depth, cand.s_max,
+                                   cfg.device_node_budget))
+    reg = jnp.float32(config.reg_lambda)
+    lr = jnp.float32(config.learning_rate)
+    all_records = []
+    for _ in range(config.n_rounds):
+        records_d: List[dict] = []
+        for d in range(cfg.max_depth):
+            prior_best = tuple(rec["best_t"] for rec in records_d)
+            prior_slots = tuple(rec["child_slot"] for rec in records_d)
+            hist_acc: Optional[np.ndarray] = None
+            for bins_c, labels_c, w0, score_c in chunks:
+                h = np.asarray(_stream_boost_hist(
+                    labels_c, bins_c, w0, score_c, prior_best,
+                    prior_slots, cand.seg_of_bin, cand.col_of_t,
+                    widths=widths, s_max=cand.s_max, b_max=cand.b_max,
+                    n_classes=first.n_classes,
+                    node_budget=cfg.device_node_budget))
+                hist_acc = h if hist_acc is None else hist_acc + h
+            records_d.append(_stream_boost_select(
+                jnp.asarray(hist_acc), cand.seg_of_bin, reg,
+                plan_slices=tuple(cand.plan_slices), k_nodes=widths[d],
+                s_max=cand.s_max, b_max=cand.b_max,
+                n_classes=first.n_classes, algorithm=cfg.algorithm,
+                min_node_size=cfg.min_node_size, min_gain=cfg.min_gain))
+        rb = tuple(rec["best_t"] for rec in records_d)
+        rs = tuple(rec["child_slot"] for rec in records_d)
+        rsp = tuple(rec["split"] for rec in records_d)
+        rnv = tuple(rec["node_val"] for rec in records_d)
+        rcv = tuple(rec["child_val"] for rec in records_d)
+        for entry in chunks:
+            entry[3] = _stream_boost_update(
+                entry[0], entry[2], entry[3], rb, rs, rsp, rnv, rcv,
+                cand.seg_of_bin, cand.col_of_t, lr, widths=widths,
+                s_max=cand.s_max, b_max=cand.b_max,
+                node_budget=cfg.device_node_budget)
+        all_records.append(records_d)
+
+    all_records = jax.device_get(all_records)
+    trees = []
+    for records in all_records:
+        T._check_frontier_budget(
+            records, widths, cfg.device_node_budget,
+            "raise the budget or lower max_depth")
+        trees.append(_build_boost_tree(records, cand.keys,
+                                       first.class_values,
+                                       first.n_classes))
+    return BoostedModel(trees=trees,
+                        class_values=list(first.class_values),
+                        base_score=float(config.base_score),
+                        learning_rate=float(config.learning_rate),
+                        reg_lambda=float(config.reg_lambda))
+
+
+# ---------------------------------------------------------------------------
+# artifact
+# ---------------------------------------------------------------------------
+
+def save_boosted(model: BoostedModel, path: str) -> None:
+    """Rename-atomic dump in the versioned ensemble JSON family,
+    ``kind: "boosted"`` — the bagged loader refuses it by name (and vice
+    versa) instead of silently mis-voting."""
+    F._validate_trees(model.trees)
+    atomic_json_dump(
+        {"format": F.ARTIFACT_FORMAT, "kind": "boosted",
+         "classValues": model.class_values,
+         "baseScore": model.base_score,
+         "learningRate": model.learning_rate,
+         "regLambda": model.reg_lambda,
+         "trees": [t.to_dict() for t in model.trees]}, path)
+
+
+def load_boosted(path: str) -> BoostedModel:
+    with open(path) as fh:
+        model = json.load(fh)
+    F.check_artifact_kind(model, expect="boosted", path=path)
+    class_values = list(model["classValues"])
+    return BoostedModel(
+        trees=[TreeNode.from_dict(d, class_values)
+               for d in model["trees"]],
+        class_values=class_values,
+        base_score=float(model["baseScore"]),
+        learning_rate=float(model["learningRate"]),
+        reg_lambda=float(model.get("regLambda", 1.0)))
+
+# ---------------------------------------------------------------------------
+# engine serving: schema-stable routing tables + one-dispatch margins
+# ---------------------------------------------------------------------------
+
+def _serving_specs(table: EncodedTable):
+    """Per splittable attribute (sorted by ordinal — the serving column
+    order): (ordinal, feature position, is_cat, numeric grid or None,
+    n_bins). Shapes downstream depend only on this — i.e. on the schema —
+    never on any particular fitted model."""
+    ord_to_pos = {f.ordinal: i for i, f in enumerate(table.feature_fields)}
+    specs = []
+    for attr in sorted(T.splittable_ordinals(table)):
+        pos = ord_to_pos[attr]
+        f = table.feature_fields[pos]
+        if f.is_categorical:
+            specs.append((attr, pos, True, None,
+                          len(table.bin_labels[pos])))
+        else:
+            grid = np.asarray(T.numeric_grid(f), np.float64)
+            specs.append((attr, pos, False, grid, int(grid.shape[0]) + 1))
+    return specs
+
+
+def serving_bins(table: EncodedTable) -> np.ndarray:
+    """[N, A] int32 bin ids in serving column order — the same binning
+    rule as the training catalog's :func:`tree._plan_bins` (numeric bin =
+    #grid points strictly below the f32 value; categorical bin = vocab
+    code), host-side so the engine can bin events as they arrive."""
+    cols = []
+    for _attr, pos, is_cat, grid, _n_b in _serving_specs(table):
+        if is_cat:
+            cols.append(np.asarray(table.binned[:, pos], np.int32))
+        else:
+            col = np.asarray(table.numeric[:, pos], np.float32)
+            cols.append(np.sum(
+                col[:, None] > grid.astype(np.float32)[None, :],
+                axis=1).astype(np.int32))
+    return np.stack(cols, axis=1)
+
+
+def serving_tables(model: BoostedModel, table: EncodedTable, *,
+                   rounds_budget: Optional[int] = None,
+                   node_budget: Optional[int] = None) -> dict:
+    """The boosted ensemble flattened to a fixed-shape dict pytree the
+    engine lifecycle can hot-swap: every leaf's shape is a pure function
+    of (schema, rounds_budget, node_budget), so a drift retrain's
+    replacement passes ``install_state``'s tree-def + shape gate no
+    matter how the new trees differ. Routing is bins-based (the serving
+    twin of the training catalog): per BFS node, ``seg_of_bin`` maps a
+    row's bin id to the node's child segment and ``child`` maps segment
+    to child slot (−1 = stay, covering leaves, padding, and segments
+    training never produced — the stayed node's own value is exactly the
+    host predictor's unseen-segment fallback)."""
+    specs = _serving_specs(table)
+    col_of_attr = {attr: a for a, (attr, *_rest) in enumerate(specs)}
+    b_max = max(n_b for *_head, n_b in specs)
+    sw = b_max  # numeric segs <= points+1 <= n_bins; cat groups <= vocab
+
+    per_tree = []
+    for tree in model.trees:
+        nodes: List[T.TreeNode] = []
+        frontier = [tree]
+        while frontier:
+            nxt = []
+            for n in frontier:
+                nodes.append(n)
+                nxt.extend(v for _k, v in sorted(n.children.items()))
+            frontier = nxt
+        per_tree.append(nodes)
+
+    kt = F._pow2(rounds_budget if rounds_budget is not None
+                 else max(1, len(model.trees)))
+    if len(model.trees) > kt:
+        raise ValueError(
+            f"boosted model has {len(model.trees)} rounds but the serving "
+            f"rounds budget holds {kt}; raise rounds_budget")
+    nn = F._pow2(node_budget if node_budget is not None
+                 else max([1] + [len(ns) for ns in per_tree]))
+    if any(len(ns) > nn for ns in per_tree):
+        raise ValueError(
+            f"a boosted tree has {max(len(ns) for ns in per_tree)} nodes "
+            f"but the serving node budget holds {nn}; raise node_budget")
+
+    split_col = np.zeros((kt, nn), np.int32)
+    sob = np.zeros((kt, nn, b_max), np.int32)
+    child = np.full((kt, nn * sw), -1, np.int32)
+    value = np.zeros((kt, nn), np.float32)
+    valid = np.zeros(kt, np.float32)
+    for t_i, nodes in enumerate(per_tree):
+        valid[t_i] = 1.0
+        slot_of = {id(n): k for k, n in enumerate(nodes)}
+        for k, n in enumerate(nodes):
+            value[t_i, k] = np.float32(
+                0.0 if n.leaf_value is None else n.leaf_value)
+            if n.split_key is None:
+                continue
+            a = col_of_attr[n.attr_ordinal]
+            split_col[t_i, k] = a
+            _attr, _pos, is_cat, grid, n_b = specs[a]
+            if is_cat:
+                vocab = table.bin_labels[specs[a][1]]
+                for gi, grp in enumerate(
+                        T.parse_categorical_split_key(n.split_key)):
+                    for v in grp:
+                        sob[t_i, k, vocab.index(v)] = gi
+            else:
+                points = np.asarray(
+                    [int(p) for p in n.split_key.split(T.SPLIT_SEP)],
+                    np.float64)
+                edges = np.concatenate([[-np.inf], grid])
+                sob[t_i, k, :n_b] = np.sum(
+                    points[None, :] <= edges[:n_b, None], axis=1)
+            for seg, ch in n.children.items():
+                child[t_i, k * sw + int(seg)] = slot_of[id(ch)]
+    return {"split_col": jnp.asarray(split_col),
+            "seg_of_bin": jnp.asarray(sob),
+            "child": jnp.asarray(child),
+            "value": jnp.asarray(value),
+            "valid": jnp.asarray(valid),
+            "base": jnp.float32(model.base_score),
+            "lr": jnp.float32(model.learning_rate)}
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _serve_margins(tables: dict, bins, *, depth: int):
+    """[M, A] bin ids -> ([M] f32 margins, [M] i32 class indices), one
+    dispatch for the whole batch across every tree. ``depth`` is a CAP,
+    not the exact tree depth: iterations past a leaf re-read child −1 and
+    stay put, so one compiled program serves every retrained model whose
+    trees fit the cap — the schema-stable property the engine's
+    ``install_state`` hot swap relies on."""
+    split_col = tables["split_col"]                       # [kt, nn]
+    kt, nn = split_col.shape
+    b_max = tables["seg_of_bin"].shape[2]
+    sw = tables["child"].shape[1] // nn
+    sob_flat = tables["seg_of_bin"].reshape(kt, nn * b_max)
+    bins = jnp.asarray(bins, jnp.int32)
+    m = bins.shape[0]
+    rows = jnp.arange(m)[None, :]
+    node = jnp.zeros((kt, m), jnp.int32)
+    for _ in range(depth):
+        a = jnp.take_along_axis(split_col, node, axis=1)   # [kt, M]
+        b = bins[rows, a]                                  # [kt, M]
+        seg = jnp.take_along_axis(sob_flat, node * b_max + b, axis=1)
+        ch = jnp.take_along_axis(tables["child"], node * sw + seg,
+                                 axis=1)
+        node = jnp.where(ch >= 0, ch, node)
+    vals = jnp.take_along_axis(tables["value"], node, axis=1)  # [kt, M]
+    margin = tables["base"] + tables["lr"] * jnp.sum(
+        vals * tables["valid"][:, None], axis=0)
+    return margin, (margin > 0).astype(jnp.int32)

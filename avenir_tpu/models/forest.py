@@ -606,15 +606,22 @@ def _validate_trees(trees: Sequence[TreeNode]) -> List[str]:
     return class_values
 
 
-@partial(jax.jit, static_argnames=("depth", "s_width", "n_classes"))
+@partial(jax.jit, static_argnames=("depth", "s_width", "n_classes",
+                                   "mode"))
 def _route_forest(flat_segs: jnp.ndarray, oks: jnp.ndarray,
                   split_of_b: jnp.ndarray, child_b: jnp.ndarray,
                   pred_b: jnp.ndarray, valid: jnp.ndarray, *, depth: int,
-                  s_width: int, n_classes: int):
-    """Every tree's leaf routing + the ensemble vote in ONE dispatch:
-    vmap of the per-tree gather chain over the stacked flattened-tree
-    tables, int one-hot votes weighted by per-tree validity (power-of-two
-    tree padding must not vote), argmax on device."""
+                  s_width: int, n_classes: int, mode: str = "vote"):
+    """Every tree's leaf routing + the ensemble reduction in ONE
+    dispatch: vmap of the per-tree gather chain over the stacked
+    flattened-tree tables, then either the bagged majority VOTE (int
+    one-hot votes weighted by per-tree validity — power-of-two tree
+    padding must not vote, argmax on device) or — ``mode="sum"``, the
+    boosted margin path — ``pred_b`` carries per-node f32 LEAF VALUES
+    and the reduction is the validity-weighted sum of each tree's routed
+    value (the additive-ensemble contraction; the caller folds in base
+    score and learning rate). The mode is a static jit arg, so the vote
+    program is byte-identical to the pre-boost one."""
     n = flat_segs.shape[1]
     fs = flat_segs.reshape(-1).astype(jnp.int32)
     idx = jnp.arange(n)
@@ -628,10 +635,64 @@ def _route_forest(flat_segs: jnp.ndarray, oks: jnp.ndarray,
         return pred_of[node]
 
     preds = jax.vmap(one_tree)(split_of_b, child_b, pred_b)   # [Kt, N]
+    if mode == "sum":
+        margins = jnp.sum(
+            preds * valid[:, None].astype(jnp.float32), axis=0)  # [N]
+        return margins, jnp.all(oks)
     votes = jnp.sum(
         jax.nn.one_hot(preds, n_classes, dtype=jnp.int32)
         * valid[:, None, None], axis=0)                       # [N, C]
     return jnp.argmax(votes, axis=1), jnp.all(oks)
+
+
+def _stack_route_tables(trees: Sequence[TreeNode], table: EncodedTable):
+    """The stacked routing operands for :func:`_route_forest`, shared by
+    the bagged vote and the boosted margin paths: each (attr, key)
+    segmentation computed ONCE across all trees, flattened-tree tables
+    padded to shared power-of-two (tree, node) axes. Returns (segs, oks,
+    split_of_b, child_b, pred_b, val_b, valid, depth, s_width) — pred_b
+    is the per-node class prediction, val_b the per-node f32 leaf value
+    (0 where unset)."""
+    flats = [T._flatten_tree(tree) for tree in trees]
+    depth = max(f[4] for f in flats)
+    seg_cache: Dict = {}
+    global_slot: Dict[Tuple[int, str], int] = {}
+    for f in flats:
+        for key in f[5]:
+            if key not in seg_cache:
+                seg_cache[key] = T._device_segments(table, *key)
+            global_slot.setdefault(key, len(global_slot))
+    ordered = sorted(global_slot, key=global_slot.get)
+    if ordered:
+        segs = jnp.stack([seg_cache[k][0] for k in ordered])
+        oks = jnp.stack([seg_cache[k][1] for k in ordered])
+    else:
+        # all-leaf ensemble: one dummy segmentation keeps shapes legal
+        segs = jnp.zeros((1, table.n_rows), jnp.int32)
+        oks = jnp.ones((1,), bool)
+
+    s_w = max(f[2] for f in flats)
+    nn = _pow2(max(len(f[3]) for f in flats))
+    kt = _pow2(len(trees))
+    split_of_b = np.zeros((kt, nn), np.int32)
+    child_b = np.full((kt, nn * s_w), -1, np.int32)
+    pred_b = np.zeros((kt, nn), np.int32)
+    val_b = np.zeros((kt, nn), np.float32)
+    valid = np.zeros(kt, np.int32)
+    for i, (split_of, child_flat, s_width, pred, _d, splits,
+            val) in enumerate(flats):
+        n_nodes = len(pred)
+        remap = (np.asarray([global_slot[k] for k in splits], np.int32)
+                 if splits else np.zeros(1, np.int32))
+        split_of_b[i, :n_nodes] = remap[split_of]
+        child = np.full((nn, s_w), -1, np.int32)
+        child[:n_nodes, :s_width] = child_flat.reshape(n_nodes, s_width)
+        child_b[i] = child.reshape(-1)
+        pred_b[i, :n_nodes] = pred
+        val_b[i, :n_nodes] = val
+        valid[i] = 1
+    return (segs, oks, split_of_b, child_b, pred_b, val_b, valid, depth,
+            int(s_w))
 
 
 def _predict_forest_device(trees: Sequence[TreeNode], table: EncodedTable
@@ -642,47 +703,18 @@ def _predict_forest_device(trees: Sequence[TreeNode], table: EncodedTable
     loop this replaced (ISSUE 15 satellite). Identical predictions to the
     host walk (asserted in tests)."""
     n_classes = len(trees[0].class_values)
-    flats = [T._flatten_tree(tree) for tree in trees]
-    depth = max(f[4] for f in flats)
-    if depth == 0:
+    if max(T._flatten_tree(t)[4] for t in trees) == 0:
         # every tree is a leaf: a constant vote, no routing to dispatch
         votes = np.zeros(n_classes, np.int64)
         for tree in trees:
             votes[tree.prediction] += 1
         return np.full(table.n_rows, votes.argmax(), np.int64)
-    seg_cache: Dict = {}
-    global_slot: Dict[Tuple[int, str], int] = {}
-    for *_rest, splits in flats:
-        for key in splits:
-            if key not in seg_cache:
-                seg_cache[key] = T._device_segments(table, *key)
-            global_slot.setdefault(key, len(global_slot))
-    ordered = sorted(global_slot, key=global_slot.get)
-    segs = jnp.stack([seg_cache[k][0] for k in ordered])
-    oks = jnp.stack([seg_cache[k][1] for k in ordered])
-
-    s_w = max(f[2] for f in flats)
-    nn = _pow2(max(len(f[3]) for f in flats))
-    kt = _pow2(len(trees))
-    split_of_b = np.zeros((kt, nn), np.int32)
-    child_b = np.full((kt, nn * s_w), -1, np.int32)
-    pred_b = np.zeros((kt, nn), np.int32)
-    valid = np.zeros(kt, np.int32)
-    for i, (split_of, child_flat, s_width, pred, _d, splits) in enumerate(
-            flats):
-        n_nodes = len(pred)
-        remap = (np.asarray([global_slot[k] for k in splits], np.int32)
-                 if splits else np.zeros(1, np.int32))
-        split_of_b[i, :n_nodes] = remap[split_of]
-        child = np.full((nn, s_w), -1, np.int32)
-        child[:n_nodes, :s_width] = child_flat.reshape(n_nodes, s_width)
-        child_b[i] = child.reshape(-1)
-        pred_b[i, :n_nodes] = pred
-        valid[i] = 1
+    (segs, oks, split_of_b, child_b, pred_b, _val_b, valid, depth,
+     s_w) = _stack_route_tables(trees, table)
     out, ok = jax.device_get(_route_forest(
         segs, oks, jnp.asarray(split_of_b), jnp.asarray(child_b),
         jnp.asarray(pred_b), jnp.asarray(valid), depth=depth,
-        s_width=int(s_w), n_classes=n_classes))
+        s_width=s_w, n_classes=n_classes))
     if not ok:
         raise ValueError("split segment not found for some value")
     return np.asarray(out, np.int64)
@@ -707,18 +739,52 @@ def predict_forest(trees: Sequence[TreeNode], table: EncodedTable,
     return votes.argmax(axis=1)
 
 
+#: artifact schema version shared by the tree-ensemble JSON family
+#: (bagged forests here, boosted ensembles in models/boost.py)
+ARTIFACT_FORMAT = 1
+
+_KNOWN_KINDS = (
+    "'bagged' (majority-vote forest: load_forest/predict_forest), "
+    "'boosted' (additive margin ensemble: boost.load_boosted/"
+    "BoostedModel.predict)")
+
+
+def check_artifact_kind(model: dict, *, expect: str, path: str) -> None:
+    """The loader gate for the versioned ensemble artifacts (ISSUE 16):
+    refuse unknown format versions, and refuse a model of the WRONG KIND
+    with an error naming both kinds — a boosted ensemble fed to the
+    bagged vote would silently argmax regression votes (and a bagged
+    forest summed as margins is equally meaningless). Artifacts written
+    before versioning carry neither field and are bagged by
+    construction."""
+    fmt = model.get("format", ARTIFACT_FORMAT)
+    if fmt != ARTIFACT_FORMAT:
+        raise ValueError(
+            f"unsupported ensemble artifact format {fmt!r} in {path} "
+            f"(this build reads format {ARTIFACT_FORMAT})")
+    kind = model.get("kind", "bagged")
+    if kind != expect:
+        raise ValueError(
+            f"artifact {path} holds a {kind!r} model but was loaded on "
+            f"the {expect!r} predict path; known kinds: {_KNOWN_KINDS}")
+
+
 def save_forest(trees: Sequence[TreeNode], path: str) -> None:
     """Rename-atomic model dump: a crash (or a tree that fails to
     serialize) mid-write leaves any previous artifact intact instead of a
-    truncated JSON for ``load_forest`` to choke on."""
+    truncated JSON for ``load_forest`` to choke on. Stamped with the
+    artifact format version and ``kind: bagged`` so the loaders can
+    refuse cross-kind loads instead of silently mis-voting."""
     class_values = _validate_trees(trees)
     atomic_json_dump(
-        {"classValues": class_values,
+        {"format": ARTIFACT_FORMAT, "kind": "bagged",
+         "classValues": class_values,
          "trees": [t.to_dict() for t in trees]}, path)
 
 
 def load_forest(path: str) -> List[TreeNode]:
     with open(path) as fh:
         model = json.load(fh)
+    check_artifact_kind(model, expect="bagged", path=path)
     return [TreeNode.from_dict(d, model["classValues"])
             for d in model["trees"]]

@@ -593,6 +593,11 @@ class TreeNode:
     attr_ordinal: Optional[int] = None
     split_key: Optional[str] = None
     children: Dict[int, "TreeNode"] = field(default_factory=dict)
+    # regression score carried by boosted trees (models/boost.py): the
+    # Newton leaf value this node contributes when a row's route stops
+    # here. None for classification/bagged trees — and then "value" never
+    # appears in the artifact, keeping bagged JSON byte-stable.
+    leaf_value: Optional[float] = None
 
     @property
     def is_leaf(self) -> bool:
@@ -603,19 +608,23 @@ class TreeNode:
         return int(np.argmax(self.class_counts))
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "classCounts": self.class_counts.tolist(),
             "attr": self.attr_ordinal,
             "splitKey": self.split_key,
             "children": {str(k): v.to_dict() for k, v in self.children.items()},
         }
+        if self.leaf_value is not None:
+            d["value"] = self.leaf_value
+        return d
 
     @classmethod
     def from_dict(cls, d: dict, class_values: List[str]) -> "TreeNode":
         node = cls(class_counts=np.asarray(d["classCounts"], np.float64),
                    class_values=list(class_values),
                    attr_ordinal=d.get("attr"),
-                   split_key=d.get("splitKey"))
+                   split_key=d.get("splitKey"),
+                   leaf_value=d.get("value"))
         for k, child in d.get("children", {}).items():
             node.children[int(k)] = cls.from_dict(child, class_values)
         return node
@@ -636,17 +645,26 @@ class TreeConfig:
     device_node_budget: int = 2048
 
 
-def canonical_tree(n: Optional["TreeNode"]):
+def canonical_tree(n: Optional["TreeNode"], with_values: bool = False):
     """Order-insensitive structural fingerprint of a tree — (attr, key,
     int class counts, sorted children) per node. THE one definition of
     'identical tree' every bit-identity assertion (tests, on-chip deep
-    growth checks) compares by; extend here when TreeNode grows fields."""
+    growth checks) compares by; extend here when TreeNode grows fields.
+    ``with_values=True`` appends the f32 ``leaf_value`` per node so
+    boosted byte-identity assertions (streamed vs in-core) cover the
+    regression scores too; the default keeps every pre-boost comparison
+    untouched."""
     if n is None:
         return None
-    return (n.attr_ordinal, n.split_key,
+    base = (n.attr_ordinal, n.split_key,
             tuple(int(c) for c in n.class_counts),
-            tuple(sorted((k, canonical_tree(v))
+            tuple(sorted((k, canonical_tree(v, with_values))
                          for k, v in n.children.items())))
+    if with_values:
+        val = (None if n.leaf_value is None
+               else float(np.float32(n.leaf_value)))
+        return base + (val,)
+    return base
 
 
 def splittable_ordinals(table: EncodedTable) -> List[int]:
@@ -1504,10 +1522,12 @@ def _route_rows(flat_segs: jnp.ndarray, split_of_node: jnp.ndarray,
 
 
 def _flatten_tree(tree: TreeNode):
-    """BFS arrays for :func:`_route_rows`: (nodes list, split-slot of each
-    node into the caller's unique-split list (0 for leaves), child table
-    [num_nodes, s_width] with -1 for leaf/missing, prediction per node,
-    depth, the unique (attr, key) pairs in first-use order)."""
+    """BFS arrays for :func:`_route_rows`: (split-slot of each node into
+    the caller's unique-split list (0 for leaves), flattened child table
+    [num_nodes * s_width] with -1 for leaf/missing, s_width, prediction
+    per node, depth, the unique (attr, key) pairs in first-use order,
+    f32 leaf value per node (0.0 where ``leaf_value`` is unset — boosted
+    trees always set it, so the 0 never leaks into a margin))."""
     nodes = [tree]
     i = 0
     while i < len(nodes):
@@ -1524,6 +1544,8 @@ def _flatten_tree(tree: TreeNode):
     split_of = np.zeros(len(nodes), np.int32)
     child = np.full((len(nodes), s_width), -1, np.int32)
     pred = np.asarray([n.prediction for n in nodes], np.int32)
+    val = np.asarray([0.0 if n.leaf_value is None else n.leaf_value
+                      for n in nodes], np.float32)
     for k, n in enumerate(nodes):
         if n.is_leaf:
             continue
@@ -1536,7 +1558,7 @@ def _flatten_tree(tree: TreeNode):
         return 0 if not n.children else 1 + max(
             depth_of(c) for c in n.children.values())
     return (split_of, child.reshape(-1), s_width, pred, depth_of(tree),
-            list(split_slot))
+            list(split_slot), val)
 
 
 def _predict_device_raw(tree: TreeNode, table: EncodedTable,
@@ -1544,7 +1566,8 @@ def _predict_device_raw(tree: TreeNode, table: EncodedTable,
     """Device-array form of :func:`predict_device`: ([N] predictions,
     [U] ok bits) — both still on device, so forest callers can accumulate
     votes without a readback per tree."""
-    split_of, child_flat, s_width, pred, depth, splits = _flatten_tree(tree)
+    (split_of, child_flat, s_width, pred, depth, splits,
+     _val) = _flatten_tree(tree)
     if depth == 0:
         return (jnp.full(table.n_rows, tree.prediction, jnp.int32),
                 jnp.ones((1,), bool))

@@ -196,6 +196,72 @@ def node_class_bin_counts(bins: jnp.ndarray, node_id: jnp.ndarray,
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
 
 
+#: node-axis chunk for node_channel_bin_sums — the boosted channel
+#: histogram runs f32 one-hots (below), so the LHS stays narrower than the
+#: bf16 count path's
+_CHANNEL_NODE_CHUNK = 256
+
+
+def node_channel_bin_sums(bins: jnp.ndarray, node_id: jnp.ndarray,
+                          channels: jnp.ndarray, n_nodes: int, n_bins: int
+                          ) -> jnp.ndarray:
+    """[N, A] bins × [N] node ids × [N, D] channel values -> [A, n_nodes,
+    n_bins, D] per-cell channel sums — the second-order boosting twin of
+    :func:`node_class_bin_counts` (ISSUE 16).
+
+    Same combined-index dispatch shape — node-onehot LHS against a
+    (bin-onehot ⊗ channels) RHS on the MXU, one pass per level — but the
+    channels are FIXED-POINT gradient/hessian quanta (models/boost.py
+    scales by 2^10 and rounds), not 0/1 labels, so precision rules
+    differ from the count path:
+
+    * the one-hots and the contraction run in **f32**, never bf16: a
+      gradient quantum reaches ±2^10 and bf16's 8 mantissa bits only
+      represent integers exactly up to 2^8 — pushing the quanta through
+      the count path's bf16 one-hot trick would corrupt them before the
+      accumulate. (Exactly why this is a separate function and not a
+      ``weights=`` variant of the count reduction.)
+    * every cell total is an exact integer in f32 while the summed
+      magnitude stays below 2^24 — which a 2^10 quantum scale holds up to
+      ~16k rows per (node, bin) cell of |grad| ≤ 1, far past any level's
+      cell occupancy here — so chunked/sharded/streamed partial sums fold
+      byte-identically, the same additive-exactness contract the count
+      fold relies on.
+
+    Rows outside a node chunk (or with out-of-range bins/nodes) zero
+    their one-hot row and DROP, partitioning rows exactly; chunked totals
+    equal an unchunked pass byte for byte. Padding rows must arrive with
+    all-zero channels (the caller folds its 0/1 row mask into
+    ``channels``), which this drop semantics preserves.
+    """
+    n, n_a = bins.shape
+    d = channels.shape[1]
+    bins = jnp.asarray(bins, jnp.int32)
+    node_id = jnp.asarray(node_id, jnp.int32)
+    channels = jnp.asarray(channels, jnp.float32)
+    bin_ok = (bins >= 0) & (bins < n_bins)
+    node_ok = (node_id >= 0) & (node_id < n_nodes)
+    # RHS once for all chunks: [N, A·B·D] = bin one-hot ⊗ channels
+    oh_bins = jnp.where(bin_ok[:, :, None],
+                        jax.nn.one_hot(bins, n_bins, dtype=jnp.float32), 0.0)
+    rhs = (oh_bins[:, :, :, None] * channels[:, None, None, :]
+           ).reshape(n, n_a * n_bins * d)
+    chunk = min(max(1, _CHANNEL_NODE_CHUNK), n_nodes)
+    parts = []
+    for k0 in range(0, n_nodes, chunk):
+        k1 = min(k0 + chunk, n_nodes)
+        in_chunk = node_ok & (node_id >= k0) & (node_id < k1)
+        wk = jnp.where(in_chunk[None, :],
+                       jax.nn.one_hot(node_id - k0, k1 - k0,
+                                      dtype=jnp.float32).T, 0.0)   # [K, N]
+        flat = jax.lax.dot_general(
+            wk, rhs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [K, A·B·D]
+        parts.append(flat.reshape(k1 - k0, n_a, n_bins, d)
+                     .transpose(1, 0, 2, 3))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
 def per_class_moments(values: jnp.ndarray, labels: jnp.ndarray,
                       n_classes: int,
                       weights: Optional[jnp.ndarray] = None

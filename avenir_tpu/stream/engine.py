@@ -230,6 +230,81 @@ def warm_serving_paths(learner: Learner, rewards: bool = True) -> None:
             [(action, 0.0)] * (Learner._SCAN_BUCKET_MAX + extra))
 
 
+class BoostServingLearner:
+    """Boosted-forest scoring behind the engine's learner protocol
+    (ISSUE 16): the SAME dispatch-then-fetch loop, pending-ledger
+    transport, admission control, and lifecycle hot swap that serve
+    bandits serve gradient-boosted margins — an event is a scoring
+    request, the "action" written back is the predicted class label.
+
+    State is the :func:`models.boost.serving_tables` pytree — every leaf
+    shape a pure function of (schema, rounds_budget, node_budget) — so a
+    drift retrain's replacement model passes ``install_state``'s
+    tree-def + shape gate and swaps in between batches without touching
+    this instance's compiled programs (``depth`` is a static CAP: routing
+    past a leaf stays put, so one program serves every model under the
+    cap). Feature rows arrive as a device-resident ring of binned ids
+    (:func:`models.boost.serving_bins` order); an n-event batch scores
+    the next n rows, padded to the power-of-two bucket so ragged batches
+    reuse compiled programs. ``next_action_batch_async`` only dispatches
+    — the engine overlaps the readback with queue I/O exactly as it does
+    a bandit select."""
+
+    def __init__(self, tables: Dict[str, Any], bins, class_values:
+                 Sequence[str], *, depth: int, batch_size: int = 1):
+        import types
+        import jax.numpy as jnp
+        self.state = tables
+        self.actions = list(class_values)
+        self.cfg = types.SimpleNamespace(batch_size=batch_size)
+        self._bins = jnp.asarray(bins, jnp.int32)
+        self._depth = int(depth)
+        self._cursor = 0
+        self.reward_count = 0
+        self.reward_sum = 0.0
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        m = 1
+        while m < n:
+            m *= 2
+        return m
+
+    def warm(self, max_batch: int) -> None:
+        """Pre-compile the pow2 batch buckets a run can reach (the
+        ``warm_serving_paths`` discipline: a compile landing inside a
+        live batch is an SLO miss that has nothing to do with serving).
+        Scoring is pure — warming never mutates state."""
+        m = 1
+        while m <= self._bucket(max_batch):
+            self.resolve_action_batch(self.next_action_batch_async(m))
+            m *= 2
+
+    def next_action_batch_async(self, n: int):
+        import jax.numpy as jnp
+        from avenir_tpu.models.boost import _serve_margins
+        m = self._bucket(n)
+        rows = self._bins.shape[0]
+        idx = (self._cursor + jnp.arange(m)) % rows
+        self._cursor = (self._cursor + n) % rows
+        _margin, cls = _serve_margins(self.state, self._bins[idx],
+                                      depth=self._depth)
+        return (cls, n)
+
+    def resolve_action_batch(self, handle) -> List[str]:
+        import numpy as np
+        cls, n = handle
+        return [self.actions[c] for c in np.asarray(cls)[:n]]
+
+    def set_reward_batch(self, pairs: Sequence[Tuple[str, float]]) -> None:
+        """Outcome feedback ledger: boosting has no online update (the
+        lifecycle RETRAIN is the update), so rewards only accumulate —
+        exactly what the engine's DriftMonitor taps to trigger it."""
+        for _action, reward in pairs:
+            self.reward_count += 1
+            self.reward_sum += float(reward)
+
+
 class AdmissionControl:
     """Bounded-depth gate for the serving engine (ISSUE 8): graceful
     degradation instead of an unbounded ``engine.queue_depth``.
@@ -586,6 +661,14 @@ class ServingEngine:
             if pending is not None:
                 self._complete(*pending, batch_size)
             if not events:
+                # an empty pop we actually attempted IS a depth
+                # observation: the queue drained to zero, so the
+                # hysteresis latch must not leave run() still shedding
+                # when the shed itself emptied the queue between the
+                # iteration's depth poll and its pop (pop_n == 0 means a
+                # max_events cap, not emptiness — no signal there)
+                if self._admission is not None and pop_n > 0:
+                    self._admission.update(0)
                 break
             # the pre-pop clock read rides along as the batch's
             # decision-latency anchor

@@ -542,6 +542,114 @@ def _forest_bench() -> dict:
     return out
 
 
+def _boost_bench() -> dict:
+    """ISSUE 16: the ``boost`` sweep arm — K device-resident Newton
+    rounds over the one binned catalog vs the bagged batched forest at
+    matched (rows, depth, K). PARITY-GATED before timing by the
+    regression anchor (a 1-round lr=1 boost from base 0 must reproduce
+    the hessian-weighted ``grow_tree_device`` byte-identically — a wrong
+    fast booster must fail loudly, the kernel-arm discipline); reports
+    per-round trained rows/sec and the ``vs_bagged`` rate ratio the
+    acceptance gate reads (>= 0.5x: a boosting round pays the channel
+    histogram + score update the bagged round doesn't). Winners persist
+    under a dedicated ``/boost/`` autotune namespace — never colliding
+    with ``/forest/`` or ``/ann/`` entries (PR 14 discipline)."""
+    import sys as _sys
+    import jax.numpy as _jnp
+    from avenir_tpu.datagen.generators import retarget_rows, retarget_schema
+    from avenir_tpu.models import boost as B
+    from avenir_tpu.models import forest as F
+    from avenir_tpu.models import tree as T
+    from avenir_tpu.utils.dataset import Featurizer
+    n_rows = int(os.environ.get("BENCH_BOOST_ROWS", 8000))
+    depth = int(os.environ.get("BENCH_BOOST_DEPTH", 4))
+    grid = [int(v) for v in
+            os.environ.get("BENCH_BOOST_ROUNDS", "4,16").split(",") if v]
+    reps = int(os.environ.get("BENCH_BOOST_REPEATS", 3))
+    table = Featurizer(retarget_schema()).fit_transform(
+        retarget_rows(n_rows, seed=11))
+
+    # the parity gate, once per run: anchor round == weighted grow_tree
+    anchor_cfg = B.BoostConfig(n_rounds=1, learning_rate=1.0,
+                               base_score=0.0,
+                               tree=T.TreeConfig(max_depth=depth))
+    anchor = B.grow_boosted(table, anchor_cfg).trees[0]
+    ref = T.grow_tree_device(
+        table, anchor_cfg.tree,
+        row_weights=_jnp.full(table.n_rows, 0.25, _jnp.float32))
+    if T.canonical_tree(anchor) != T.canonical_tree(ref):
+        raise AssertionError(
+            "boost anchor round != hessian-weighted grow_tree_device — "
+            "refusing to time a wrong result")
+
+    def key_for(k: int) -> str:
+        return (_autotune_key(("boost",))
+                + f"/boost/r{n_rows}-d{depth}-k{k}")
+
+    sweep_grid, cache_mode = list(grid), "off"
+    if AUTOTUNE:
+        cache_mode = "miss"
+        for k in grid:
+            hit = _autotune_load(key_for(k))
+            if hit and hit.get("winner") == "boost":
+                sweep_grid, cache_mode = [k], "hit"
+                print(f"boost autotune cache hit: k{k} (grid sweep "
+                      "skipped; BENCH_AUTOTUNE=0 to re-sweep)",
+                      file=_sys.stderr)
+                break
+
+    def measure(k: int) -> dict:
+        bcfg = B.BoostConfig(n_rounds=k,
+                             tree=T.TreeConfig(max_depth=depth))
+        fcfg = F.ForestConfig(n_trees=k, seed=7, growth="batched",
+                              tree=T.TreeConfig(max_depth=depth))
+        B.grow_boosted(table, bcfg)          # warms the compiles
+        F.grow_forest(table, fcfg)
+
+        def best_of(fn) -> float:
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        tb = best_of(lambda: B.grow_boosted(table, bcfg))
+        tf = best_of(lambda: F.grow_forest(table, fcfg))
+        return {"rounds": k, "depth": depth, "rows": n_rows,
+                "boost_rows_per_sec": round(k * n_rows / tb, 1),
+                "bagged_rows_per_sec": round(k * n_rows / tf, 1),
+                "vs_bagged": round(tf / tb, 3)}
+
+    points, errors = [], []
+    for k in sweep_grid:
+        try:
+            points.append(measure(k))
+        except AssertionError:
+            raise                      # a WRONG booster must sink the arm
+        except Exception as exc:       # one bad point must not lose the grid
+            errors.append({"rounds": k, "error": repr(exc)})
+            print(f"boost point k{k} dropped: {exc!r}", file=_sys.stderr)
+    if not points:
+        raise RuntimeError(f"every boost grid point failed: {errors}")
+    best = max(points, key=lambda p: p["boost_rows_per_sec"])
+    if cache_mode == "miss":
+        _autotune_store(key_for(best["rounds"]), "boost",
+                        best["rounds"] * n_rows
+                        / best["boost_rows_per_sec"] * 1e3)
+    # the acceptance ratio reads at the LARGEST round count: round
+    # chaining amortizes the catalog build, so the widest point is the
+    # honest per-round number
+    at_k = max(points, key=lambda p: p["rounds"])
+    out = {"grid": points, "best": best,
+           "vs_bagged": at_k["vs_bagged"],
+           "vs_bagged_at_rounds": at_k["rounds"],
+           "autotune": {"cache": cache_mode}}
+    if errors:
+        out["errors"] = errors
+    return out
+
+
 def _online_serving_bench() -> dict:
     """ISSUE 5: the serving-engine bench — decisions/sec of the pipelined
     ``stream.engine.ServingEngine`` vs the synchronous ``run()`` loop over
@@ -964,6 +1072,23 @@ def main() -> None:
         except Exception as exc:
             print(f"forest bench skipped: {exc!r}", file=sys.stderr)
             out["forest"] = {"error": repr(exc)}
+    # ISSUE-16 GRADIENT BOOSTING: per-round rate of chained
+    # device-resident Newton rounds vs the bagged batched forest at
+    # matched (rows, depth, K), anchor-parity-gated. BENCH_BOOST=0
+    # disables; BENCH_BOOST_{ROWS,DEPTH,ROUNDS,REPEATS} tune the grid.
+    if os.environ.get("BENCH_BOOST", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        try:
+            out["boost"] = _boost_bench()
+            bb = out["boost"]["best"]
+            print(f"boost: {bb['boost_rows_per_sec'] / 1e6:.2f}M "
+                  f"round-rows/s at K={bb['rounds']} depth={bb['depth']} "
+                  f"({bb['vs_bagged']:.2f}x the bagged rate "
+                  f"{bb['bagged_rows_per_sec'] / 1e6:.2f}M)",
+                  file=sys.stderr)
+        except Exception as exc:
+            print(f"boost bench skipped: {exc!r}", file=sys.stderr)
+            out["boost"] = {"error": repr(exc)}
     # ISSUE-5 ONLINE SERVING: the always-on path's own headline —
     # engine-vs-sync decisions/sec on CPU over MiniRedis (subprocess;
     # fallback-safe: a serving failure must not sink the KNN headline)

@@ -1,0 +1,213 @@
+"""Boost smoke (ISSUE 16, tier-1 via tests/test_boost.py): gradient
+boosting's regression anchor, out-of-core byte identity, margin parity,
+accuracy vs bagged, and the LIVE engine-served scenario — boosted
+margins behind the serving engine with a drift-triggered lifecycle
+retrain hot-swapping mid-drain — in one lean in-process run.
+
+Five gates, one JSON line on stdout, non-zero exit on any failure:
+
+1. ANCHOR: one boosting round at learning_rate=1 from base 0 grows the
+   byte-identical tree to hessian-weighted (0.25) ``grow_tree_device``.
+2. STREAMING: ``grow_boosted_streaming`` over 3 ragged part files ==
+   in-core boosting INCLUDING leaf values (with_values canonical form).
+3. MARGIN PARITY: host walk == stacked ``mode="sum"`` device route ==
+   the engine's fixed-shape serving tables at a deeper depth cap.
+4. ACCURACY: boosted beats-or-matches the bagged forest on a holdout.
+5. SERVED: ~1.5k scoring events through ``ServingEngine`` over a real
+   MiniRedis broker with ``BoostServingLearner``; a reward regime shift
+   trips the DriftMonitor -> RetrainDaemon wave -> registry publish ->
+   hot swap. Gates: zero drops, >= 1 swap landed, >= 1 drift alarm,
+   the drift-triggered wave published, decision p99 <= 500ms.
+
+CPU-sized (700 rows, depth 2) — tier-1 is near its kill budget, so
+everything runs in this one process.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+DECISION_P99_BOUND_MS = 500.0
+
+
+def main() -> int:
+    import jax.numpy as jnp
+    from avenir_tpu.datagen.generators import retarget_rows, retarget_schema
+    from avenir_tpu.models import boost as B
+    from avenir_tpu.models import forest as F
+    from avenir_tpu.models import tree as T
+    from avenir_tpu.utils.dataset import Featurizer
+
+    report = {}
+    rows = retarget_rows(900, seed=13)
+    fz = Featurizer(retarget_schema())
+    table = fz.fit_transform(rows[:700])
+    test = fz.transform(rows[700:])
+
+    # 1. the regression anchor: 1 round @ lr=1, base 0 == weighted tree
+    acfg = B.BoostConfig(n_rounds=1, learning_rate=1.0, base_score=0.0,
+                         tree=T.TreeConfig(max_depth=2))
+    anchor = B.grow_boosted(table, acfg).trees[0]
+    ref = T.grow_tree_device(
+        table, acfg.tree,
+        row_weights=jnp.full(table.n_rows, 0.25, jnp.float32))
+    assert T.canonical_tree(anchor) == T.canonical_tree(ref), \
+        "anchor round != hessian-weighted grow_tree_device"
+    report["anchor"] = True
+
+    # 2. streaming over ragged part files — values included
+    bcfg = B.BoostConfig(n_rounds=3, learning_rate=0.3,
+                         tree=T.TreeConfig(max_depth=2,
+                                           device_node_budget=64))
+    model = B.grow_boosted(table, bcfg)
+    with tempfile.TemporaryDirectory() as td:
+        paths, bounds = [], [0, 220, 460, 700]
+        for i in range(3):
+            p = os.path.join(td, f"part-{i}.txt")
+            with open(p, "w") as fh:
+                for r in rows[bounds[i]:bounds[i + 1]]:
+                    fh.write(",".join(r) + "\n")
+            paths.append(p)
+        streamed = B.grow_boosted_streaming(fz, paths, bcfg)
+    assert all(T.canonical_tree(a, with_values=True)
+               == T.canonical_tree(b, with_values=True)
+               for a, b in zip(model.trees, streamed.trees)), \
+        "streamed boosting != in-core"
+    report["streaming"] = True
+
+    # 3. host == device == serving-table margins (cap deeper than trees)
+    mh = model.margins(test)
+    md = np.asarray(model.margins(test, device=True))
+    assert np.allclose(mh, md, atol=1e-5), "device margins != host"
+    budgets = {"rounds_budget": bcfg.n_rounds,
+               "node_budget": ((bcfg.tree.max_depth + 1)
+                               * bcfg.tree.device_node_budget)}
+    tables = B.serving_tables(model, table, **budgets)
+    test_bins = jnp.asarray(B.serving_bins(test))
+    ms, cls = B._serve_margins(tables, test_bins, depth=4)
+    assert np.allclose(mh, np.asarray(ms), atol=1e-5), \
+        "serving-table margins != host"
+    assert np.array_equal(np.asarray(cls), model.predict(test)), \
+        "served classes != predict"
+    report["margin_parity"] = True
+
+    # 4. boosted >= bagged on the holdout
+    labels = np.asarray(test.labels)
+    acc_boost = float(np.mean(model.predict(test) == labels))
+    bagged = F.grow_forest(table, F.ForestConfig(
+        n_trees=3, seed=7, tree=T.TreeConfig(max_depth=2)))
+    acc_bag = float(np.mean(
+        np.asarray(F.predict_forest(bagged, test)) == labels))
+    assert acc_boost >= acc_bag, \
+        f"boosted {acc_boost} under bagged {acc_bag}"
+    assert acc_boost > 0.6, f"boosted accuracy {acc_boost}"
+    report["accuracy"] = {"boosted": acc_boost, "bagged": acc_bag}
+
+    # 5. served live: drift -> retrain -> hot swap, under the SLO
+    from avenir_tpu.lifecycle.drift import DriftMonitor, PageHinkley
+    from avenir_tpu.lifecycle.registry import SnapshotRegistry
+    from avenir_tpu.lifecycle.retrain import (
+        RetrainDaemon, boost_refit_train_fn)
+    from avenir_tpu.obs import exporters as E
+    from avenir_tpu.stream.engine import BoostServingLearner, ServingEngine
+    from avenir_tpu.stream.loop import RedisQueues
+    from avenir_tpu.stream.miniredis import MiniRedisClient, MiniRedisServer
+
+    n_events = 1200
+    hub = E.hub().enable()
+    hub.set_meta(worker_id=0)
+    from avenir_tpu.obs import telemetry as tel
+    with tempfile.TemporaryDirectory() as tmp, MiniRedisServer() as srv:
+        registry = SnapshotRegistry(os.path.join(tmp, "registry"))
+        daemon = RetrainDaemon(
+            registry, boost_refit_train_fn(lambda: table, bcfg))
+        # wave 1 synchronously BEFORE serving starts: its publish is
+        # waiting at the first batch boundary, so a swap lands mid-drain
+        # deterministically; the drift-triggered wave exercises the
+        # request path beside the live engine
+        assert daemon.run_once() is not None, \
+            f"retrain wave failed: {daemon.last_error!r}"
+        monitor = DriftMonitor(
+            {"reward": PageHinkley(delta=0.005, threshold=5.0,
+                                   min_samples=30)},
+            on_drift=daemon.request, cooldown_s=0.0)
+        daemon.start()
+
+        learner = BoostServingLearner(
+            B.serving_tables(model, table, **budgets),
+            B.serving_bins(test), model.class_values,
+            depth=bcfg.tree.max_depth, batch_size=1)
+        learner.warm(64)
+
+        client = MiniRedisClient(srv.host, srv.port)
+        client.flushall()
+        for i in range(n_events):
+            client.lpush("eventQueue", f"e{i:05d}")
+        # reward regime shift mid-stream: the folded drains walk the
+        # stream in order, so PageHinkley sees high -> low and alarms
+        rng = np.random.default_rng(3)
+        for i in range(450):
+            mean = 1.0 if i < 225 else 0.0
+            r = mean + 0.05 * float(rng.standard_normal())
+            a = model.class_values[i % 2]
+            client.lpush("rewardQueue", f"{a},{r}")
+        queues = RedisQueues(client=client, pending_queue="pendingQueue")
+
+        watcher = registry.subscribe()
+        engine_box = {}
+
+        def swap_source():
+            snap = watcher.poll()
+            if snap is None:
+                return None
+            return snap.version, snap.restore(
+                like=engine_box["engine"].learner.state)
+
+        engine = ServingEngine(
+            "boost", model.class_values, {}, queues, learner=learner,
+            swap_source=swap_source, drift_monitor=monitor)
+        engine_box["engine"] = engine
+        t0 = time.perf_counter()
+        stats = engine.run()
+        elapsed = time.perf_counter() - t0
+        assert stats.events == n_events, \
+            f"served {stats.events}/{n_events}"
+        assert client.llen("pendingQueue") == 0, "un-acked ledger entries"
+        assert stats.swaps >= 1, "no hot-swap landed during the drain"
+        assert monitor.alarms >= 1, "reward regime shift never alarmed"
+        # the drift request's wave may finish after the drain — join it
+        assert daemon.wait_for_waves(2, timeout=60), \
+            "drift-triggered retrain wave never published"
+        daemon.stop()
+        n_actions = 0
+        while client.rpop("actionQueue") is not None:
+            n_actions += 1
+        assert n_actions == n_events, \
+            f"action queue holds {n_actions}/{n_events}"
+        client.close()
+        snap = tel.tracer().snapshot()
+    hub.disable()
+    lat = snap.get("engine.decision_latency") or {}
+    p99 = float(lat.get("p99_ms", float("inf")))
+    assert p99 <= DECISION_P99_BOUND_MS, \
+        f"decision p99 {p99:.1f}ms exceeds {DECISION_P99_BOUND_MS:.0f}ms"
+    report["served"] = True
+    report["decision_p99_ms"] = round(p99, 3)
+    report["decisions_per_sec"] = round(n_events / elapsed, 1)
+    report["swaps"] = stats.swaps
+    report["drift_alarms"] = monitor.alarms
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

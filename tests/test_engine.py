@@ -808,17 +808,21 @@ class TestHistoryDropped:
 class TestServingSmokeScript:
     def test_serving_smoke_script(self):
         """tier-1 hook (the multichip_smoke pattern): the smoke must
-        gate engine >= 2x sync decisions/sec, bit-parity, and <=5%
-        disabled-telemetry overhead. One retry absorbs a transient
-        co-tenant load spike — the gates themselves are unchanged."""
+        gate engine >= 2x sync decisions/sec (multi-core hosts only —
+        the overlap needs a second core), bit-parity, and the
+        disabled-telemetry overhead bound. One retry absorbs a
+        transient co-tenant load spike."""
         script = os.path.join(os.path.dirname(__file__), os.pardir,
                               "scripts", "serving_smoke.py")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         env.pop("XLA_FLAGS", None)
         last = None
         for attempt in range(2):
+            # 5000 events: every gate (parity, p99, overhead, speedup
+            # where cores allow) is count-independent, and the timed
+            # engine passes dominate this test's tier-1 footprint
             proc = subprocess.run(
-                [sys.executable, script, "--events", "10000"],
+                [sys.executable, script, "--events", "5000"],
                 capture_output=True, text=True, timeout=560, env=env)
             last = proc
             if proc.returncode == 0:
@@ -830,5 +834,9 @@ class TestServingSmokeScript:
         import json
         report = json.loads(last.stdout.strip().splitlines()[-1])
         assert report["bit_identical"] is True
-        assert report["speedup_vs_sync"] >= 2.0
+        if (os.cpu_count() or 1) >= 2:
+            # the 2x is a thread-overlap win; on a single-core host the
+            # engine and broker time-slice one CPU and the script skips
+            # its speedup gate — mirror that here
+            assert report["speedup_vs_sync"] >= 2.0
         assert report["round_trips_per_batch"] <= 5.0
