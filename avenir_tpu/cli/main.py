@@ -2425,16 +2425,43 @@ def main(argv: List[str] = None) -> int:
     # worker's --obs-flight); the <metrics-out>.flight.jsonl default is
     # only where the recorder lands once something else armed it
     if (obs_port is not None or conf.get_bool("obs.live", False)
-            or conf_flight):
+            or conf_flight or conf.get_bool("alerts.enable", False)):
         import json as _json
         import os as _os
         from avenir_tpu.obs.live import start_live_obs
         slo = conf.get("obs.slo.p99.ms")
+        # alerting (ISSUE 17): ``alerts.enable`` arms the SLO burn-rate
+        # evaluator + alert manager on the pump; ``alerts.out`` names
+        # the transition log (default <metrics-out>.alerts.jsonl);
+        # ``alerts.high.water`` (the admission latch) arms the
+        # saturation forecast with ``alerts.horizon.s``. Custom p99
+        # bars come from obs.slo.p99.ms, which also rebinds the first
+        # declared latency SLO for the flight recorder's breach latch.
+        alerts_on = conf.get_bool("alerts.enable", False)
+        alerts_out = conf.get("alerts.out") or (
+            args.metrics_out + ".alerts.jsonl"
+            if args.metrics_out else None)
+        alerts_hw = conf.get_int("alerts.high.water", -1)
+        slos = None
+        if alerts_on and slo:
+            from avenir_tpu.obs.signals import DEFAULT_SLOS
+            from dataclasses import replace as _dc_replace
+            slos = [(_dc_replace(s, bound_ms=float(slo))
+                     if s.name == "admitted_p99" else s)
+                    for s in DEFAULT_SLOS]
         live_obs = start_live_obs(
             port=obs_port,
             interval_s=float(conf.get("obs.pump.interval.s") or 0.25),
             flight_path=flight_path,
-            slo_p99_ms=float(slo) if slo else None)
+            slo_p99_ms=float(slo) if slo else None,
+            alerts=alerts_on or None,
+            slos=slos,
+            alerts_path=alerts_out if alerts_on else None,
+            high_water=alerts_hw if alerts_on and alerts_hw >= 0
+            else None,
+            forecast_horizon_s=float(
+                conf.get("alerts.horizon.s") or 30.0),
+            alert_source="cli")
         if live_obs.port is not None:
             print(_json.dumps({"obs_port": live_obs.port,
                                "pid": _os.getpid()}), flush=True)
@@ -2491,8 +2518,6 @@ def main(argv: List[str] = None) -> int:
             live_obs.crash_dump("crash:cli")
         raise
     finally:
-        if live_obs is not None:
-            live_obs.stop()
         if tel_hub is not None:
             # the wall-time summary (now with p50/p95/p99) rides along as
             # gauges; dump even on failure — a crashed job's partial
@@ -2500,6 +2525,10 @@ def main(argv: List[str] = None) -> int:
             for key, value in timer.summary().items():
                 tel_hub.set_gauge(f"job.{key}", value)
             try:
+                # write BEFORE live_obs.stop(): stop() clears the hub
+                # alerts provider, and the final .prom must still name
+                # any alert firing at exit (the aggregate counts alone
+                # don't tell the postmortem WHICH objective was burning)
                 paths = tel_hub.write(args.metrics_out)
             except OSError as exc:
                 # an unwritable report path must not fail a finished job
@@ -2509,8 +2538,10 @@ def main(argv: List[str] = None) -> int:
             else:
                 logger.info("telemetry report: %s + %s",
                             paths["jsonl"], paths["prom"])
-            finally:
-                tel_hub.disable()
+        if live_obs is not None:
+            live_obs.stop()
+        if tel_hub is not None:
+            tel_hub.disable()
     if debug_on:
         logger.debug("timing %s", timer.summary())
     return 0

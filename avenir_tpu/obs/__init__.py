@@ -15,10 +15,15 @@ The measurement substrate every job and loop reports into (ISSUE 2):
   background :class:`MetricsPump`, and the :class:`FlightRecorder`
   (crash / SIGUSR2 / SLO-breach dumps).
 - :mod:`avenir_tpu.obs.live` — per-process scrape endpoints
-  (``/metrics``, ``/metrics/rates``, ``/healthz``) and the
+  (``/metrics``, ``/metrics/rates``, ``/healthz``, ``/alerts``) and the
   :func:`start_live_obs` bundle.
 - :mod:`avenir_tpu.obs.tracing` — sampled cross-process event tracing
   (``id|ts|traceid`` wire stamps) exported as Chrome-trace JSON.
+- :mod:`avenir_tpu.obs.signals` — the judgment layer (ISSUE 17):
+  declared :class:`SloSpec` objectives evaluated over ring windows into
+  multi-window error-budget burn rates + the saturation forecast.
+- :mod:`avenir_tpu.obs.alerts` — the :class:`AlertManager` episode
+  state machine (pending → firing → resolved) and every delivery sink.
 
 One switch: ``obs.hub().enable()`` (the CLI's ``--metrics-out`` flag);
 the live layer opts in per process (``--obs-port`` / ``obs.http.port``).
@@ -41,11 +46,18 @@ from avenir_tpu.obs.telemetry import (BUCKET_BOUNDS_MS, LatencyHistogram,
 from avenir_tpu.obs.timeseries import (FlightRecorder, MetricsPump,
                                        MetricsRing, counter_delta,
                                        flight_dump_if_armed)
+from avenir_tpu.obs.signals import (DEFAULT_SLOS, SaturationForecaster,
+                                    SignalEvaluator, SloSpec,
+                                    burn_rate, window_badness)
+from avenir_tpu.obs.alerts import Alert, AlertManager
 
 __all__ = [
-    "BUCKET_BOUNDS_MS", "CompileTracker", "FlightRecorder",
+    "Alert", "AlertManager",
+    "BUCKET_BOUNDS_MS", "CompileTracker", "DEFAULT_SLOS",
+    "FlightRecorder",
     "LatencyHistogram", "MetricsPump", "MetricsRing",
-    "RuntimeSampler", "TelemetryHub", "Tracer", "counter_delta",
+    "RuntimeSampler", "SaturationForecaster", "SignalEvaluator",
+    "SloSpec", "TelemetryHub", "Tracer", "burn_rate", "counter_delta",
     "device_memory_stats",
     "enable", "events_to_report", "flight_dump_if_armed", "hub",
     "install_compile_listener",
@@ -54,5 +66,5 @@ __all__ = [
     "prometheus_text", "read_jsonl",
     "read_proc_status", "report_to_events", "snapshot_brief",
     "snapshot_slot_counts", "source_label", "span", "tracer",
-    "write_jsonl", "write_report",
+    "window_badness", "write_jsonl", "write_report",
 ]

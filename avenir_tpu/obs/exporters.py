@@ -110,6 +110,8 @@ def report_to_events(report: Dict) -> List[Dict]:
         events.append({"type": "counter", "name": name, "value": value})
     for name, value in sorted(report.get("gauges", {}).items()):
         events.append({"type": "gauge", "name": name, "value": value})
+    for sample in report.get("alerts", []):
+        events.append({"type": "alert", **sample})
     if "runtime" in report:
         events.append({"type": "runtime", **report["runtime"]})
     return events
@@ -158,6 +160,8 @@ def events_to_report(events: Iterable[Dict]) -> Dict:
             report["counters"][body["name"]] = body["value"]
         elif kind == "gauge":
             report["gauges"][body["name"]] = body["value"]
+        elif kind == "alert":
+            report.setdefault("alerts", []).append(body)
         elif kind == "runtime":
             report["runtime"] = body
         elif kind == "meta":
@@ -186,6 +190,24 @@ def prometheus_text(report: Dict, prefix: str = "avenir") -> str:
                   for src, v in sorted(value.items())])
         else:
             emit(metric, "gauge", [f"{metric} {value}"])
+
+    alerts = report.get("alerts", [])
+    if alerts:
+        # one labeled series per tracked alert (ISSUE 17): the value is
+        # constant 1, the information is the label set — state/severity
+        # move as the episode does, and every label value goes through
+        # the escape (alert names are declared but sources are not)
+        metric = f"{prefix}_alert"
+        emit(metric, "gauge", [
+            "{metric}{{{labels}}} 1".format(
+                metric=metric,
+                labels=",".join(
+                    f'{key}="{_prom_label(str(sample.get(key, "")))}"'
+                    for key in ("name", "source", "state", "severity")))
+            for sample in sorted(alerts,
+                                 key=lambda s: (str(s.get("name", "")),
+                                                str(s.get("source",
+                                                          ""))))])
 
     runtime = report.get("runtime", {})
     for key in ("rss_kb_last", "rss_kb_max", "vm_hwm_kb", "samples"):
@@ -275,6 +297,7 @@ def merge_reports(reports: List[Dict]) -> Dict:
                     "runtime": {"compile": {}}}
     hists: Dict[str, _telemetry.LatencyHistogram] = {}
     sources: List[Dict] = []
+    alerts: List[Dict] = []
     generated_at = 0.0
     for i, report in enumerate(reports):
         meta = report.get("meta", {})
@@ -301,6 +324,10 @@ def merge_reports(reports: List[Dict]) -> Dict:
                 slot.update(value)
             else:
                 slot[label] = value
+        # alerts concatenate: each sample already carries its source
+        # label, so the fleet report's firing set is the union
+        alerts.extend(dict(sample)
+                      for sample in report.get("alerts", []))
         runtime = report.get("runtime", {})
         for key in _RUNTIME_MAX:
             if key in runtime:
@@ -320,6 +347,10 @@ def merge_reports(reports: List[Dict]) -> Dict:
                     merged["runtime"]["compile"].get(key, 0) + value, 6)
     merged["spans"] = {name: h.snapshot()
                        for name, h in sorted(hists.items())}
+    if alerts:
+        merged["alerts"] = sorted(
+            alerts, key=lambda s: (str(s.get("name", "")),
+                                   str(s.get("source", ""))))
     merged["meta"] = {"format": "avenir-telemetry-v1",
                       "generated_at": generated_at or time.time(),
                       "merged_sources": len(reports),
@@ -351,6 +382,11 @@ class TelemetryHub:
         # fleet-merged reports stay attributable; survives reset() — the
         # process's identity does not change between jobs
         self._meta: Dict = {}
+        # alerts provider (ISSUE 17): an AlertManager's flat sample
+        # list, folded into every report so the .prom rendering, the
+        # JSONL events, and the scrape endpoints all carry the same
+        # firing set without any of them knowing about alerting
+        self._alerts_provider: Optional[Callable[[], List[Dict]]] = None
 
     @classmethod
     def get(cls) -> "TelemetryHub":
@@ -444,6 +480,18 @@ class TelemetryHub:
             for name, value in values.items():
                 self._gauges[name] = self._gauge_value(value)
 
+    def set_alerts_provider(
+            self, provider: Optional[Callable[[], List[Dict]]]) -> None:
+        """Attach (or clear with None) the callable whose samples land
+        in ``report()["alerts"]`` — ``AlertManager.alert_samples``."""
+        self._alerts_provider = provider
+
+    def clear_alerts_provider(self, provider) -> None:
+        """Detach ``provider`` iff it is still the installed one — a
+        stopped bundle must not evict a newer bundle's manager."""
+        if self._alerts_provider is provider:
+            self._alerts_provider = None
+
     def set_meta(self, **kw) -> None:
         """Attach identity fields (``worker_id=3``) to every future
         report's meta — the attribution the fleet merge keys its
@@ -466,7 +514,14 @@ class TelemetryHub:
         with self._lock:
             gauges = dict(self._gauges)
             extra_meta = dict(self._meta)
-        return {
+        alerts: Optional[List[Dict]] = None
+        provider = self._alerts_provider
+        if provider is not None:
+            try:
+                alerts = list(provider() or [])
+            except Exception:
+                alerts = None
+        out = {
             "meta": {"generated_at": now,
                      "enabled_at": self._enabled_at,
                      # how long telemetry has been collecting — the
@@ -482,6 +537,9 @@ class TelemetryHub:
             "gauges": gauges,
             "runtime": runtime,
         }
+        if alerts is not None:
+            out["alerts"] = alerts
+        return out
 
     def write(self, path: str) -> Dict[str, str]:
         """Dump the merged report: JSONL events at ``path``, Prometheus

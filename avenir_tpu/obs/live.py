@@ -67,6 +67,10 @@ class _ObsHandler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(owner.health(),
                                            sort_keys=True).encode(),
                            "application/json")
+            elif path == "/alerts":
+                self._send(200, json.dumps(owner.alerts(),
+                                           sort_keys=True).encode(),
+                           "application/json")
             else:
                 self._send(404, b'{"error": "not found"}',
                            "application/json")
@@ -87,11 +91,15 @@ class ObsHttpServer:
 
     def __init__(self, ring: Optional[_timeseries.MetricsRing] = None,
                  host: str = "localhost", port: int = 0,
-                 health_provider: Optional[Callable[[], Dict]] = None):
+                 health_provider: Optional[Callable[[], Dict]] = None,
+                 alerts_provider: Optional[Callable[[], Dict]] = None):
         self.ring = ring
         self.host = host
         self.port = int(port)
         self.health_provider = health_provider
+        # an AlertManager.snapshot — the /alerts body and the healthz
+        # degradation input (ISSUE 17)
+        self.alerts_provider = alerts_provider
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_at: Optional[float] = None
@@ -107,6 +115,18 @@ class ObsHttpServer:
                     "windows": [], "current": {}}
         return self.ring.rates_snapshot()
 
+    def alerts(self) -> Dict:
+        """The ``/alerts`` body: the manager's snapshot, or an empty
+        well-formed one when no alerting is armed (the endpoint must
+        answer either way, like ``rates()`` on an empty ring)."""
+        if self.alerts_provider is None:
+            return {"format": "avenir-alerts-v1", "now": time.time(),
+                    "alerts": [], "firing": [],
+                    "counts": {"pending": 0, "firing": 0,
+                               "resolved": 0},
+                    "events_total": 0}
+        return self.alerts_provider()
+
     def health(self) -> Dict:
         from avenir_tpu.obs.exporters import TelemetryHub
         h = TelemetryHub._instance
@@ -119,6 +139,27 @@ class ObsHttpServer:
                          if self._started_at else 0.0),
             "telemetry_enabled": bool(h is not None and h.enabled),
         }
+        if self.alerts_provider is not None:
+            # healthz degrades on page-severity firings (ISSUE 17):
+            # "ok" stays the liveness bit a supervisor restarts on,
+            # flipping only for pages — warn-level burn is degradation
+            # a human reads, not a restart signal
+            try:
+                snap = self.alerts_provider() or {}
+                firing = list(snap.get("firing", []))
+                out["alerts_firing"] = len(firing)
+                if firing:
+                    out["firing"] = firing
+                paging = sorted(
+                    a["name"] for a in snap.get("alerts", [])
+                    if a.get("state") == "firing"
+                    and a.get("severity") == "page")
+                out["degraded"] = bool(firing)
+                if paging:
+                    out["ok"] = False
+                    out["paging"] = paging
+            except Exception as exc:
+                out["alerts_error"] = repr(exc)
         if self.health_provider is not None:
             try:
                 out.update(self.health_provider() or {})
@@ -159,11 +200,18 @@ class LiveObs:
     optional HTTP endpoint, optional flight recorder)."""
 
     def __init__(self, ring, pump, server: Optional[ObsHttpServer],
-                 recorder, enabled_hub_here: bool):
+                 recorder, enabled_hub_here: bool,
+                 evaluator=None, alerts=None):
         self.ring = ring
         self.pump = pump
         self.server = server
         self.recorder = recorder
+        self.evaluator = evaluator   # SignalEvaluator, when armed
+        self.alerts = alerts         # AlertManager, when armed
+        # the exact provider object installed on the hub — bound-method
+        # access mints a fresh object each time, so the identity-gated
+        # clear needs the one that was set
+        self._hub_alerts_provider = None
         self._enabled_hub_here = enabled_hub_here
         self._stopped = False
 
@@ -202,6 +250,13 @@ class LiveObs:
             return
         self._stopped = True
         self.pump.stop()
+        if self.alerts is not None:
+            # final transition log + detach from the hub's report (a
+            # newer bundle's manager survives: clear is identity-gated)
+            self.alerts.flush()
+            if self._hub_alerts_provider is not None:
+                from avenir_tpu.obs.exporters import hub
+                hub().clear_alerts_provider(self._hub_alerts_provider)
         if dump and self.recorder is not None:
             self.recorder.dump("stop")
         if self.server is not None:
@@ -237,7 +292,13 @@ def start_live_obs(port: Optional[int] = None, host: str = "localhost",
                    slo_p99_ms: Optional[float] = None,
                    ring_windows: int = 240,
                    health_provider: Optional[Callable[[], Dict]] = None,
-                   arm_signal: bool = True) -> LiveObs:
+                   arm_signal: bool = True,
+                   slos=None,
+                   alerts: Optional[bool] = None,
+                   alerts_path: Optional[str] = None,
+                   high_water: Optional[int] = None,
+                   forecast_horizon_s: float = 30.0,
+                   alert_source: str = "engine") -> LiveObs:
     """Arm the live half of ``obs`` for this process.
 
     - Enables the :class:`TelemetryHub` if nothing else has (remembering
@@ -247,7 +308,21 @@ def start_live_obs(port: Optional[int] = None, host: str = "localhost",
       auto-assign; read ``.port`` back and surface it in the job JSON).
     - ``flight_path``: arms a :class:`FlightRecorder` there — crash
       hooks + atexit backstop + SIGUSR2 (main thread only) + SLO breach
-      at ``slo_p99_ms``.
+      at ``slo_p99_ms`` (or, when the caller declared a ``slos`` list
+      and gave no explicit bar, at its primary latency SLO's bound —
+      one source of truth; default alerting alone leaves the
+      single-window latch un-armed).
+    - **Alerting** (ISSUE 17): armed when ``alerts`` is True, or left
+      at None with any of ``slos`` / ``alerts_path`` / ``high_water``
+      given. A :class:`~avenir_tpu.obs.signals.SignalEvaluator` over
+      ``slos`` (default: the declared fleet SLOs) rides the pump behind
+      the recorder's check; its verdicts feed an :class:`~avenir_tpu.
+      obs.alerts.AlertManager` whose snapshot backs ``/alerts`` +
+      healthz degradation, whose samples land in every hub report (and
+      so in ``/metrics`` + the .prom file), and whose transition log is
+      rewritten atomically at ``alerts_path``. ``high_water`` (the
+      admission latch) arms the saturation forecast with horizon
+      ``forecast_horizon_s``.
     """
     global _CURRENT
     from avenir_tpu.obs.exporters import hub
@@ -256,6 +331,32 @@ def start_live_obs(port: Optional[int] = None, host: str = "localhost",
     if enabled_here:
         h.enable()
     ring = _timeseries.MetricsRing(max_windows=ring_windows)
+
+    if alerts is None:
+        alerts = bool(slos is not None or alerts_path
+                      or high_water is not None)
+    evaluator = manager = None
+    hub_provider = None
+    if alerts:
+        from avenir_tpu.obs import alerts as _alerts
+        from avenir_tpu.obs import signals as _signals
+        specs = list(_signals.DEFAULT_SLOS if slos is None else slos)
+        manager = _alerts.AlertManager(path=alerts_path)
+        evaluator = _signals.SignalEvaluator(
+            slos=specs, manager=manager, source=alert_source,
+            high_water=high_water, horizon_s=forecast_horizon_s)
+        hub_provider = manager.alert_samples
+        h.set_alerts_provider(hub_provider)
+        # the recorder's single-window breach latch arms off the spec
+        # list only when the caller DECLARED one: default alerting must
+        # not change the recorder's behavior (a worker's cold-start
+        # compile blip is absorbed by the alert pending window, but
+        # would trip the one-window latch and dump on a clean exit)
+        if slo_p99_ms is None and slos is not None:
+            primary = _signals.primary_latency_slo(specs)
+            if primary is not None:
+                slo_p99_ms = primary.bound_ms
+
     recorder = None
     if flight_path:
         recorder = _timeseries.FlightRecorder(ring, flight_path,
@@ -263,16 +364,36 @@ def start_live_obs(port: Optional[int] = None, host: str = "localhost",
         _timeseries.arm_flight_recorder(recorder)
         if arm_signal:
             recorder.arm_signal()
+
+    hooks = [hook for hook in
+             (recorder.check if recorder is not None else None,
+              evaluator.on_window if evaluator is not None else None)
+             if hook is not None]
+
+    def on_window(window):
+        # each hook isolated: a recorder defect must not starve the
+        # evaluator of its window (and vice versa)
+        for hook in hooks:
+            try:
+                hook(window)
+            except Exception:
+                pass
+
     pump = _timeseries.MetricsPump(
         ring, interval_s=interval_s, hub=h,
-        on_window=recorder.check if recorder is not None else None)
+        on_window=on_window if hooks else None)
     pump.start()
     server = None
     if port is not None:
-        server = ObsHttpServer(ring=ring, host=host, port=port,
-                               health_provider=health_provider)
+        server = ObsHttpServer(
+            ring=ring, host=host, port=port,
+            health_provider=health_provider,
+            alerts_provider=(manager.snapshot
+                             if manager is not None else None))
         server.start()
-    live = LiveObs(ring, pump, server, recorder, enabled_here)
+    live = LiveObs(ring, pump, server, recorder, enabled_here,
+                   evaluator=evaluator, alerts=manager)
+    live._hub_alerts_provider = hub_provider
     if recorder is not None:
         atexit.register(live._atexit)
     _CURRENT = live

@@ -97,7 +97,11 @@ def span_window(cur_snap: Dict, prev_slots: Optional[List[int]],
                 dt_s: float) -> Optional[Dict]:
     """One span's window record out of its cumulative snapshot and the
     previous sample's slot counts: per-slot delta (restart-clamped
-    per slot), window count/rate, window percentiles. None when nothing
+    per slot), window count/rate, window percentiles — and the slot
+    deltas themselves (``slots``), which the burn-rate evaluator
+    (obs.signals, ISSUE 17) counts above an SLO bound: bad/total counts
+    add across windows, so multi-window burn is exact under coalescing
+    where re-averaged percentiles would not be. None when nothing
     happened this window — quiet spans stay out of the export."""
     cur_slots = _telemetry.snapshot_slot_counts(cur_snap)
     if prev_slots is None:
@@ -108,7 +112,8 @@ def span_window(cur_snap: Dict, prev_slots: Optional[List[int]],
     if count <= 0:
         return None
     out = {"count": count,
-           "rate_per_s": round(count / dt_s, 3) if dt_s > 0 else 0.0}
+           "rate_per_s": round(count / dt_s, 3) if dt_s > 0 else 0.0,
+           "slots": slots}
     for q in _PCTS:
         out[f"p{q}_ms"] = slot_percentile(slots, q)
     return out
@@ -339,9 +344,19 @@ class FlightRecorder:
 
     def __init__(self, ring: MetricsRing, path: str,
                  slo_p99_ms: Optional[float] = None,
-                 slo_span: str = "engine.decision_latency"):
+                 slo_span: str = "engine.decision_latency",
+                 slo=None):
+        # ``slo`` (an obs.signals.SloSpec, ISSUE 17) is the declared
+        # single source of truth for the breach latch; ``slo_p99_ms``
+        # is the pre-spec kwarg, kept as a deprecated alias — an
+        # explicit number still wins so existing callers keep their
+        # behavior bit-for-bit.
         self.ring = ring
         self.path = path
+        if slo is not None and slo_p99_ms is None:
+            slo_p99_ms = slo.bound_ms
+            slo_span = slo.span or slo_span
+        self.slo = slo
         self.slo_p99_ms = slo_p99_ms
         self.slo_span = slo_span
         self.dumps = 0

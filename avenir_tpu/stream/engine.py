@@ -540,6 +540,12 @@ class ServingEngine:
                      if hasattr(self.queues, "depth") else None)
             if depth is not None:
                 self.stats.queue_depth = depth
+            # gauge sweep per completed batch (ISSUE 17): the
+            # saturation forecaster differences engine.queue_depth out
+            # of ring windows, so the gauge must move DURING an
+            # overload ramp — run()'s end-of-run publish would hand the
+            # forecast one flat line and then a cliff
+            self._publish_gauges()
         if self._on_batch is not None:
             self._on_batch(len(events))
 

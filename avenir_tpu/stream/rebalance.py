@@ -539,6 +539,15 @@ class Coordinator:
         # monotonic receipt stamps for shipped reports (the aging
         # clock, same NTP-immunity story as last_seen_mono)
         self._report_seen: Dict[int, float] = {}
+        # fleet-level health evaluation (ISSUE 17): armed by
+        # enable_signals(), ticked by observe() on the cadence — the
+        # coordinator is the one process that sees every worker's
+        # report AND the brokers' INFO, so fleet SLO burn and broker
+        # saturation are judged here, not per worker
+        self.signals = None          # obs.signals.SignalEvaluator
+        self.alerts = None           # obs.alerts.AlertManager
+        self._signal_ring = None
+        self._last_signals = 0.0
 
     # -- broker-fleet routing (ISSUE 12) -------------------------------------
 
@@ -709,6 +718,7 @@ class Coordinator:
             self._mirror_stale_homes()
             self.poll_broker_info(now)
             self.poll_worker_reports(now)
+            self.evaluate_signals(now)
             self._migrate_moved()      # routing-change straggler sweep
             return self.step(now)
         except (ConnectionError, OSError):
@@ -836,6 +846,66 @@ class Coordinator:
                 seen=self._report_seen if now is None else None)
         except Exception:
             return self.worker_reports
+
+    # -- fleet health signals (ISSUE 17) -------------------------------------
+
+    def enable_signals(self, slos=None, alerts_path: Optional[str] = None,
+                       high_water: Optional[int] = None,
+                       horizon_s: float = 30.0,
+                       ring_windows: int = 240):
+        """Arm fleet-level SLO burn + saturation evaluation on the
+        coordinator tick. The evaluation input is the MERGED worker
+        report (every worker's spans/counters sum source-for-source)
+        plus the broker INFO depth gauges — the only vantage point that
+        can see "the fleet p99 is burning budget" or "the brokers'
+        event backlog saturates in 20s" as one statement rather than N
+        per-worker ones. ``high_water`` (the admission latch, when the
+        fleet runs one) arms the forecast over ``broker.event_depth``.
+        Returns the :class:`~avenir_tpu.obs.signals.SignalEvaluator`;
+        ``self.alerts`` holds the manager (``subscribe()`` is the
+        autoscaler seam, ROADMAP item 5)."""
+        from avenir_tpu.obs.alerts import AlertManager
+        from avenir_tpu.obs.signals import SignalEvaluator
+        from avenir_tpu.obs.timeseries import MetricsRing
+        self.alerts = AlertManager(path=alerts_path)
+        self.signals = SignalEvaluator(
+            slos=slos, manager=self.alerts, source="fleet",
+            high_water=high_water, depth_gauge="broker.event_depth",
+            horizon_s=horizon_s)
+        self._signal_ring = MetricsRing(max_windows=ring_windows)
+        self._last_signals = 0.0
+        return self.signals
+
+    def fleet_report(self) -> Dict:
+        """The evaluation input: merged worker reports with the broker
+        depth gauges spliced in as fleet scalars. Cheap relative to the
+        tick (the reports are already drained and parsed)."""
+        from avenir_tpu.obs.exporters import merge_reports
+        report = merge_reports(list(self.worker_reports.values()))
+        depths = (self.broker_info or {}).get("queue_depths") or {}
+        by_class = self._depth_by_class(depths)
+        gauges = report.setdefault("gauges", {})
+        gauges.update(by_class)
+        gauges["broker.queue_depth_total"] = sum(by_class.values())
+        return report
+
+    def evaluate_signals(self, now: Optional[float] = None) -> None:
+        """One throttled evaluation tick (observe() calls this): close
+        a window over the merged fleet view, judge it. Best-effort —
+        health evaluation must never sink the control plane."""
+        if self.signals is None:
+            return
+        t_now = time.monotonic() if now is None else now
+        if t_now - self._last_signals < self.cadence_s:
+            return
+        self._last_signals = t_now
+        try:
+            window = self._signal_ring.observe(self.fleet_report(),
+                                               now_mono=t_now)
+            if window is not None:
+                self.signals.on_window(window)
+        except Exception:
+            pass
 
     def _llen_depths(self, client=None) -> Dict[str, int]:
         """Depth map for brokers whose INFO carries no ``queue_depths``

@@ -47,6 +47,7 @@ import argparse
 import contextlib
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -3859,10 +3860,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # curl a port-0 auto-assigned endpoint mid-run.
             from avenir_tpu.obs.live import start_live_obs
             wid = args.worker_id
+            # alerting rides along (ISSUE 17): the declared default
+            # SLOs evaluated per window, transitions logged beside the
+            # flight file (<base>.alerts.jsonl), /alerts + healthz
+            # degradation live on the same scrape port
+            alerts_path = None
+            if args.obs_flight:
+                base = re.sub(r"\.flight\.jsonl$", "", args.obs_flight)
+                alerts_path = base + ".alerts.jsonl"
             live_obs = start_live_obs(
                 port=args.obs_port, flight_path=args.obs_flight,
                 slo_p99_ms=args.obs_slo_ms,
-                health_provider=lambda: {"worker_id": wid})
+                health_provider=lambda: {"worker_id": wid},
+                alerts=True, alerts_path=alerts_path,
+                alert_source=f"w{wid}")
             if live_obs.port is not None:
                 print(json.dumps({"worker": args.worker_id,
                                   "obs_port": live_obs.port}), flush=True)
@@ -3912,6 +3923,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 brokers=args.brokers)
         if live_obs is not None:
             stats["obs_port"] = live_obs.port
+            if live_obs.alerts is not None:
+                # end-of-run health beside the perf stats: firing/
+                # pending counts + any page names this run produced
+                stats["alerts"] = live_obs.alerts.brief()
             live_obs.stop()
         from avenir_tpu.stream import faultnet as _faultnet
         injector = _faultnet.from_env()

@@ -689,6 +689,12 @@ def _online_serving_bench() -> dict:
     # the fixed-bucket dump) — the SLO the serving tier is gated on
     if "decision_latency" in report:
         out["decision_latency"] = report["decision_latency"]
+    # ISSUE 17: the derived-signal verdict over the same run — firing/
+    # pending alert counts, worst SLO burn rate, forecast margin. The
+    # perf trajectory records health, not just speed: a rev that gets
+    # faster while burning budget shows both.
+    if "health" in report:
+        out["health"] = report["health"]
     return out
 
 

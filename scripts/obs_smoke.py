@@ -24,7 +24,10 @@ Three checks, exit 0 only if all pass:
    record path and nothing else. PR 5's own gate (serving_smoke)
    continues to bound the DISABLED engine vs the bare loop, so the
    chain bare -> disabled engine -> enabled engine is covered end to
-   end, each link ≤5%.
+   end, each link ≤5%. The enabled side also runs one SignalEvaluator
+   window (SLO burn rates + saturation forecast, ISSUE 17) per draw
+   inside the timed region, so the bound covers the derived-signal
+   engine too.
 
 Usage: JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 """
@@ -243,8 +246,17 @@ def check_enabled_latency_overhead() -> dict:
     so the measured diff is the record path and nothing else; the
     engine-vs-bare link of the chain stays gated by serving_smoke
     (PR 5's gate). Only the tracer is armed (no hub => no sampler
-    thread): this measures the record path, not a background poller."""
+    thread): this measures the record path, not a background poller.
+
+    ISSUE 17: the enabled side also runs a full SignalEvaluator pass
+    (ring window close + burn rates + saturation forecast + alert
+    bookkeeping) inside the timed region, once per draw — the cadence
+    the production pump evaluates at — so the ≤5% bound certifies the
+    record path AND the derived-signal engine together."""
     from avenir_tpu.obs import telemetry
+    from avenir_tpu.obs.alerts import AlertManager
+    from avenir_tpu.obs.signals import SignalEvaluator
+    from avenir_tpu.obs.timeseries import MetricsRing
     from avenir_tpu.stream.engine import ServingEngine
     from avenir_tpu.stream.loop import InProcQueues
     if telemetry.tracer().enabled:
@@ -253,12 +265,27 @@ def check_enabled_latency_overhead() -> dict:
     queues = InProcQueues()
     engine = ServingEngine("softMax", ACTIONS, dict(LEARNER_CFG),
                            queues, seed=3)
+    ring = MetricsRing()
+    evaluator = SignalEvaluator(manager=AlertManager(), source="smoke",
+                                high_water=1 << 20)
+    # pin the ring baseline so every timed draw closes a real window
+    ring.observe({"spans": {}, "counters": {}, "gauges": {}},
+                 now_mono=time.perf_counter())
+    windows_seen = [0]
 
     def timed(enabled: bool) -> float:
         _fill(queues, N_ENABLED_EVENTS)
         telemetry.enable(enabled)
         t0 = time.perf_counter()
         engine.run()
+        if enabled:
+            window = ring.observe(
+                {"spans": telemetry.tracer().snapshot(),
+                 "counters": {}, "gauges": {}},
+                now_mono=time.perf_counter())
+            if window is not None:
+                evaluator.on_window(window)
+                windows_seen[0] += 1
         elapsed = time.perf_counter() - t0
         telemetry.enable(False)
         return elapsed
@@ -274,6 +301,9 @@ def check_enabled_latency_overhead() -> dict:
         telemetry.tracer().reset()
     if not snap or snap["count"] < N_ENABLED_EVENTS:
         fail(f"enabled engine recorded no per-event latency: {snap}")
+    if windows_seen[0] < 1:
+        fail("signal evaluator never saw a window on the enabled path")
+    out["signal_windows"] = windows_seen[0]
     return out
 
 

@@ -172,25 +172,54 @@ def run_engine(srv, n_events: int):
     return elapsed, stats, actions, round_trips
 
 
-def measure_decision_latency(srv, n_events: int) -> dict:
+def measure_decision_latency(srv, n_events: int) -> tuple:
     """The SLO-gate pass: one telemetry-enabled engine run over the same
     workload, returning the ``engine.decision_latency`` histogram
-    snapshot. Enabled AFTER (and disabled before) every timed gate so the
-    latency pass can never contaminate the throughput/overhead numbers;
-    exactly one observation per event is itself asserted here."""
+    snapshot plus the derived-signal health record (ISSUE 17): the run's
+    spans closed into one ring window and judged by the declared SLOs —
+    firing/pending alert counts, the worst burn rate, and the forecast
+    margin land in the JSON so the perf trajectory records health, not
+    just speed. Enabled AFTER (and disabled before) every timed gate so
+    the latency pass can never contaminate the throughput/overhead
+    numbers; exactly one observation per event is itself asserted
+    here."""
     from avenir_tpu.obs import telemetry
+    from avenir_tpu.obs.alerts import AlertManager
+    from avenir_tpu.obs.signals import SignalEvaluator
+    from avenir_tpu.obs.timeseries import MetricsRing
     telemetry.enable(True)
+    ring = MetricsRing()
+    manager = AlertManager()
+    evaluator = SignalEvaluator(manager=manager, source="smoke")
+
+    def observe(mono: float):
+        return ring.observe({"spans": telemetry.tracer().snapshot(),
+                             "counters": {}, "gauges": {}},
+                            now_mono=mono)
+
+    observe(time.perf_counter())      # baseline: the delta needs two ends
     try:
         _, stats, _, _ = run_engine(srv, n_events)
     finally:
+        window = observe(time.perf_counter())
         telemetry.enable(False)
+    if window is not None:
+        evaluator.on_window(window)
+    forecast = evaluator.snapshot().get("forecast") or {}
+    alerts = manager.snapshot()
+    health = {
+        "alerts_firing": alerts["counts"]["firing"],
+        "alerts_pending": alerts["counts"]["pending"],
+        "worst_burn": round(evaluator.worst_burn(), 4),
+        "forecast_eta_s": forecast.get("eta_s"),
+    }
     snap = telemetry.tracer().snapshot().get("engine.decision_latency")
     telemetry.tracer().reset()
     if not snap:
         fail("telemetry-enabled engine recorded no decision latency")
     if snap["count"] != n_events:
         fail(f"decision_latency count {snap['count']} != events {n_events}")
-    return snap
+    return snap, health
 
 
 def _bare_pipelined_run(learner, queues, batch_size: int,
@@ -309,11 +338,12 @@ def main() -> int:
         # every other timing gate here: a co-tenant load spike during the
         # single pass inflates p99 ~10x and must not fail CI — the better
         # of two passes is still a real measured distribution.
-        latency = measure_decision_latency(srv, args.events)
+        latency, health = measure_decision_latency(srv, args.events)
         if latency["p99_ms"] > args.p99_ms and not args.skip_gates:
-            retry = measure_decision_latency(srv, args.events)
+            retry, retry_health = measure_decision_latency(srv,
+                                                           args.events)
             if retry["p99_ms"] < latency["p99_ms"]:
-                latency = retry
+                latency, health = retry, retry_health
 
     if sync_actions != eng_actions:
         for i, (a, b) in enumerate(zip(sync_actions, eng_actions)):
@@ -378,6 +408,7 @@ def main() -> int:
             "p99_bound_ms": args.p99_ms,
             "buckets": latency.get("buckets", {}),
         },
+        "health": health,
     }))
     return 0
 

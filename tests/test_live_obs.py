@@ -724,6 +724,29 @@ class TestPrometheusEscaping:
         assert set(sources) == set(self.HOSTILE)
         assert sources['quo"te'] == 1.0
 
+    def test_alert_label_round_trip_hostile_values(self):
+        """ISSUE 17: alert name/source labels survive the exposition
+        round trip even with quotes, backslashes and newlines — one
+        well-formed ``avenir_alert`` sample line per tracked alert."""
+        report = {"spans": {}, "counters": {}, "gauges": {},
+                  "alerts": [{"name": n, "source": s,
+                              "state": "firing", "severity": "page"}
+                             for n in self.HOSTILE
+                             for s in self.HOSTILE]}
+        text = E.prometheus_text(report)
+        for line in text.splitlines():
+            assert "\n" not in line
+        samples = E.parse_prometheus_text(text)
+        alert = [(labels, value) for name, labels, value in samples
+                 if name == "avenir_alert"]
+        assert {(labels["name"], labels["source"])
+                for labels, _ in alert} == {
+                    (n, s) for n in self.HOSTILE for s in self.HOSTILE}
+        # the value is the constant 1; state/severity ride as labels
+        assert {value for _, value in alert} == {1.0}
+        assert {labels["state"] for labels, _ in alert} == {"firing"}
+        assert {labels["severity"] for labels, _ in alert} == {"page"}
+
     def test_parser_rejects_malformed(self):
         with pytest.raises(ValueError):
             E.parse_prometheus_text('m{a=b} 1')

@@ -1,0 +1,519 @@
+"""Fleet health signals (ISSUE 17): SLO burn-rate math (coalescing
+consistency, restart clamping, zero-budget ordering), saturation
+forecasting (gap widening, flat/draining -> None, shed-rate pressure),
+the alert state machine + every delivery sink, and the seeded overload
+scenario — the forecast pages strictly BEFORE the admission latch sheds
+its first event, and resolves after recovery."""
+
+import dataclasses
+import json
+import math
+import os
+import random
+import urllib.request
+
+import pytest
+
+from avenir_tpu.obs import exporters as E
+from avenir_tpu.obs import telemetry as T
+from avenir_tpu.obs import timeseries as TS
+from avenir_tpu.obs.alerts import AlertManager
+from avenir_tpu.obs.signals import (DEFAULT_SLOS, SaturationForecaster,
+                                    SignalEvaluator, SloSpec, burn_rate,
+                                    primary_latency_slo, slot_bad_count,
+                                    window_badness)
+
+N_SLOTS = len(T.BUCKET_BOUNDS_MS) + 1          # finite buckets + overflow
+
+
+def _span_window(slots, dt_s=1.0, t=0.0, rates=None, gauges=None):
+    """A ring-shaped window carrying one decision-latency span delta."""
+    return {"t": t, "dt_s": dt_s,
+            "spans": {"engine.decision_latency":
+                      {"count": sum(slots), "slots": list(slots)}},
+            "counters": {}, "gauges": dict(gauges or {}),
+            "rates": dict(rates or {})}
+
+
+class TestBurnRateMath:
+    def test_burn_scale_total_ordered(self):
+        assert burn_rate(0, 0, 0.01) == 0.0           # no traffic
+        assert burn_rate(1, 100, 0.01) == pytest.approx(1.0)
+        assert burn_rate(2, 100, 0.01) == pytest.approx(2.0)
+        # zero budget: inf on ANY badness, 0.0 otherwise — never NaN
+        assert burn_rate(1, 100, 0.0) == math.inf
+        assert burn_rate(0, 100, 0.0) == 0.0
+        assert burn_rate(0, 0, 0.0) == 0.0
+
+    def test_slot_bad_count_matches_bucket_edges(self):
+        h = T.LatencyHistogram()
+        for _ in range(1000):
+            h.record(1.0)
+        for _ in range(30):
+            h.record(900.0)
+        slots = T.snapshot_slot_counts(h.snapshot())
+        assert slot_bad_count(slots, 500.0) == 30
+        assert slot_bad_count(slots, 0.0005) == 1030  # everything is bad
+        # the overflow slot is bad for any realistic bound
+        overflow = [0] * N_SLOTS
+        overflow[-1] = 7
+        assert slot_bad_count(overflow, 500.0) == 7
+
+    def test_burn_consistent_under_window_coalescing(self):
+        """The tentpole property: bad/total ADD across windows, so 12
+        one-second windows and 3 coalesced four-second windows of the
+        SAME traffic yield the same slow burn (percentile averaging,
+        the naive approach, fails this)."""
+        rng = random.Random(17)
+        spec = SloSpec(name="p99", span="engine.decision_latency",
+                       bound_ms=500.0, budget=0.01, slow_windows=12)
+        windows = []
+        for i in range(12):
+            slots = [0] * N_SLOTS
+            for _ in range(rng.randint(5, 40)):
+                slots[rng.randrange(N_SLOTS)] += 1
+            windows.append(_span_window(slots, t=float(i)))
+        coalesced = []
+        for g in range(0, 12, 4):
+            agg = [0] * N_SLOTS
+            for w in windows[g:g + 4]:
+                for j, c in enumerate(
+                        w["spans"]["engine.decision_latency"]["slots"]):
+                    agg[j] += c
+            coalesced.append(_span_window(agg, dt_s=4.0, t=float(g)))
+        fine = SignalEvaluator(slos=[spec])
+        for w in windows:
+            fine.on_window(w)
+        coarse = SignalEvaluator(
+            slos=[dataclasses.replace(spec, slow_windows=3)])
+        for w in coalesced:
+            coarse.on_window(w)
+        slow_fine = fine.snapshot()["slos"][0]["slow_burn"]
+        slow_coarse = coarse.snapshot()["slos"][0]["slow_burn"]
+        assert slow_fine > 0                  # the draw really had burn
+        assert slow_fine == pytest.approx(slow_coarse)
+
+    def test_counter_restart_cannot_manufacture_burn(self):
+        """A worker restart drops the cumulative shed gauge backward;
+        the window spanning the restart must burn nothing (the ring's
+        per-slot/per-gauge clamps feed the badness math)."""
+        ring = TS.MetricsRing()
+        ring.observe({"spans": {}, "counters": {},
+                      "gauges": {"engine.shed_total": 500}}, now_mono=0.0)
+        w = ring.observe({"spans": {}, "counters": {},
+                          "gauges": {"engine.shed_total": 3}},
+                         now_mono=1.0)
+        shed_spec = next(s for s in DEFAULT_SLOS
+                         if s.name == "shed_fraction")
+        bad, total = window_badness(shed_spec, w)
+        assert bad == 0.0
+        assert burn_rate(bad, total, shed_spec.budget) == 0.0
+
+    def test_shed_fraction_counts_against_admitted(self):
+        """Forward path: 50 shed over a 2s window against 150 admitted
+        decisions -> bad 50 of 200 popped, inf burn at zero budget."""
+        h = T.LatencyHistogram()
+        ring = TS.MetricsRing()
+        ring.observe({"spans": {"engine.decision_latency": h.snapshot()},
+                      "counters": {},
+                      "gauges": {"engine.shed_total": 0}}, now_mono=0.0)
+        for _ in range(150):
+            h.record(1.0)
+        w = ring.observe(
+            {"spans": {"engine.decision_latency": h.snapshot()},
+             "counters": {}, "gauges": {"engine.shed_total": 50}},
+            now_mono=2.0)
+        shed_spec = next(s for s in DEFAULT_SLOS
+                         if s.name == "shed_fraction")
+        bad, total = window_badness(shed_spec, w)
+        assert bad == pytest.approx(50.0)
+        assert total == pytest.approx(200.0)
+        assert burn_rate(bad, total, shed_spec.budget) == math.inf
+
+    def test_primary_latency_slo_selection(self):
+        assert primary_latency_slo().name == "admitted_p99"
+        assert primary_latency_slo([SloSpec(name="x", bad_rate="shed_per_s",
+                                            budget=0.0)]) is None
+
+
+class TestSaturationForecaster:
+    @staticmethod
+    def _w(depth, dt=1.0, shed=0.0):
+        return {"dt_s": dt, "gauges": {"engine.queue_depth": depth},
+                "rates": {"shed_per_s": shed}, "spans": {},
+                "counters": {}}
+
+    def test_flat_and_draining_forecast_none(self):
+        f = SaturationForecaster(high_water=512)
+        for d in (100, 100, 100):
+            out = f.update(self._w(d))
+        assert out["eta_s"] is None and not out["alarm"]
+        for d in (80, 60, 40):
+            out = f.update(self._w(d))
+        assert out["eta_s"] is None and not out["alarm"]
+
+    def test_ramp_eta_within_horizon_alarms(self):
+        f = SaturationForecaster(high_water=512, horizon_s=30.0)
+        f.update(self._w(100))
+        out = f.update(self._w(200))          # +100/s toward 512
+        assert out["pressure_per_s"] == pytest.approx(100.0)
+        assert out["eta_s"] == pytest.approx((512 - 200) / 100.0)
+        assert out["alarm"]
+
+    def test_slow_ramp_outside_horizon_forecasts_without_alarm(self):
+        f = SaturationForecaster(high_water=100000, horizon_s=30.0)
+        f.update(self._w(100))
+        out = f.update(self._w(110))          # +10/s, ETA ~2.8 hours
+        assert out["eta_s"] == pytest.approx((100000 - 110) / 10.0)
+        assert not out["alarm"]
+
+    def test_gap_widening_scales_slope_by_real_dt(self):
+        """The same depth rise over a 10x longer measured gap is a 10x
+        smaller slope — dt is the wall clock, never a nominal tick."""
+        fast = SaturationForecaster(high_water=10000)
+        fast.update(self._w(0))
+        a = fast.update(self._w(100, dt=1.0))
+        slow = SaturationForecaster(high_water=10000)
+        slow.update(self._w(0, dt=10.0))
+        b = slow.update(self._w(100, dt=10.0))
+        assert a["slope_per_s"] == pytest.approx(100.0)
+        assert b["slope_per_s"] == pytest.approx(10.0)
+        assert b["eta_s"] == pytest.approx(a["eta_s"] * 10.0)
+
+    def test_saturated_now_is_eta_zero(self):
+        f = SaturationForecaster(high_water=100)
+        out = f.update(self._w(150))
+        assert out["saturated"] and out["eta_s"] == 0.0 and out["alarm"]
+
+    def test_shed_rate_keeps_pressure_during_clamped_depth(self):
+        """Once shedding clamps the depth, the raw slope flattens — but
+        arrivals being shed are still pressure, so the forecast must
+        keep alarming through the overload instead of flapping."""
+        quiet = SaturationForecaster(high_water=1000, horizon_s=30.0)
+        loud = SaturationForecaster(high_water=1000, horizon_s=30.0)
+        for f, shed in ((quiet, 0.0), (loud, 100.0)):
+            f.update(self._w(500))
+            f.update(self._w(512))
+            out = f.update(self._w(512, shed=shed))
+        assert not quiet.snapshot()["alarm"]
+        out = loud.snapshot()
+        assert out["pressure_per_s"] > 100.0
+        assert out["alarm"]
+
+
+def _sig(active, name="slo:x", source="engine", severity="page",
+         payload=None):
+    return {"name": name, "source": source, "severity": severity,
+            "active": active, "payload": payload or {}}
+
+
+class TestAlertManager:
+    def test_pending_firing_resolved_lifecycle(self):
+        m = AlertManager(pending_windows=1, resolve_windows=2)
+        m.observe([_sig(True)], now=1.0)      # pending: one window pages nobody
+        assert m.firing() == []
+        assert m.snapshot()["counts"]["pending"] == 1
+        m.observe([_sig(True)], now=2.0)      # second consecutive: fires
+        assert m.firing() == ["slo:x"]
+        m.observe([_sig(False)], now=3.0)     # one quiet window: still firing
+        assert m.firing() == ["slo:x"]
+        m.observe([_sig(False)], now=4.0)     # resolve_windows quiet: resolves
+        assert m.firing() == []
+        [a] = m.snapshot()["alerts"]
+        assert a["state"] == "resolved" and a["episodes"] == 1
+        assert a["fired_at"] == 2.0 and a["resolved_at"] == 4.0
+
+    def test_one_window_blip_never_fires_and_drops(self):
+        m = AlertManager(pending_windows=1, resolve_windows=2)
+        m.observe([_sig(True)], now=1.0)
+        m.observe([_sig(False)], now=2.0)
+        m.observe([_sig(False)], now=3.0)
+        snap = m.snapshot()
+        assert snap["alerts"] == []           # noise, not an episode
+        assert snap["events_total"] == 1      # but the blip is on record
+
+    def test_refire_is_new_episode_and_absent_signal_goes_quiet(self):
+        m = AlertManager(pending_windows=0, resolve_windows=1)
+        m.observe([_sig(True)], now=1.0)
+        assert m.firing() == ["slo:x"]
+        m.observe([], now=2.0)                # absent counts as inactive
+        assert m.firing() == []
+        m.observe([_sig(True)], now=3.0)
+        [a] = m.snapshot()["alerts"]
+        assert a["state"] == "firing" and a["episodes"] == 2
+
+    def test_dedup_by_name_and_source(self):
+        m = AlertManager(pending_windows=0)
+        m.observe([_sig(True, source="w0"), _sig(True, source="w1")],
+                  now=1.0)
+        samples = m.alert_samples()
+        assert [(s["source"], s["state"]) for s in samples] == [
+            ("w0", "firing"), ("w1", "firing")]
+        assert m.firing() == ["slo:x"]        # names dedup in the set
+
+    def test_severity_upgrades_only_within_episode(self):
+        m = AlertManager(pending_windows=0, resolve_windows=3)
+        m.observe([_sig(True, severity="warn")], now=1.0)
+        m.observe([_sig(True, severity="page")], now=2.0)
+        [a] = m.snapshot()["alerts"]
+        assert a["severity"] == "page"
+        m.observe([_sig(True, severity="warn")], now=3.0)
+        [a] = m.snapshot()["alerts"]
+        assert a["severity"] == "page"        # the page someone was woken for
+
+    def test_cooldown_suppresses_notification_not_bookkeeping(self):
+        m = AlertManager(pending_windows=0, resolve_windows=1,
+                         cooldown_s=100.0)
+        notes = []
+        m.subscribe(lambda a, tr: notes.append(tr))
+        m.observe([_sig(True)], now=1.0)      # episode 1: notified
+        m.observe([_sig(False)], now=2.0)
+        m.observe([_sig(True)], now=3.0)      # re-fire inside cooldown
+        assert m.firing() == ["slo:x"]        # state machine proceeds
+        [a] = m.snapshot()["alerts"]
+        assert a["episodes"] == 2
+        assert notes.count("firing") == 1     # the human was paged once
+
+    def test_subscriber_exception_is_isolated(self):
+        m = AlertManager(pending_windows=0)
+        seen = []
+        m.subscribe(lambda a, tr: (_ for _ in ()).throw(RuntimeError()))
+        m.subscribe(lambda a, tr: seen.append(tr))
+        m.observe([_sig(True)], now=1.0)
+        assert "firing" in seen
+
+    def test_page_firing_latches_flight_dump(self, tmp_path):
+        ring = TS.MetricsRing()
+        ring.observe({"spans": {}, "counters": {}, "gauges": {}},
+                     now_mono=0.0)
+        ring.observe({"spans": {}, "counters": {}, "gauges": {}},
+                     now_mono=1.0)
+        path = str(tmp_path / "page.flight.jsonl")
+        rec = TS.FlightRecorder(ring, path)
+        TS.arm_flight_recorder(rec)
+        try:
+            m = AlertManager(pending_windows=0)
+            m.observe([_sig(True, name="slo:y", severity="warn")],
+                      now=1.0)
+            assert rec.dumps == 0             # warn never wakes the recorder
+            m.observe([_sig(True, severity="page")], now=2.0)
+            assert rec.dumps == 1
+        finally:
+            TS.arm_flight_recorder(None)
+        meta = json.loads(open(path).readline())
+        assert meta["reason"] == "alert:slo:x"
+
+    def test_jsonl_transition_log_round_trips(self, tmp_path):
+        path = str(tmp_path / "m.jsonl.alerts.jsonl")
+        m = AlertManager(path=path, pending_windows=0, resolve_windows=1)
+        m.observe([_sig(True)], now=1.0)
+        m.observe([_sig(False)], now=2.0)
+        lines = E.read_jsonl(path)
+        assert lines[0]["type"] == "alerts-meta"
+        assert lines[0]["format"] == "avenir-alerts-v1"
+        transitions = [ev["transition"] for ev in lines[1:]]
+        assert transitions == ["pending", "firing", "resolved"]
+        assert all(ev["name"] == "slo:x" and ev["source"] == "engine"
+                   for ev in lines[1:])
+
+
+class TestAlertSinks:
+    def test_hub_report_prom_and_events_round_trip(self):
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=60.0)
+        provider = None
+        try:
+            m = AlertManager(pending_windows=0)
+            provider = m.alert_samples
+            hub.set_alerts_provider(provider)
+            m.observe([_sig(True)], now=1.0)
+            report = hub.report()
+            assert report["alerts"] == [
+                {"name": "slo:x", "source": "engine",
+                 "state": "firing", "severity": "page"}]
+            # the alert.* gauges landed through the live-hub publish
+            assert report["gauges"]["alert.firing"] == 1.0
+            # events round trip (the .jsonl wire)
+            rt = E.events_to_report(E.report_to_events(report))
+            assert rt["alerts"] == report["alerts"]
+            # prometheus round trip (the .prom / /metrics wire)
+            samples = E.parse_prometheus_text(E.prometheus_text(report))
+            alert = [(labels, v) for name, labels, v in samples
+                     if name == "avenir_alert"]
+            assert alert == [({"name": "slo:x", "source": "engine",
+                               "state": "firing", "severity": "page"},
+                              1.0)]
+            # fleet merge concatenates per-worker samples
+            merged = E.merge_reports([report, report])
+            assert len(merged["alerts"]) == 2
+        finally:
+            if provider is not None:
+                hub.clear_alerts_provider(provider)
+            hub.disable()
+            hub.reset()
+
+    def test_start_live_obs_arms_alerting(self, tmp_path):
+        from avenir_tpu.obs import live as L
+        apath = str(tmp_path / "m.jsonl.alerts.jsonl")
+        bundle = L.start_live_obs(port=0, interval_s=0.02,
+                                  alerts_path=apath, high_water=100)
+        try:
+            assert bundle.alerts is not None
+            assert bundle.evaluator is not None
+            assert bundle.evaluator.forecaster is not None
+            base = f"http://localhost:{bundle.port}"
+            body = json.loads(urllib.request.urlopen(
+                base + "/alerts", timeout=10).read())
+            assert body["format"] == "avenir-alerts-v1"
+            assert body["firing"] == []
+            health = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=10).read())
+            assert health["ok"] and health["alerts_firing"] == 0
+            assert "alerts" in E.hub().report()     # provider installed
+        finally:
+            bundle.stop()
+        assert os.path.exists(apath)          # final flush on stop
+        E.hub().reset()
+        T.tracer().reset()
+
+
+def _jsonl_firing(path):
+    """The firing set per the transition log: names whose LAST
+    transition is ``firing``."""
+    last = {}
+    for ev in E.read_jsonl(path):
+        if ev.get("type") == "alert":
+            last[(ev["name"], ev["source"])] = ev["transition"]
+    return sorted({name for (name, _), tr in last.items()
+                   if tr == "firing"})
+
+
+class TestOverloadScenario:
+    def test_forecast_pages_before_first_shed_then_resolves(self,
+                                                            tmp_path):
+        """The acceptance scenario: a seeded 4x overload against a real
+        ServingEngine + AdmissionControl. The saturation forecast must
+        fire while ``engine.shed_total`` is still 0 (paging before the
+        latch trips), the page must latch the armed flight dump, every
+        sink (/alerts, the alerts JSONL, the rendered .prom) must agree
+        on the firing set at that instant, healthz must degrade, and
+        recovery must resolve the episode."""
+        from avenir_tpu.obs.live import ObsHttpServer
+        from avenir_tpu.stream.engine import (AdmissionControl,
+                                              ServingEngine)
+        from avenir_tpu.stream.loop import InProcQueues
+
+        hub = E.hub()
+        hub.reset()
+        hub.enable(sample_interval_s=60.0)
+        ring = TS.MetricsRing()
+        alerts_path = str(tmp_path / "m.jsonl.alerts.jsonl")
+        flight_path = str(tmp_path / "m.jsonl.flight.jsonl")
+        manager = AlertManager(path=alerts_path, pending_windows=0,
+                               resolve_windows=3)
+        evaluator = SignalEvaluator(manager=manager, source="engine",
+                                    high_water=512, horizon_s=30.0)
+        recorder = TS.FlightRecorder(
+            ring, flight_path, slo=primary_latency_slo(DEFAULT_SLOS))
+        TS.arm_flight_recorder(recorder)
+        hub_provider = manager.alert_samples
+        hub.set_alerts_provider(hub_provider)
+        server = ObsHttpServer(ring=ring, port=0,
+                               alerts_provider=manager.snapshot).start()
+
+        # prefill must exceed one pop cap: the pipelined loop pops batch
+        # n+1 BEFORE batch n's _complete (where on_batch produces), so a
+        # one-cap prefill reads empty one iteration early and run() exits
+        q = InProcQueues()
+        for i in range(128):
+            q.push_event(f"e{i}")
+        produced = [128]
+        tick = [0.0]
+        capture = {}
+        PRODUCE_MAX = 2048
+
+        def observe_window():
+            # deterministic 1s windows: the producer stamps the
+            # post-push depth, so the forecaster sees the true ramp
+            tick[0] += 1.0
+            hub.set_gauge("engine.queue_depth", float(q.depth() or 0))
+            w = ring.observe(hub.report(), now_mono=tick[0])
+            if w is not None:
+                evaluator.on_window(w)
+
+        def on_batch(n):
+            k = min(4 * n, PRODUCE_MAX - produced[0])   # the 4x overload
+            for i in range(k):
+                q.push_event(f"p{produced[0] + i}")
+            produced[0] += k
+            observe_window()
+            if ("at_fire" not in capture
+                    and "saturation_forecast" in manager.firing()):
+                base = f"http://localhost:{server.port}"
+                http_alerts = json.loads(urllib.request.urlopen(
+                    base + "/alerts", timeout=10).read())
+                health = json.loads(urllib.request.urlopen(
+                    base + "/healthz", timeout=10).read())
+                prom = E.parse_prometheus_text(
+                    E.prometheus_text(hub.report()))
+                capture["at_fire"] = {
+                    "shed_gauge": hub.report()["gauges"].get(
+                        "engine.shed_total", 0.0),
+                    "http": http_alerts,
+                    "health": health,
+                    "prom_firing": sorted(
+                        labels["name"] for name, labels, _ in prom
+                        if name == "avenir_alert"
+                        and labels["state"] == "firing"),
+                    "jsonl_firing": _jsonl_firing(alerts_path),
+                    "flight_reason": (
+                        json.loads(open(flight_path).readline())["reason"]
+                        if os.path.exists(flight_path) else None),
+                }
+
+        adm = AdmissionControl(high_water=512, low_water=128,
+                               policy="drop-oldest", shed_chunk=256)
+        eng = ServingEngine(
+            "softMax", ["a", "b", "c"],
+            {"current.decision.round": 1, "batch.size": 2},
+            q, seed=7, admission=adm, on_batch=on_batch)
+        try:
+            observe_window()                  # pin the ring baseline
+            stats = eng.run()
+            # recovery: quiet evaluation rounds after the drain — the
+            # zero-budget shed SLO stays warn-active until its 12-deep
+            # slow-burn history flushes, then needs 3 resolve rounds
+            for _ in range(20):
+                if not manager.firing():
+                    break
+                observe_window()
+        finally:
+            server.stop()
+            TS.arm_flight_recorder(None)
+            hub.clear_alerts_provider(hub_provider)
+            hub.disable()
+            hub.reset()
+
+        assert stats.shed_total > 0           # the overload was real
+        at = capture.get("at_fire")
+        assert at is not None, "saturation forecast never fired"
+        # ...and it fired strictly BEFORE the first shed
+        assert at["shed_gauge"] == 0.0
+        # the page latched the armed flight dump, attributed to itself
+        assert at["flight_reason"] == "alert:saturation_forecast"
+        # every sink agreed on the firing set at that instant
+        assert "saturation_forecast" in at["http"]["firing"]
+        assert (at["http"]["firing"] == at["prom_firing"]
+                == at["jsonl_firing"])
+        # healthz degraded: a page flips the liveness bit
+        assert at["health"]["ok"] is False
+        assert at["health"]["degraded"] is True
+        assert "saturation_forecast" in at["health"]["paging"]
+        # recovery resolved everything, re-armed for a new episode
+        assert manager.firing() == []
+        states = {(a["name"], a["source"]): a["state"]
+                  for a in manager.snapshot()["alerts"]}
+        assert states[("saturation_forecast", "engine")] == "resolved"
+        # the shed episode itself paged (zero-budget SLO) and resolved
+        assert states.get(("slo:shed_fraction", "engine")) == "resolved"
