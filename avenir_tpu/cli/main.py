@@ -58,6 +58,17 @@ def run_bayesian_distribution(conf: JobConfig, in_path: str, out_path: str) -> N
     :115-131): rows are ``text<delim>classVal`` and every token becomes a bin
     of the text feature at ordinal 1.
     """
+    # ISSUE 18: the mainline mode runs as a plan (cacheable staged
+    # table, per-node spans); non-plan-capable modes and
+    # plan.enable=false fall through to the hand-wired body below,
+    # which stays as the byte-identity oracle
+    from avenir_tpu.cli import plans as cli_plans
+    if cli_plans.plan_enabled(conf):
+        plan = cli_plans.build_nb_plan(conf, in_path, out_path)
+        if plan is not None:
+            from avenir_tpu.plan.scheduler import execute
+            execute(plan)
+            return
     from avenir_tpu.models import naive_bayes as nb
     if not conf.get_bool("tabular.input", True):
         from avenir_tpu.text import text_bayes
@@ -798,6 +809,17 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
     adaptation: the reference reads it from precomputed neighbor records,
     :162-169, which this fused pipeline no longer has).
     """
+    # ISSUE 18: classification (merged AND prefetch-sharded) runs as a
+    # plan — the staged train table is content-addressed, so a KNN after
+    # an NB over the same train data pays zero encode. Neighbor-records
+    # and regression modes keep the hand-wired body.
+    from avenir_tpu.cli import plans as cli_plans
+    if cli_plans.plan_enabled(conf):
+        plan = cli_plans.build_knn_plan(conf, in_path, out_path)
+        if plan is not None:
+            from avenir_tpu.plan.scheduler import execute
+            execute(plan)
+            return
     import numpy as np
     import jax.numpy as jnp
     from avenir_tpu.models import knn
@@ -1095,6 +1117,13 @@ def run_forest_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
     device program for `best` selection) plus the TreeBuilder keys; the
     artifact stacks TreeBuilder's JSON tree format, written
     rename-atomically."""
+    from avenir_tpu.cli import plans as cli_plans
+    if cli_plans.plan_enabled(conf):
+        plan = cli_plans.build_forest_plan(conf, in_path, out_path)
+        if plan is not None:
+            from avenir_tpu.plan.scheduler import execute
+            execute(plan)
+            return
     import json
     from avenir_tpu.models import forest as F
     from avenir_tpu.models.tree import TreeConfig
@@ -1169,6 +1198,16 @@ def run_boost_builder(conf: JobConfig, in_path: str, out_path: str) -> None:
     ``forest.boost.reg.lambda`` plus the shared TreeBuilder split keys;
     ``streaming.train=true`` boosts out-of-core over an MR part-file dir
     via the cached-chunk fold (byte-identical model)."""
+    # ISSUE 18: the in-core mode runs as a plan with the binned catalog
+    # as its own content-addressed stage node — hyperparameter re-runs
+    # over the same data re-bin nothing
+    from avenir_tpu.cli import plans as cli_plans
+    if cli_plans.plan_enabled(conf):
+        plan = cli_plans.build_boost_plan(conf, in_path, out_path)
+        if plan is not None:
+            from avenir_tpu.plan.scheduler import execute
+            execute(plan)
+            return
     import json
     from avenir_tpu.models import boost as B
     cfg = _boost_config(conf)
@@ -2061,6 +2100,13 @@ def run_mutual_information(conf: JobConfig, in_path: str,
     (reference MutualInformation job). Output: per-feature class MI lines,
     pair MI lines, then the chosen selection algorithm's ranking
     (``mi.score.algorithms`` names match the reference registry)."""
+    from avenir_tpu.cli import plans as cli_plans
+    if cli_plans.plan_enabled(conf):
+        plan = cli_plans.build_mi_plan(conf, in_path, out_path)
+        if plan is not None:
+            from avenir_tpu.plan.scheduler import execute
+            execute(plan)
+            return
     from avenir_tpu.explore import mutual_information as mi
     from avenir_tpu.utils.dataset import part_file_paths
     shard_paths = part_file_paths(in_path)
@@ -2091,7 +2137,13 @@ def _write_mi_output(conf: JobConfig, out_path: str, dists) -> None:
     """Scores + file emission shared by the merged and per-shard MI paths
     (identical ``dists`` arrays -> identical bytes)."""
     from avenir_tpu.explore import mutual_information as mi
-    scores = mi.compute_scores(dists)
+    _emit_mi_scores(conf, out_path, mi.compute_scores(dists))
+
+
+def _emit_mi_scores(conf: JobConfig, out_path: str, scores) -> None:
+    """The emission half alone — the plan path's reduce node computes
+    scores separately (its own telemetry span), then writes here."""
+    from avenir_tpu.explore import mutual_information as mi
     delim = conf.get("field.delim.out", ",")
     # the reference's key/value names (MutualInformation.java:452-455,
     # resource/hosp.properties) with this build's camelCase names as aliases
@@ -2368,6 +2420,12 @@ def main(argv: List[str] = None) -> int:
                              "Perfetto) — the flag form of the "
                              "profile.trace.dir config key, mirroring "
                              "--metrics-out")
+    parser.add_argument("--explain", action="store_true",
+                        help="print the verb's execution plan (nodes, "
+                             "edges, fingerprints, cache hit/miss per "
+                             "node) WITHOUT executing it; with "
+                             "--metrics-out PATH the plan JSON lands at "
+                             "PATH.plan.json — ISSUE 18")
     parser.add_argument("--resume", action="store_true",
                         help="resume a killed sharded batch job from its "
                              "per-shard completion journal (<out>.shards/): "
@@ -2383,6 +2441,32 @@ def main(argv: List[str] = None) -> int:
         conf.set(key, value)
     if args.resume:
         conf.set("job.resume", "true")
+
+    if args.explain:
+        # plan-only mode: build, print, optionally dump JSON — never
+        # execute (and never perturb cache statistics: the renderer
+        # probes with the non-mutating `contains`)
+        from avenir_tpu.cli import plans as cli_plans
+        from avenir_tpu.plan import explain as plan_explain
+        if not cli_plans.plan_enabled(conf):
+            raise ValueError("--explain needs the plan path "
+                             "(plan.enable is false)")
+        plan = cli_plans.build_plan(args.verb, conf, args.input,
+                                    args.output)
+        if plan is None:
+            raise ValueError(
+                f"--explain: {args.verb} does not run on the plan path "
+                "with this config (plan-capable verbs: "
+                + ", ".join(sorted(cli_plans._BUILDERS)) + "; text/"
+                "streaming/neighbor-record/regression/journaled-shard "
+                "modes keep the hand-wired body)")
+        print(plan_explain.render(plan))
+        if args.metrics_out:
+            from avenir_tpu.utils.atomicio import atomic_json_dump
+            atomic_json_dump(plan_explain.plan_json(plan),
+                             args.metrics_out + ".plan.json",
+                             indent=2, sort_keys=True)
+        return 0
 
     # observability (SURVEY.md §5): the reference's ``debug.on`` log switch
     # plus the TPU-native additions — an XLA trace when

@@ -1,0 +1,428 @@
+"""Per-verb plan constructors (ISSUE 18).
+
+Each builder returns a :class:`avenir_tpu.plan.Plan` mirroring the
+verb's legacy hand-wired body node for node, or ``None`` when the
+requested mode is not plan-capable (text NB, streaming trains, the
+neighbor-records and regression KNN modes, the journaled sharded
+NB/MI trains) — the caller then falls through to the legacy body, which
+stays in place both as the fallback and as the byte-identity oracle
+(``plan.enable=false``).
+
+Builders read config EXACTLY like the legacy bodies (same keys, same
+defaults) and defer imports into node closures, so constructing a plan
+for ``--explain`` touches no model code. cli/main.py imports this
+module lazily inside verb functions; this module imports cli.main
+lazily inside closures — no import cycle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from avenir_tpu.plan import fingerprint as FP
+from avenir_tpu.plan.graph import Plan
+from avenir_tpu.utils.config import JobConfig
+
+
+def plan_enabled(conf: JobConfig) -> bool:
+    """``plan.enable`` (default on). False keeps the legacy hand-wired
+    verb bodies — the byte-identity oracle the plan tests compare
+    against."""
+    return conf.get_bool("plan.enable", True)
+
+
+def _new_plan(conf: JobConfig, verb: str) -> Plan:
+    budget = conf.get_int("plan.cache.budget.bytes", -1)
+    return Plan(verb,
+                cache_enabled=conf.get_bool("plan.cache", True),
+                cache_budget_bytes=budget if budget >= 0 else None)
+
+
+def _add_staged_train(plan: Plan, conf: JobConfig, in_path: str, *,
+                      with_labels: bool = True) -> str:
+    """The shared encode:train -> stage:train pair. Returns the stage
+    fingerprint (dependent tables chain to it). The fingerprint is
+    verb-independent on purpose: NB's staged train table IS KNN's —
+    that equality is the chained-verbs cache hit."""
+    fp = FP.staged_table_fingerprint(conf, in_path,
+                                     with_labels=with_labels)
+
+    def _encode(values):
+        from avenir_tpu.cli import main as cli_main
+        return cli_main._load_table(conf, in_path)
+
+    def _stage(values):
+        fz, rows = values["train.rows"]
+        return fz, fz.transform(rows, with_labels=with_labels)
+
+    plan.add(name="encode:train", kind="encode", run=_encode,
+             output="train.rows", edge_type="row-batch",
+             detail=f"parse + featurizer fit over {in_path}")
+    plan.add(name="stage:train", kind="stage", run=_stage,
+             inputs=("train.rows",), output="train.table",
+             edge_type="staged-table", fingerprint=fp,
+             skips_on_hit=("encode:train",),
+             detail="encoded table -> device arrays (content-addressed)")
+    return fp
+
+
+# -- BayesianDistribution ----------------------------------------------------
+
+def build_nb_plan(conf: JobConfig, in_path: str,
+                  out_path: str) -> Optional[Plan]:
+    if not conf.get_bool("tabular.input", True):
+        return None             # text mode
+    if conf.get_bool("streaming.train", False):
+        return None             # out-of-core windowed fold
+    from avenir_tpu.utils.dataset import part_file_paths
+    if len(part_file_paths(in_path)) > 1 and (
+            conf.get_bool("shard.parts", False)
+            or conf.get_bool("job.resume", False)):
+        return None             # journaled per-shard count fold
+    plan = _new_plan(conf, "BayesianDistribution")
+    _add_staged_train(plan, conf, in_path)
+
+    def _train(values):
+        from avenir_tpu.models import naive_bayes as nb
+        _, table = values["train.table"]
+        if conf.get_bool("train.sharded", False):
+            from avenir_tpu.parallel import collective
+            from avenir_tpu.parallel.data import shard_table
+            mesh = collective.data_mesh(
+                tuple(conf.get_int_list("mesh.shape") or ()))
+            st = shard_table(table, mesh)
+            return nb.train_sharded(st, mesh)
+        return nb.train(table)
+
+    def _write(values):
+        from avenir_tpu.models import naive_bayes as nb
+        model, meta, metrics = values["nb.model"]
+        nb.save_model(model, meta, out_path,
+                      delim=conf.get("field.delim", ","))
+        print(metrics.to_json())
+
+    plan.add(name="kernel:nb.train", kind="kernel", run=_train,
+             inputs=("train.table",), output="nb.model",
+             edge_type="model", detail="count fold (+psum when sharded)")
+    plan.add(name="write:model", kind="write", run=_write,
+             inputs=("nb.model",), detail=f"model -> {out_path}")
+    return plan
+
+
+# -- NearestNeighbor ---------------------------------------------------------
+
+def _knn_config(conf: JobConfig, fz):
+    """The full KnnConfig exactly as run_nearest_neighbor builds it
+    (classification form — the regression mode is not plan-capable)."""
+    from avenir_tpu.models import knn
+    return knn.KnnConfig(
+        top_match_count=conf.get_int("top.match.count", 5),
+        kernel_function=conf.get("kernel.function", "none"),
+        kernel_param=conf.get_int("kernel.param", 100),
+        class_cond_weighted=(
+            conf.get_bool("class.condition.weighted", False)
+            or conf.get_bool("class.condtion.weighted", False)),
+        inverse_distance_weighted=conf.get_bool(
+            "inverse.distance.weighted", False),
+        decision_threshold=conf.get_float("decision.threshold", -1.0),
+        positive_class=conf.get("positive.class.value"),
+        distance_scale=conf.get_int("distance.scale", 1000),
+        algorithm=fz.schema.dist_algorithm or "euclidean",
+        prediction_mode="classification",
+        regression_method=conf.get("regression.method", "average"),
+        feed_chunk_rows=conf.get_int("feed.chunk.rows", 0),
+        feed_depth=conf.get_int("feed.depth", 2),
+        sharded=conf.get_bool("knn.sharded", False),
+        mesh_shape=tuple(conf.get_int_list("mesh.shape") or ()),
+        mode=conf.get("knn.mode", "fast"),
+        fused=conf.get_bool("knn.fused", True),
+        quantized=conf.get_bool("knn.quantized", False),
+        quantized_oversample=conf.get_int("knn.quantized.oversample", 4),
+        quantized_dtype=conf.get("knn.quantized.dtype", "int8"),
+        ann=conf.get_bool("knn.ann", False),
+        ann_nlist=conf.get_int("knn.ann.nlist", 0),
+        ann_nprobe=conf.get_int("knn.ann.nprobe", 0),
+        ann_iters=conf.get_int("knn.ann.iters", 15),
+        ann_seed=conf.get_int("knn.ann.seed", 0))
+
+
+def build_knn_plan(conf: JobConfig, in_path: str,
+                   out_path: str) -> Optional[Plan]:
+    if conf.get("neighbor.data.path"):
+        return None             # precomputed-distance replay mode
+    if conf.get("prediction.mode", "classification") == "regression":
+        return None             # needs raw token columns (regr_input)
+    from avenir_tpu.utils.dataset import part_file_paths
+    validation = conf.get_bool("validation.mode", False)
+    delim_in = conf.get("field.delim.regex", ",")
+    delim = conf.get("field.delim.out", ",")
+    train_path = conf.get_required("train.data.path")
+    feed_chunk_rows = conf.get_int("feed.chunk.rows", 0)
+    shard_paths = part_file_paths(in_path)
+    sharded = (len(shard_paths) > 1
+               and conf.get_bool("shard.prefetch", True))
+
+    plan = _new_plan(conf, "NearestNeighbor")
+    fp_train = _add_staged_train(plan, conf, train_path)
+
+    if sharded:
+        # fused shard pipeline: PrefetchLoader featurizes + stages shard
+        # n+1 host->device while shard n scores, fragments journaling
+        # rename-atomically — the whole encode/stage/kernel/write chain
+        # of each shard overlaps inside ONE node, with the ShardJournal
+        # resume contract carried as the node's property
+        def _run_shards(values):
+            from avenir_tpu.cli import main as cli_main
+            fz, train = values["train.table"]
+            cfg = _knn_config(conf, fz)
+            cli_main._run_knn_sharded(conf, cfg, fz, train, shard_paths,
+                                      out_path, validation, delim)
+
+        plan.add(name="kernel:knn.shards", kind="kernel",
+                 run=_run_shards, inputs=("train.table",), fused=True,
+                 journal={
+                     "dir": out_path + ".shards",
+                     "shards": len(shard_paths),
+                     "resume": conf.get_bool("job.resume", False),
+                     "enabled": conf.get_bool("shard.journal", True)},
+                 detail="prefetch-staged shard loop: classify + "
+                        "journaled fragment write + assemble")
+        return plan
+
+    fp_test = FP.staged_table_fingerprint(
+        conf, in_path, with_labels=validation,
+        feed_chunk_rows=feed_chunk_rows, fit_fingerprint=fp_train)
+
+    def _encode_test(values):
+        from avenir_tpu.utils.dataset import read_csv_lines
+        return read_csv_lines(in_path, delim_in)
+
+    def _stage_test(values):
+        fz, _ = values["train.table"]
+        return fz.transform(values["test.rows"], with_labels=validation)
+
+    def _classify(values):
+        from avenir_tpu.cli import main as cli_main
+        from avenir_tpu.models import knn
+        fz, train = values["train.table"]
+        cfg = _knn_config(conf, fz)
+        feature_post = cli_main._knn_feature_post(train, cfg)
+        return knn.classify(train, values["test.table"], cfg,
+                            feature_post=feature_post)
+
+    def _write(values):
+        _, train = values["train.table"]
+        test = values["test.table"]
+        pred = values["knn.pred"]
+        output_distr = conf.get_bool("output.class.distr", False)
+        with open(out_path, "w") as fh:
+            for i in range(test.n_rows):
+                parts = [test.ids[i],
+                         train.class_values[int(pred.predicted[i])]]
+                if output_distr and pred.class_prob is not None:
+                    for ci, cls in enumerate(train.class_values):
+                        parts += [cls, str(int(pred.class_prob[i, ci]))]
+                fh.write(delim.join(parts) + "\n")
+
+    def _validate(values):
+        from avenir_tpu.models import knn
+        test = values["test.table"]
+        if test.labels is None:
+            return
+        cm = knn.validate(values["knn.pred"], test,
+                          positive_class=conf.get("positive.class.value"))
+        print(cm.report().to_json())
+
+    plan.add(name="encode:test", kind="encode", run=_encode_test,
+             output="test.rows", edge_type="row-batch",
+             detail=f"parse {in_path}")
+    plan.add(name="stage:test", kind="stage", run=_stage_test,
+             inputs=("train.table", "test.rows"), output="test.table",
+             edge_type="staged-table", fingerprint=fp_test,
+             skips_on_hit=("encode:test",),
+             detail="test rows through the train-fitted featurizer")
+    plan.add(name="kernel:knn.classify", kind="kernel", run=_classify,
+             inputs=("train.table", "test.table"), output="knn.pred",
+             edge_type="predictions", fused=feed_chunk_rows > 0,
+             detail=("DeviceFeed chunks overlap H2D with distance+vote"
+                     if feed_chunk_rows > 0 else
+                     "distance + top-k + vote"))
+    plan.add(name="write:predictions", kind="write", run=_write,
+             inputs=("train.table", "test.table", "knn.pred"),
+             detail=f"id,class lines -> {out_path}")
+    if validation:
+        plan.add(name="reduce:validate", kind="reduce", run=_validate,
+                 inputs=("train.table", "test.table", "knn.pred"),
+                 detail="confusion-matrix report -> stdout")
+    return plan
+
+
+# -- MutualInformation -------------------------------------------------------
+
+def build_mi_plan(conf: JobConfig, in_path: str,
+                  out_path: str) -> Optional[Plan]:
+    from avenir_tpu.utils.dataset import part_file_paths
+    if len(part_file_paths(in_path)) > 1 and (
+            conf.get_bool("shard.parts", False)
+            or conf.get_bool("job.resume", False)):
+        return None             # journaled per-shard distribution fold
+    plan = _new_plan(conf, "MutualInformation")
+    _add_staged_train(plan, conf, in_path)
+
+    def _distributions(values):
+        from avenir_tpu.explore import mutual_information as mi
+        _, table = values["train.table"]
+        if conf.get_bool("train.sharded", False):
+            from avenir_tpu.parallel import collective
+            from avenir_tpu.parallel.data import shard_table
+            mesh = collective.data_mesh(
+                tuple(conf.get_int_list("mesh.shape") or ()))
+            st = shard_table(table, mesh)
+            return mi.compute_distributions(st.table, mesh=mesh,
+                                            mask=st.mask)
+        return mi.compute_distributions(table)
+
+    def _scores(values):
+        from avenir_tpu.explore import mutual_information as mi
+        return mi.compute_scores(values["mi.dists"])
+
+    def _write(values):
+        from avenir_tpu.cli import main as cli_main
+        cli_main._emit_mi_scores(conf, out_path, values["mi.scores"])
+
+    plan.add(name="kernel:mi.distributions", kind="kernel",
+             run=_distributions, inputs=("train.table",),
+             output="mi.dists", edge_type="distributions",
+             detail="seven count families (+psum when sharded)")
+    plan.add(name="reduce:mi.scores", kind="reduce", run=_scores,
+             inputs=("mi.dists",), output="mi.scores",
+             edge_type="scores", detail="MI scores from count families")
+    plan.add(name="write:scores", kind="write", run=_write,
+             inputs=("mi.scores",),
+             detail=f"score + ranking lines -> {out_path}")
+    return plan
+
+
+# -- RandomForestBuilder -----------------------------------------------------
+
+def build_forest_plan(conf: JobConfig, in_path: str,
+                      out_path: str) -> Optional[Plan]:
+    plan = _new_plan(conf, "RandomForestBuilder")
+    _add_staged_train(plan, conf, in_path)
+
+    def _grow(values):
+        from avenir_tpu.cli import main as cli_main
+        from avenir_tpu.models import forest as F
+        from avenir_tpu.models.tree import TreeConfig
+        _, table = values["train.table"]
+        cfg = F.ForestConfig(
+            n_trees=conf.get_int("num.trees", 10),
+            attrs_per_tree=conf.get_int("random.split.set.size", 3),
+            bagging=conf.get_bool("bagging", True),
+            seed=conf.get_int("random.seed", 0),
+            growth=conf.get("forest.growth", "auto"),
+            tree=TreeConfig(
+                algorithm=cli_main._split_algorithm(conf),
+                max_depth=conf.get_int("max.depth", 3),
+                min_node_size=conf.get_int("min.node.size", 10),
+                max_cat_attr_split_groups=conf.get_int(
+                    "max.cat.attr.split.groups", 3),
+                split_selection_strategy=conf.get(
+                    "split.selection.strategy", "best"),
+                num_top_splits=conf.get_int("num.top.splits", 5),
+                min_gain=conf.get_float("min.gain", 1e-6),
+                device_node_budget=conf.get_int(
+                    "device.node.budget", 2048)))
+        return F.grow_forest(table, cfg)
+
+    def _write(values):
+        import json
+        from avenir_tpu.models import forest as F
+        _, table = values["train.table"]
+        trees = values["forest.model"]
+        F.save_forest(trees, out_path)
+        print(json.dumps({"Forest.Trees": len(trees),
+                          "Forest.Rows": table.n_rows}))
+
+    plan.add(name="kernel:forest.grow", kind="kernel", run=_grow,
+             inputs=("train.table",), output="forest.model",
+             edge_type="model",
+             detail="batched whole-forest growth (forest.growth)")
+    plan.add(name="write:model", kind="write", run=_write,
+             inputs=("train.table", "forest.model"),
+             detail=f"stacked tree JSON -> {out_path}")
+    return plan
+
+
+# -- GradientBoostBuilder ----------------------------------------------------
+
+def build_boost_plan(conf: JobConfig, in_path: str,
+                     out_path: str) -> Optional[Plan]:
+    if conf.get_bool("streaming.train", False):
+        return None             # out-of-core cached-chunk fold
+    plan = _new_plan(conf, "GradientBoostBuilder")
+    fp_train = _add_staged_train(plan, conf, in_path)
+    # the binned candidate catalog depends on the staged table plus the
+    # split-shaping keys ONLY — rounds / learning rate / depth changes
+    # re-hit it (the "binned catalog is a cache hit across rounds"
+    # payload: hyperparameter sweeps over the same data re-bin nothing)
+    fp_catalog = FP.digest({
+        "v": 1, "node": "boost-catalog", "table": fp_train,
+        "max_cat_attr_split_groups": conf.get_int(
+            "max.cat.attr.split.groups", 3)})
+
+    def _catalog(values):
+        from avenir_tpu.cli import main as cli_main
+        from avenir_tpu.models import boost as B
+        _, table = values["train.table"]
+        return B.build_boost_catalog(table,
+                                     cli_main._boost_config(conf).tree)
+
+    def _rounds(values):
+        from avenir_tpu.cli import main as cli_main
+        from avenir_tpu.models import boost as B
+        _, table = values["train.table"]
+        return B.grow_boosted(table, cli_main._boost_config(conf),
+                              catalog=values["boost.catalog"])
+
+    def _write(values):
+        import json
+        from avenir_tpu.models import boost as B
+        model = values["boost.model"]
+        B.save_boosted(model, out_path)
+        print(json.dumps({"Boost.Rounds": len(model.trees),
+                          "Boost.LearningRate": model.learning_rate}))
+
+    plan.add(name="stage:catalog", kind="stage", run=_catalog,
+             inputs=("train.table",), output="boost.catalog",
+             edge_type="binned-catalog", fingerprint=fp_catalog,
+             detail="attr plans + device candidate tensors (binned once)")
+    plan.add(name="kernel:boost.rounds", kind="kernel", run=_rounds,
+             inputs=("train.table", "boost.catalog"),
+             output="boost.model", edge_type="model",
+             detail="K Newton rounds over the catalog, one readback")
+    plan.add(name="write:model", kind="write", run=_write,
+             inputs=("boost.model",),
+             detail=f"boosted artifact -> {out_path}")
+    return plan
+
+
+# -- dispatch ----------------------------------------------------------------
+
+_BUILDERS = {
+    "BayesianDistribution": build_nb_plan,
+    "NearestNeighbor": build_knn_plan,
+    "MutualInformation": build_mi_plan,
+    "RandomForestBuilder": build_forest_plan,
+    "GradientBoostBuilder": build_boost_plan,
+}
+
+
+def build_plan(verb: str, conf: JobConfig, in_path: str,
+               out_path: str) -> Optional[Plan]:
+    """Plan for (verb, conf, paths), or None when the verb/mode is not
+    plan-capable."""
+    builder = _BUILDERS.get(verb)
+    if builder is None:
+        return None
+    return builder(conf, in_path, out_path)

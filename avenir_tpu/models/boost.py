@@ -393,20 +393,33 @@ def _tree_values_host(tree: TreeNode, table: EncodedTable,
 # in-core training
 # ---------------------------------------------------------------------------
 
-def grow_boosted(table: EncodedTable, config: BoostConfig) -> BoostedModel:
+def build_boost_catalog(table: EncodedTable, tree_cfg) -> tuple:
+    """The binned candidate catalog: attribute split plans + the
+    device-resident candidate tensors every round scans. Deterministic
+    in (table, split-shaping config) alone — which is what lets the
+    plan layer (ISSUE 18) content-address it and re-serve it across
+    invocations (a hyperparameter sweep over the same data bins once)."""
+    attrs = list(tree_cfg.split_attributes) or T.splittable_ordinals(table)
+    plans = T._attr_plans(table, attrs, tree_cfg.max_cat_attr_split_groups)
+    if not plans:
+        raise ValueError("no splittable attributes for boosting")
+    return plans, T._device_candidates(table, plans)
+
+
+def grow_boosted(table: EncodedTable, config: BoostConfig,
+                 catalog: tuple = None) -> BoostedModel:
     """K boosting rounds, device-resident: the binned candidate catalog
-    is built ONCE, every round is one call of the single compiled
+    is built ONCE (or passed in prebuilt via ``catalog`` — the plan
+    layer's cache hit), every round is one call of the single compiled
     :func:`_boost_round` program chained through the on-device score
     vector, and ONE ``device_get`` at the end fetches all K rounds'
     level records for host tree assembly."""
     _validate_boost_config(config)
     _require_binary(table.n_classes)
     cfg = config.tree
-    attrs = list(cfg.split_attributes) or T.splittable_ordinals(table)
-    plans = T._attr_plans(table, attrs, cfg.max_cat_attr_split_groups)
-    if not plans:
-        raise ValueError("no splittable attributes for boosting")
-    cand = T._device_candidates(table, plans)
+    if catalog is None:
+        catalog = build_boost_catalog(table, cfg)
+    plans, cand = catalog
 
     score = jnp.full(table.n_rows, np.float32(config.base_score),
                      jnp.float32)

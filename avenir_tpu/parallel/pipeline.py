@@ -58,8 +58,14 @@ import jax
 
 from avenir_tpu.obs import telemetry
 
+# the canonical shape-bucket floor every bucketed staging path shares —
+# exported because staged-table cache fingerprints (plan/fingerprint.py)
+# must cover the bucket geometry: a different floor means different
+# padded device shapes, which must never share a cache entry
+BUCKET_FLOOR = 512
 
-def bucket_rows(n: int, floor: int = 512) -> int:
+
+def bucket_rows(n: int, floor: int = BUCKET_FLOOR) -> int:
     """Smallest power-of-two ≥ ``max(n, floor)`` — the shape-bucket rule.
 
     The floor keeps tiny tail chunks from minting extra buckets (a 7-row

@@ -1,0 +1,31 @@
+"""Plan-graph execution layer (ISSUE 18, ROADMAP item 7).
+
+CLI verbs stop hand-wiring featurize -> stage -> compute -> write and
+instead CONSTRUCT an explicit plan graph — nodes of kind encode / stage
+/ kernel / reduce / write joined by typed table edges — which the
+scheduler executes. The graph is where cross-cutting machinery lives
+once instead of per verb:
+
+* content-addressed staged-table caching (:mod:`cache`): stage nodes
+  carry a fingerprint over input file facts + schema hash + every
+  encode-affecting config key, so a chained ``BayesianDistribution`` ->
+  ``NearestNeighbor`` run pays the train-table encode exactly once;
+* per-node telemetry spans (``plan.<verb>.<node>``) for free;
+* the ShardJournal retry/resume contract as a node PROPERTY
+  (``PlanNode.journal``) rather than per-verb plumbing;
+* fusion flags marking where a stage hands host chunks straight into a
+  ``DeviceFeed`` so H2D overlaps compute instead of materializing a
+  per-verb intermediate.
+
+The refactor gate: byte-identical per-verb output (stdout, model files,
+job JSON) with the cache cold AND bit-identical warm — enforced by
+tests/test_plan.py against the legacy hand-wired bodies, which remain
+reachable via ``plan.enable=false``.
+"""
+
+from avenir_tpu.plan.cache import StagedTableCache, reset_cache, staged_cache
+from avenir_tpu.plan.graph import Plan, PlanNode
+from avenir_tpu.plan.scheduler import execute, last_run
+
+__all__ = ["Plan", "PlanNode", "StagedTableCache", "execute", "last_run",
+           "reset_cache", "staged_cache"]

@@ -1,0 +1,63 @@
+"""``--explain`` rendering: print the plan, never execute it.
+
+The probe is the cache's NON-mutating ``contains`` — explaining a plan
+twice shows the same hit/miss picture and perturbs no statistics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from avenir_tpu.plan.cache import staged_cache
+from avenir_tpu.plan.graph import Plan
+
+
+def probe(plan: Plan) -> Dict[str, Optional[str]]:
+    """node name -> "hit" | "miss" (cacheable nodes) | None."""
+    cache = staged_cache() if plan.cache_enabled else None
+    out: Dict[str, Optional[str]] = {}
+    for node in plan.nodes:
+        if node.fingerprint is None:
+            out[node.name] = None
+        elif cache is not None and cache.contains(node.fingerprint):
+            out[node.name] = "hit"
+        else:
+            out[node.name] = "miss"
+    return out
+
+
+def plan_json(plan: Plan) -> dict:
+    return plan.to_json(probes=probe(plan))
+
+
+def render(plan: Plan) -> str:
+    probes = probe(plan)
+    lines = [f"plan {plan.verb}: {len(plan.nodes)} nodes, cache "
+             f"{'on' if plan.cache_enabled else 'off'}"]
+    width = max(len(n.name) for n in plan.nodes)
+    for node in plan.nodes:
+        bits = [f"  [{node.kind:<6}] {node.name:<{width}}"]
+        if node.inputs:
+            bits.append("<- " + ",".join(node.inputs))
+        if node.output:
+            bits.append(f"-> {node.output}:{node.edge_type}")
+        if node.fingerprint:
+            bits.append(f"fp={node.fingerprint[:12]} "
+                        f"cache={probes[node.name]}")
+        if node.fused:
+            bits.append("fused")
+        if node.journal:
+            j = node.journal
+            bits.append(f"journal={j.get('dir')} shards={j.get('shards')}"
+                        f" resume={j.get('resume')}")
+        lines.append(" ".join(bits))
+        if node.detail:
+            lines.append(" " * 12 + node.detail)
+    lines.append("edges:")
+    for node in plan.nodes:
+        if node.output is None:
+            continue
+        consumers = plan.consumers(node.output) or ["(terminal)"]
+        lines.append(f"  {node.output} ({node.edge_type}): "
+                     f"{node.name} -> {', '.join(consumers)}")
+    return "\n".join(lines)

@@ -1,0 +1,122 @@
+"""The plan node/edge model (DESIGN.md §25).
+
+A :class:`Plan` is a short topologically-ordered list of
+:class:`PlanNode`\\ s. Edges are NAMED, TYPED values ("train.table" of
+type ``staged-table``): a node declares which edge names it consumes
+and which single edge it produces, and the scheduler threads the values
+through a dict — no implicit state between nodes, which is exactly what
+makes a node's output cacheable and its execution skippable.
+
+Node kinds (the closed vocabulary the explain renderer and DESIGN.md
+speak):
+
+``encode``   host-side parse + featurize-prep (reads files, returns rows)
+``stage``    device placement: encoded table / binned catalog lands on
+             the accelerator (the cacheable kind — carries a fingerprint)
+``kernel``   the verb's compute (train / classify / distributions)
+``reduce``   host-side folds over kernel output (scores, validation)
+``write``    output emission (model files, prediction files, stdout JSON)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+Runner = Callable[[Dict[str, Any]], Any]
+
+NODE_KINDS = ("encode", "stage", "kernel", "reduce", "write")
+
+
+@dataclasses.dataclass
+class PlanNode:
+    """One unit of work. ``run(values)`` receives the edge dict and
+    returns the produced edge value (or None for sink nodes)."""
+
+    name: str                       # e.g. "stage:train"
+    kind: str                       # one of NODE_KINDS
+    run: Runner
+    inputs: Tuple[str, ...] = ()    # edge names consumed
+    output: Optional[str] = None    # edge name produced (None = sink)
+    edge_type: Optional[str] = None  # type of the produced edge
+    # content-addressed cache key (None = not cacheable). A hit returns
+    # the cached edge value and skips this node's run AND every node
+    # named in skips_on_hit (its now-dead producers).
+    fingerprint: Optional[str] = None
+    skips_on_hit: Tuple[str, ...] = ()
+    # fusion marker: this node's device work overlaps H2D with compute
+    # through one DeviceFeed instead of materializing an intermediate
+    fused: bool = False
+    # ShardJournal retry/resume as a node property (ISSUE 9 made it
+    # per-verb plumbing; the plan carries it declaratively):
+    # {"dir": ..., "shards": N, "resume": bool, "enabled": bool}
+    journal: Optional[Dict[str, Any]] = None
+    detail: str = ""                # one-line human note for --explain
+
+    def __post_init__(self):
+        if self.kind not in NODE_KINDS:
+            raise ValueError(f"unknown plan node kind {self.kind!r} "
+                             f"(expected one of {NODE_KINDS})")
+
+
+class Plan:
+    """Node container in construction (= topological) order, plus the
+    per-plan cache switches the scheduler honors."""
+
+    def __init__(self, verb: str, cache_enabled: bool = True,
+                 cache_budget_bytes: Optional[int] = None):
+        self.verb = verb
+        self.nodes: List[PlanNode] = []
+        self.cache_enabled = cache_enabled
+        self.cache_budget_bytes = cache_budget_bytes
+        # filled by the scheduler after execute(): node name ->
+        # "ran" | "hit" | "miss" | "skipped"
+        self.outcomes: Dict[str, str] = {}
+
+    def add(self, **kwargs) -> PlanNode:
+        node = PlanNode(**kwargs)
+        if any(n.name == node.name for n in self.nodes):
+            raise ValueError(f"duplicate plan node name {node.name!r}")
+        missing = [e for e in node.inputs
+                   if not any(n.output == e for n in self.nodes)]
+        if missing:
+            raise ValueError(
+                f"plan node {node.name!r} consumes undeclared edge(s) "
+                f"{missing} — producers must be added first")
+        self.nodes.append(node)
+        return node
+
+    def node(self, name: str) -> PlanNode:
+        for n in self.nodes:
+            if n.name == name:
+                return n
+        raise KeyError(name)
+
+    def consumers(self, edge: str) -> List[str]:
+        return [n.name for n in self.nodes if edge in n.inputs]
+
+    def to_json(self, probes: Optional[Dict[str, Optional[str]]] = None
+                ) -> Dict[str, Any]:
+        """The --explain / beside-``--metrics-out`` JSON form. ``probes``
+        (node name -> "hit"|"miss"|None) comes from a NON-mutating cache
+        probe so explaining a plan never perturbs hit statistics."""
+        nodes = []
+        for n in self.nodes:
+            nodes.append({
+                "name": n.name,
+                "kind": n.kind,
+                "inputs": list(n.inputs),
+                "output": n.output,
+                "edge_type": n.edge_type,
+                "fingerprint": n.fingerprint,
+                "cache": (probes or {}).get(n.name),
+                "skips_on_hit": list(n.skips_on_hit),
+                "fused": n.fused,
+                "journal": n.journal,
+                "detail": n.detail,
+            })
+        edges = [{"name": n.output, "type": n.edge_type,
+                  "producer": n.name, "consumers": self.consumers(n.output)}
+                 for n in self.nodes if n.output is not None]
+        return {"verb": self.verb, "cache_enabled": self.cache_enabled,
+                "nodes": nodes, "edges": edges}

@@ -1,0 +1,80 @@
+"""The plan scheduler: one pass over the topo-ordered nodes.
+
+Execution semantics (DESIGN.md §25):
+
+1. PRE-PASS — every cacheable node's fingerprint is probed
+   (non-mutating); on a hit, the nodes it names in ``skips_on_hit``
+   (its now-dead producers — typically the encode feeding a cached
+   stage) are marked skipped and never run.
+2. RUN — nodes execute in order inside a ``plan.<verb>.<node>``
+   telemetry span (free: a disabled tracer costs one attribute read).
+   A cacheable node consults the cache (the mutating ``get`` — this is
+   where hit/miss statistics accrue); a miss runs the node and stores
+   its edge value under the fingerprint.
+3. GAUGES — cache statistics publish to the hub (``plan.cache.*``)
+   when telemetry is armed.
+
+Byte-identity invariant: a cache hit returns the SAME edge value the
+node would have computed (fingerprints cover every input that can
+change it), so downstream nodes — and therefore stdout, model files and
+job JSON — cannot observe whether the cache was warm.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from avenir_tpu.plan.cache import MISS, staged_cache
+from avenir_tpu.plan.graph import Plan
+
+# last executed plan's (verb, outcomes) — introspection for tests and
+# smokes that need per-node hit/miss without threading the plan out of
+# the CLI entrypoint
+_LAST: Optional[Dict[str, Any]] = None
+
+
+def last_run() -> Optional[Dict[str, Any]]:
+    """{"verb": ..., "outcomes": {node: "ran"|"hit"|"miss"|"skipped"}}
+    of the most recent :func:`execute`, or None."""
+    return _LAST
+
+
+def execute(plan: Plan) -> Dict[str, Any]:
+    """Run the plan; return the edge-value dict."""
+    global _LAST
+    from avenir_tpu.obs import telemetry
+    cache = staged_cache() if plan.cache_enabled else None
+    if cache is not None and plan.cache_budget_bytes is not None:
+        cache.set_budget(plan.cache_budget_bytes)
+
+    skipped = set()
+    if cache is not None:
+        for node in plan.nodes:
+            if node.fingerprint and cache.contains(node.fingerprint):
+                skipped.update(node.skips_on_hit)
+
+    values: Dict[str, Any] = {}
+    outcomes: Dict[str, str] = {}
+    for node in plan.nodes:
+        if node.name in skipped:
+            outcomes[node.name] = "skipped"
+            continue
+        with telemetry.span(f"plan.{plan.verb}.{node.name}"):
+            if node.fingerprint and cache is not None:
+                value = cache.get(node.fingerprint)
+                if value is not MISS:
+                    outcomes[node.name] = "hit"
+                else:
+                    value = node.run(values)
+                    cache.put(node.fingerprint, value)
+                    outcomes[node.name] = "miss"
+            else:
+                value = node.run(values)
+                outcomes[node.name] = "ran"
+        if node.output is not None:
+            values[node.output] = value
+    plan.outcomes = outcomes
+    _LAST = {"verb": plan.verb, "outcomes": dict(outcomes)}
+    if cache is not None:
+        cache.publish_gauges()
+    return values

@@ -542,6 +542,128 @@ def _forest_bench() -> dict:
     return out
 
 
+def _plan_bench() -> dict:
+    """ISSUE 18: the ``plan`` arm — a chained BayesianDistribution ->
+    NearestNeighbor pipeline through the plan-graph execution layer vs
+    the same two verbs run independently (cache cleared between them).
+    The chain's second verb re-serves the content-addressed staged train
+    table, so the delta IS the encode+stage cost the plan layer
+    eliminates. PARITY-GATED before reporting: chained outputs must be
+    byte-identical to the independent runs (a fast-but-wrong cache hit
+    must fail loudly). Winners persist under a dedicated ``/plan/``
+    autotune namespace (PR 14 discipline)."""
+    import contextlib
+    import io
+    import sys as _sys
+    import tempfile
+    from avenir_tpu.cli.main import main as _cli
+    from avenir_tpu.datagen.generators import _CHURN_SCHEMA_JSON, churn_rows
+    from avenir_tpu.plan.cache import reset_cache, staged_cache
+
+    n_train = int(os.environ.get("BENCH_PLAN_ROWS", 40000))
+    n_test = int(os.environ.get("BENCH_PLAN_TEST", 100))
+    reps = int(os.environ.get("BENCH_PLAN_REPEATS", 3))
+
+    def run(argv):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            _cli(argv)
+        return buf.getvalue()
+
+    with tempfile.TemporaryDirectory() as td:
+        rows = churn_rows(n_train + n_test, seed=11)
+        train = os.path.join(td, "train.csv")
+        test = os.path.join(td, "test.csv")
+        with open(train, "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows[:n_train]) + "\n")
+        with open(test, "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows[n_train:]) + "\n")
+        schema = os.path.join(td, "schema.json")
+        with open(schema, "w") as fh:
+            json.dump(_CHURN_SCHEMA_JSON, fh)
+        props = os.path.join(td, "job.properties")
+        with open(props, "w") as fh:
+            fh.write("field.delim.regex=,\nfield.delim=,\n"
+                     f"feature.schema.file.path={schema}\n"
+                     f"train.data.path={train}\n"
+                     "top.match.count=5\n")
+
+        def nb(out):
+            return run(["BayesianDistribution", train,
+                        os.path.join(td, out), "--conf", props])
+
+        def knn(out):
+            return run(["NearestNeighbor", test, os.path.join(td, out),
+                        "--conf", props])
+
+        def read(name):
+            with open(os.path.join(td, name), "rb") as fh:
+                return fh.read()
+
+        # warm every compile path once (both verbs, full shapes)
+        reset_cache()
+        nb("nb_warm.txt")
+        knn("knn_warm.txt")
+
+        ind_nb, ind_knn, ch_nb, ch_knn = [], [], [], []
+        hit_fraction = 0.0
+        for _ in range(reps):
+            # independent: cache cold before EACH verb
+            reset_cache()
+            t0 = time.perf_counter()
+            nb("nb_ind.txt")
+            t1 = time.perf_counter()
+            reset_cache()
+            knn("knn_ind.txt")
+            t2 = time.perf_counter()
+            ind_nb.append(t1 - t0)
+            ind_knn.append(t2 - t1)
+            # chained: one plan cache across both verbs
+            reset_cache()
+            t0 = time.perf_counter()
+            nb("nb_chain.txt")
+            t1 = time.perf_counter()
+            knn("knn_chain.txt")
+            t2 = time.perf_counter()
+            ch_nb.append(t1 - t0)
+            ch_knn.append(t2 - t1)
+            stats = staged_cache().stats()
+            if stats["hits"] < 1:
+                raise AssertionError(
+                    "chained NB->KNN recorded no staged-table cache hit")
+            hit_fraction = stats["hit_fraction"]
+            if (read("nb_chain.txt") != read("nb_ind.txt")
+                    or read("knn_chain.txt") != read("knn_ind.txt")):
+                raise AssertionError(
+                    "chained outputs != independent outputs — refusing "
+                    "to time a wrong result")
+
+        indep_s = min(a + b for a, b in zip(ind_nb, ind_knn))
+        chain_s = min(a + b for a, b in zip(ch_nb, ch_knn))
+        speedup = indep_s / chain_s
+        encode_saved_s = min(ind_knn) - min(ch_knn)
+        out = {
+            "n_train": n_train, "n_test": n_test, "repeats": reps,
+            "independent_s": round(indep_s, 4),
+            "chained_s": round(chain_s, 4),
+            "chain_speedup": round(speedup, 3),
+            "encode_saved_s": round(encode_saved_s, 4),
+            "plan.cache_hit_fraction": round(hit_fraction, 4),
+        }
+        key = (_autotune_key(("plan",))
+               + f"/plan/nb-knn-r{n_train}x{n_test}")
+        winner = "chained" if speedup > 1.0 else "independent"
+        if AUTOTUNE:
+            prior = _autotune_load(key)
+            if prior:
+                out["autotune_prior"] = prior
+            _autotune_store(key, winner, chain_s * 1e3)
+            print(f"plan autotune: {winner} recorded under {key}",
+                  file=_sys.stderr)
+        out["winner"] = winner
+        return out
+
+
 def _boost_bench() -> dict:
     """ISSUE 16: the ``boost`` sweep arm — K device-resident Newton
     rounds over the one binned catalog vs the bagged batched forest at
@@ -1095,6 +1217,25 @@ def main() -> None:
         except Exception as exc:
             print(f"boost bench skipped: {exc!r}", file=sys.stderr)
             out["boost"] = {"error": repr(exc)}
+    # ISSUE-18 PLAN LAYER: chained NB->KNN through the plan graph vs two
+    # independent runs — the staged-table cache hit eliminates the
+    # second verb's encode+stage (parity-gated byte identity;
+    # fallback-safe like its siblings). BENCH_PLAN=0 disables;
+    # BENCH_PLAN_{ROWS,TEST,REPEATS} tune the workload.
+    if os.environ.get("BENCH_PLAN", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        try:
+            out["plan"] = _plan_bench()
+            pb = out["plan"]
+            print(f"plan: chained NB->KNN {pb['chained_s']:.2f}s vs "
+                  f"independent {pb['independent_s']:.2f}s "
+                  f"({pb['chain_speedup']:.2f}x, encode saved "
+                  f"{pb['encode_saved_s']:.2f}s, hit fraction "
+                  f"{pb['plan.cache_hit_fraction']:.2f})",
+                  file=sys.stderr)
+        except Exception as exc:
+            print(f"plan bench skipped: {exc!r}", file=sys.stderr)
+            out["plan"] = {"error": repr(exc)}
     # ISSUE-5 ONLINE SERVING: the always-on path's own headline —
     # engine-vs-sync decisions/sec on CPU over MiniRedis (subprocess;
     # fallback-safe: a serving failure must not sink the KNN headline)

@@ -1,0 +1,137 @@
+"""Plan-layer smoke (ISSUE 18, tier-1 via tests/test_plan.py): the
+chained NB -> KNN scenario through the plan-graph execution layer, one
+lean in-process run.
+
+Gates, one JSON line on stdout, non-zero exit on any failure:
+
+1. CHAIN HIT: BayesianDistribution then NearestNeighbor over the same
+   train file — the KNN run's ``stage:train`` node is a staged-table
+   cache HIT and its ``encode:train`` is skipped (>= 1 cache hit).
+2. BYTE IDENTITY: the chained runs' stdout and output files are
+   byte-identical to independent (cold-cache) runs of each verb AND to
+   the legacy hand-wired bodies (``plan.enable=false``).
+3. SPANS: per-node ``plan.<verb>.<node>`` spans appear in the merged
+   telemetry report written by ``--metrics-out``.
+
+CPU-sized (600 rows) and in-process — tier-1 is near its kill budget.
+"""
+
+import io
+import contextlib
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run(argv):
+    from avenir_tpu.cli.main import main as cli
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli(argv)
+    assert rc in (0, None), f"cli exit {rc}"
+    return buf.getvalue()
+
+
+def main() -> int:
+    from avenir_tpu.datagen import generators as G
+    from avenir_tpu.plan.cache import reset_cache, staged_cache
+    from avenir_tpu.plan.scheduler import last_run
+
+    report = {}
+    with tempfile.TemporaryDirectory() as td:
+        rows = G.churn_rows(600, seed=101)
+        train = os.path.join(td, "train.csv")
+        test = os.path.join(td, "test.csv")
+        with open(train, "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows[:450]) + "\n")
+        with open(test, "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows[450:]) + "\n")
+        with open(os.path.join(td, "schema.json"), "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        props = os.path.join(td, "job.properties")
+        with open(props, "w") as fh:
+            fh.write("field.delim.regex=,\nfield.delim=,\n"
+                     f"feature.schema.file.path={td}/schema.json\n"
+                     f"train.data.path={train}\n"
+                     "top.match.count=5\nvalidation.mode=true\n"
+                     "positive.class.value=closed\n")
+
+        def nb(out, *extra):
+            return _run(["BayesianDistribution", train,
+                         os.path.join(td, out), "--conf", props, *extra])
+
+        def knn(out, *extra):
+            return _run(["NearestNeighbor", test, os.path.join(td, out),
+                         "--conf", props, *extra])
+
+        def read(name):
+            with open(os.path.join(td, name), "rb") as fh:
+                return fh.read()
+
+        # legacy oracles (hand-wired bodies)
+        s_nb_legacy = nb("nb_legacy.txt", "-D", "plan.enable=false")
+        s_knn_legacy = knn("knn_legacy.txt", "-D", "plan.enable=false")
+
+        # independent plan runs: cache cold before EACH verb
+        reset_cache()
+        s_nb_ind = nb("nb_ind.txt")
+        reset_cache()
+        s_knn_ind = knn("knn_ind.txt")
+
+        # the chain: NB then KNN, cache carried across verbs; KNN runs
+        # with --metrics-out so the merged report captures the spans
+        reset_cache()
+        s_nb_chain = nb("nb_chain.txt")
+        metrics = os.path.join(td, "metrics.jsonl")
+        s_knn_chain = knn("knn_chain.txt", "--metrics-out", metrics)
+
+        # 1. chain hit: staged train table re-served, encode skipped
+        lr = last_run()
+        assert lr and lr["verb"] == "NearestNeighbor", lr
+        assert lr["outcomes"]["stage:train"] == "hit", lr
+        assert lr["outcomes"]["encode:train"] == "skipped", lr
+        stats = staged_cache().stats()
+        assert stats["hits"] >= 1, stats
+        report["chain_hits"] = stats["hits"]
+        report["cache_hit_fraction"] = round(stats["hit_fraction"], 4)
+
+        # 2. byte identity: chained == independent == legacy, stdout
+        # and files (model file + prediction file)
+        assert s_nb_chain == s_nb_ind == s_nb_legacy, \
+            (s_nb_chain, s_nb_ind, s_nb_legacy)
+        assert s_knn_chain == s_knn_ind == s_knn_legacy, \
+            (s_knn_chain, s_knn_ind, s_knn_legacy)
+        assert read("nb_chain.txt") == read("nb_ind.txt") \
+            == read("nb_legacy.txt"), "NB model bytes diverge"
+        assert read("knn_chain.txt") == read("knn_ind.txt") \
+            == read("knn_legacy.txt"), "KNN prediction bytes diverge"
+        report["byte_identical"] = True
+
+        # 3. per-node spans in the merged report
+        span_names = set()
+        with open(metrics) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                # plan spans nest under the job span:
+                # job.NearestNeighbor/plan.NearestNeighbor.<node>
+                if ev.get("type") == "span" and "plan." in ev.get(
+                        "name", ""):
+                    span_names.add(ev["name"])
+        for want in ("plan.NearestNeighbor.stage:train",
+                     "plan.NearestNeighbor.kernel:knn.classify",
+                     "plan.NearestNeighbor.write:predictions"):
+            assert any(want in n for n in span_names), \
+                f"span {want} missing from merged report ({span_names})"
+        report["plan_spans"] = len(span_names)
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,448 @@
+"""Plan-graph execution layer (ISSUE 18).
+
+The plan path (default on) must be a pure refactor of the hand-wired
+verb bodies: byte-identical stdout + output files for every plan-capable
+verb, cache COLD and cache WARM, with the legacy bodies
+(``plan.enable=false``) kept as the oracle. On top of that the
+cross-verb staged-table cache must be CORRECT — any encode-affecting
+key change (bad-row policy, quarantine, feed bucket sizes, schema or
+data content) must change the fingerprint and miss, never serve stale
+bytes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from avenir_tpu.datagen import generators as G
+from avenir_tpu.plan.cache import (MISS, StagedTableCache, reset_cache,
+                                   staged_cache)
+from avenir_tpu.plan.scheduler import last_run
+from avenir_tpu.utils.config import JobConfig
+
+
+@pytest.fixture(autouse=True)
+def _cold_cache():
+    """Every test starts and ends with an empty process-global cache —
+    the singleton is the point of the layer, so tests must not leak
+    staged tables into each other."""
+    reset_cache()
+    yield
+    reset_cache()
+
+
+def _churn_fixture(tmp_path, n=300, split=220):
+    rows = G.churn_rows(n, seed=77)
+    train = tmp_path / "train.csv"
+    test = tmp_path / "test.csv"
+    train.write_text("\n".join(",".join(r) for r in rows[:split]) + "\n")
+    test.write_text("\n".join(",".join(r) for r in rows[split:]) + "\n")
+    schema = tmp_path / "schema.json"
+    schema.write_text(json.dumps(G._CHURN_SCHEMA_JSON))
+    props = tmp_path / "job.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim=,\n"
+        f"feature.schema.file.path={schema}\n"
+        f"train.data.path={train}\n"
+        "top.match.count=5\nvalidation.mode=true\n"
+        "positive.class.value=closed\n"
+        "num.trees=3\nforest.boost.num.rounds=3\nmax.depth=3\n")
+    return str(train), str(test), str(props)
+
+
+# verb -> (input selector, extra -D overrides); input "train" or "test"
+_VERBS = {
+    "BayesianDistribution": ("train", ()),
+    "NearestNeighbor": ("test", ()),
+    "MutualInformation": ("train", ()),
+    "RandomForestBuilder": ("train", ()),
+    "GradientBoostBuilder": ("train", ()),
+}
+
+
+def _run_verb(capsys, verb, in_path, out_path, props, *extra):
+    from avenir_tpu.cli.main import main as cli
+    rc = cli([verb, in_path, out_path, "--conf", props, *extra])
+    assert rc in (0, None)
+    return capsys.readouterr().out
+
+
+class TestByteIdentity:
+    """The refactor gate: plan output == legacy output, bit for bit,
+    cache cold AND warm, for all five ported verbs."""
+
+    @pytest.mark.parametrize("verb", sorted(_VERBS))
+    def test_plan_matches_legacy_cold_and_warm(self, tmp_path, capsys,
+                                               verb):
+        train, test, props = _churn_fixture(tmp_path)
+        in_path = test if _VERBS[verb][0] == "test" else train
+        extra = _VERBS[verb][1]
+
+        legacy = _run_verb(capsys, verb, in_path,
+                           str(tmp_path / "out_legacy.txt"), props,
+                           "-D", "plan.enable=false", *extra)
+        reset_cache()
+        cold = _run_verb(capsys, verb, in_path,
+                         str(tmp_path / "out_cold.txt"), props, *extra)
+        lr = last_run()
+        assert lr["verb"] == verb
+        assert lr["outcomes"]["stage:train"] == "miss"
+        warm = _run_verb(capsys, verb, in_path,
+                         str(tmp_path / "out_warm.txt"), props, *extra)
+        lr = last_run()
+        assert lr["outcomes"]["stage:train"] == "hit"
+        assert lr["outcomes"]["encode:train"] == "skipped"
+
+        assert cold == legacy and warm == legacy
+        want = (tmp_path / "out_legacy.txt").read_bytes()
+        assert (tmp_path / "out_cold.txt").read_bytes() == want
+        assert (tmp_path / "out_warm.txt").read_bytes() == want
+
+    def test_nb_then_knn_chain_hits_staged_train(self, tmp_path, capsys):
+        """The headline payload: KNN after NB pays zero encode — the
+        staged train table is served from the cross-verb cache."""
+        train, test, props = _churn_fixture(tmp_path)
+        _run_verb(capsys, "BayesianDistribution", train,
+                  str(tmp_path / "nb.txt"), props)
+        out = _run_verb(capsys, "NearestNeighbor", test,
+                        str(tmp_path / "knn.txt"), props)
+        lr = last_run()
+        assert lr["outcomes"]["stage:train"] == "hit"
+        assert lr["outcomes"]["encode:train"] == "skipped"
+        assert staged_cache().stats()["hits"] >= 1
+        # and the chained prediction is still byte-identical to legacy
+        legacy = _run_verb(capsys, "NearestNeighbor", test,
+                           str(tmp_path / "knn_legacy.txt"), props,
+                           "-D", "plan.enable=false")
+        assert out == legacy
+        assert (tmp_path / "knn.txt").read_bytes() \
+            == (tmp_path / "knn_legacy.txt").read_bytes()
+
+    def test_boost_warm_rerun_rehits_binned_catalog(self, tmp_path,
+                                                    capsys):
+        """Hyperparameter re-runs over the same data re-bin nothing: the
+        catalog fingerprint covers only the table + split-shaping keys,
+        so a changed round count still HITS stage:catalog."""
+        train, _, props = _churn_fixture(tmp_path)
+        _run_verb(capsys, "GradientBoostBuilder", train,
+                  str(tmp_path / "b1.txt"), props)
+        out = _run_verb(capsys, "GradientBoostBuilder", train,
+                        str(tmp_path / "b2.txt"), props,
+                        "-D", "forest.boost.num.rounds=5")
+        lr = last_run()
+        assert lr["outcomes"]["stage:catalog"] == "hit"
+        legacy = _run_verb(capsys, "GradientBoostBuilder", train,
+                           str(tmp_path / "b3.txt"), props,
+                           "-D", "forest.boost.num.rounds=5",
+                           "-D", "plan.enable=false")
+        assert out == legacy
+        assert (tmp_path / "b2.txt").read_bytes() \
+            == (tmp_path / "b3.txt").read_bytes()
+
+
+class TestResumedShardedKnn:
+    """The ShardJournal retry/resume contract carried as a plan-node
+    property: a sharded KNN run through the fused ``kernel:knn.shards``
+    node, killed after one shard, resumed with ``--resume`` — final
+    output byte-identical to an uninterrupted run."""
+
+    def _fixtures(self, tmp_path, n=600):
+        from avenir_tpu.datagen.generators import (elearn_rows,
+                                                   elearn_schema_json)
+        rows = elearn_rows(n, seed=21)
+        (tmp_path / "train.csv").write_text(
+            "\n".join(",".join(r) for r in rows[:420]) + "\n")
+        d = tmp_path / "testdir"
+        d.mkdir()
+        for s, (lo, hi) in enumerate(((420, 480), (480, 540), (540, n))):
+            (d / f"part-{s:05d}").write_text(
+                "\n".join(",".join(r) for r in rows[lo:hi]) + "\n")
+        (d / "_SUCCESS").write_text("")
+        (tmp_path / "elearn.json").write_text(
+            json.dumps(elearn_schema_json()))
+        props = tmp_path / "knn.properties"
+        props.write_text(
+            "field.delim.regex=,\nfield.delim=,\n"
+            f"feature.schema.file.path={tmp_path}/elearn.json\n"
+            f"train.data.path={tmp_path}/train.csv\n"
+            "top.match.count=5\nvalidation.mode=true\n"
+            "positive.class.value=fail\n")
+        return d, str(props)
+
+    def test_sharded_plan_carries_journal_property(self, tmp_path):
+        from avenir_tpu.cli.plans import build_plan
+        d, props = self._fixtures(tmp_path)
+        conf = JobConfig.from_file(props).set("job.resume", "true")
+        plan = build_plan("NearestNeighbor", conf, str(d),
+                          str(tmp_path / "o.txt"))
+        node = plan.node("kernel:knn.shards")
+        assert node.fused
+        assert node.journal == {"dir": str(tmp_path / "o.txt") + ".shards",
+                                "shards": 3, "resume": True,
+                                "enabled": True}
+
+    def test_resume_is_byte_identical_through_plan(self, tmp_path,
+                                                   capsys):
+        d, props = self._fixtures(tmp_path)
+        out = tmp_path / "out.txt"
+        ref = tmp_path / "ref.txt"
+        # uninterrupted run (legacy body) — the oracle
+        _run_verb(capsys, "NearestNeighbor", str(d), str(ref), props,
+                  "-D", "plan.enable=false")
+        # plan run, journal kept so we can fake a mid-job kill
+        report = _run_verb(capsys, "NearestNeighbor", str(d), str(out),
+                           props, "-D", "shard.journal.keep=true")
+        lr = last_run()
+        assert lr["verb"] == "NearestNeighbor"
+        assert lr["outcomes"]["kernel:knn.shards"] == "ran"
+        shards_dir = tmp_path / "out.txt.shards"
+        assert sorted(p.name for p in shards_dir.glob("shard-*.json")) \
+            == ["shard-00000.json", "shard-00001.json",
+                "shard-00002.json"]
+        # "kill": shard 1 never committed, assembly never happened
+        (shards_dir / "shard-00001.json").unlink()
+        (shards_dir / "shard-00001.out").unlink()
+        out.unlink()
+        reset_cache()
+        resumed = _run_verb(capsys, "NearestNeighbor", str(d), str(out),
+                            props, "--resume")
+        # resume prints the same validation report plus the resilience
+        # summary line proving the two committed shards were NOT redone
+        assert resumed.startswith(report)
+        assert '"shards_resumed": 2' in resumed
+        assert '"shards_computed": 1' in resumed
+        assert out.read_bytes() == ref.read_bytes()
+
+
+class TestCacheCorrectness:
+    """Fingerprints cover every encode-affecting key: a changed key must
+    MISS (regression guard against silently serving stale staged
+    bytes)."""
+
+    def _conf(self, tmp_path):
+        _, _, props = _churn_fixture(tmp_path)
+        return JobConfig.from_file(props)
+
+    def _fp(self, conf, train, **kw):
+        from avenir_tpu.plan.fingerprint import staged_table_fingerprint
+        return staged_table_fingerprint(conf, train, with_labels=True,
+                                        **kw)
+
+    @pytest.mark.parametrize("key,value", [
+        ("on.bad.row", "skip"),
+        ("on.bad.row", "quarantine"),
+        ("max.bad.fraction", "0.5"),
+        ("quarantine.dir", "/tmp/q"),
+        ("unseen.value.handling", "other"),
+        ("field.delim.regex", ";"),
+    ])
+    def test_encode_affecting_key_changes_fingerprint(self, tmp_path,
+                                                      key, value):
+        train, _, props = _churn_fixture(tmp_path)
+        base = self._fp(JobConfig.from_file(props), train)
+        changed = self._fp(JobConfig.from_file(props).set(key, value),
+                           train)
+        assert changed != base
+
+    def test_feed_bucket_keys_change_fingerprint(self, tmp_path):
+        train, _, props = _churn_fixture(tmp_path)
+        conf = JobConfig.from_file(props)
+        base = self._fp(conf, train)
+        assert self._fp(conf, train, feed_chunk_rows=256) != base
+        assert self._fp(conf, train, bucketed=True) != base
+        assert self._fp(conf, train, fit_fingerprint=base) != base
+
+    def test_schema_and_data_content_change_fingerprint(self, tmp_path):
+        train, _, props = _churn_fixture(tmp_path)
+        conf = JobConfig.from_file(props)
+        base = self._fp(conf, train)
+        # schema edited IN PLACE (same path) must miss: content-hashed
+        schema = conf.get_required("feature.schema.file.path")
+        with open(schema, "a") as fh:
+            fh.write("\n")
+        assert self._fp(conf, train) != base
+        # data rewritten (size or mtime_ns moves) must miss
+        with open(train, "a") as fh:
+            fh.write("x\n")
+        base2 = self._fp(conf, train)
+        assert base2 != base
+
+    def test_changed_bad_row_policy_misses_on_full_run(self, tmp_path,
+                                                       capsys):
+        """End to end: warm cache, then flip an encode-affecting key —
+        the next run's stage:train must be a MISS, not a stale hit."""
+        train, _, props = _churn_fixture(tmp_path)
+        _run_verb(capsys, "BayesianDistribution", train,
+                  str(tmp_path / "m1.txt"), props)
+        _run_verb(capsys, "BayesianDistribution", train,
+                  str(tmp_path / "m2.txt"), props,
+                  "-D", "on.bad.row=skip")
+        lr = last_run()
+        assert lr["outcomes"]["stage:train"] == "miss"
+        assert lr["outcomes"]["encode:train"] == "ran"
+
+
+class TestStagedTableCache:
+    """LRU-over-byte-budget unit behavior."""
+
+    def test_get_put_and_miss_sentinel(self):
+        c = StagedTableCache(budget_bytes=1 << 20)
+        assert c.get("a") is MISS
+        assert c.put("a", [1, 2, 3])
+        assert c.get("a") == [1, 2, 3]
+        assert c.contains("a") and not c.contains("b")
+        s = c.stats()
+        assert (s["hits"], s["misses"], s["entries"]) == (1, 1, 1)
+
+    def test_contains_does_not_touch_stats_or_order(self):
+        c = StagedTableCache(budget_bytes=1 << 20)
+        c.put("a", "x")
+        for _ in range(5):
+            c.contains("a")
+            c.contains("zzz")
+        s = c.stats()
+        assert s["hits"] == 0 and s["misses"] == 0
+
+    def test_lru_eviction_order_and_budget(self):
+        c = StagedTableCache(budget_bytes=400)
+        c.put("a", "x", nbytes=150)
+        c.put("b", "y", nbytes=150)
+        assert c.get("a") == "x"          # a now MRU
+        c.put("c", "z", nbytes=150)       # over budget -> evict LRU = b
+        assert c.contains("a") and c.contains("c")
+        assert not c.contains("b")
+        assert c.stats()["evictions"] == 1
+
+    def test_oversize_entry_is_skipped_not_cached(self):
+        c = StagedTableCache(budget_bytes=100)
+        assert not c.put("big", "x", nbytes=101)
+        assert not c.contains("big")
+        assert c.stats()["oversize_skips"] == 1
+
+    def test_set_budget_evicts_down(self):
+        c = StagedTableCache(budget_bytes=1000)
+        c.put("a", "x", nbytes=400)
+        c.put("b", "y", nbytes=400)
+        c.set_budget(500)
+        assert not c.contains("a") and c.contains("b")
+
+    def test_clear_resets_entries_and_counters(self):
+        c = StagedTableCache(budget_bytes=1000)
+        c.put("a", "x")
+        c.get("a")
+        c.get("nope")
+        c.clear()
+        s = c.stats()
+        assert s == {"hits": 0, "misses": 0, "evictions": 0,
+                     "oversize_skips": 0, "entries": 0, "bytes": 0,
+                     "budget_bytes": 1000, "hit_fraction": 0.0}
+
+    def test_nbytes_of_counts_arrays_exactly(self):
+        import numpy as np
+        from avenir_tpu.plan.cache import nbytes_of
+        arr = np.zeros((10, 10), dtype=np.float32)
+        assert nbytes_of(arr) == 400
+        assert nbytes_of([arr, arr]) >= 800
+
+
+class TestExplain:
+    """--explain prints the plan (nodes / edges / fingerprints / cache
+    probes) WITHOUT executing, and dumps plan JSON beside
+    --metrics-out."""
+
+    def test_explain_prints_plan_and_runs_nothing(self, tmp_path,
+                                                  capsys):
+        train, test, props = _churn_fixture(tmp_path)
+        out = tmp_path / "never_written.txt"
+        from avenir_tpu.cli.main import main as cli
+        rc = cli(["NearestNeighbor", test, str(out), "--conf", props,
+                  "--explain"])
+        assert rc == 0
+        txt = capsys.readouterr().out
+        for want in ("stage:train", "kernel:knn.classify",
+                     "write:predictions", "cache=", "fp="):
+            assert want in txt
+        assert not out.exists()
+        # probes stayed non-mutating: no hit/miss stats recorded
+        s = staged_cache().stats()
+        assert s["hits"] == 0 and s["misses"] == 0
+
+    def test_explain_probe_shows_warm_cache_hit(self, tmp_path, capsys):
+        train, test, props = _churn_fixture(tmp_path)
+        _run_verb(capsys, "BayesianDistribution", train,
+                  str(tmp_path / "nb.txt"), props)
+        from avenir_tpu.cli.main import main as cli
+        cli(["NearestNeighbor", test, str(tmp_path / "o.txt"),
+             "--conf", props, "--explain"])
+        txt = capsys.readouterr().out
+        assert "cache=hit" in txt        # stage:train would be served
+        assert "cache=miss" in txt       # stage:test would not
+
+    def test_explain_dumps_plan_json_beside_metrics_out(self, tmp_path,
+                                                        capsys):
+        train, _, props = _churn_fixture(tmp_path)
+        metrics = tmp_path / "m.jsonl"
+        from avenir_tpu.cli.main import main as cli
+        cli(["BayesianDistribution", train, str(tmp_path / "o.txt"),
+             "--conf", props, "--explain",
+             "--metrics-out", str(metrics)])
+        capsys.readouterr()
+        doc = json.loads((tmp_path / "m.jsonl.plan.json").read_text())
+        assert doc["verb"] == "BayesianDistribution"
+        names = [n["name"] for n in doc["nodes"]]
+        assert names == ["encode:train", "stage:train",
+                         "kernel:nb.train", "write:model"]
+        assert {e["type"] for e in doc["edges"]} >= {"row-batch",
+                                                     "staged-table"}
+        assert not metrics.exists()      # explain never executes
+
+    def test_explain_refuses_non_plan_mode(self, tmp_path):
+        train, test, props = _churn_fixture(tmp_path)
+        from avenir_tpu.cli.main import main as cli
+        with pytest.raises(ValueError, match="plan-capable"):
+            cli(["NearestNeighbor", test, str(tmp_path / "o.txt"),
+                 "--conf", props, "--explain",
+                 "-D", "prediction.mode=regression"])
+        with pytest.raises(ValueError, match="plan.enable"):
+            cli(["BayesianDistribution", train, str(tmp_path / "o.txt"),
+                 "--conf", props, "--explain",
+                 "-D", "plan.enable=false"])
+
+
+class TestGraphValidation:
+    def test_bad_kind_and_duplicate_and_undeclared_edge(self):
+        from avenir_tpu.plan.graph import Plan
+        p = Plan("X")
+        p.add(name="encode:a", kind="encode", run=lambda v: None,
+              output="a")
+        with pytest.raises(ValueError, match="kind"):
+            p.add(name="bad", kind="mystery", run=lambda v: None)
+        with pytest.raises(ValueError, match="duplicate"):
+            p.add(name="encode:a", kind="encode", run=lambda v: None)
+        with pytest.raises(ValueError, match="undeclared"):
+            p.add(name="stage:b", kind="stage", run=lambda v: None,
+                  inputs=("nope",))
+
+
+def test_plan_smoke_script():
+    """Tier-1 hook: scripts/plan_smoke.py gates the chained NB->KNN
+    cache hit, byte-identical outputs vs independent runs, and per-node
+    spans in the merged report, in one in-process run."""
+    script = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "scripts", "plan_smoke.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    for attempt in (1, 2):
+        proc = subprocess.run([sys.executable, script],
+                              capture_output=True, text=True,
+                              timeout=120, env=env)
+        if proc.returncode == 0:
+            break
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] and report["byte_identical"]
+    assert report["chain_hits"] >= 1
+    assert report["plan_spans"] >= 3
