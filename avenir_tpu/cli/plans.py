@@ -39,13 +39,56 @@ def _new_plan(conf: JobConfig, verb: str) -> Plan:
 
 
 def _add_staged_train(plan: Plan, conf: JobConfig, in_path: str, *,
-                      with_labels: bool = True) -> str:
+                      with_labels: bool = True,
+                      out_path: Optional[str] = None) -> str:
     """The shared encode:train -> stage:train pair. Returns the stage
     fingerprint (dependent tables chain to it). The fingerprint is
     verb-independent on purpose: NB's staged train table IS KNN's —
-    that equality is the chained-verbs cache hit."""
+    that equality is the chained-verbs cache hit.
+
+    ISSUE 19: when the input is big enough and the featurizer fit is
+    schema-only, the encode runs as the PARALLEL split ingest
+    (``parallel/ingest.py``) — same fingerprint, byte-identical staged
+    table, carried declaratively as the encode node's ``ingest``
+    property so ``--explain`` shows the split plan. Otherwise the
+    serial ``_load_table`` body runs unchanged."""
     fp = FP.staged_table_fingerprint(conf, in_path,
                                      with_labels=with_labels)
+    from avenir_tpu.parallel import ingest as ING
+    iplan = ING.plan_ingest(conf, in_path, with_labels=with_labels)
+
+    if iplan.parallel:
+        def _encode(values):
+            from avenir_tpu.utils.dataset import Featurizer
+            from avenir_tpu.utils.schema import FeatureSchema
+            schema = FeatureSchema.from_file(
+                conf.get_required("feature.schema.file.path"))
+            fz = Featurizer(schema, unseen=conf.get(
+                "unseen.value.handling", "error"))
+            fz.fit([])   # eligibility gate: schema-only fit == fit(rows)
+            return fz, iplan
+
+        def _stage(values):
+            fz, ip = values["train.rows"]
+            table = ING.run_ingest(
+                fz, ip, conf, with_labels=with_labels, table_fp=fp,
+                journal_dir=(out_path + ".ingest-train")
+                if out_path else None, tag="train")
+            return fz, table
+
+        plan.add(name="encode:train", kind="encode", run=_encode,
+                 output="train.rows", edge_type="split-plan",
+                 ingest=iplan.describe(),
+                 detail=f"parallel split parse over {in_path} "
+                        f"({len(iplan.splits)} splits x "
+                        f"{iplan.workers} workers)")
+        plan.add(name="stage:train", kind="stage", run=_stage,
+                 inputs=("train.rows",), output="train.table",
+                 edge_type="staged-table", fingerprint=fp,
+                 skips_on_hit=("encode:train",), fused=True,
+                 detail="re-sequenced encode pool -> DeviceFeed "
+                        "(decode/encode || H2D || assemble)")
+        return fp
 
     def _encode(values):
         from avenir_tpu.cli import main as cli_main
@@ -80,7 +123,7 @@ def build_nb_plan(conf: JobConfig, in_path: str,
             or conf.get_bool("job.resume", False)):
         return None             # journaled per-shard count fold
     plan = _new_plan(conf, "BayesianDistribution")
-    _add_staged_train(plan, conf, in_path)
+    _add_staged_train(plan, conf, in_path, out_path=out_path)
 
     def _train(values):
         from avenir_tpu.models import naive_bayes as nb
@@ -163,7 +206,8 @@ def build_knn_plan(conf: JobConfig, in_path: str,
                and conf.get_bool("shard.prefetch", True))
 
     plan = _new_plan(conf, "NearestNeighbor")
-    fp_train = _add_staged_train(plan, conf, train_path)
+    fp_train = _add_staged_train(plan, conf, train_path,
+                                 out_path=out_path)
 
     if sharded:
         # fused shard pipeline: PrefetchLoader featurizes + stages shard
@@ -193,13 +237,31 @@ def build_knn_plan(conf: JobConfig, in_path: str,
         conf, in_path, with_labels=validation,
         feed_chunk_rows=feed_chunk_rows, fit_fingerprint=fp_train)
 
-    def _encode_test(values):
-        from avenir_tpu.utils.dataset import read_csv_lines
-        return read_csv_lines(in_path, delim_in)
+    # the test table encodes through the TRAIN-fitted featurizer, so
+    # parallel eligibility does not need a schema-only fit
+    from avenir_tpu.parallel import ingest as ING
+    iplan_test = ING.plan_ingest(conf, in_path, with_labels=validation,
+                                 require_schema_only_fit=False)
 
-    def _stage_test(values):
-        fz, _ = values["train.table"]
-        return fz.transform(values["test.rows"], with_labels=validation)
+    if iplan_test.parallel:
+        def _encode_test(values):
+            return iplan_test
+
+        def _stage_test(values):
+            fz, _ = values["train.table"]
+            return ING.run_ingest(
+                fz, values["test.rows"], conf, with_labels=validation,
+                table_fp=fp_test,
+                journal_dir=out_path + ".ingest-test", tag="test")
+    else:
+        def _encode_test(values):
+            from avenir_tpu.utils.dataset import read_csv_lines
+            return read_csv_lines(in_path, delim_in)
+
+        def _stage_test(values):
+            fz, _ = values["train.table"]
+            return fz.transform(values["test.rows"],
+                                with_labels=validation)
 
     def _classify(values):
         from avenir_tpu.cli import main as cli_main
@@ -234,13 +296,22 @@ def build_knn_plan(conf: JobConfig, in_path: str,
         print(cm.report().to_json())
 
     plan.add(name="encode:test", kind="encode", run=_encode_test,
-             output="test.rows", edge_type="row-batch",
-             detail=f"parse {in_path}")
+             output="test.rows",
+             edge_type="split-plan" if iplan_test.parallel
+             else "row-batch",
+             ingest=iplan_test.describe() if iplan_test.parallel
+             else None,
+             detail=(f"parallel split parse over {in_path} "
+                     f"({len(iplan_test.splits)} splits x "
+                     f"{iplan_test.workers} workers)")
+             if iplan_test.parallel else f"parse {in_path}")
     plan.add(name="stage:test", kind="stage", run=_stage_test,
              inputs=("train.table", "test.rows"), output="test.table",
              edge_type="staged-table", fingerprint=fp_test,
-             skips_on_hit=("encode:test",),
-             detail="test rows through the train-fitted featurizer")
+             skips_on_hit=("encode:test",), fused=iplan_test.parallel,
+             detail="re-sequenced encode pool through the train-fitted "
+                    "featurizer" if iplan_test.parallel else
+                    "test rows through the train-fitted featurizer")
     plan.add(name="kernel:knn.classify", kind="kernel", run=_classify,
              inputs=("train.table", "test.table"), output="knn.pred",
              edge_type="predictions", fused=feed_chunk_rows > 0,
@@ -267,7 +338,7 @@ def build_mi_plan(conf: JobConfig, in_path: str,
             or conf.get_bool("job.resume", False)):
         return None             # journaled per-shard distribution fold
     plan = _new_plan(conf, "MutualInformation")
-    _add_staged_train(plan, conf, in_path)
+    _add_staged_train(plan, conf, in_path, out_path=out_path)
 
     def _distributions(values):
         from avenir_tpu.explore import mutual_information as mi
@@ -308,7 +379,7 @@ def build_mi_plan(conf: JobConfig, in_path: str,
 def build_forest_plan(conf: JobConfig, in_path: str,
                       out_path: str) -> Optional[Plan]:
     plan = _new_plan(conf, "RandomForestBuilder")
-    _add_staged_train(plan, conf, in_path)
+    _add_staged_train(plan, conf, in_path, out_path=out_path)
 
     def _grow(values):
         from avenir_tpu.cli import main as cli_main
@@ -361,7 +432,7 @@ def build_boost_plan(conf: JobConfig, in_path: str,
     if conf.get_bool("streaming.train", False):
         return None             # out-of-core cached-chunk fold
     plan = _new_plan(conf, "GradientBoostBuilder")
-    fp_train = _add_staged_train(plan, conf, in_path)
+    fp_train = _add_staged_train(plan, conf, in_path, out_path=out_path)
     # the binned candidate catalog depends on the staged table plus the
     # split-shaping keys ONLY — rounds / learning rate / depth changes
     # re-hit it (the "binned catalog is a cache hit across rounds"

@@ -1,0 +1,535 @@
+"""Parallel cold-path ingest: sharded encode pool + three-stage overlap.
+
+PR 18's staged-table cache made the WARM path free; this module is the
+cold path's answer (ISSUE 19). The serial cold path — one thread parsing
+CSV and featurizing in front of the kernel — is the dominant cost of
+every first run. The reference's batch tier got ingest parallelism for
+free from Hadoop splits (each mapper parses its own HDFS split); this is
+that contract rebuilt inside one process, as the tf.data-style input
+pipeline the :class:`~avenir_tpu.parallel.pipeline.DeviceFeed` already
+half-implements:
+
+1. **Split planning** (:func:`plan_splits`): input part files — and byte
+   ranges of large single files — cut into ~``ingest.split.bytes``
+   splits, each owned by exactly the lines whose first byte falls inside
+   it (``utils.dataset.read_line_window``, the HDFS-split boundary rule,
+   so windows tile a file's lines exactly whatever the byte cuts hit).
+2. **Encode pool**: ``ingest.workers`` threads decode + encode splits
+   concurrently. The native C++ parser releases the GIL, so worker
+   threads genuinely parallelize the parse; the Python fallback keeps
+   byte-identical output (same tokenization, same bad-row
+   classification) at GIL-bound speed.
+3. **Re-sequencing + staging**: workers may COMPLETE out of order, but
+   the driver consumes futures strictly in split order (a bounded
+   ordered-futures window of ``workers + ingest.queue.depth`` splits),
+   so chunks re-sequence before staging and the assembled table is
+   byte-identical to the serial encoder — cold, warm, and under
+   ``plan.enable=false``. Ordered chunks stream through a
+   :class:`DeviceFeed` (bounded ``ingest.queue.depth``), overlapping
+   host decode/encode (stage 1) with H2D staging (stage 2) with the
+   device-side assembly of already-staged chunks (stage 3).
+
+Determinism invariants (DESIGN.md §26):
+
+- Output ordering is the file/line order of the serial encoder — the
+  re-sequencer guarantees it regardless of worker completion order.
+- Fingerprints do not change: same bytes in → same staged table out, so
+  ``plan/fingerprint.py`` is untouched and a table encoded in parallel
+  HITS a cache entry written by the serial encoder (and vice versa).
+- ``on.bad.row`` policy is applied by the DRIVER in split order from
+  the workers' split-relative bad-row records (rebased to exact
+  file-global line numbers via cumulative per-split physical line
+  counts): raise mode raises on the globally-first bad row, and
+  skip/quarantine produce the same surviving rows, sidecars and
+  circuit-breaker behavior as the serial resilient encoder
+  (``native/loader.transform_file``).
+
+``ShardJournal`` retry/resume composes per split (``ingest.journal``):
+each split's encoded arrays commit as an npz payload + completion
+record, so a killed cold ingest resumes encoding only the missing
+splits, byte-identical to an uninterrupted run.
+
+Telemetry: workers record per-split ``ingest.decode`` / ``ingest.encode``
+spans (raw-name records — safe from worker threads), the feed records
+``feed.h2d`` per staged chunk, and exhaustion publishes an
+``ingest.overlap_fraction`` gauge (share of worker encode time hidden
+behind the driver's staging + assembly) to the telemetry hub.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import re
+import time
+from collections import deque
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from avenir_tpu.obs import telemetry
+from avenir_tpu.parallel.pipeline import DeviceFeed
+from avenir_tpu.utils.dataset import part_file_paths, read_line_window
+
+# line terminators the text-mode readers recognize (universal newlines):
+# the Python-fallback worker must split windows EXACTLY like
+# read_csv_lines / _python_encode_file or line numbers and blank-line
+# skipping drift between the serial and parallel encoders
+_LINE_SPLIT = re.compile("\r\n|\r|\n")
+
+
+# ---------------------------------------------------------------------------
+# split planning
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Split:
+    """One unit of parallel encode work: a byte window of one file."""
+
+    index: int          # global submission/consumption order
+    path: str
+    start: int
+    stop: int
+    last_in_file: bool  # the driver finalizes the file's policy here
+
+
+def plan_splits(paths: List[str], split_bytes: int) -> List[Split]:
+    """Cut ``paths`` (in part-file order) into byte windows of roughly
+    ``split_bytes``. Boundary bytes are arbitrary — ownership of the
+    straddling line is resolved at read time by
+    :func:`~avenir_tpu.utils.dataset.read_line_window`."""
+    splits: List[Split] = []
+    index = 0
+    for path in paths:
+        size = os.path.getsize(path)
+        if size == 0:
+            continue
+        n = max(1, -(-size // split_bytes))   # ceil
+        for k in range(n):
+            splits.append(Split(
+                index=index, path=path,
+                start=k * split_bytes,
+                stop=min((k + 1) * split_bytes, size),
+                last_in_file=(k == n - 1)))
+            index += 1
+    return splits
+
+
+def fit_is_schema_only(schema) -> bool:
+    """True when ``Featurizer.fit`` is fully determined by the schema —
+    every categorical (and the class field) carries a cardinality list
+    and every numeric (bucketed AND continuous) carries min+max — so
+    ``fit([])`` builds the same encoders as ``fit(rows)``. STRICTER than
+    ``Featurizer.schema_data_dependent``, which only flags bucketed
+    numerics: a continuous numeric without min/max still fits its
+    normalization range from the data."""
+    for f in schema.get_feature_fields():
+        if f.is_categorical:
+            if f.cardinality is None:
+                return False
+        elif f.is_numeric:
+            if f.min is None or f.max is None:
+                return False
+        else:
+            return False   # unknown field kind: be conservative
+    try:
+        class_field = schema.find_class_attr_field()
+    except ValueError:
+        class_field = None
+    if class_field is not None and class_field.cardinality is None:
+        return False
+    return True
+
+
+@dataclass
+class IngestPlan:
+    """The build-time decision: parallel (with a split plan) or serial
+    (with the reason — surfaced by ``--explain``)."""
+
+    parallel: bool
+    reason: str
+    workers: int = 0
+    split_bytes: int = 0
+    queue_depth: int = 2
+    chunk_rows: int = 65536
+    splits: List[Split] = dc_field(default_factory=list)
+
+    @classmethod
+    def serial(cls, reason: str) -> "IngestPlan":
+        return cls(parallel=False, reason=reason)
+
+    def describe(self) -> Dict[str, Any]:
+        """The plan node's ``ingest`` property (graph/to_json/--explain)."""
+        return {"workers": self.workers,
+                "splits": len(self.splits),
+                "split_bytes": self.split_bytes,
+                "files": len({s.path for s in self.splits}),
+                "queue_depth": self.queue_depth}
+
+
+def plan_ingest(conf, in_path: str, *, with_labels: bool = True,
+                require_schema_only_fit: bool = True) -> IngestPlan:
+    """Decide at plan-build time whether this table encodes in parallel.
+
+    Serial fallbacks (each with its reason): ``ingest.parallel=false``,
+    a single worker, input that fits one split, or — for tables whose
+    encode includes the featurizer FIT — a schema with data-dependent
+    vocabularies/ranges (the fit must see every row, so splitting the
+    parse cannot be transparent). The KNN test table encodes through the
+    train-fitted featurizer and passes ``require_schema_only_fit=False``.
+    """
+    del with_labels   # same eligibility either way; kept for symmetry
+    if not conf.get_bool("ingest.parallel", True):
+        return IngestPlan.serial("ingest.parallel=false")
+    workers = conf.get_int("ingest.workers", 0)
+    if workers <= 0:
+        workers = os.cpu_count() or 1
+    if workers < 2:
+        return IngestPlan.serial("one worker (ingest.workers)")
+    split_bytes = max(conf.get_int("ingest.split.bytes", 32 << 20), 1)
+    splits = plan_splits(part_file_paths(in_path), split_bytes)
+    if len(splits) < 2:
+        return IngestPlan.serial("input fits one split")
+    if require_schema_only_fit:
+        from avenir_tpu.utils.schema import FeatureSchema
+        schema = FeatureSchema.from_file(
+            conf.get_required("feature.schema.file.path"))
+        if not fit_is_schema_only(schema):
+            return IngestPlan.serial("data-dependent featurizer fit")
+    return IngestPlan(
+        parallel=True, reason="",
+        workers=min(workers, len(splits)),
+        split_bytes=split_bytes,
+        queue_depth=max(conf.get_int("ingest.queue.depth", 2), 1),
+        chunk_rows=max(conf.get_int("ingest.chunk.rows", 65536), 1),
+        splits=splits)
+
+
+# ---------------------------------------------------------------------------
+# worker side: one split -> encoded arrays + split-relative bad rows
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EncodedChunk:
+    """One split's encode result. ``bads`` carry SPLIT-RELATIVE 1-based
+    line numbers (``line_base=0`` on the worker); the driver rebases
+    them with the cumulative physical line count of the split's
+    predecessors in the same file."""
+
+    split: Split
+    binned: np.ndarray
+    numeric: np.ndarray
+    labels: Optional[np.ndarray]
+    ids: Optional[List[str]]
+    n_lines: int               # physical lines this split's window spans
+    bads: List[Any]            # loader.BadRow, split-relative lines
+    decode_ms: float = 0.0
+    encode_ms: float = 0.0
+    resumed: bool = False
+
+
+class _Encoder:
+    """Per-run encode context shared by the worker threads: native specs
+    (built once) or the Python row specs + splitter, plus the schema
+    facts the assembly needs."""
+
+    def __init__(self, fz, conf, with_labels: bool):
+        from avenir_tpu.native import loader
+        self.fz = fz
+        self.with_labels = with_labels
+        self.delim_regex = conf.get("field.delim.regex", ",")
+        self.has_id = fz.schema.find_id_field() is not None
+        try:
+            class_field = fz.schema.find_class_attr_field()
+        except ValueError:
+            class_field = None
+        self.use_labels = with_labels and class_field is not None
+        self.native = False
+        if conf.get_bool("ingest.native", True):
+            try:
+                self.lib, self.delim = loader._native_lib_and_delim(
+                    fz, self.delim_regex)
+                self.specs = loader._build_specs(fz, with_labels)
+                self.native = True
+            except loader.NativeUnavailable:
+                pass
+        if not self.native:
+            self.pyspecs, self.pyclass = loader._python_row_specs(
+                fz, with_labels)
+            self.splitter = re.compile(self.delim_regex)
+
+    def encode_split(self, split: Split) -> EncodedChunk:
+        """Worker entry: read the split's owned lines, encode them, and
+        classify malformed rows WITHOUT raising — the driver applies the
+        real ``on.bad.row`` policy in split order, so errors surface
+        deterministically whatever the completion order."""
+        from avenir_tpu.native import loader
+        tracer = telemetry.tracer()
+        t0 = time.perf_counter()
+        buf = read_line_window(split.path, split.start, split.stop)
+        t1 = time.perf_counter()
+        n_lines = loader._count_lines(buf)
+        if self.native:
+            # a private always-skip policy: bad rows are RECORDED (and
+            # compacted) but never raise here, and line_base=0 keeps the
+            # recorded line numbers split-relative
+            policy = loader._BadRowPolicy(
+                split.path, "skip", 1.0, None, loader.ParseStats())
+            binned, numeric, labels, ids = loader._encode_buffer(
+                self.lib, self.fz, buf, self.delim, self.specs,
+                n_threads=1, want_ids=True, policy=policy, line_base=0)
+            bads = list(policy.stats.bad_rows)
+            t2 = time.perf_counter()
+        else:
+            binned, numeric, labels, ids, bads, t2 = \
+                self._encode_python(buf, t1)
+        decode_ms = (t1 - t0) * 1e3
+        encode_ms = (t2 - t1) * 1e3
+        if tracer.enabled:
+            tracer.record("ingest.decode", decode_ms)
+            tracer.record("ingest.encode", encode_ms)
+        return EncodedChunk(
+            split=split, binned=binned, numeric=numeric, labels=labels,
+            ids=ids if self.has_id else None, n_lines=n_lines, bads=bads,
+            decode_ms=decode_ms, encode_ms=encode_ms)
+
+    def _encode_python(self, buf: bytes, t1: float):
+        """Python fallback: the `_python_encode_file` row loop over one
+        byte window — same tokenization (regex split + strip), same
+        blank-line skipping, same first-failure classification."""
+        from avenir_tpu.native import loader
+        rows: List[List[str]] = []
+        bads: List[Any] = []
+        for lineno, line in enumerate(_LINE_SPLIT.split(buf.decode()), 1):
+            if not line:
+                continue
+            row = [t.strip() for t in self.splitter.split(line)]
+            verdict = loader._check_row(self.pyspecs, self.pyclass, row)
+            if verdict is not None:
+                code, ordinal, tok, n_fields = verdict
+                bads.append(loader._make_bad(lineno, code, ordinal, tok,
+                                             n_fields))
+                continue
+            rows.append(row)
+        t_mid = time.perf_counter()
+        binned, numeric, labels, ids = self.fz.transform_arrays(
+            rows, with_labels=self.with_labels, row_offset=0)
+        # tokenize counts as decode, transform as encode — mirror the
+        # native split where the C++ pass fuses both into "encode"
+        del t_mid
+        return binned, numeric, labels, ids, bads, time.perf_counter()
+
+
+# ---------------------------------------------------------------------------
+# driver side: ordered consumption, policy, journal, staging, assembly
+# ---------------------------------------------------------------------------
+
+# most recent run's stats per tag ("train"/"test") — the scheduler
+# attaches these to last_run() and the smokes/tests read them
+_LAST_STATS: Dict[str, Dict[str, Any]] = {}
+
+
+def take_last_stats() -> Dict[str, Dict[str, Any]]:
+    """Pop the stats of every ingest run since the previous take."""
+    global _LAST_STATS
+    out, _LAST_STATS = _LAST_STATS, {}
+    return out
+
+
+def last_stats() -> Dict[str, Dict[str, Any]]:
+    return dict(_LAST_STATS)
+
+
+def _journal_for(iplan: IngestPlan, conf, table_fp: Optional[str],
+                 journal_dir: Optional[str]):
+    """(journal, completed-records) when ``ingest.journal`` is armed."""
+    if journal_dir is None or not conf.get_bool("ingest.journal", False):
+        return None, {}
+    from avenir_tpu.plan import fingerprint as FP
+    from avenir_tpu.utils.resume import ShardJournal
+    key = FP.digest({
+        "v": 1, "node": "ingest-journal", "table": table_fp,
+        "split_bytes": iplan.split_bytes,
+        "splits": [[os.path.basename(s.path), s.start, s.stop]
+                   for s in iplan.splits]})
+    journal = ShardJournal(journal_dir, key, len(iplan.splits))
+    completed = journal.open(resume=conf.get_bool("job.resume", False))
+    return journal, completed
+
+
+def _load_payload(journal, split: Split, record: dict,
+                  use_labels: bool, has_id: bool) -> EncodedChunk:
+    """Rehydrate a journaled split — the resume path's 'encode'."""
+    from avenir_tpu.native import loader
+    arrays = journal.read_payload(split.index)
+    bads = [loader.BadRow(**b) for b in record.get("bad", [])]
+    labels = arrays.get("labels") if use_labels else None
+    ids = ([str(t) for t in arrays["ids"]]
+           if has_id and "ids" in arrays else None)
+    return EncodedChunk(
+        split=split, binned=arrays["binned"], numeric=arrays["numeric"],
+        labels=labels, ids=ids, n_lines=int(record["n_lines"]),
+        bads=bads, resumed=True)
+
+
+def run_ingest(fz, iplan: IngestPlan, conf, *, with_labels: bool = True,
+               table_fp: Optional[str] = None,
+               journal_dir: Optional[str] = None, tag: str = "train"):
+    """Encode ``iplan``'s splits in parallel and return the assembled
+    :class:`~avenir_tpu.utils.dataset.EncodedTable`, byte-identical to
+    ``fz.transform(read_csv_lines(...))`` / the serial native encoder.
+    ``fz`` must already be fitted (schema-only for train tables — the
+    eligibility check in :func:`plan_ingest` — or train-fitted for the
+    KNN test table)."""
+    from avenir_tpu.native import loader
+    if not iplan.parallel:
+        raise ValueError("run_ingest called with a serial IngestPlan "
+                         f"({iplan.reason})")
+    enc = _Encoder(fz, conf, with_labels)
+    journal, completed = _journal_for(iplan, conf, table_fp, journal_dir)
+
+    on_bad = conf.get("on.bad.row", "raise")
+    max_bad = conf.get_float("max.bad.fraction", 0.1)
+    qdir = conf.get("quarantine.dir")
+    shared_stats = loader.ParseStats()
+    policies: Dict[str, Any] = {}
+
+    stats = {"tag": tag, "parallel": True,
+             "workers": iplan.workers, "splits": len(iplan.splits),
+             "resumed_splits": 0, "encoded_splits": 0, "rows": 0,
+             "rows_quarantined": 0, "decode_ms": 0.0, "encode_ms": 0.0,
+             "wait_ms": 0.0, "overlap_fraction": 0.0}
+    ids_all: List[str] = []
+    lines_before: Dict[str, int] = {}
+    consume_order: List[int] = []   # completion/consume audit for tests
+
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=iplan.workers, thread_name_prefix="avenir-ingest")
+
+    def submit(split: Split):
+        if split.index in completed:
+            return pool.submit(_load_payload, journal, split,
+                               completed[split.index], enc.use_labels,
+                               enc.has_id)
+        return pool.submit(enc.encode_split, split)
+
+    def ordered_chunks() -> Iterator[Tuple[np.ndarray, ...]]:
+        """The re-sequencer: submit in split order with a bounded window
+        of outstanding futures, CONSUME strictly in split order (workers
+        may finish in any order), apply the bad-row policy + journal
+        commits, then yield fixed-size sub-chunks for the feed."""
+        pending: deque = deque()
+        it = iter(iplan.splits)
+        window = iplan.workers + iplan.queue_depth
+
+        def top_up():
+            while len(pending) < window:
+                s = next(it, None)
+                if s is None:
+                    return
+                pending.append((s, submit(s)))
+
+        top_up()
+        while pending:
+            split, fut = pending.popleft()
+            t0 = time.perf_counter()
+            chunk: EncodedChunk = fut.result()
+            stats["wait_ms"] += (time.perf_counter() - t0) * 1e3
+            top_up()
+
+            # --- bad-row policy, in deterministic split order ---------
+            base = lines_before.setdefault(split.path, 0)
+            policy = policies.get(split.path)
+            if policy is None:
+                policy = policies[split.path] = loader._BadRowPolicy(
+                    split.path, on_bad, max_bad, qdir, shared_stats)
+            if chunk.bads:
+                rebased = [loader.BadRow(
+                    line=base + b.line, ordinal=b.ordinal, token=b.token,
+                    reason=b.reason, detail=b.detail) for b in chunk.bads]
+                policy.record(rebased)   # raises here in raise mode
+            n = chunk.binned.shape[0]
+            policy.note_rows(n)
+            policy.check_fraction()      # per-split breaker cadence
+            lines_before[split.path] = base + chunk.n_lines
+            if split.last_in_file:
+                policy.finalize()        # exact breaker + sidecar + gauge
+
+            # --- journal commit (payload first, record after) ---------
+            if journal is not None and not chunk.resumed:
+                payload = {"binned": chunk.binned, "numeric": chunk.numeric}
+                if chunk.labels is not None:
+                    payload["labels"] = chunk.labels
+                if chunk.ids is not None:
+                    payload["ids"] = np.asarray(chunk.ids)
+                journal.write_payload(split.index, payload)
+                journal.mark_done(split.index, {
+                    "rows": int(n), "n_lines": int(chunk.n_lines),
+                    "bad": [{"line": b.line, "ordinal": b.ordinal,
+                             "token": b.token, "reason": b.reason,
+                             "detail": b.detail} for b in chunk.bads]})
+
+            stats["resumed_splits" if chunk.resumed
+                  else "encoded_splits"] += 1
+            stats["decode_ms"] += chunk.decode_ms
+            stats["encode_ms"] += chunk.encode_ms
+            stats["rows"] += int(n)
+            consume_order.append(split.index)
+            if chunk.ids is not None:
+                ids_all.extend(chunk.ids)
+            # fixed-size sub-chunks keep the feed's buckets uniform
+            # (power-of-two chunk_rows stages with no padding at all)
+            for lo in range(0, n, iplan.chunk_rows):
+                hi = min(lo + iplan.chunk_rows, n)
+                yield (chunk.binned[lo:hi], chunk.numeric[lo:hi],
+                       chunk.labels[lo:hi] if chunk.labels is not None
+                       else None)
+
+    try:
+        feed = DeviceFeed(ordered_chunks(), depth=iplan.queue_depth,
+                          bucket_floor=min(iplan.chunk_rows, 512),
+                          span_prefix="feed")
+        dev_b, dev_v, dev_l = [], [], []
+        for fc in feed:
+            b, v, l = fc.arrays
+            dev_b.append(b[:fc.n_rows])
+            dev_v.append(v[:fc.n_rows])
+            if l is not None:
+                dev_l.append(l[:fc.n_rows])
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+
+    if journal is not None and not conf.get_bool("shard.journal.keep",
+                                                 False):
+        journal.cleanup()
+
+    worker_ms = stats["decode_ms"] + stats["encode_ms"]
+    stats["overlap_fraction"] = (
+        min(max(1.0 - stats["wait_ms"] / worker_ms, 0.0), 1.0)
+        if worker_ms > 0 else 1.0)
+    stats["consume_order"] = consume_order
+    fs = feed.stats()
+    stats["feed"] = {"chunks": fs.chunks, "h2d_ms": round(fs.h2d_ms, 3),
+                     "overlap_fraction": round(fs.overlap_fraction, 4)}
+    stats["rows_quarantined"] = shared_stats.rows_quarantined
+    _LAST_STATS[tag] = stats
+    try:
+        from avenir_tpu.obs.exporters import set_hub_gauges_if_live
+        set_hub_gauges_if_live(
+            {"ingest.overlap_fraction": stats["overlap_fraction"]})
+    except Exception:
+        pass   # telemetry must never sink the ingest
+
+    if not dev_b:
+        # every line was blank/skipped (or zero-byte inputs): the serial
+        # encoder's empty-table shape
+        return fz.transform([], with_labels=with_labels)
+    import jax.numpy as jnp
+    binned = jnp.concatenate(dev_b) if len(dev_b) > 1 else dev_b[0]
+    numeric = jnp.concatenate(dev_v) if len(dev_v) > 1 else dev_v[0]
+    labels = None
+    if dev_l:
+        labels = jnp.concatenate(dev_l) if len(dev_l) > 1 else dev_l[0]
+    return loader._wrap_table(fz, binned, numeric, labels,
+                              ids_all if enc.has_id else None)

@@ -174,11 +174,17 @@ class DeviceFeed:
 
     @classmethod
     def from_arrays(cls, arrays: Sequence[Optional[np.ndarray]],
-                    chunk_rows: int, **kw) -> "DeviceFeed":
+                    chunk_rows: int, pad_tail: bool = True,
+                    **kw) -> "DeviceFeed":
         """Feed over row-slices of a tuple of host arrays (the chunked-
-        scoring entry: cut ``[M, ...]`` tables into ``chunk_rows`` pieces;
-        the ragged tail shares the same bucket as full chunks whenever
-        ``chunk_rows`` ≤ the bucket floor's next power of two)."""
+        scoring entry: cut ``[M, ...]`` tables into ``chunk_rows``
+        pieces). With ``pad_tail`` (the default) the bucket floor is the
+        FULL chunk's power-of-two bucket, so the ragged tail chunk pads
+        into the same bucket as the full chunks instead of landing in a
+        smaller one — one jit shape (and one compile) per feed, at the
+        price of padding the tail up. ``pad_tail=False`` restores the
+        small-tail-bucket behavior for consumers that prefer less
+        padding over shape reuse."""
         if chunk_rows < 1:
             raise ValueError("chunk_rows must be >= 1")
         present = [a for a in arrays if a is not None]
@@ -193,7 +199,10 @@ class DeviceFeed:
             for lo in range(0, m, chunk_rows):
                 yield tuple(None if a is None else a[lo:lo + chunk_rows]
                             for a in arrays)
-        kw.setdefault("bucket_floor", min(chunk_rows, 512))
+        floor = min(chunk_rows, 512)
+        if pad_tail:
+            floor = bucket_rows(chunk_rows, floor)
+        kw.setdefault("bucket_floor", floor)
         return cls(cut(), **kw)
 
     # -- background stage ---------------------------------------------------

@@ -50,6 +50,11 @@ def render(plan: Plan) -> str:
             j = node.journal
             bits.append(f"journal={j.get('dir')} shards={j.get('shards')}"
                         f" resume={j.get('resume')}")
+        if node.ingest:
+            g = node.ingest
+            bits.append(f"ingest=parallel workers={g.get('workers')} "
+                        f"splits={g.get('splits')} "
+                        f"split_bytes={g.get('split_bytes')}")
         lines.append(" ".join(bits))
         if node.detail:
             lines.append(" " * 12 + node.detail)

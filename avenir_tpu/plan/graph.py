@@ -51,6 +51,11 @@ class PlanNode:
     # per-verb plumbing; the plan carries it declaratively):
     # {"dir": ..., "shards": N, "resume": bool, "enabled": bool}
     journal: Optional[Dict[str, Any]] = None
+    # parallel cold-path ingest as an encode-node property (ISSUE 19):
+    # {"workers": N, "splits": N, "split_bytes": B, "files": N,
+    #  "queue_depth": D}. None = serial encode. Advisory only — the
+    # fingerprint is unchanged (same bytes in -> same staged table out).
+    ingest: Optional[Dict[str, Any]] = None
     detail: str = ""                # one-line human note for --explain
 
     def __post_init__(self):
@@ -113,6 +118,7 @@ class Plan:
                 "skips_on_hit": list(n.skips_on_hit),
                 "fused": n.fused,
                 "journal": n.journal,
+                "ingest": n.ingest,
                 "detail": n.detail,
             })
         edges = [{"name": n.output, "type": n.edge_type,

@@ -75,6 +75,15 @@ def execute(plan: Plan) -> Dict[str, Any]:
             values[node.output] = value
     plan.outcomes = outcomes
     _LAST = {"verb": plan.verb, "outcomes": dict(outcomes)}
+    # parallel-ingest stats (ISSUE 19): attach what the split encode
+    # pool recorded during THIS plan's stage nodes, keyed by table tag
+    try:
+        from avenir_tpu.parallel.ingest import take_last_stats
+        stats = take_last_stats()
+        if stats:
+            _LAST["ingest"] = stats
+    except Exception:
+        pass
     if cache is not None:
         cache.publish_gauges()
     return values

@@ -102,6 +102,34 @@ def iter_csv_rows(path: str, delim_regex: str = ",",
                 yield [t.strip() for t in splitter.split(line)]
 
 
+def read_line_window(path: str, start: int, stop: int) -> bytes:
+    """Read the bytes of every line OWNED by the byte window ``[start,
+    stop)`` of one file — :func:`iter_csv_rows`'s HDFS-split boundary
+    rule applied to raw bytes (the parallel-ingest worker's read): the
+    line straddling ``start`` belongs to the previous window (skipped by
+    peeking one byte back), and the line straddling ``stop`` is read to
+    completion by the window that owns its first byte. Consecutive
+    windows therefore tile a file's bytes exactly — every byte lands in
+    exactly one window's return — which is what lets per-window physical
+    line counts accumulate into exact file-global line numbers."""
+    size = os.path.getsize(path)
+    stop = min(stop, size)
+    if start >= stop:
+        return b""
+    with open(path, "rb") as fh:
+        if start > 0:
+            fh.seek(start - 1)
+            if fh.read(1) != b"\n":
+                fh.readline()    # partial line: the previous window's
+        pos = fh.tell()
+        if pos >= stop:
+            return b""
+        buf = fh.read(stop - pos)
+        if buf and not buf.endswith(b"\n"):
+            buf += fh.readline()  # the line owning ``stop`` reads fully
+    return buf
+
+
 @dataclass
 class FieldEncoder:
     """Per-column encoder derived from a :class:`FeatureField` (+ data)."""

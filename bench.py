@@ -664,6 +664,134 @@ def _plan_bench() -> dict:
         return out
 
 
+def _ingest_bench() -> dict:
+    """ISSUE 19: the ``ingest`` arm — cold staged-table construction
+    rows/s: serial encoder vs the parallel split pool vs a warm
+    staged-cache hit. PARITY-GATED before timing: the pool's table must
+    equal the serial encoder's arrays and ids exactly (a fast-but-wrong
+    parse must fail loudly). On >= 4-core hosts the pool must beat
+    serial by >= 2x (the acceptance gate; 1-core boxes report without
+    gating — the pool cannot beat serial while time-slicing one core).
+    Winners persist under a dedicated ``/ingest/`` autotune namespace
+    (PR 14 discipline)."""
+    import sys as _sys
+    import tempfile
+    import numpy as _np
+    from avenir_tpu.datagen.generators import (_CHURN_SCHEMA_JSON,
+                                               churn_rows, churn_schema)
+    from avenir_tpu.parallel import ingest as ING
+    from avenir_tpu.utils.config import JobConfig
+    from avenir_tpu.utils.dataset import Featurizer, read_csv_lines
+
+    n_rows = int(os.environ.get("BENCH_INGEST_ROWS", 150_000))
+    reps = int(os.environ.get("BENCH_INGEST_REPEATS", 3))
+    split_bytes = int(os.environ.get("BENCH_INGEST_SPLIT", 1 << 20))
+
+    with tempfile.TemporaryDirectory() as td:
+        rows = churn_rows(n_rows, seed=23)
+        big = os.path.join(td, "big.csv")
+        with open(big, "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows) + "\n")
+        schema = os.path.join(td, "schema.json")
+        with open(schema, "w") as fh:
+            json.dump(_CHURN_SCHEMA_JSON, fh)
+        # force >= 2 workers so 1-core boxes still REPORT the
+        # comparison (the 2x gate below stays core-count-aware)
+        conf = JobConfig({"field.delim.regex": ",",
+                          "feature.schema.file.path": schema,
+                          "ingest.workers": str(max(2, os.cpu_count()
+                                                    or 1)),
+                          "ingest.split.bytes": str(split_bytes)})
+        fz = Featurizer(churn_schema(), unseen="error")
+        fz.fit([])
+        iplan = ING.plan_ingest(conf, big)
+        if not iplan.parallel:
+            raise AssertionError(
+                f"ingest bench fixture not parallel: {iplan.reason}")
+
+        # parity gate BEFORE timing
+        serial_t = fz.transform(read_csv_lines(big, ","),
+                                with_labels=True)
+        par_t = ING.run_ingest(fz, iplan, conf, tag="parity")
+        if not (_np.array_equal(_np.asarray(serial_t.binned),
+                                _np.asarray(par_t.binned))
+                and _np.array_equal(_np.asarray(serial_t.numeric),
+                                    _np.asarray(par_t.numeric))
+                and serial_t.ids == par_t.ids):
+            raise AssertionError("parallel ingest != serial encoder — "
+                                 "refusing to time a wrong result")
+
+        t_serial = t_par = t_native = float("inf")
+        overlap = 0.0
+        for _ in range(reps):
+            # the plan's serial cold path (read_csv_lines + transform —
+            # what plan.enable=false does): the headline comparator
+            t0 = time.perf_counter()
+            fz.transform(read_csv_lines(big, ","), with_labels=True)
+            t_serial = min(t_serial, time.perf_counter() - t0)
+            # single-threaded NATIVE encode: separates native-vs-Python
+            # parse speed from the pool's actual parallelism
+            try:
+                from avenir_tpu.native import loader as _loader
+                t0 = time.perf_counter()
+                _loader.transform_file(fz, big, ",", n_threads=1)
+                t_native = min(t_native, time.perf_counter() - t0)
+            except Exception:
+                pass
+            t0 = time.perf_counter()
+            ING.run_ingest(fz, iplan, conf, tag="timed")
+            t_par = min(t_par, time.perf_counter() - t0)
+            overlap = max(overlap, ING.take_last_stats()
+                          .get("timed", {}).get("overlap_fraction", 0.0))
+        # warm path: the staged-table cache serves the whole thing
+        from avenir_tpu.plan.cache import MISS, reset_cache, staged_cache
+        reset_cache()
+        cache = staged_cache()
+        cache.put("bench-ingest-fp", (fz, par_t))
+        t0 = time.perf_counter()
+        hit = cache.get("bench-ingest-fp")
+        t_warm = time.perf_counter() - t0
+        assert hit is not MISS
+        reset_cache()
+
+        speedup = t_serial / t_par
+        cores = os.cpu_count() or 1
+        if cores >= 4 and speedup < 2.0:
+            raise AssertionError(
+                f"parallel cold encode speedup {speedup:.2f}x under the "
+                f"2x acceptance gate on a {cores}-core host "
+                f"(serial={t_serial:.3f}s parallel={t_par:.3f}s)")
+        out = {
+            "n_rows": n_rows, "repeats": reps, "cores": cores,
+            "splits": len(iplan.splits), "workers": iplan.workers,
+            "serial_s": round(t_serial, 4),
+            "parallel_s": round(t_par, 4),
+            "warm_hit_s": round(t_warm, 6),
+            "serial_rows_per_sec": round(n_rows / t_serial, 1),
+            "parallel_rows_per_sec": round(n_rows / t_par, 1),
+            "warm_rows_per_sec": round(n_rows / max(t_warm, 1e-9), 1),
+            "speedup": round(speedup, 3),
+            "encode_saved_s": round(t_serial - t_par, 4),
+            "overlap_fraction": round(overlap, 4),
+            "gated_2x": cores >= 4,
+        }
+        if t_native < float("inf"):
+            out["native_serial_s"] = round(t_native, 4)
+            out["speedup_vs_native_serial"] = round(t_native / t_par, 3)
+        key = (_autotune_key(("ingest",))
+               + f"/ingest/cold-r{n_rows}-s{split_bytes}")
+        winner = "parallel" if speedup > 1.0 else "serial"
+        if AUTOTUNE:
+            prior = _autotune_load(key)
+            if prior:
+                out["autotune_prior"] = prior
+            _autotune_store(key, winner, t_par * 1e3)
+            print(f"ingest autotune: {winner} recorded under {key}",
+                  file=_sys.stderr)
+        out["winner"] = winner
+        return out
+
+
 def _boost_bench() -> dict:
     """ISSUE 16: the ``boost`` sweep arm — K device-resident Newton
     rounds over the one binned catalog vs the bagged batched forest at
@@ -1236,6 +1364,25 @@ def main() -> None:
         except Exception as exc:
             print(f"plan bench skipped: {exc!r}", file=sys.stderr)
             out["plan"] = {"error": repr(exc)}
+    # ISSUE-19 PARALLEL INGEST: cold staged-table construction rows/s —
+    # serial encoder vs the split pool vs a warm cache hit (parity-gated
+    # byte identity; 2x gate on >= 4-core hosts; fallback-safe like its
+    # siblings). BENCH_INGEST=0 disables; BENCH_INGEST_{ROWS,REPEATS,
+    # SPLIT} tune the workload.
+    if os.environ.get("BENCH_INGEST", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        try:
+            out["ingest"] = _ingest_bench()
+            ib = out["ingest"]
+            print(f"ingest: cold encode {ib['parallel_rows_per_sec']:,.0f} "
+                  f"rows/s parallel vs {ib['serial_rows_per_sec']:,.0f} "
+                  f"serial ({ib['speedup']:.2f}x on {ib['cores']} cores, "
+                  f"{ib['splits']} splits, overlap "
+                  f"{ib['overlap_fraction']:.3f}, encode saved "
+                  f"{ib['encode_saved_s']:.2f}s)", file=sys.stderr)
+        except Exception as exc:
+            print(f"ingest bench skipped: {exc!r}", file=sys.stderr)
+            out["ingest"] = {"error": repr(exc)}
     # ISSUE-5 ONLINE SERVING: the always-on path's own headline —
     # engine-vs-sync decisions/sec on CPU over MiniRedis (subprocess;
     # fallback-safe: a serving failure must not sink the KNN headline)

@@ -1,0 +1,170 @@
+"""Parallel-ingest smoke (ISSUE 19, tier-1 via tests/test_ingest.py).
+
+One lean in-process run, gates:
+
+1. BYTE IDENTITY: the cold plan run with the split encode pool forced on
+   (small splits, 3 workers) produces stdout + output files identical to
+   the legacy serial body (``plan.enable=false``) AND to a warm rerun.
+2. SPANS: the per-stage spans (``ingest.decode``, ``ingest.encode``,
+   ``feed.h2d``) and the ``ingest.overlap_fraction`` gauge appear in the
+   merged telemetry report written by ``--metrics-out``.
+3. SPEEDUP (>= 4 cores only, per the tier-1 time-budget rules): parallel
+   cold encode beats serial on a larger table. 1-core CI boxes skip the
+   timing — the pool cannot beat serial while time-slicing one core —
+   but still gate identity and spans above.
+
+CPU-sized and in-process — tier-1 is near its kill budget.
+"""
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPEEDUP_MIN_CORES = 4
+SPEEDUP_BOUND = 1.2      # modest in-process gate; bench.py owns the 2x
+SPEEDUP_ROWS = 60_000
+
+
+def fail(msg: str) -> None:
+    print(f"ingest_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _run(argv):
+    from avenir_tpu.cli.main import main as cli
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli(argv)
+    assert rc in (0, None), f"cli exit {rc}"
+    return buf.getvalue()
+
+
+def main() -> int:
+    from avenir_tpu.datagen import generators as G
+    from avenir_tpu.plan.cache import reset_cache
+    from avenir_tpu.plan.scheduler import last_run
+
+    report = {}
+    with tempfile.TemporaryDirectory() as td:
+        rows = G.churn_rows(600, seed=101)
+        train = os.path.join(td, "train.csv")
+        with open(train, "w") as fh:
+            fh.write("\n".join(",".join(r) for r in rows) + "\n")
+        with open(os.path.join(td, "schema.json"), "w") as fh:
+            json.dump(G._CHURN_SCHEMA_JSON, fh)
+        props = os.path.join(td, "job.properties")
+        with open(props, "w") as fh:
+            fh.write("field.delim.regex=,\nfield.delim=,\n"
+                     f"feature.schema.file.path={td}/schema.json\n"
+                     "ingest.workers=3\ningest.split.bytes=4096\n")
+
+        def nb(out, *extra):
+            return _run(["BayesianDistribution", train,
+                         os.path.join(td, out), "--conf", props, *extra])
+
+        def read(name):
+            with open(os.path.join(td, name), "rb") as fh:
+                return fh.read()
+
+        # 1. byte identity: serial oracle vs cold pool vs warm rerun
+        s_legacy = nb("legacy.txt", "-D", "plan.enable=false")
+        reset_cache()
+        metrics = os.path.join(td, "metrics.jsonl")
+        s_cold = nb("cold.txt", "--metrics-out", metrics)
+        lr = last_run()
+        if not lr or not lr.get("ingest"):
+            fail(f"split pool did not run: {lr}")
+        st = lr["ingest"]["train"]
+        if st["splits"] < 2 or st["workers"] < 2:
+            fail(f"degenerate split plan: {st}")
+        s_warm = nb("warm.txt")
+        lr2 = last_run()
+        if lr2["outcomes"].get("stage:train") != "hit":
+            fail(f"warm rerun missed the staged-table cache: {lr2}")
+        if s_cold != s_legacy or s_warm != s_legacy:
+            fail("stdout diverges between pool and serial oracle")
+        if read("cold.txt") != read("legacy.txt") \
+                or read("warm.txt") != read("legacy.txt"):
+            fail("model bytes diverge between pool and serial oracle")
+        report["byte_identical"] = True
+        report["splits"] = st["splits"]
+        report["overlap_fraction"] = round(st["overlap_fraction"], 4)
+
+        # 2. per-stage spans + overlap gauge in the merged report
+        want = {"ingest.decode": 0, "ingest.encode": 0, "feed.h2d": 0}
+        gauge = False
+        with open(metrics) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                name = ev.get("name", "")
+                if ev.get("type") == "span":
+                    for w in want:
+                        if name == w or name.endswith("/" + w):
+                            want[w] += 1
+                elif ev.get("type") == "gauge" and \
+                        name.endswith("ingest.overlap_fraction"):
+                    gauge = True
+        missing = [w for w, n in want.items() if n == 0]
+        if missing:
+            fail(f"per-stage spans missing from merged report: {missing}")
+        if not gauge:
+            fail("ingest.overlap_fraction gauge missing from report")
+        report["spans"] = sum(1 for n in want.values() if n)
+
+    # 3. speedup gate, multi-core hosts only
+    if (os.cpu_count() or 1) >= SPEEDUP_MIN_CORES:
+        from avenir_tpu.datagen import generators as G
+        from avenir_tpu.parallel import ingest as ING
+        from avenir_tpu.utils.config import JobConfig
+        from avenir_tpu.utils.dataset import Featurizer, read_csv_lines
+        with tempfile.TemporaryDirectory() as td:
+            rows = G.churn_rows(SPEEDUP_ROWS, seed=3)
+            big = os.path.join(td, "big.csv")
+            with open(big, "w") as fh:
+                fh.write("\n".join(",".join(r) for r in rows) + "\n")
+            with open(os.path.join(td, "schema.json"), "w") as fh:
+                json.dump(G._CHURN_SCHEMA_JSON, fh)
+            conf = JobConfig({
+                "field.delim.regex": ",",
+                "feature.schema.file.path": os.path.join(td,
+                                                         "schema.json"),
+                "ingest.split.bytes": str(256 << 10)})
+            fz = Featurizer(G.churn_schema(), unseen="error")
+            fz.fit([])
+            iplan = ING.plan_ingest(conf, big)
+            if not iplan.parallel:
+                fail(f"speedup fixture not parallel: {iplan.reason}")
+            # warm both paths once (jit + page cache), then best-of-2
+            fz.transform(read_csv_lines(big, ","), with_labels=True)
+            ING.run_ingest(fz, iplan, conf, tag="warmup")
+            t_serial = t_par = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                fz.transform(read_csv_lines(big, ","), with_labels=True)
+                t_serial = min(t_serial, time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                ING.run_ingest(fz, iplan, conf, tag="timed")
+                t_par = min(t_par, time.perf_counter() - t0)
+            speedup = t_serial / t_par
+            if speedup < SPEEDUP_BOUND:
+                fail(f"parallel cold encode speedup {speedup:.2f}x under "
+                     f"{SPEEDUP_BOUND}x (serial={t_serial * 1e3:.0f}ms "
+                     f"parallel={t_par * 1e3:.0f}ms)")
+            report["speedup"] = round(speedup, 2)
+    else:
+        report["speedup"] = None   # 1-core box: identity + spans only
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

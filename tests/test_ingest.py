@@ -1,0 +1,386 @@
+"""Parallel cold-path ingest (ISSUE 19).
+
+The split encode pool must be a pure perf optimization: byte-identical
+to the serial encoder for every plan-capable verb (cold, warm, and
+against the ``plan.enable=false`` oracle), deterministic under
+out-of-order worker completion, policy-identical on poisoned rows, and
+resumable per split through the ShardJournal.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.datagen import generators as G
+from avenir_tpu.native import loader
+from avenir_tpu.parallel import ingest as ING
+from avenir_tpu.plan.cache import reset_cache
+from avenir_tpu.plan.scheduler import last_run
+from avenir_tpu.utils.config import JobConfig
+from avenir_tpu.utils.dataset import (Featurizer, read_csv_lines,
+                                      read_line_window)
+
+
+@pytest.fixture(autouse=True)
+def _cold_cache():
+    reset_cache()
+    ING.take_last_stats()
+    yield
+    reset_cache()
+    ING.take_last_stats()
+
+
+def _churn_fixture(tmp_path, n=300, split=220, extra_props=""):
+    rows = G.churn_rows(n, seed=77)
+    train = tmp_path / "train.csv"
+    test = tmp_path / "test.csv"
+    train.write_text("\n".join(",".join(r) for r in rows[:split]) + "\n")
+    test.write_text("\n".join(",".join(r) for r in rows[split:]) + "\n")
+    schema = tmp_path / "schema.json"
+    schema.write_text(json.dumps(G._CHURN_SCHEMA_JSON))
+    props = tmp_path / "job.properties"
+    props.write_text(
+        "field.delim.regex=,\nfield.delim=,\n"
+        f"feature.schema.file.path={schema}\n"
+        f"train.data.path={train}\n"
+        "top.match.count=5\nvalidation.mode=true\n"
+        "positive.class.value=closed\n"
+        "num.trees=3\nforest.boost.num.rounds=3\nmax.depth=3\n"
+        # force the pool on this small fixture: ~10KB input, 2KB splits
+        "ingest.workers=3\ningest.split.bytes=2048\n"
+        + extra_props)
+    return str(train), str(test), str(props)
+
+
+def _conf(tmp_path, **over):
+    _, _, props = _churn_fixture(tmp_path)
+    conf = JobConfig.from_file(props)
+    for k, v in over.items():
+        conf.set(k, v)
+    return conf
+
+
+def _fitted(conf):
+    fz = Featurizer(G.churn_schema(),
+                    unseen=conf.get("unseen.value.handling", "error"))
+    fz.fit([])
+    return fz
+
+
+def _tables_equal(a, b):
+    assert np.array_equal(np.asarray(a.binned), np.asarray(b.binned))
+    assert np.array_equal(np.asarray(a.numeric), np.asarray(b.numeric))
+    if a.labels is None or b.labels is None:
+        assert a.labels is None and b.labels is None
+    else:
+        assert np.array_equal(np.asarray(a.labels), np.asarray(b.labels))
+    assert a.ids == b.ids
+
+
+# -- split planning ----------------------------------------------------------
+
+class TestSplitPlanning:
+    def test_windows_tile_file_bytes(self, tmp_path):
+        """read_line_window over consecutive windows reassembles the file
+        exactly — every line owned once, whatever the cut points hit
+        (mid-line, at a newline, a line spanning several windows)."""
+        p = tmp_path / "f.csv"
+        # ragged line lengths + one line far longer than the window
+        lines = [("x" * (3 + (i * 7) % 23)) for i in range(40)]
+        lines[17] = "y" * 300
+        blob = ("\n".join(lines) + "\n").encode()
+        p.write_text(blob.decode())
+        for win in (1, 7, 64, 100, len(blob), len(blob) + 5):
+            got = b"".join(
+                read_line_window(str(p), s, min(s + win, len(blob)))
+                for s in range(0, len(blob), win))
+            assert got == blob, f"window={win}"
+
+    def test_plan_splits_order_and_bounds(self, tmp_path):
+        a = tmp_path / "part-0000"
+        b = tmp_path / "part-0001"
+        a.write_text("x" * 100)
+        b.write_text("y" * 10)
+        (tmp_path / "part-0002").write_text("")   # zero-byte: skipped
+        splits = ING.plan_splits(
+            [str(a), str(b), str(tmp_path / "part-0002")], 40)
+        assert [s.index for s in splits] == [0, 1, 2, 3]
+        assert [(os.path.basename(s.path), s.start, s.stop, s.last_in_file)
+                for s in splits] == [
+            ("part-0000", 0, 40, False), ("part-0000", 40, 80, False),
+            ("part-0000", 80, 100, True), ("part-0001", 0, 10, True)]
+
+    def test_eligibility_reasons(self, tmp_path):
+        conf = _conf(tmp_path)
+        train = conf.get_required("train.data.path")
+        assert ING.plan_ingest(conf, train).parallel
+
+        off = _conf(tmp_path, **{"ingest.parallel": "false"})
+        assert not ING.plan_ingest(off, train).parallel
+
+        one = _conf(tmp_path, **{"ingest.workers": "1"})
+        assert not ING.plan_ingest(one, train).parallel
+
+        big = _conf(tmp_path, **{"ingest.split.bytes": str(1 << 30)})
+        got = ING.plan_ingest(big, train)
+        assert not got.parallel and "one split" in got.reason
+
+    def test_data_dependent_fit_falls_back(self, tmp_path):
+        """A schema whose fit must see the data (categorical without
+        cardinality) cannot split the parse transparently — plan_ingest
+        says serial, with the reason."""
+        schema = json.loads(json.dumps(G._CHURN_SCHEMA_JSON))
+        del schema["fields"][1]["cardinality"]
+        sp = tmp_path / "dd.json"
+        sp.write_text(json.dumps(schema))
+        conf = _conf(tmp_path, **{"feature.schema.file.path": str(sp)})
+        got = ING.plan_ingest(conf, conf.get_required("train.data.path"))
+        assert not got.parallel and "data-dependent" in got.reason
+        # ...but the same schema is fine for the train-fitted test table
+        assert ING.plan_ingest(conf,
+                               conf.get_required("train.data.path"),
+                               require_schema_only_fit=False).parallel
+
+
+# -- byte identity through the CLI (all five verbs) --------------------------
+
+_VERBS = {
+    "BayesianDistribution": "train",
+    "NearestNeighbor": "test",
+    "MutualInformation": "train",
+    "RandomForestBuilder": "train",
+    "GradientBoostBuilder": "train",
+}
+
+
+def _run_verb(capsys, verb, in_path, out_path, props, *extra):
+    from avenir_tpu.cli.main import main as cli
+    rc = cli([verb, in_path, out_path, "--conf", props, *extra])
+    assert rc in (0, None)
+    return capsys.readouterr().out
+
+
+class TestByteIdentity:
+    """Parallel ingest == serial encoder, bit for bit: legacy oracle
+    (plan.enable=false), cold plan run (the pool), warm plan run (cache
+    hit — the pool must not change the fingerprint)."""
+
+    @pytest.mark.parametrize("verb", sorted(_VERBS))
+    def test_parallel_matches_serial_cold_and_warm(self, tmp_path,
+                                                   capsys, verb):
+        train, test, props = _churn_fixture(tmp_path)
+        inp = test if _VERBS[verb] == "test" else train
+
+        def out(name):
+            return str(tmp_path / name)
+
+        s_legacy = _run_verb(capsys, verb, inp, out("legacy.txt"), props,
+                             "-D", "plan.enable=false")
+        reset_cache()
+        ING.take_last_stats()
+        s_cold = _run_verb(capsys, verb, inp, out("cold.txt"), props)
+        lr = last_run()
+        assert lr["ingest"], lr   # the pool actually ran
+        for tag, st in lr["ingest"].items():
+            assert st["splits"] >= 2 and st["workers"] >= 2, (tag, st)
+            assert st["consume_order"] == sorted(st["consume_order"])
+        s_warm = _run_verb(capsys, verb, inp, out("warm.txt"), props)
+        lr2 = last_run()
+        assert lr2["outcomes"]["stage:train"] == "hit", lr2
+        assert "ingest" not in lr2, lr2   # warm: no encode at all
+
+        assert s_cold == s_legacy and s_warm == s_legacy
+        legacy = (tmp_path / "legacy.txt").read_bytes()
+        assert (tmp_path / "cold.txt").read_bytes() == legacy
+        assert (tmp_path / "warm.txt").read_bytes() == legacy
+
+    def test_python_fallback_byte_identical(self, tmp_path, capsys):
+        train, _, props = _churn_fixture(
+            tmp_path, extra_props="ingest.native=false\n")
+        s_legacy = _run_verb(capsys, "BayesianDistribution", train,
+                             str(tmp_path / "l.txt"), props,
+                             "-D", "plan.enable=false")
+        reset_cache()
+        s_par = _run_verb(capsys, "BayesianDistribution", train,
+                          str(tmp_path / "p.txt"), props)
+        assert s_par == s_legacy
+        assert (tmp_path / "p.txt").read_bytes() == \
+            (tmp_path / "l.txt").read_bytes()
+
+
+# -- out-of-order completion -------------------------------------------------
+
+class TestResequencing:
+    def test_out_of_order_workers_resequence(self, tmp_path, monkeypatch):
+        """Workers finishing in REVERSE split order must not change one
+        byte: the driver consumes futures in split order."""
+        conf = _conf(tmp_path, **{"ingest.workers": "4",
+                                  "ingest.split.bytes": "1024"})
+        train = conf.get_required("train.data.path")
+        iplan = ING.plan_ingest(conf, train)
+        assert iplan.parallel and len(iplan.splits) >= 4
+
+        completion: list = []
+        orig = ING._Encoder.encode_split
+
+        def staggered(self, split):
+            # later splits finish first: stall early splits
+            time.sleep(0.03 * max(0, len(iplan.splits) - split.index))
+            out = orig(self, split)
+            completion.append(split.index)
+            return out
+
+        monkeypatch.setattr(ING._Encoder, "encode_split", staggered)
+        fz = _fitted(conf)
+        par = ING.run_ingest(fz, iplan, conf, tag="train")
+        st = ING.take_last_stats()["train"]
+        assert completion != sorted(completion), completion
+        assert st["consume_order"] == sorted(st["consume_order"])
+        serial = fz.transform(read_csv_lines(train, ","),
+                              with_labels=True)
+        _tables_equal(serial, par)
+
+
+# -- poisoned rows -----------------------------------------------------------
+
+def _poisoned_fixture(tmp_path):
+    """churn rows with three malformed lines planted in different
+    splits: unseen categorical, ragged, and a bad class value."""
+    rows = G.churn_rows(200, seed=5)
+    rows[20][1] = "NOPE"                     # unseen categorical
+    rows[90] = rows[90][:4]                  # ragged
+    rows[170][6] = "weird"                   # bad class label
+    p = tmp_path / "poison.csv"
+    p.write_text("\n".join(",".join(r) for r in rows) + "\n")
+    schema = tmp_path / "schema.json"
+    schema.write_text(json.dumps(G._CHURN_SCHEMA_JSON))
+    conf = JobConfig({
+        "field.delim.regex": ",",
+        "feature.schema.file.path": str(schema),
+        "ingest.workers": "3", "ingest.split.bytes": "2048",
+    })
+    return str(p), conf
+
+
+class TestPoisonParity:
+    """on.bad.row through the pool == the serial resilient encoder
+    (transform_file): same survivors, same accounting, same sidecar,
+    same raise."""
+
+    @pytest.mark.parametrize("native", [True, False])
+    def test_skip_mode_survivors_identical(self, tmp_path, native):
+        path, conf = _poisoned_fixture(tmp_path)
+        conf.set("on.bad.row", "skip")
+        conf.set("ingest.native", str(native).lower())
+        fz = _fitted(conf)
+        serial_stats = loader.ParseStats()
+        serial = loader.transform_file(
+            fz, path, ",", force_python=not native, on_bad_row="skip",
+            parse_stats=serial_stats)
+        iplan = ING.plan_ingest(conf, path)
+        par = ING.run_ingest(fz, iplan, conf, tag="train")
+        st = ING.take_last_stats()["train"]
+        _tables_equal(serial, par)
+        assert st["rows_quarantined"] == serial_stats.rows_quarantined == 3
+        assert st["rows"] == serial_stats.rows
+
+    def test_quarantine_sidecar_identical(self, tmp_path):
+        path, conf = _poisoned_fixture(tmp_path)
+        qs = tmp_path / "q_serial"
+        qp = tmp_path / "q_par"
+        conf.set("on.bad.row", "quarantine")
+        conf.set("quarantine.dir", str(qp))
+        fz = _fitted(conf)
+        serial = loader.transform_file(
+            fz, path, ",", on_bad_row="quarantine",
+            quarantine_dir=str(qs))
+        iplan = ING.plan_ingest(conf, path)
+        par = ING.run_ingest(fz, iplan, conf, tag="train")
+        _tables_equal(serial, par)
+        name = os.path.basename(path) + ".bad.jsonl"
+        assert (qp / name).read_text() == (qs / name).read_text()
+        bad_lines = [json.loads(l)["line"]
+                     for l in (qp / name).read_text().splitlines()]
+        assert bad_lines == [21, 91, 171]   # exact GLOBAL line numbers
+
+    def test_raise_mode_same_first_bad_row(self, tmp_path):
+        path, conf = _poisoned_fixture(tmp_path)
+        fz = _fitted(conf)
+        with pytest.raises(loader.ParseError) as serial_err:
+            loader.transform_file(fz, path, ",", on_bad_row="raise")
+        iplan = ING.plan_ingest(conf, path)
+        with pytest.raises(loader.ParseError) as par_err:
+            ING.run_ingest(fz, iplan, conf, tag="train")
+        assert str(par_err.value) == str(serial_err.value)
+        assert par_err.value.bad_row.line == 21
+
+
+# -- journal resume ----------------------------------------------------------
+
+class TestJournalResume:
+    def test_resume_after_kill_reencodes_only_missing_split(
+            self, tmp_path):
+        conf = _conf(tmp_path, **{"ingest.journal": "true",
+                                  "shard.journal.keep": "true"})
+        train = conf.get_required("train.data.path")
+        jd = str(tmp_path / "out.txt.ingest-train")
+        fz = _fitted(conf)
+        iplan = ING.plan_ingest(conf, train)
+        n = len(iplan.splits)
+        assert n >= 3
+        full = ING.run_ingest(fz, iplan, conf, table_fp="fp",
+                              journal_dir=jd, tag="train")
+        st = ING.take_last_stats()["train"]
+        assert st["encoded_splits"] == n and st["resumed_splits"] == 0
+
+        # the kill: one split's commit is gone
+        os.remove(os.path.join(jd, "shard-00001.npz"))
+        os.remove(os.path.join(jd, "shard-00001.json"))
+        conf.set("job.resume", "true")
+        resumed = ING.run_ingest(fz, iplan, conf, table_fp="fp",
+                                 journal_dir=jd, tag="train")
+        st2 = ING.take_last_stats()["train"]
+        assert st2["encoded_splits"] == 1, st2
+        assert st2["resumed_splits"] == n - 1, st2
+        _tables_equal(full, resumed)
+
+    def test_resume_off_reencodes_everything(self, tmp_path):
+        conf = _conf(tmp_path, **{"ingest.journal": "true",
+                                  "shard.journal.keep": "true"})
+        train = conf.get_required("train.data.path")
+        jd = str(tmp_path / "out.txt.ingest-train")
+        fz = _fitted(conf)
+        iplan = ING.plan_ingest(conf, train)
+        ING.run_ingest(fz, iplan, conf, table_fp="fp",
+                       journal_dir=jd, tag="train")
+        ING.run_ingest(fz, iplan, conf, table_fp="fp",
+                       journal_dir=jd, tag="train")   # no job.resume
+        st = ING.take_last_stats()["train"]
+        assert st["resumed_splits"] == 0
+        assert st["encoded_splits"] == len(iplan.splits)
+
+
+# -- tier-1 hook -------------------------------------------------------------
+
+def test_ingest_smoke_script():
+    """Tier-1 hook: scripts/ingest_smoke.py gates serial-vs-parallel
+    byte identity and the per-stage spans in the merged report."""
+    script = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "scripts", "ingest_smoke.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    for attempt in (1, 2):
+        proc = subprocess.run([sys.executable, script],
+                              capture_output=True, text=True,
+                              timeout=120, env=env)
+        if proc.returncode == 0:
+            break
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] and report["byte_identical"]
+    assert report["spans"] >= 3

@@ -53,6 +53,38 @@ class TestDeviceFeed:
         assert stats.buckets == (256,)
         assert 0.0 <= stats.overlap_fraction <= 1.0
 
+    def test_pad_tail_reuses_full_chunk_bucket(self):
+        """ISSUE 19 satellite: with chunk_rows above the 512 floor the
+        ragged tail used to land in a SMALLER power-of-two bucket than
+        the full chunks — one extra jit compile per feed. pad_tail (the
+        default) pads it into the full-chunk bucket instead."""
+        a = np.arange(2500 * 3, dtype=np.float32).reshape(2500, 3)
+
+        feed = DeviceFeed.from_arrays((a,), chunk_rows=1024,
+                                      pad_tail=False)
+        rows = [np.asarray(fc.arrays[0])[:fc.n_rows] for fc in feed]
+        assert feed.stats().buckets == (512, 1024)   # the old shape split
+        np.testing.assert_array_equal(np.concatenate(rows), a)
+
+        feed = DeviceFeed.from_arrays((a,), chunk_rows=1024)
+        rows = [np.asarray(fc.arrays[0])[:fc.n_rows] for fc in feed]
+        assert feed.stats().buckets == (1024,)       # one bucket, one jit
+        np.testing.assert_array_equal(np.concatenate(rows), a)
+
+    def test_pad_tail_compile_count(self):
+        """The payload of the single bucket: a consumer kernel compiles
+        ONCE for the whole feed, tail included."""
+        tracker = obs_runtime.CompileTracker()
+        if not tracker.available:
+            pytest.skip("jax.monitoring unavailable")
+        a = np.arange(2500 * 3, dtype=np.float32).reshape(2500, 3)
+        kernel = jax.jit(lambda x: jnp.sum(x, axis=1))
+        tracker.start()
+        for fc in DeviceFeed.from_arrays((a,), chunk_rows=1024):
+            kernel(fc.arrays[0]).block_until_ready()
+        snap = tracker.snapshot()
+        assert snap["backend_compile_count"] == 1, snap
+
     def test_depth_respected(self):
         produced = []
         consumed = []
