@@ -940,7 +940,14 @@ def run_nearest_neighbor(conf: JobConfig, in_path: str, out_path: str) -> None:
         ann_nlist=conf.get_int("knn.ann.nlist", 0),
         ann_nprobe=conf.get_int("knn.ann.nprobe", 0),
         ann_iters=conf.get_int("knn.ann.iters", 15),
-        ann_seed=conf.get_int("knn.ann.seed", 0))
+        ann_seed=conf.get_int("knn.ann.seed", 0),
+        # knn.ann.live routes queries through the live index wrapper
+        # (models/live_ann.py): per-list overflow tails for streamed
+        # appends, background re-cluster + zero-downtime swap. With no
+        # appends the results are identical to the frozen knn.ann path.
+        ann_live=conf.get_bool("knn.ann.live", False),
+        ann_live_tail_budget=conf.get_int("knn.ann.live.tail.budget",
+                                          1024))
     delim = conf.get("field.delim.out", ",")
 
     if not regression:
@@ -1180,6 +1187,12 @@ def _boost_config(conf: JobConfig):
         learning_rate=conf.get_float("forest.boost.learning.rate", 0.3),
         base_score=conf.get_float("forest.boost.base.score", 0.0),
         reg_lambda=conf.get_float("forest.boost.reg.lambda", 1.0),
+        # ROADMAP 3c: > 0 stops once the strided-holdout logloss has
+        # plateaued for this many consecutive rounds (in-core only; the
+        # artifact records roundsUsed)
+        early_stop_rounds=conf.get_int("forest.boost.early.stop.rounds", 0),
+        holdout_fraction=conf.get_float(
+            "forest.boost.early.stop.holdout", 0.2),
         tree=TreeConfig(
             algorithm=_split_algorithm(conf),
             max_depth=conf.get_int("max.depth", 3),

@@ -186,7 +186,51 @@ def _knn_config(conf: JobConfig, fz):
         ann_nlist=conf.get_int("knn.ann.nlist", 0),
         ann_nprobe=conf.get_int("knn.ann.nprobe", 0),
         ann_iters=conf.get_int("knn.ann.iters", 15),
-        ann_seed=conf.get_int("knn.ann.seed", 0))
+        ann_seed=conf.get_int("knn.ann.seed", 0),
+        ann_live=conf.get_bool("knn.ann.live", False),
+        ann_live_tail_budget=conf.get_int("knn.ann.live.tail.budget",
+                                          1024))
+
+
+def _ann_provenance(conf: JobConfig) -> Optional[dict]:
+    """The knn kernel node's ANN annotation (ISSUE 20): which index the
+    scoring will go through, whether a staged copy already lives in this
+    process (the one-slot caches), and — when the live slot is warm —
+    its version / tail-fill / swap count. Probe-only: never builds."""
+    if not conf.get_bool("knn.ann", False):
+        return None
+    live_on = conf.get_bool("knn.ann.live", False)
+    prov = {
+        "nlist": conf.get_int("knn.ann.nlist", 0) or "auto",
+        "nprobe": conf.get_int("knn.ann.nprobe", 0) or "auto",
+        "live": live_on,
+        "source": "build",
+        "reason": "no staged index in-process: k-means build runs "
+                  "before the first query batch",
+    }
+    if live_on:
+        prov["tail_budget"] = conf.get_int("knn.ann.live.tail.budget",
+                                           1024)
+        from avenir_tpu.models.live_ann import peek_live_index
+        slot = peek_live_index()
+        if slot is not None:
+            d = slot.describe()
+            prov.update(
+                source="cached", nlist=d["nlist"],
+                version=d["version"],
+                tail_fill=round(float(d["tail_fill"]), 4),
+                tail_rows=d["tail_rows"], swaps=d["swaps"],
+                reason="live slot is warm (reused when the train table "
+                       "and build params match; appended rows probe "
+                       "through the overflow tails)")
+    else:
+        from avenir_tpu.models import knn as knn_mod
+        if knn_mod._ANN_INDEX_CACHE:
+            prov.update(
+                source="cached",
+                reason="staged IVF slot is warm (reused when the train "
+                       "table and build params match)")
+    return prov
 
 
 def build_knn_plan(conf: JobConfig, in_path: str,
@@ -224,6 +268,7 @@ def build_knn_plan(conf: JobConfig, in_path: str,
 
         plan.add(name="kernel:knn.shards", kind="kernel",
                  run=_run_shards, inputs=("train.table",), fused=True,
+                 ann=_ann_provenance(conf),
                  journal={
                      "dir": out_path + ".shards",
                      "shards": len(shard_paths),
@@ -315,6 +360,7 @@ def build_knn_plan(conf: JobConfig, in_path: str,
     plan.add(name="kernel:knn.classify", kind="kernel", run=_classify,
              inputs=("train.table", "test.table"), output="knn.pred",
              edge_type="predictions", fused=feed_chunk_rows > 0,
+             ann=_ann_provenance(conf),
              detail=("DeviceFeed chunks overlap H2D with distance+vote"
                      if feed_chunk_rows > 0 else
                      "distance + top-k + vote"))

@@ -130,6 +130,37 @@ class WindowedMeanDetector:
         return drifted
 
 
+class ThresholdDetector:
+    """Level-crossing trigger for MAINTENANCE signals (ISSUE 20): the
+    live ANN index's tail-fill fraction and list-imbalance skew are not
+    distribution drift — they are resource pressure with a known bound —
+    so the right detector is a latched threshold, not a sequential test.
+    Fires once when the signal crosses ``threshold`` and re-arms only
+    after it falls back below (a rebuild resets the signal), so one
+    sustained excursion requests ONE rebuild wave no matter how many
+    appends observe it. Duck-types the detector protocol
+    (``update(x) -> bool``), so it plugs into :class:`DriftMonitor`
+    beside Page–Hinkley unchanged."""
+
+    def __init__(self, threshold: float, direction: str = "up"):
+        if direction not in ("up", "down"):
+            raise ValueError(f"invalid direction {direction!r}")
+        self.threshold = float(threshold)
+        self.direction = direction
+        self._armed = True
+
+    def update(self, x: float) -> bool:
+        x = float(x)
+        crossed = (x > self.threshold if self.direction == "up"
+                   else x < self.threshold)
+        if crossed and self._armed:
+            self._armed = False
+            return True
+        if not crossed:
+            self._armed = True
+        return False
+
+
 class DriftMonitor:
     """Named signals -> detectors -> retrain request / alarm counter.
 

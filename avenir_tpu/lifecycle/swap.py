@@ -42,9 +42,20 @@ def install_state(learner, pytree: Any) -> None:
     mismatched snapshot must fail loudly HERE, not as a shape error
     inside the next jitted dispatch) and copied into fresh buffers cast
     to the live dtypes — ``jnp.array`` copies even jax-array leaves, so
-    the source snapshot survives any number of donated dispatches."""
+    the source snapshot survives any number of donated dispatches.
+
+    Learners whose swapped state is NOT shape-stable (the live ANN
+    index: a rebuild's list layout depends on the grown table, so leaf
+    shapes legitimately differ from the live state's) may define their
+    own ``install_state(pytree)`` hook — it is delegated to verbatim,
+    and owns its own validation. The engine-side swap protocol
+    (boundary timing, span, gauges) is identical either way."""
     import jax
     import jax.numpy as jnp
+    hook = getattr(learner, "install_state", None)
+    if callable(hook):
+        hook(pytree)
+        return
     ref_leaves, ref_def = jax.tree_util.tree_flatten(learner.state)
     new_leaves, new_def = jax.tree_util.tree_flatten(pytree)
     if ref_def != new_def:

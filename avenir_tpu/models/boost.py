@@ -88,6 +88,15 @@ class BoostConfig:
     learning_rate: float = 0.3            # forest.boost.learning.rate
     base_score: float = 0.0               # forest.boost.base.score
     reg_lambda: float = 1.0               # forest.boost.reg.lambda
+    # forest.boost.early.stop.rounds (ROADMAP 3c): > 0 carves a
+    # deterministic holdout out of the training rows (strided, every
+    # round(1/holdout_fraction)-th row — seed-free so two processes
+    # carve identically), scores it after every round (rounds are
+    # sequential, so the host-side stop is free), and stops once the
+    # holdout logloss has not improved for this many consecutive
+    # rounds, trimming the ensemble back to the best round. 0 = off.
+    early_stop_rounds: int = 0            # forest.boost.early.stop.rounds
+    holdout_fraction: float = 0.2         # forest.boost.early.stop.holdout
     tree: TreeConfig = field(default_factory=TreeConfig)
 
 
@@ -115,6 +124,18 @@ def _validate_boost_config(config: BoostConfig) -> None:
             np.isfinite(rl) and rl >= 0.0):
         raise ValueError(
             f"reg_lambda must be a finite number >= 0, got {rl!r}")
+    es = config.early_stop_rounds
+    if not isinstance(es, int) or isinstance(es, bool) or es < 0:
+        raise ValueError(
+            "forest.boost.early.stop.rounds must be an int >= 0 "
+            f"(0 = off), got {es!r}")
+    if es:
+        hf = config.holdout_fraction
+        if not isinstance(hf, (int, float)) or isinstance(hf, bool) \
+                or not (np.isfinite(hf) and 0.0 < hf <= 0.5):
+            raise ValueError(
+                "forest.boost.early.stop.holdout must be a fraction in "
+                f"(0, 0.5], got {hf!r}")
     if config.tree.split_selection_strategy != "best":
         raise ValueError(
             "tree.split_selection_strategy must be 'best' for boosting "
@@ -233,19 +254,28 @@ def _value_level_step(node_id, row_w, value_row, rec, bins_rows,
                                    "b_max", "n_classes", "algorithm",
                                    "min_node_size", "min_gain",
                                    "node_budget"))
-def _boost_round(labels, bins_rows, seg_of_bin, col_of_t, row_w0, score,
-                 reg_lambda, learning_rate, *, plan_slices, depth: int,
-                 s_max: int, b_max: int, n_classes: int, algorithm: str,
-                 min_node_size: int, min_gain: float, node_budget: int):
+def _boost_round(labels, bins_rows, seg_of_bin, col_of_t, row_w0,
+                 hist_mask, score, reg_lambda, learning_rate, *,
+                 plan_slices, depth: int, s_max: int, b_max: int,
+                 n_classes: int, algorithm: str, min_node_size: int,
+                 min_gain: float, node_budget: int):
     """ONE boosting round as ONE dispatch: channels from the current
     score, ``depth`` levels of channel-histogram → selection → Newton
     values → value-tracked routing, then the device-resident score update
     ``score + lr · value``. K rounds call this SAME compiled program (the
     operand shapes never change), and the returned records stay on device
     until the caller's single fetch — no host readback inside the
-    training loop. Returns (new_score, level records)."""
+    training loop. Returns (new_score, level records).
+
+    ``row_w0`` is the ROUTING weight (0 kills a row's traversal — the
+    streamed-padding seam); ``hist_mask`` additionally zeroes a row's
+    histogram contribution while letting it route to a leaf and take a
+    value. Early stopping needs the distinction: holdout rows must not
+    shape splits, but their margins must still advance each round or the
+    holdout loss is a constant."""
     n = labels.shape[0]
     chan = _channels(labels, score, n_classes)             # [N, C+1]
+    chan = chan * hist_mask[:, None]
     node_id = jnp.zeros(n, jnp.int32)
     row_w = row_w0
     value_row = jnp.zeros(n, jnp.float32)
@@ -323,6 +353,10 @@ class BoostedModel:
     base_score: float
     learning_rate: float
     reg_lambda: float = 1.0
+    # rounds the early-stopped fit actually kept (None when early
+    # stopping was off) — recorded in the artifact so a sweep over
+    # forest.boost.num.rounds can read back where the holdout plateaued
+    rounds_used: Optional[int] = None
 
     def margins(self, table: EncodedTable,
                 device: bool = False) -> np.ndarray:
@@ -406,6 +440,27 @@ def build_boost_catalog(table: EncodedTable, tree_cfg) -> tuple:
     return plans, T._device_candidates(table, plans)
 
 
+@jax.jit
+def _holdout_logloss(score: jnp.ndarray, idx: jnp.ndarray,
+                     y01: jnp.ndarray) -> jnp.ndarray:
+    """Mean logistic loss of the current margins on the holdout rows:
+    ``softplus(s) − y·s`` — the exact objective the Newton rounds
+    descend, so "holdout stopped improving" means the ensemble stopped
+    generalizing, not that a surrogate plateaued."""
+    s = score[idx]
+    return jnp.mean(jax.nn.softplus(s) - y01 * s)
+
+
+def _holdout_split(n_rows: int, fraction: float) -> np.ndarray:
+    """Deterministic strided holdout mask: every ``round(1/fraction)``-th
+    row (floored at stride 2 so both sides are always non-empty for
+    n >= 2). Seed-free by design — the early-stopped ensemble must be a
+    bit-exact PREFIX of the same config run without stopping, which a
+    sampled split would break across processes."""
+    step = max(int(round(1.0 / fraction)), 2)
+    return (np.arange(n_rows) % step) == 0
+
+
 def grow_boosted(table: EncodedTable, config: BoostConfig,
                  catalog: tuple = None) -> BoostedModel:
     """K boosting rounds, device-resident: the binned candidate catalog
@@ -413,7 +468,18 @@ def grow_boosted(table: EncodedTable, config: BoostConfig,
     layer's cache hit), every round is one call of the single compiled
     :func:`_boost_round` program chained through the on-device score
     vector, and ONE ``device_get`` at the end fetches all K rounds'
-    level records for host tree assembly."""
+    level records for host tree assembly.
+
+    With ``early_stop_rounds`` > 0 (ROADMAP 3c) the strided holdout's
+    rows are masked out of every histogram (``hist_mask``) while still
+    routing to leaves so their margins advance,
+    each round's holdout logloss reads back as one scalar
+    (rounds are host-sequential anyway — the stop is free), and the
+    loop exits after that many consecutive non-improving rounds; the
+    kept ensemble is trimmed to the best round and ``rounds_used``
+    records it. Because rounds are sequential and deterministic, the
+    stopped ensemble is byte-identical to the first ``rounds_used``
+    trees of the same config run to completion."""
     _validate_boost_config(config)
     _require_binary(table.n_classes)
     cfg = config.tree
@@ -424,18 +490,44 @@ def grow_boosted(table: EncodedTable, config: BoostConfig,
     score = jnp.full(table.n_rows, np.float32(config.base_score),
                      jnp.float32)
     row_w0 = jnp.ones(table.n_rows, jnp.float32)
+    hist_mask = row_w0
+    es_rounds = config.early_stop_rounds
+    h_idx = h_y01 = None
+    if es_rounds:
+        hmask = _holdout_split(table.n_rows, config.holdout_fraction)
+        if hmask.all():
+            raise ValueError(
+                "forest.boost.early.stop.rounds needs >= 2 training "
+                f"rows to carve a holdout, got {table.n_rows}")
+        # holdout rows keep routing weight 1 (their margins must advance
+        # for the loss to move) but contribute zero to every histogram
+        hist_mask = jnp.asarray(np.where(hmask, 0.0, 1.0), jnp.float32)
+        h_idx = jnp.asarray(np.nonzero(hmask)[0].astype(np.int32))
+        h_y01 = (jnp.asarray(table.labels)[h_idx] == 1).astype(jnp.float32)
     reg = jnp.float32(config.reg_lambda)
     lr = jnp.float32(config.learning_rate)
     all_records = []
-    for _ in range(config.n_rounds):
+    best_loss, best_round, stale = np.inf, -1, 0
+    for r in range(config.n_rounds):
         score, records = _boost_round(
             table.labels, cand.bins_rows, cand.seg_of_bin, cand.col_of_t,
-            row_w0, score, reg, lr, plan_slices=tuple(cand.plan_slices),
+            row_w0, hist_mask, score, reg, lr,
+            plan_slices=tuple(cand.plan_slices),
             depth=cfg.max_depth, s_max=cand.s_max, b_max=cand.b_max,
             n_classes=table.n_classes, algorithm=cfg.algorithm,
             min_node_size=cfg.min_node_size, min_gain=cfg.min_gain,
             node_budget=cfg.device_node_budget)
         all_records.append(records)
+        if es_rounds:
+            loss = float(_holdout_logloss(score, h_idx, h_y01))
+            if loss < best_loss:
+                best_loss, best_round, stale = loss, r, 0
+            else:
+                stale += 1
+                if stale >= es_rounds:
+                    break
+    if es_rounds:
+        all_records = all_records[:best_round + 1]
     all_records = jax.device_get(all_records)    # ONE readback, K rounds
 
     widths = T._level_widths(cfg.max_depth, cand.s_max,
@@ -452,7 +544,8 @@ def grow_boosted(table: EncodedTable, config: BoostConfig,
                         class_values=list(table.class_values),
                         base_score=float(config.base_score),
                         learning_rate=float(config.learning_rate),
-                        reg_lambda=float(config.reg_lambda))
+                        reg_lambda=float(config.reg_lambda),
+                        rounds_used=len(trees) if es_rounds else None)
 
 # ---------------------------------------------------------------------------
 # out-of-core training: cached binned chunks, additive channel fold
@@ -546,6 +639,12 @@ def grow_boosted_streaming(fz, paths: Sequence[str], config: BoostConfig,
     from avenir_tpu.native.prefetch import PrefetchLoader
     from avenir_tpu.parallel.pipeline import bucket_rows
     _validate_boost_config(config)
+    if config.early_stop_rounds:
+        raise ValueError(
+            "forest.boost.early.stop.rounds is not supported by the "
+            "streaming trainer: the per-round holdout scoring would "
+            "re-stream every cached chunk's score slice per round — use "
+            "the in-core path, or drop the early-stop key (0 = off)")
     if not paths:
         raise ValueError("no part files to stream")
     loader_kwargs = dict(loader_kwargs or {})
@@ -657,13 +756,15 @@ def save_boosted(model: BoostedModel, path: str) -> None:
     ``kind: "boosted"`` — the bagged loader refuses it by name (and vice
     versa) instead of silently mis-voting."""
     F._validate_trees(model.trees)
-    atomic_json_dump(
-        {"format": F.ARTIFACT_FORMAT, "kind": "boosted",
-         "classValues": model.class_values,
-         "baseScore": model.base_score,
-         "learningRate": model.learning_rate,
-         "regLambda": model.reg_lambda,
-         "trees": [t.to_dict() for t in model.trees]}, path)
+    payload = {"format": F.ARTIFACT_FORMAT, "kind": "boosted",
+               "classValues": model.class_values,
+               "baseScore": model.base_score,
+               "learningRate": model.learning_rate,
+               "regLambda": model.reg_lambda,
+               "trees": [t.to_dict() for t in model.trees]}
+    if model.rounds_used is not None:
+        payload["roundsUsed"] = int(model.rounds_used)
+    atomic_json_dump(payload, path)
 
 
 def load_boosted(path: str) -> BoostedModel:
@@ -677,7 +778,9 @@ def load_boosted(path: str) -> BoostedModel:
         class_values=class_values,
         base_score=float(model["baseScore"]),
         learning_rate=float(model["learningRate"]),
-        reg_lambda=float(model.get("regLambda", 1.0)))
+        reg_lambda=float(model.get("regLambda", 1.0)),
+        rounds_used=(int(model["roundsUsed"])
+                     if "roundsUsed" in model else None))
 
 # ---------------------------------------------------------------------------
 # engine serving: schema-stable routing tables + one-dispatch margins

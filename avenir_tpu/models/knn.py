@@ -107,6 +107,15 @@ class KnnConfig:
     ann_nprobe: int = 0                      # knn.ann.nprobe (0 = auto)
     ann_iters: int = 15                      # knn.ann.iters (k-means)
     ann_seed: int = 0                        # knn.ann.seed (build determinism)
+    # knn.ann.live: route queries through the LIVE index wrapper
+    # (models/live_ann.py) — same IVF build, plus per-list overflow
+    # tails so rows appended after the build are probed alongside the
+    # main spans, background re-clustering, and zero-downtime swap.
+    # With no appends the query program and its results are identical
+    # to the frozen path. tail.budget is the per-list soft capacity
+    # that feeds the tail-fill rebuild trigger.
+    ann_live: bool = False                   # knn.ann.live
+    ann_live_tail_budget: int = 1024         # knn.ann.live.tail.budget
 
 
 def _split_features(table: EncodedTable
@@ -240,6 +249,21 @@ def validate_config(config: KnnConfig) -> None:
         if config.ann_iters < 0:
             raise ValueError(
                 f"knn.ann.iters must be >= 0, got {config.ann_iters}")
+        if config.ann_live:
+            if config.sharded:
+                raise ValueError(
+                    "knn.ann.live and knn.sharded conflict: the live "
+                    "index's overflow tails and swap protocol are "
+                    "single-device; drop one of the two")
+            if config.ann_live_tail_budget < 8:
+                raise ValueError(
+                    "knn.ann.live.tail.budget must be >= 8 (per-list "
+                    f"overflow capacity), got "
+                    f"{config.ann_live_tail_budget}")
+    elif config.ann_live:
+        raise ValueError(
+            "knn.ann.live is set but knn.ann=false; the live index IS "
+            "the IVF index plus append tails — set knn.ann=true")
     elif config.ann_nlist or config.ann_nprobe:
         raise ValueError(
             "knn.ann.nlist/knn.ann.nprobe are set but knn.ann=false; "
@@ -415,14 +439,30 @@ def _neighbors_ann(train: EncodedTable, test: EncodedTable,
     fetch, one epoch-end sweep)."""
     from avenir_tpu.ops import ivf
     _, n_probe = _resolved_ann_params(train, config)
-    index = _staged_ann_index(train, config)
+    if config.ann_live:
+        # knn.ann.live (ISSUE 20): same build, but queries go through the
+        # LiveAnnIndex wrapper so rows appended between CLI invocations
+        # of the same process (or by an engine scenario sharing the
+        # slot) are probed too; with no appends the live query is
+        # value-identical to the frozen path
+        from avenir_tpu.models import live_ann
+        live = live_ann.live_index_for(train, config)
 
-    def run(xn, xc):
-        return ivf.ann_topk(
-            index, xn, xc, k=config.top_match_count, n_probe=n_probe,
-            oversample=config.quantized_oversample,
-            qdtype=config.quantized_dtype,
-            distance_scale=config.distance_scale)
+        def run(xn, xc):
+            return live.query(
+                xn, xc, k=config.top_match_count, n_probe=n_probe,
+                oversample=config.quantized_oversample,
+                qdtype=config.quantized_dtype,
+                distance_scale=config.distance_scale)
+    else:
+        index = _staged_ann_index(train, config)
+
+        def run(xn, xc):
+            return ivf.ann_topk(
+                index, xn, xc, k=config.top_match_count, n_probe=n_probe,
+                oversample=config.quantized_oversample,
+                qdtype=config.quantized_dtype,
+                distance_scale=config.distance_scale)
 
     m = int(test.binned.shape[0])
     if 0 < config.feed_chunk_rows < m:

@@ -1,0 +1,524 @@
+"""Live ANN: incremental IVF maintenance under streaming ingest
+(ISSUE 20 / ROADMAP item 6).
+
+``ops/ivf.py`` (PR 14) builds a frozen index: any table growth is a full
+device k-means rebuild — O(N) on the ANN path's amortized cost, exactly
+the batch/online split PAPER.md motivates. This module makes the index a
+living object with three cooperating mechanisms:
+
+- **Append tails** (:meth:`LiveAnnIndex.append`): new rows land in
+  per-list overflow tails — fixed-width device blocks (``tail_cap`` rows
+  per list, a power of two doubled on overflow), bucket-padded with gid
+  −1 exactly like the main spans and probed alongside them through the
+  same masked gather (``ivf.ann_core``'s ``tail_cap`` extension). An
+  append is O(batch) host placement + one O(L·tail_cap·D) tail upload;
+  traced shapes never change between doublings, so the jit cache stays
+  flat and a growth step costs exactly ONE recompile. The int8 tail is
+  quantized at the index's build scale; when an appended row raises
+  ``max|y|`` the base and tail tables re-quantize ONCE at the new joint
+  scale — which is what keeps full-probe parity with a from-scratch
+  ``build_ivf`` over the union table exact (same scale, same tie rule,
+  same candidate set when every list is probed).
+
+- **Background rebuild** (:meth:`LiveAnnIndex.make_train_fn` +
+  :meth:`maybe_swap`): a lifecycle ``RetrainDaemon`` wave re-clusters
+  the grown table — warm-started from the current centroids when the
+  list count is unchanged — and publishes the fresh index through the
+  ``SnapshotRegistry`` (atomic temp-dir + rename, PR 7) while queries
+  keep serving the old one. The subscriber adopts the snapshot at a
+  dispatch boundary (the learner hot-swap parity contract): base index
+  swaps, tails reset, and rows appended AFTER the rebuild's snapshot
+  point replay into fresh tails — no row is lost or served twice.
+
+- **Drift trigger**: every append feeds two scalar signals into a
+  :class:`~avenir_tpu.lifecycle.drift.DriftMonitor` — the tail-fill
+  fraction (appended rows vs the total tail budget) and the list-
+  imbalance skew (max list size over mean, from the same Pallas
+  histogram dispatch the Lloyd step uses via ``ivf.assign_counts``).
+  Crossing a threshold requests a rebuild wave exactly the way
+  Page–Hinkley triggers model retrains. A batch too large for the tail
+  budget bypasses the daemon entirely and rebuilds inline (the index
+  must never refuse rows).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import replace as _dc_replace
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from avenir_tpu.obs import telemetry
+from avenir_tpu.obs.exporters import set_hub_gauges_if_live as _hub_gauges
+from avenir_tpu.ops import ivf
+from avenir_tpu.ops.distance import encode_mixed
+from avenir_tpu.ops.quantized import QDTYPES, _q8, int8_scale
+
+#: snapshot leaf names in jax dict-pytree flatten order (sorted keys) —
+#: the registry stores pytree leaves positionally, so pack/unpack agree
+#: on this ordering by construction
+_IVF_LEAVES = ("amax", "cent_valid", "centroids", "flat", "gids",
+               "lengths", "offsets", "qflat")
+
+#: manifest kind for published index snapshots — subscribers filter on
+#: it so a learner-state publisher sharing the registry can't be
+#: mistaken for an index
+IVF_SNAPSHOT_KIND = "ivf-index"
+
+
+def pack_ivf_index(index: ivf.IvfIndex) -> Dict[str, np.ndarray]:
+    """The registry-publishable pytree of an index: its array leaves as
+    a flat dict (static ints ride in the manifest ``extra``, where
+    :func:`unpack_ivf_index` reads them back)."""
+    return {name: np.asarray(getattr(index, name)) for name in _IVF_LEAVES}
+
+
+def ivf_index_extra(index: ivf.IvfIndex) -> Dict[str, int]:
+    """The static index metadata for the snapshot manifest."""
+    return {"nlist": int(index.nlist), "probe_pad": int(index.probe_pad),
+            "n_real": int(index.n_real), "n_attrs": int(index.n_attrs),
+            "n_cat_bins": int(index.n_cat_bins), "seed": int(index.seed)}
+
+
+def unpack_ivf_index(leaves: Any, extra: Dict[str, Any]) -> ivf.IvfIndex:
+    """Rebuild an :class:`~avenir_tpu.ops.ivf.IvfIndex` from a restored
+    snapshot: ``leaves`` is either the packed dict or the positional
+    leaf list ``Snapshot.restore()`` returns (flatten order == sorted
+    key order), ``extra`` the manifest statics."""
+    if isinstance(leaves, dict):
+        arrs = {name: leaves[name] for name in _IVF_LEAVES}
+    else:
+        if len(leaves) != len(_IVF_LEAVES):
+            raise ValueError(
+                f"ivf-index snapshot has {len(leaves)} leaves, expected "
+                f"{len(_IVF_LEAVES)}")
+        arrs = dict(zip(_IVF_LEAVES, leaves))
+    return ivf.IvfIndex(
+        centroids=jnp.asarray(arrs["centroids"], jnp.float32),
+        cent_valid=jnp.asarray(arrs["cent_valid"], bool),
+        flat=jnp.asarray(arrs["flat"], jnp.float32),
+        qflat=jnp.asarray(arrs["qflat"], jnp.int8),
+        gids=jnp.asarray(arrs["gids"], jnp.int32),
+        offsets=jnp.asarray(arrs["offsets"], jnp.int32),
+        lengths=jnp.asarray(arrs["lengths"], jnp.int32),
+        amax=jnp.float32(np.asarray(arrs["amax"], np.float32)),
+        nlist=int(extra["nlist"]), probe_pad=int(extra["probe_pad"]),
+        n_real=int(extra["n_real"]), n_attrs=int(extra["n_attrs"]),
+        n_cat_bins=int(extra["n_cat_bins"]), seed=int(extra["seed"]))
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    m = max(int(floor), 1)
+    while m < n:
+        m *= 2
+    return m
+
+
+class LiveAnnIndex:
+    """An IVF index that accepts appends while serving queries.
+
+    Single-writer discipline: ``append`` / ``maybe_swap`` / ``query``
+    run on the serving thread; the only cross-thread reader is the
+    rebuild ``train_fn`` (a ``RetrainDaemon`` worker), which snapshots
+    the row ledger under ``_lock``. Device state is published as ONE
+    immutable tuple (:attr:`_live`), so a query mid-append sees either
+    the whole old state or the whole new one, never a torn mix.
+    """
+
+    def __init__(self, y_num: Optional[np.ndarray],
+                 y_cat: Optional[np.ndarray] = None, *, n_cat_bins: int = 0,
+                 nlist: int = 0, n_iters: int = 15, seed: int = 0,
+                 tail_budget: int = 1024,
+                 rebuild_tail_fill: float = 0.5,
+                 rebuild_skew: float = 8.0,
+                 cooldown_s: float = 0.0,
+                 registry=None):
+        from avenir_tpu.lifecycle.drift import DriftMonitor, ThresholdDetector
+        if tail_budget < ivf._LIST_FLOOR:
+            raise ValueError(
+                f"tail_budget must be >= {ivf._LIST_FLOOR}, got "
+                f"{tail_budget}")
+        self._nlist_cfg = int(nlist)
+        self._n_iters = int(n_iters)
+        self._seed = int(seed)
+        self._n_cat_bins = int(n_cat_bins)
+        self.tail_budget = _pow2_at_least(tail_budget, ivf._LIST_FLOOR)
+        self._lock = threading.RLock()
+        self._tel = telemetry.tracer()
+        self._chunks: List[Tuple[Optional[np.ndarray],
+                                 Optional[np.ndarray], int]] = []
+        self.version = 0
+        self.swaps = 0
+        self.appended_rows = 0
+        self.inline_rebuilds = 0
+        self.rebuild_requests = 0
+        self._on_rebuild = None
+        self._watcher = None
+        self._registry = registry
+        if registry is not None:
+            self._watcher = registry.subscribe()
+        self.monitor = DriftMonitor(
+            {"ann.tail_fill": ThresholdDetector(rebuild_tail_fill),
+             "ann.list_skew": ThresholdDetector(rebuild_skew)},
+            on_drift=self._request_rebuild, cooldown_s=cooldown_s)
+        self._push_ledger(y_num, y_cat)
+        index = ivf.build_ivf(
+            None if y_num is None else jnp.asarray(y_num),
+            None if y_cat is None else jnp.asarray(y_cat),
+            n_cat_bins=n_cat_bins, nlist=self._nlist_cfg, n_iters=n_iters,
+            seed=seed)
+        self._install_base(index)
+
+    # -- wiring --------------------------------------------------------------
+
+    def bind_daemon(self, daemon) -> None:
+        """Route drift-triggered rebuild requests to a RetrainDaemon
+        (its ``request`` wakes the background wave)."""
+        self._on_rebuild = daemon.request
+
+    def _request_rebuild(self) -> None:
+        self.rebuild_requests += 1
+        _hub_gauges({"ann.rebuild_requests": self.rebuild_requests})
+        if self._on_rebuild is not None:
+            self._on_rebuild()
+
+    # -- row ledger ----------------------------------------------------------
+
+    def _push_ledger(self, y_num, y_cat) -> int:
+        num = None if y_num is None else np.asarray(y_num, np.float32)
+        cat = None if y_cat is None else np.asarray(y_cat)
+        n = int((num if num is not None else cat).shape[0])
+        if self._chunks:
+            head_num, head_cat, _ = self._chunks[0]
+            if (head_num is None) != (num is None) or \
+                    (head_cat is None) != (cat is None):
+                raise ValueError(
+                    "appended batch feature split (numeric/categorical) "
+                    "does not match the table this index was built over")
+        self._chunks.append((num, cat, n))
+        return n
+
+    def _ledger_rows(self, start: int
+                     ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """Rows ``[start:]`` of the ledger as concatenated host arrays."""
+        nums, cats = [], []
+        off = 0
+        for num, cat, n in self._chunks:
+            lo = max(start - off, 0)
+            if lo < n:
+                if num is not None:
+                    nums.append(num[lo:])
+                if cat is not None:
+                    cats.append(cat[lo:])
+            off += n
+        return (np.concatenate(nums) if nums else None,
+                np.concatenate(cats) if cats else None)
+
+    # -- device state --------------------------------------------------------
+
+    def _install_base(self, index: ivf.IvfIndex,
+                      tail_cap: Optional[int] = None) -> None:
+        """Adopt ``index`` as the serving base with EMPTY tails."""
+        cap = _pow2_at_least(tail_cap or ivf._LIST_FLOOR, ivf._LIST_FLOOR)
+        L, d = index.nlist, index.d
+        self._t_flat = np.zeros((L, cap, d), np.float32)
+        self._t_gids = np.full((L, cap), -1, np.int32)
+        self._t_len = np.zeros(L, np.int32)
+        self._tail_cap = cap
+        self._amax = float(index.amax)
+        self._counts = np.asarray(index.lengths, np.int64).copy()
+        self._publish(index)
+
+    def _publish(self, index: ivf.IvfIndex) -> None:
+        """Upload tails and atomically swap the serving tuple."""
+        L, cap = self._t_len.shape[0], self._tail_cap
+        tail_flat = jnp.asarray(self._t_flat.reshape(L * cap, -1))
+        tail_qflat = _q8(tail_flat, int8_scale(jnp.float32(self._amax)))
+        self._live = (index, tail_flat, tail_qflat,
+                      jnp.asarray(self._t_gids.reshape(L * cap)),
+                      jnp.asarray(self._t_len), cap)
+
+    @property
+    def index(self) -> ivf.IvfIndex:
+        return self._live[0]
+
+    @property
+    def tail_cap(self) -> int:
+        return self._live[5]
+
+    @property
+    def n_total(self) -> int:
+        return self.index.n_real + int(self._t_len.sum())
+
+    @property
+    def tail_fill(self) -> float:
+        """Fraction of the total tail budget consumed — the primary
+        rebuild-pressure signal (monotone between rebuilds)."""
+        L = self._t_len.shape[0]
+        return float(self._t_len.sum()) / float(L * self.tail_budget)
+
+    @property
+    def list_skew(self) -> float:
+        """Max list population over the mean — the imbalance signal (a
+        skewed clustering makes sparse probes miss and hot lists slow)."""
+        total = int(self._counts.sum())
+        if total <= 0:
+            return 0.0
+        return float(self._counts.max()) * len(self._counts) / total
+
+    # -- append path ---------------------------------------------------------
+
+    def append(self, y_num: Optional[np.ndarray],
+               y_cat: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        """File a batch of new rows into the overflow tails — O(batch)
+        placement, one tail-block upload, NO index rebuild (unless the
+        batch overflows the whole tail budget, which rebuilds inline).
+        Returns append stats including the drift-signal values."""
+        with self._lock:
+            n_batch = self._push_ledger(y_num, y_cat)
+        with telemetry.span("knn.ann.live.append"):
+            return self._append_tail(y_num, y_cat, n_batch)
+
+    def _append_tail(self, y_num, y_cat, n_batch: int) -> Dict[str, Any]:
+        index = self.index
+        y = encode_mixed(
+            None if y_num is None else jnp.asarray(y_num),
+            None if y_cat is None else jnp.asarray(y_cat),
+            index.n_cat_bins)
+        assign_d, _counts_d = ivf.assign_counts(y, index.centroids)
+        assign = np.asarray(assign_d, np.int64)
+        encoded = np.asarray(y, np.float32)
+        with self._lock:
+            L = index.nlist
+            batch_counts = np.bincount(assign, minlength=L)
+            new_fill = self._t_len + batch_counts
+            needed = _pow2_at_least(int(new_fill.max()), self._tail_cap)
+            if needed > self.tail_budget:
+                # the batch cannot fit any legal tail: rebuild the base
+                # index over the union inline — the index never refuses
+                # rows, and the daemonless caller still converges
+                self._request_rebuild()
+                self._rebuild_inline()
+                return self._stats(n_batch, inline=True)
+            if needed > self._tail_cap:
+                # tail doubling: a NEW static gather width — exactly one
+                # recompile on the next query, then flat again
+                old = self._tail_cap
+                grown_f = np.zeros((L, needed, encoded.shape[1]),
+                                   np.float32)
+                grown_g = np.full((L, needed), -1, np.int32)
+                grown_f[:, :old] = self._t_flat
+                grown_g[:, :old] = self._t_gids
+                self._t_flat, self._t_gids = grown_f, grown_g
+                self._tail_cap = needed
+            # per-list placement: stable order keeps gids ascending
+            # within each list's tail (the two-key tie rule's invariant)
+            order = np.argsort(assign, kind="stable")
+            starts = np.zeros(L, np.int64)
+            starts[1:] = np.cumsum(batch_counts)[:-1]
+            gid0 = self.n_total
+            gids_new = gid0 + np.arange(n_batch, dtype=np.int64)
+            for li in np.nonzero(batch_counts)[0]:
+                c = int(batch_counts[li])
+                rows = order[starts[li]:starts[li] + c]
+                base = int(self._t_len[li])
+                self._t_flat[li, base:base + c] = encoded[rows]
+                self._t_gids[li, base:base + c] = gids_new[rows]
+            self._t_len = (self._t_len + batch_counts).astype(np.int32)
+            self._counts += batch_counts
+            self.appended_rows += n_batch
+            bmax = float(np.max(np.abs(encoded))) if n_batch else 0.0
+            if bmax > self._amax:
+                # joint-scale maintenance: re-quantize the BASE table at
+                # the union max so the prebuilt int8 bytes equal a
+                # from-scratch build over the grown table (full-probe
+                # parity); the tail re-quantizes in _publish anyway
+                self._amax = bmax
+                index = _dc_replace(
+                    index, amax=jnp.float32(bmax),
+                    qflat=_q8(index.flat,
+                              int8_scale(jnp.float32(bmax))))
+            self._publish(index)
+            return self._stats(n_batch, inline=False)
+
+    def _stats(self, n_batch: int, *, inline: bool) -> Dict[str, Any]:
+        fill, skew = self.tail_fill, self.list_skew
+        self.monitor.observe("ann.tail_fill", fill)
+        self.monitor.observe("ann.list_skew", skew)
+        _hub_gauges({"ann.tail_fill": fill, "ann.list_skew": skew,
+                     "ann.tail_rows": float(self._t_len.sum()),
+                     "ann.index_version": float(self.version),
+                     "ann.rows_total": float(self.n_total)})
+        return {"appended": n_batch, "tail_fill": fill, "list_skew": skew,
+                "tail_cap": self._tail_cap, "inline_rebuild": inline,
+                "n_total": self.n_total}
+
+    # -- rebuild + swap ------------------------------------------------------
+
+    def _rebuild_inline(self) -> None:
+        index = self._build_union_from(*self._ledger_rows(0))
+        self.inline_rebuilds += 1
+        self.version += 1
+        self._install_base(index)
+
+    def make_train_fn(self):
+        """The RetrainDaemon wave: snapshot the ledger under the lock,
+        re-cluster warm-started from the serving centroids, and hand the
+        registry a publishable pytree + manifest extras. Runs on the
+        daemon thread; never touches serving state."""
+        def train() -> Dict[str, Any]:
+            with self._lock:
+                num, cat = self._ledger_rows(0)
+                n_snap = self.n_total
+            index = self._build_union_from(num, cat)
+            extra = ivf_index_extra(index)
+            extra["n_snapshot"] = n_snap
+            return {"pytree": pack_ivf_index(index), "train_rows": n_snap,
+                    "kind": IVF_SNAPSHOT_KIND, "extra": extra}
+        return train
+
+    def _build_union_from(self, num, cat) -> ivf.IvfIndex:
+        n = int((num if num is not None else cat).shape[0])
+        nlist = self._nlist_cfg or ivf.default_nlist(n)
+        index = self.index
+        init = (np.asarray(index.centroids)
+                if nlist == index.nlist else None)
+        return ivf.build_ivf(
+            None if num is None else jnp.asarray(num),
+            None if cat is None else jnp.asarray(cat),
+            n_cat_bins=self._n_cat_bins, nlist=nlist,
+            n_iters=self._n_iters, seed=self._seed, init_centroids=init)
+
+    def maybe_swap(self) -> Optional[int]:
+        """Poll the registry for a fresh index and adopt it — call at a
+        dispatch boundary (between query batches), exactly where the
+        learner hot-swap installs. Returns the adopted version or None."""
+        if self._watcher is None:
+            return None
+        snap = self._watcher.poll()
+        if snap is None or snap.manifest.get("kind") != IVF_SNAPSHOT_KIND:
+            return None
+        t0 = time.perf_counter()
+        self.adopt(snap.restore(), snap.manifest.get("extra") or {},
+                   version=snap.version)
+        from avenir_tpu.lifecycle.swap import record_swap
+        record_swap(self._tel, t0, snap.version, self.swaps)
+        return snap.version
+
+    def adopt(self, leaves: Any, extra: Dict[str, Any],
+              version: Optional[int] = None) -> None:
+        """Install a rebuilt index: swap the base, reset the tails, and
+        replay every ledger row appended AFTER the rebuild's snapshot
+        point into fresh tails — the zero-loss half of the swap parity
+        contract (queries in flight hold the old tuple; the next query
+        reads the new one)."""
+        index = unpack_ivf_index(leaves, extra)
+        n_snap = int(extra.get("n_snapshot", index.n_real))
+        with self._lock:
+            replay_num, replay_cat = self._ledger_rows(n_snap)
+            self._install_base(index)
+            self.version = (version if version is not None
+                            else self.version + 1)
+            self.swaps += 1
+        n_replay = 0
+        if replay_num is not None or replay_cat is not None:
+            n_replay = int((replay_num if replay_num is not None
+                            else replay_cat).shape[0])
+        if n_replay:
+            self._append_tail(replay_num, replay_cat, n_replay)
+
+    # -- query path ----------------------------------------------------------
+
+    def query(self, x_num, x_cat=None, *, k: int, n_probe: int = 0,
+              oversample: int = 4, qdtype: str = "int8",
+              distance_scale: int = 1000
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """``ivf.ann_topk`` over base + tails: same validation, same
+        auto-sizing, same return contract (scaled-int distances, global
+        row ids — appended rows number ``n_base..n_total-1`` in append
+        order, exactly their row position in the union table). With no
+        appends the tail candidates all mask out and the results are
+        value-identical to the frozen index's."""
+        index, t_flat, t_qflat, t_gids, t_len, cap = self._live
+        if qdtype not in QDTYPES:
+            raise ValueError(f"qdtype {qdtype!r} not one of {QDTYPES}")
+        if oversample < 1:
+            raise ValueError("oversample must be >= 1")
+        if n_probe == 0:
+            n_probe = ivf.default_nprobe(index.nlist)
+        if not 1 <= n_probe <= index.nlist:
+            raise ValueError(
+                f"n_probe must be in [1, nlist={index.nlist}], got "
+                f"{n_probe}")
+        x = encode_mixed(x_num, x_cat, index.n_cat_bins)
+        n = index.n_real + int(np.asarray(t_len).sum())
+        k_eff = max(min(k, n), 1)
+        kprime = min(max(oversample * k_eff, k_eff), max(n, 1))
+        return ivf._live_ann_query(
+            x, index.centroids, index.cent_valid, index.flat, index.qflat,
+            index.gids, index.offsets, index.lengths, index.amax,
+            t_flat, t_qflat, t_gids, t_len,
+            n_probe=n_probe, probe_pad=index.probe_pad, kprime=kprime,
+            k_out=k_eff, n_attrs=index.n_attrs, qdtype=qdtype,
+            distance_scale=distance_scale, tail_cap=cap)
+
+    # -- provenance ----------------------------------------------------------
+
+    def describe(self) -> Dict[str, Any]:
+        """Index provenance for ``--explain`` and reports."""
+        index = self.index
+        return {"nlist": int(index.nlist), "version": int(self.version),
+                "tail_fill": round(self.tail_fill, 6),
+                "tail_rows": int(self._t_len.sum()),
+                "tail_cap": int(self.tail_cap), "swaps": int(self.swaps),
+                "n_rows": int(self.n_total),
+                "rebuild_requests": int(self.rebuild_requests),
+                "inline_rebuilds": int(self.inline_rebuilds)}
+
+
+# ---------------------------------------------------------------------------
+# CLI live slot: one-slot cache, the _ANN_INDEX_CACHE contract
+# ---------------------------------------------------------------------------
+
+#: one-slot live-index cache for the CLI verb: the part-file loop scores
+#: many test shards against ONE train table, and a live index must
+#: survive across shards to keep its version/tails (the frozen-index
+#: cache discipline, extended with the live knobs)
+_LIVE_SLOT: dict = {}
+
+
+def live_index_for(train, config) -> LiveAnnIndex:
+    """Build (or reuse) the live index for this train table + config —
+    mirrors ``models.knn._staged_ann_index`` keying, plus the tail
+    budget (a budget change is a different index)."""
+    from avenir_tpu.models.knn import (_resolved_ann_params,
+                                       _split_features_host)
+    nlist, _ = _resolved_ann_params(train, config)
+    key = (id(train), nlist, config.ann_iters, config.ann_seed,
+           config.ann_live_tail_budget)
+    hit = _LIVE_SLOT.get(key)
+    if hit is not None and hit[0] is train:
+        return hit[1]
+    tr_num, tr_cat = _split_features_host(train)
+    cat_idx = [i for i, f in enumerate(train.feature_fields)
+               if f.is_categorical]
+    n_bins = max((train.bins_per_feature[i] for i in cat_idx), default=0)
+    with telemetry.span("knn.ann.build"):
+        live = LiveAnnIndex(
+            tr_num, tr_cat, n_cat_bins=n_bins, nlist=nlist,
+            n_iters=config.ann_iters, seed=config.ann_seed,
+            tail_budget=config.ann_live_tail_budget)
+    _LIVE_SLOT.clear()
+    _LIVE_SLOT[key] = (train, live)
+    return live
+
+
+def peek_live_index() -> Optional[LiveAnnIndex]:
+    """The currently cached live index, if any (explain provenance)."""
+    for _key, (_train, live) in _LIVE_SLOT.items():
+        return live
+    return None

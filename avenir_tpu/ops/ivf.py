@@ -161,6 +161,23 @@ def _lloyd_step(y: jnp.ndarray, cents: jnp.ndarray
 
 
 @jax.jit
+def assign_counts(y: jnp.ndarray, cents: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Nearest-centroid assignment plus per-list counts in ONE device
+    program — the live-append hot path (ISSUE 20): the assignment files
+    each appended row into its overflow tail, and the counts fold
+    through the same Pallas histogram dispatch the Lloyd step uses so
+    the list-imbalance drift signal costs nothing extra."""
+    nlist = cents.shape[0]
+    c2 = jnp.sum(cents * cents, axis=1)[None, :]
+    assign = jnp.argmin(c2 - 2.0 * (y @ cents.T), axis=1).astype(jnp.int32)
+    counts = histogram.class_feature_bin_counts(
+        assign[:, None], jnp.zeros((y.shape[0],), jnp.int32),
+        n_classes=1, n_bins=nlist).reshape(nlist)
+    return assign, counts
+
+
+@jax.jit
 def _assign_rows(y: jnp.ndarray, cents: jnp.ndarray) -> jnp.ndarray:
     """Nearest-centroid assignment (argmin ties → lowest centroid id) —
     the FINAL pass after Lloyd stops, so the inverted lists agree with
@@ -173,25 +190,39 @@ def _assign_rows(y: jnp.ndarray, cents: jnp.ndarray) -> jnp.ndarray:
 
 def train_coarse_quantizer(y: jnp.ndarray, nlist: int, *, n_iters: int = 15,
                            seed: int = 0, seed_sample: int = 64,
-                           tol: float = 1e-12
+                           tol: float = 1e-12,
+                           init_centroids: Optional[np.ndarray] = None
                            ) -> Tuple[jnp.ndarray, np.ndarray]:
     """Device k-means over the encoded rows ``y`` [N, D]: host k-means++
     seeding (on a deterministic sample of ≤ ``seed_sample·nlist`` rows —
     the FAISS training-subsample discipline, sized so seeding never
     dominates the build) + ``n_iters`` jitted Lloyd steps with an early
     stop once the largest centroid move drops under ``tol``. Returns
-    (centroids [nlist, D] device, final assignment [N] host int32)."""
+    (centroids [nlist, D] device, final assignment [N] host int32).
+
+    ``init_centroids`` warm-starts Lloyd from an existing [nlist, D]
+    solution instead of re-seeding — the live-index rebuild path, where
+    the previous clustering is already near the new optimum and a few
+    Lloyd steps converge where a cold k-means++ would pay full price."""
     n = int(y.shape[0])
     if nlist < 1:
         raise ValueError(f"nlist must be >= 1, got {nlist}")
     if n_iters < 0:
         raise ValueError(f"n_iters must be >= 0, got {n_iters}")
-    rng = np.random.default_rng(seed)
-    y_host = np.asarray(y, np.float32)
-    cap = max(nlist, min(n, seed_sample * nlist))
-    sample = (y_host if cap >= n
-              else y_host[rng.choice(n, cap, replace=False)])
-    cents = jnp.asarray(_seed_centroids(sample, nlist, rng))
+    if init_centroids is not None:
+        init = np.asarray(init_centroids, np.float32)
+        if init.shape != (nlist, int(y.shape[1])):
+            raise ValueError(
+                f"init_centroids shape {init.shape} does not match "
+                f"(nlist={nlist}, d={int(y.shape[1])})")
+        cents = jnp.asarray(init)
+    else:
+        rng = np.random.default_rng(seed)
+        y_host = np.asarray(y, np.float32)
+        cap = max(nlist, min(n, seed_sample * nlist))
+        sample = (y_host if cap >= n
+                  else y_host[rng.choice(n, cap, replace=False)])
+        cents = jnp.asarray(_seed_centroids(sample, nlist, rng))
     for _ in range(n_iters):
         cents, _, shift = _lloyd_step(y, cents)
         if float(shift) < tol:
@@ -267,10 +298,12 @@ def _build_lists(encoded: np.ndarray, assign: np.ndarray, nlist: int
 
 def build_ivf(y_num: Optional[jnp.ndarray],
               y_cat: Optional[jnp.ndarray] = None, *, n_cat_bins: int = 0,
-              nlist: int = 0, n_iters: int = 15, seed: int = 0) -> IvfIndex:
+              nlist: int = 0, n_iters: int = 15, seed: int = 0,
+              init_centroids: Optional[np.ndarray] = None) -> IvfIndex:
     """Build the IVF index over already-normalized train features (the
     same input contract as every kernel sibling). ``nlist=0`` auto-sizes
-    to ~√N. Deterministic for a fixed ``seed`` across processes."""
+    to ~√N. Deterministic for a fixed ``seed`` across processes.
+    ``init_centroids`` warm-starts the k-means (live-index rebuilds)."""
     y = encode_mixed(y_num, y_cat, n_cat_bins)
     n = int(y.shape[0])
     if n == 0:
@@ -279,7 +312,8 @@ def build_ivf(y_num: Optional[jnp.ndarray],
     if nlist == 0:
         nlist = default_nlist(n)
     cents, assign = train_coarse_quantizer(y, nlist, n_iters=n_iters,
-                                           seed=seed)
+                                           seed=seed,
+                                           init_centroids=init_centroids)
     encoded = np.asarray(y, np.float32)
     flat, gids, offsets, lengths, probe_pad = _build_lists(
         encoded, assign, nlist)
@@ -306,14 +340,33 @@ def ann_core(x: jnp.ndarray, cents: jnp.ndarray, cvalid: jnp.ndarray,
              gids: jnp.ndarray, offsets: jnp.ndarray,
              lengths: jnp.ndarray, amax: jnp.ndarray, *, n_probe: int,
              probe_pad: int, kprime: int, k_out: int, n_attrs: int,
-             qdtype: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+             qdtype: str, tail_flat: Optional[jnp.ndarray] = None,
+             tail_qflat: Optional[jnp.ndarray] = None,
+             tail_gids: Optional[jnp.ndarray] = None,
+             tail_lengths: Optional[jnp.ndarray] = None,
+             tail_cap: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The trace-level query core, shared verbatim by the single-device
     jit and the per-shard ``shard_map`` body: probe selection, the
     per-probe gathered candidate scan with the two-key running merge,
     and the exact f32 re-rank. Returns the PRE-finalize sorted key
     (exact f32 metric with ``_BIG`` sentinels, global row ids with
     ``INT_BIG`` sentinels) — exactly the contract
-    ``quantized.finalize_quantized`` and the cross-shard merge consume."""
+    ``quantized.finalize_quantized`` and the cross-shard merge consume.
+
+    **Overflow tails (live index, ISSUE 20):** when ``tail_cap > 0``,
+    every list additionally owns a fixed-width tail block of appended
+    rows — ``tail_flat``/``tail_qflat`` are ``[L·tail_cap, D]`` with
+    list ``li``'s tail at rows ``[li·tail_cap, (li+1)·tail_cap)``,
+    ``tail_gids`` carries −1 padding exactly like the main spans, and
+    ``tail_lengths[li]`` counts the real appended rows. The scan body
+    gathers each probed list's tail alongside its main span through the
+    SAME masked-gather + two-key-merge discipline, so tail candidates
+    compete under the identical (metric, lowest global id) rule, and
+    ``tail_cap`` being static (a power of two, doubled on overflow)
+    keeps the jit cache flat: appends change only array CONTENTS, never
+    traced shapes. ``tail_cap = 0`` (the default) emits a trace
+    bit-identical to the pre-live program — every existing caller,
+    including the sharded ``shard_map`` body, is untouched."""
     m = x.shape[0]
     n_pad_rows = flat.shape[0]
     big = jnp.float32(_BIG)
@@ -343,8 +396,19 @@ def ann_core(x: jnp.ndarray, cents: jnp.ndarray, cvalid: jnp.ndarray,
         qflat = lax.cond(amax_x <= amax,
                          lambda: build_qflat,
                          lambda: _q8(flat, s))
+        if tail_cap:
+            # ``amax`` on a live index is the max over base AND appended
+            # rows (the maintainer re-quantizes both tables when an
+            # append raises it), so the in-range branch reuses prebuilt
+            # tail bytes and out-of-range chunks re-quantize both at
+            # the same joint scale — the brute-force-parity expression
+            tail_q = lax.cond(amax_x <= amax,
+                              lambda: tail_qflat,
+                              lambda: _q8(tail_flat, s))
     else:
         xq, qflat = x, flat          # bf16 casts inside the metric
+        if tail_cap:
+            tail_q = tail_flat
 
     def body(carry, pid):
         best_d, best_g, best_p = carry
@@ -362,9 +426,27 @@ def ann_core(x: jnp.ndarray, cents: jnp.ndarray, cvalid: jnp.ndarray,
         found = (iota < lengths[pid][:, None]) & (g >= 0)
         metric = jnp.where(found, metric, big)
         gkey = jnp.where(found, g, INT_BIG)
-        all_d = jnp.concatenate([best_d, metric], axis=1)
-        all_g = jnp.concatenate([best_g, gkey], axis=1)
-        all_p = jnp.concatenate([best_p, pos], axis=1)
+        cat_d = [best_d, metric]
+        cat_g = [best_g, gkey]
+        cat_p = [best_p, pos]
+        if tail_cap:
+            # the probed list's overflow tail: fixed-width block at
+            # li·tail_cap, masked by the tail fill count and the −1
+            # padding gids — the same discipline as the main span. Tail
+            # positions ride as ``n_pad_rows + tpos`` so the re-rank
+            # below can route them to the tail table without an
+            # id→row map.
+            t_iota = jnp.arange(tail_cap, dtype=jnp.int32)[None, :]
+            tpos = pid[:, None] * tail_cap + t_iota         # [M, TC]
+            tg = tail_gids[tpos]
+            tmetric = gathered_candidate_metric(xq, tail_q[tpos], qdtype)
+            tfound = (t_iota < tail_lengths[pid][:, None]) & (tg >= 0)
+            cat_d.append(jnp.where(tfound, tmetric, big))
+            cat_g.append(jnp.where(tfound, tg, INT_BIG))
+            cat_p.append(n_pad_rows + tpos)
+        all_d = jnp.concatenate(cat_d, axis=1)
+        all_g = jnp.concatenate(cat_g, axis=1)
+        all_p = jnp.concatenate(cat_p, axis=1)
         # two-key merge: global top-k' by (metric, lowest global row id)
         # — the brute-force scan's tie rule, enforced explicitly
         d_s, g_s, p_s = lax.sort((all_d, all_g, all_p), dimension=1,
@@ -381,7 +463,18 @@ def ann_core(x: jnp.ndarray, cents: jnp.ndarray, cvalid: jnp.ndarray,
     # ordering rule to quantized._rerank_metric, with the flat-table
     # position riding as a passenger so the gather needs no id->row map
     found = cand_g < INT_BIG
-    yc = flat[jnp.clip(cand_p, 0, max(n_pad_rows - 1, 0))]  # [M, K', D]
+    if tail_cap:
+        # positions ≥ n_pad_rows address the tail table: two clipped
+        # gathers + a select, no concatenated materialization of
+        # base+tail (the tail block stays O(L·tail_cap))
+        in_tail = cand_p >= n_pad_rows
+        base_yc = flat[jnp.clip(cand_p, 0, max(n_pad_rows - 1, 0))]
+        tail_rows = tail_flat.shape[0]
+        tail_yc = tail_flat[jnp.clip(cand_p - n_pad_rows, 0,
+                                     max(tail_rows - 1, 0))]
+        yc = jnp.where(in_tail[..., None], tail_yc, base_yc)
+    else:
+        yc = flat[jnp.clip(cand_p, 0, max(n_pad_rows - 1, 0))]  # [M, K', D]
     em = exact_candidate_metric(x, yc, n_attrs)
     em = jnp.where(found, em, big)
     m_s, g_s, _ = lax.sort((em, jnp.where(found, cand_g, INT_BIG), cand_p),
@@ -402,6 +495,29 @@ def _ann_query(x, cents, cvalid, flat, qflat, gids, offsets, lengths,
                   amax, n_probe=n_probe, probe_pad=probe_pad,
                   kprime=kprime, k_out=k_out, n_attrs=n_attrs,
                   qdtype=qdtype),
+        distance_scale)
+
+
+_LIVE_ANN_STATICS = _ANN_STATICS + ("tail_cap",)
+
+
+@partial(jax.jit, static_argnames=_LIVE_ANN_STATICS)
+def _live_ann_query(x, cents, cvalid, flat, qflat, gids, offsets, lengths,
+                    amax, tail_flat, tail_qflat, tail_gids, tail_lengths, *,
+                    n_probe, probe_pad, kprime, k_out, n_attrs, qdtype,
+                    distance_scale, tail_cap):
+    """The live-index twin of ``_ann_query``: same core, plus the
+    overflow-tail arrays. A SEPARATE jit entry so the frozen-index
+    program (and its cache key) is untouched; ``tail_cap`` is the only
+    extra static, so appends re-hit one compiled program until a tail
+    doubling changes it — exactly one recompile per growth step."""
+    return finalize_quantized(
+        *ann_core(x, cents, cvalid, flat, qflat, gids, offsets, lengths,
+                  amax, n_probe=n_probe, probe_pad=probe_pad,
+                  kprime=kprime, k_out=k_out, n_attrs=n_attrs,
+                  qdtype=qdtype, tail_flat=tail_flat, tail_qflat=tail_qflat,
+                  tail_gids=tail_gids, tail_lengths=tail_lengths,
+                  tail_cap=tail_cap),
         distance_scale)
 
 

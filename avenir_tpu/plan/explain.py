@@ -55,9 +55,21 @@ def render(plan: Plan) -> str:
             bits.append(f"ingest=parallel workers={g.get('workers')} "
                         f"splits={g.get('splits')} "
                         f"split_bytes={g.get('split_bytes')}")
+        if node.ann:
+            a = node.ann
+            ann_bits = [f"ann={'live' if a.get('live') else 'ivf'} "
+                        f"nlist={a.get('nlist')} nprobe={a.get('nprobe')} "
+                        f"index={a.get('source')}"]
+            if a.get("version") is not None:
+                ann_bits.append(f"v={a['version']} "
+                                f"tail_fill={a['tail_fill']} "
+                                f"swaps={a['swaps']}")
+            bits.append(" ".join(ann_bits))
         lines.append(" ".join(bits))
         if node.detail:
             lines.append(" " * 12 + node.detail)
+        if node.ann and node.ann.get("reason"):
+            lines.append(" " * 12 + node.ann["reason"])
     lines.append("edges:")
     for node in plan.nodes:
         if node.output is None:

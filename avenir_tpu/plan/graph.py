@@ -56,6 +56,11 @@ class PlanNode:
     #  "queue_depth": D}. None = serial encode. Advisory only — the
     # fingerprint is unchanged (same bytes in -> same staged table out).
     ingest: Optional[Dict[str, Any]] = None
+    # ANN index provenance on a knn kernel node (ISSUE 20): {"nlist",
+    # "nprobe", "live", "source" ("cached"|"build"), "reason", and when
+    # the live slot is warm its "version"/"tail_fill"/"swaps"}. None =
+    # brute-force scoring. Advisory only, like ingest.
+    ann: Optional[Dict[str, Any]] = None
     detail: str = ""                # one-line human note for --explain
 
     def __post_init__(self):
@@ -119,6 +124,7 @@ class Plan:
                 "fused": n.fused,
                 "journal": n.journal,
                 "ingest": n.ingest,
+                "ann": n.ann,
                 "detail": n.detail,
             })
         edges = [{"name": n.output, "type": n.edge_type,

@@ -305,6 +305,101 @@ class BoostServingLearner:
             self.reward_sum += float(reward)
 
 
+class AnnServingLearner:
+    """Similar-user lookup behind the engine's learner protocol
+    (ISSUE 20): an event is a "find users like this one" request, the
+    action written back is the nearest neighbor's global row id, and the
+    model being served is a :class:`~avenir_tpu.models.live_ann.
+    LiveAnnIndex` — so recall-under-churn rides the same dispatch-then-
+    fetch pipeline, SLO gates, and lifecycle hot-swap as every other
+    scenario instead of being assumed.
+
+    The index is NOT shape-stable across rebuilds (a re-clustered list
+    layout depends on the grown table), so swaps delegate through the
+    learner's own :meth:`install_state` hook (lifecycle.swap): the
+    engine's swap protocol — boundary timing, ``lifecycle.swap`` span,
+    version gauges — is identical to a bandit/boost swap, only the
+    install differs (adopt + tail replay instead of a leaf-wise copy).
+    Ingest (``live.append``) runs OUTSIDE the learner, exactly like the
+    reference's batch half feeding the online half.
+
+    Query feature rows arrive as a host-resident ring; an n-event batch
+    queries the next n rows padded to the power-of-two bucket so ragged
+    batches reuse compiled programs (the ``BoostServingLearner``
+    discipline)."""
+
+    def __init__(self, live, q_num, q_cat=None, *, k: int = 5,
+                 n_probe: int = 0, batch_size: int = 1):
+        import types
+        import numpy as np
+        self.live = live
+        self.state = None         # swaps route through install_state
+        self.actions = ["similar-user"]
+        self.cfg = types.SimpleNamespace(batch_size=batch_size)
+        self._q_num = (None if q_num is None
+                       else np.asarray(q_num, np.float32))
+        self._q_cat = None if q_cat is None else np.asarray(q_cat)
+        self._rows = int((self._q_num if self._q_num is not None
+                          else self._q_cat).shape[0])
+        self._k = int(k)
+        self._n_probe = int(n_probe)
+        self._cursor = 0
+        self.reward_count = 0
+        self.reward_sum = 0.0
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        m = 1
+        while m < n:
+            m *= 2
+        return m
+
+    def install_state(self, payload) -> None:
+        """The variable-shape swap hook ``lifecycle.swap.install_state``
+        delegates to: ``payload`` is ``(leaves, extra)`` from a
+        published ivf-index snapshot — adopt the rebuilt base and replay
+        post-snapshot appends into fresh tails."""
+        leaves, extra = payload
+        self.live.adopt(leaves, extra)
+
+    def warm(self, max_batch: int) -> None:
+        """Pre-compile the pow2 batch buckets (queries are pure — no
+        state mutation, the warm_serving_paths discipline)."""
+        m = 1
+        while m <= self._bucket(max_batch):
+            self.resolve_action_batch(self.next_action_batch_async(m))
+            m *= 2
+
+    def _probe(self) -> int:
+        # an explicit n_probe survives a rebuild that shrank nlist
+        if self._n_probe <= 0:
+            return 0
+        return min(self._n_probe, self.live.index.nlist)
+
+    def next_action_batch_async(self, n: int):
+        import numpy as np
+        m = self._bucket(n)
+        idx = (self._cursor + np.arange(m)) % self._rows
+        self._cursor = (self._cursor + n) % self._rows
+        xn = None if self._q_num is None else self._q_num[idx]
+        xc = None if self._q_cat is None else self._q_cat[idx]
+        handle = self.live.query(xn, xc, k=self._k, n_probe=self._probe())
+        return (handle, n)
+
+    def resolve_action_batch(self, handle) -> List[str]:
+        import numpy as np
+        (_dist, ids), n = handle
+        return [str(int(g)) for g in np.asarray(ids)[:n, 0]]
+
+    def set_reward_batch(self, pairs: Sequence[Tuple[str, float]]) -> None:
+        """Outcome feedback (did the suggested similar user convert?):
+        like boosting, the lifecycle REBUILD is the update, so rewards
+        only accumulate — the engine's DriftMonitor taps them."""
+        for _action, reward in pairs:
+            self.reward_count += 1
+            self.reward_sum += float(reward)
+
+
 class AdmissionControl:
     """Bounded-depth gate for the serving engine (ISSUE 8): graceful
     degradation instead of an unbounded ``engine.queue_depth``.

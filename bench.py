@@ -1040,6 +1040,72 @@ def _lifecycle_bench() -> dict:
     }
 
 
+def _autotune_key_live_ann(nlist: int, batch_rows: int) -> str:
+    """Live-ANN winner-cache key (ISSUE 20): the ``/live_ann/``
+    namespace keeps streaming-ingest records from ever colliding with
+    the frozen ``/ann/`` sweep entries — same device/shape prefix, a
+    disjoint suffix."""
+    return (_autotune_key(("live_ann",))
+            + f"/live_ann/nl{nlist}-br{batch_rows}")
+
+
+def _live_ann_bench() -> dict:
+    """ISSUE 20: streaming-ingest ANN — append-tail rows/min, recall
+    over the union table, full-probe parity vs a from-scratch build, and
+    mid-stream hot-swap latency. Runs scripts/live_ann_smoke.py in a
+    subprocess with ``--skip-gates`` (a loaded bench host records the
+    measured rate instead of failing; the hard gates are enforced by the
+    tier-1 smoke hook in tests/test_live_ann.py). The measured ingest
+    rate persists in the autotune cache under the ``/live_ann/``
+    namespace (PR 14 discipline — never colliding with ``/ann/``)."""
+    import subprocess
+    import sys as _sys
+    script = os.path.join(os.path.dirname(__file__), "scripts",
+                          "live_ann_smoke.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)     # no virtual-device carryover
+    batches = os.environ.get("BENCH_LIVE_ANN_BATCHES", "32")
+    batch_rows = os.environ.get("BENCH_LIVE_ANN_BATCH_ROWS", "256")
+    proc = subprocess.run(
+        [_sys.executable, script, "--batches", batches,
+         "--batch-rows", batch_rows, "--skip-gates"],
+        env=env, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"live_ann_smoke rc={proc.returncode}: {proc.stderr[-500:]}")
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    out = {
+        "ingest_rows_per_min": report["ingest_rows_per_min"],
+        "appended_rows": report["appended_rows"],
+        "rebuild_requests": report["rebuild_requests"],
+        "waves_published": report["waves_published"],
+        "swaps": report["swaps"],
+        "index_version": report["index_version"],
+        "recall": report["recall"],
+        "full_probe_parity_vs_fresh_build":
+            report["full_probe_parity_vs_fresh_build"],
+        "query_errors": report["query_errors"],
+        "query_rows_per_sec_during_rebuild":
+            report["query_rows_per_sec_during_rebuild"],
+        "query_rows_per_sec_quiescent":
+            report["query_rows_per_sec_quiescent"],
+        "swap_p50_ms": report["swap_p50_ms"],
+        "swap_p99_ms": report["swap_p99_ms"],
+    }
+    if os.environ.get("BENCH_AUTOTUNE", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        key = _autotune_key_live_ann(32, int(batch_rows))
+        prior = _autotune_load(key)
+        if prior:
+            out["autotune_prior"] = prior
+        appended = max(float(report["appended_rows"]), 1.0)
+        _autotune_store(key, "live",
+                        appended / max(report["ingest_rows_per_min"],
+                                       1e-9) * 60e3)
+        out["autotune"] = {"cache": "hit" if prior else "miss"}
+    return out
+
+
 def main() -> None:
     import sys
     # telemetry (obs layer): count compiles from here on so the JSON
@@ -1422,6 +1488,23 @@ def main() -> None:
         except Exception as exc:
             print(f"lifecycle bench skipped: {exc!r}", file=sys.stderr)
             out["lifecycle"] = {"error": repr(exc)}
+    # ISSUE-20 LIVE ANN: streaming-ingest rows/min, union recall,
+    # full-probe parity and mid-stream swap p99 (subprocess;
+    # fallback-safe: a live-ANN failure must not sink the KNN headline)
+    if os.environ.get("BENCH_LIVE_ANN", "1").lower() not in (
+            "0", "false", "no", "off", ""):
+        try:
+            out["live_ann"] = _live_ann_bench()
+            la = out["live_ann"]
+            print(f"live ann: {la['ingest_rows_per_min']:,.0f} rows/min "
+                  f"ingest, recall {la['recall']:.4f}, "
+                  f"{la['swaps']} swaps (p99 {la['swap_p99_ms']:.2f}ms), "
+                  f"full-probe parity "
+                  f"{la['full_probe_parity_vs_fresh_build']}",
+                  file=sys.stderr)
+        except Exception as exc:
+            print(f"live ann bench skipped: {exc!r}", file=sys.stderr)
+            out["live_ann"] = {"error": repr(exc)}
     # ISSUE-12 BROKER FLEET: aggregate decisions/sec across 1 vs 2
     # broker shards + fleet serve/SLO numbers (subprocess; fallback-safe
     # like its siblings). BENCH_FLEET=0 disables; BENCH_FLEET_HEADLINE=1

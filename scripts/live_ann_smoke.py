@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""Live-ANN smoke gate (ISSUE 20, tier-1 via tests/test_live_ann.py).
+
+Streams append batches into a :class:`~avenir_tpu.models.live_ann.
+LiveAnnIndex` WHILE queries serve from it, with a ``RetrainDaemon``
+re-clustering in the background and the index hot-swapping the rebuilt
+base mid-stream. Asserts, exiting non-zero on any failure:
+
+1. **Zero query errors**: every query batch during the stream answers
+   with the right shape and only real row ids — before, during and
+   after the swap.
+2. **Rebuild + swap under load**: the tail-fill drift trigger requests
+   >= 1 background wave, the registry publishes it, and the serving
+   side adopts it at an iteration boundary BEFORE the stream ends
+   (tails reset, post-snapshot rows replayed — none lost).
+3. **Ingest throughput**: append-path rate >= 100k rows/min on >= 4
+   cores (halved below — the CI floor fights the daemon for cores).
+4. **Recall**: after the full stream, live queries at default probing
+   hold recall >= 0.98 vs the f64 ground truth over the UNION table —
+   appended rows must be as findable as built ones.
+5. **Full-probe parity**: ``n_probe = nlist`` over the live index
+   (base + tails) EXACTLY equals a from-scratch ``build_ivf`` over the
+   union table queried the same way — same joint int8 scale, same tie
+   rule, same bytes (ops/ivf.py's parity contract extended to tails).
+6. **Swap latency SLO**: p99 of the ``lifecycle.swap`` span <= 250ms
+   (the swap is an install + O(post-snapshot) tail replay; anything
+   slower grew a blocking rebuild or compile).
+
+Prints ONE JSON line consumed by bench.py's ``live_ann`` section.
+
+Usage: python scripts/live_ann_smoke.py [--batches N] [--batch-rows N]
+       [--swap-p99-ms MS] [--skip-gates]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N_BASE = 4096
+D = 8
+K = 5
+QUERY_ROWS = 64
+MIN_RECALL = 0.98
+MIN_ROWS_PER_MIN = 100_000.0
+SWAP_P99_BOUND_MS = 250.0
+
+
+def fail(msg: str) -> None:
+    print(f"live_ann_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _clustered(rng, n, d=D, n_clusters=64):
+    centers = rng.random((n_clusters, d), dtype=np.float32) * 4.0
+    ca = rng.integers(0, n_clusters, n)
+    return (centers[ca] + rng.normal(0, 0.08, (n, d))).astype(np.float32)
+
+
+def _truth(x, y, k):
+    dd = ((x[:, None, :].astype(np.float64) -
+           y[None].astype(np.float64)) ** 2).sum(-1)
+    m, n = dd.shape
+    order = np.lexsort((np.broadcast_to(np.arange(n), (m, n)), dd), axis=1)
+    return order[:, :min(k, n)]
+
+
+def _recall(truth, ids):
+    k = truth.shape[1]
+    return float(np.mean([len(set(t.tolist()) & set(q.tolist())) / k
+                          for t, q in zip(truth, ids)]))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=32)
+    ap.add_argument("--batch-rows", type=int, default=256)
+    ap.add_argument("--swap-p99-ms", type=float, default=SWAP_P99_BOUND_MS)
+    ap.add_argument("--skip-gates", action="store_true",
+                    help="measure and report without failing the perf "
+                         "gates (bench mode on a loaded host)")
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    from avenir_tpu.lifecycle.registry import SnapshotRegistry
+    from avenir_tpu.lifecycle.retrain import RetrainDaemon
+    from avenir_tpu.models.live_ann import LiveAnnIndex
+    from avenir_tpu.obs import exporters as E
+    from avenir_tpu.obs import telemetry as T
+    from avenir_tpu.ops import ivf
+
+    hub = E.hub().enable()
+    hub.set_meta(worker_id=0)
+    T.tracer().enabled = True
+
+    rng = np.random.default_rng(20)
+    y_base = _clustered(rng, N_BASE)
+    batches = [_clustered(rng, args.batch_rows)
+               for _ in range(args.batches)]
+    xq = _clustered(rng, QUERY_ROWS)
+    xq_j = jnp.asarray(xq)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        registry = SnapshotRegistry(os.path.join(tmp, "registry"),
+                                    max_to_keep=4)
+        # tail budget sized so the stream's fill crosses the rebuild
+        # threshold mid-run: the trigger, wave, publish and adoption all
+        # happen under live append+query load
+        live = LiveAnnIndex(
+            y_base, nlist=32, n_iters=8, seed=0, tail_budget=512,
+            rebuild_tail_fill=0.25, registry=registry)
+        daemon = RetrainDaemon(registry, live.make_train_fn())
+        live.bind_daemon(daemon)
+        daemon.start()
+
+        # warm the query caches (build-scale compile) before timing
+        live.query(xq_j, k=K)
+
+        append_s = 0.0
+        query_errors = 0
+        swap_batches = []
+        # per-batch query timing, bucketed by whether a requested
+        # rebuild is still in flight (bench: serving must not stall
+        # while the daemon re-clusters)
+        q_rebuild_s, q_quiet_s = [], []
+        n_expected = N_BASE
+        for bi, batch in enumerate(batches):
+            t0 = time.perf_counter()
+            live.append(batch)
+            append_s += time.perf_counter() - t0
+            n_expected += args.batch_rows
+            in_flight = (live.rebuild_requests
+                         > live.swaps - live.inline_rebuilds)
+            try:
+                t0 = time.perf_counter()
+                d, ids = live.query(xq_j, k=K)
+                ids = np.asarray(ids)
+                (q_rebuild_s if in_flight else q_quiet_s).append(
+                    time.perf_counter() - t0)
+                if ids.shape != (QUERY_ROWS, K) or \
+                        not np.all((ids >= 0) & (ids < live.n_total)):
+                    raise RuntimeError(f"bad ids at batch {bi}")
+            except Exception as exc:     # noqa: BLE001 - the gate itself
+                query_errors += 1
+                print(f"live_ann_smoke: query error at batch {bi}: "
+                      f"{exc!r}", file=sys.stderr)
+            if live.maybe_swap() is not None:
+                swap_batches.append(bi)
+
+        # let any wave requested near the end land, then adopt it so the
+        # swap count reflects every published rebuild
+        if live.rebuild_requests and not daemon.waves:
+            daemon.wait_for_waves(1, timeout=60.0)
+        deadline = time.monotonic() + 60.0
+        while (daemon.waves > live.swaps - live.inline_rebuilds
+               and time.monotonic() < deadline):
+            if live.maybe_swap() is None:
+                time.sleep(0.01)
+        daemon.stop()
+        report = hub.report()
+    hub.disable()
+
+    if daemon.errors:
+        fail(f"retrain wave errored: {daemon.last_error!r}")
+    if live.n_total != n_expected:
+        fail(f"row accounting broke: n_total {live.n_total} != "
+             f"{n_expected}")
+
+    # 1. zero query errors
+    if query_errors:
+        fail(f"{query_errors} query batches errored during the stream")
+
+    # 2. rebuild + swap landed mid-stream
+    if live.rebuild_requests < 1:
+        fail("drift trigger never requested a rebuild "
+             f"(tail_fill ended at {live.tail_fill:.3f})")
+    if daemon.waves < 1:
+        fail("no background wave published")
+    if live.swaps < 1:
+        fail("no rebuilt index was adopted")
+    if not [b for b in swap_batches if b < args.batches - 1] \
+            and not args.skip_gates:
+        fail(f"no swap landed mid-stream: {swap_batches}")
+
+    # 3. ingest throughput (core-count-aware: below 4 cores the daemon's
+    # k-means and the append path share schedulable cores)
+    appended = args.batches * args.batch_rows
+    rows_per_min = appended / append_s * 60.0
+    cores = os.cpu_count() or 1
+    rate_bound = MIN_ROWS_PER_MIN if cores >= 4 else MIN_ROWS_PER_MIN / 2
+    if rows_per_min < rate_bound and not args.skip_gates:
+        fail(f"append path {rows_per_min:,.0f} rows/min < "
+             f"{rate_bound:,.0f} ({cores} cores)")
+
+    # 4. recall over the union table at default probing
+    union = np.concatenate([y_base] + batches)
+    truth = _truth(xq, union, K)
+    _, ids_live = map(np.asarray, live.query(xq_j, k=K))
+    recall = _recall(truth, ids_live)
+    if recall < MIN_RECALL:
+        fail(f"live recall {recall:.4f} < {MIN_RECALL}")
+
+    # 5. full-probe parity with a from-scratch build over the union
+    fresh = ivf.build_ivf(jnp.asarray(union), nlist=live.index.nlist,
+                          n_iters=8, seed=0)
+    da, ia = map(np.asarray, live.query(xq_j, k=K,
+                                        n_probe=live.index.nlist))
+    df, if_ = map(np.asarray, ivf.ann_topk(fresh, xq_j, k=K,
+                                           n_probe=fresh.nlist))
+    parity = bool(np.array_equal(ia, if_) and np.array_equal(da, df))
+    if not parity:
+        fail("full-probe live != from-scratch build over the union")
+
+    # 6. swap latency SLO
+    swap_snap = (report.get("spans") or {}).get("lifecycle.swap")
+    if not swap_snap or swap_snap["count"] < live.swaps - \
+            live.inline_rebuilds:
+        fail(f"lifecycle.swap span missing/short: {swap_snap}")
+    if swap_snap["p99_ms"] > args.swap_p99_ms and not args.skip_gates:
+        fail(f"swap p99 {swap_snap['p99_ms']:.2f}ms exceeds "
+             f"{args.swap_p99_ms:.0f}ms")
+
+    print("live_ann_smoke OK", file=sys.stderr)
+    print(json.dumps({
+        "live_ann_smoke": "ok",
+        "base_rows": N_BASE,
+        "appended_rows": appended,
+        "ingest_rows_per_min": round(rows_per_min, 1),
+        "ingest_bound_rows_per_min": rate_bound,
+        "rebuild_requests": live.rebuild_requests,
+        "waves_published": daemon.waves,
+        "swaps": live.swaps,
+        "swap_batches": swap_batches,
+        "index_version": live.version,
+        "tail_rows_after_swap": int(np.asarray(live.describe()
+                                               ["tail_rows"])),
+        "query_errors": query_errors,
+        "query_rows_per_sec_during_rebuild":
+            (round(QUERY_ROWS * len(q_rebuild_s) / sum(q_rebuild_s), 1)
+             if q_rebuild_s else None),
+        "query_rows_per_sec_quiescent":
+            (round(QUERY_ROWS * len(q_quiet_s) / sum(q_quiet_s), 1)
+             if q_quiet_s else None),
+        "recall": round(recall, 4),
+        "full_probe_parity_vs_fresh_build": parity,
+        "swap_p50_ms": round(swap_snap["p50_ms"], 3),
+        "swap_p99_ms": round(swap_snap["p99_ms"], 3),
+        "swap_p99_bound_ms": args.swap_p99_ms,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,6 +94,12 @@ class TestValidationMatrix:
         ({"tree": T.TreeConfig(
             split_selection_strategy="randomFromTop")},
          "tree.split_selection_strategy must be 'best'"),
+        ({"early_stop_rounds": -1},
+         r"forest.boost.early.stop.rounds must be an int >= 0"),
+        ({"early_stop_rounds": 1, "holdout_fraction": 0.0},
+         r"forest.boost.early.stop.holdout must be .* \(0, 0.5\]"),
+        ({"early_stop_rounds": 1, "holdout_fraction": 0.9},
+         r"forest.boost.early.stop.holdout must be .* \(0, 0.5\]"),
     ])
     def test_invalid_raises_with_key(self, split, kwargs, match):
         train, _ = split
@@ -114,6 +120,75 @@ class TestValidationMatrix:
         table = Featurizer(schema).fit_transform(rows)
         with pytest.raises(ValueError, match="binary classification"):
             B.grow_boosted(table, B.BoostConfig(n_rounds=1))
+
+
+class TestEarlyStopping:
+    """ROADMAP 3c: holdout-margin early stopping. The contract is that a
+    stopped ensemble IS the prefix of the never-stopping run — same
+    rounds computed, trimmed at the holdout-loss plateau — and that the
+    es-off path is byte-unchanged (hist_mask of exact 1.0s)."""
+
+    # deliberately overfitting: big steps + deep trees plateau the
+    # strided holdout well before the round budget
+    _ES_KW = dict(learning_rate=0.9, early_stop_rounds=2,
+                  holdout_fraction=0.2, tree=T.TreeConfig(max_depth=5))
+
+    def test_stops_early_and_is_prefix_of_full_run(self, split):
+        train, _ = split
+        stopped = B.grow_boosted(train, B.BoostConfig(
+            n_rounds=30, **self._ES_KW))
+        assert stopped.rounds_used == len(stopped.trees) < 30
+        # "full run" = same program, same holdout trim, a stale budget
+        # that can never fire — the stopped model must be its prefix
+        full_kw = dict(self._ES_KW, early_stop_rounds=10 ** 6)
+        full = B.grow_boosted(train, B.BoostConfig(n_rounds=30, **full_kw))
+        assert len(full.trees) >= len(stopped.trees)
+        assert all(
+            T.canonical_tree(a, with_values=True)
+            == T.canonical_tree(b, with_values=True)
+            for a, b in zip(stopped.trees, full.trees))
+
+    def test_es_off_anchor_unchanged(self, split):
+        """Multiplying histograms by an all-ones hist_mask is IEEE-exact:
+        the es-off model is byte-identical with the key absent."""
+        train, _ = split
+        cfg = dict(n_rounds=3, learning_rate=0.3,
+                   tree=T.TreeConfig(max_depth=3))
+        off = B.grow_boosted(train, B.BoostConfig(**cfg))
+        explicit = B.grow_boosted(train, B.BoostConfig(
+            early_stop_rounds=0, **cfg))
+        assert off.rounds_used is None and explicit.rounds_used is None
+        assert all(
+            T.canonical_tree(a, with_values=True)
+            == T.canonical_tree(b, with_values=True)
+            for a, b in zip(off.trees, explicit.trees))
+
+    def test_rounds_used_artifact_round_trip(self, split, tmp_path):
+        train, _ = split
+        model = B.grow_boosted(train, B.BoostConfig(
+            n_rounds=30, **self._ES_KW))
+        path = str(tmp_path / "es.json")
+        B.save_boosted(model, path)
+        with open(path) as fh:
+            assert json.load(fh)["roundsUsed"] == model.rounds_used
+        assert B.load_boosted(path).rounds_used == model.rounds_used
+
+    def test_rounds_used_absent_when_off(self, boosted, tmp_path):
+        path = str(tmp_path / "no_es.json")
+        B.save_boosted(boosted, path)
+        with open(path) as fh:
+            assert "roundsUsed" not in json.load(fh)
+        assert B.load_boosted(path).rounds_used is None
+
+    def test_streaming_refuses_early_stop(self, split, tmp_path):
+        fz = Featurizer(retarget_schema())
+        p = tmp_path / "part-0.txt"
+        p.write_text("")
+        with pytest.raises(ValueError,
+                           match="forest.boost.early.stop.rounds is not "
+                                 "supported by the streaming trainer"):
+            B.grow_boosted_streaming(fz, [str(p)], B.BoostConfig(
+                n_rounds=4, **self._ES_KW))
 
 
 class TestStreamedEquivalence:

@@ -1,0 +1,325 @@
+"""Live ANN (ISSUE 20): append tails vs the frozen index (no-append
+value identity, full-probe parity over the union table, exactly-one
+recompile per tail doubling), background rebuild + zero-downtime swap
+through the snapshot registry, the knn.ann.live config matrix and
+--explain provenance, and the smoke-script tier-1 hook."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from avenir_tpu.models.live_ann import (
+    IVF_SNAPSHOT_KIND, LiveAnnIndex, ivf_index_extra, pack_ivf_index,
+    unpack_ivf_index)
+from avenir_tpu.ops import ivf
+
+
+def _clustered(rng, n, d=6, n_clusters=24):
+    centers = rng.random((n_clusters, d), dtype=np.float32) * 4.0
+    ca = rng.integers(0, n_clusters, n)
+    return (centers[ca] + rng.normal(0, 0.08, (n, d))).astype(np.float32)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
+
+
+class TestAppendTails:
+    def test_no_append_value_identity(self, rng):
+        """The byte-safety gate: a live index nobody appended to answers
+        every query with the frozen index's exact values — full probe
+        AND sparse probe."""
+        y = _clustered(rng, 1500)
+        x = jnp.asarray(_clustered(rng, 40))
+        frozen = ivf.build_ivf(jnp.asarray(y), nlist=16, n_iters=8, seed=3)
+        live = LiveAnnIndex(y, nlist=16, n_iters=8, seed=3)
+        for n_probe in (16, 4):
+            df, idf = map(np.asarray, ivf.ann_topk(
+                frozen, x, k=5, n_probe=n_probe))
+            dl, idl = map(np.asarray, live.query(x, k=5, n_probe=n_probe))
+            assert np.array_equal(df, dl)
+            assert np.array_equal(idf, idl)
+
+    def test_full_probe_parity_with_fresh_build(self, rng):
+        """Appended index at n_probe=nlist == from-scratch build_ivf
+        over the union table, exactly — including when an appended row
+        raises max|y| (the joint int8 scale re-quantizes the base)."""
+        y = _clustered(rng, 1200)
+        extra = _clustered(rng, 300)
+        extra[0] *= 3.0              # raise amax past the build scale
+        x = jnp.asarray(_clustered(rng, 32))
+        live = LiveAnnIndex(y, nlist=16, n_iters=8, seed=1,
+                            tail_budget=64)
+        live.append(extra)
+        union = np.concatenate([y, extra])
+        fresh = ivf.build_ivf(jnp.asarray(union), nlist=16, n_iters=8,
+                              seed=1)
+        da, ia = map(np.asarray, live.query(x, k=5, n_probe=16))
+        df, if_ = map(np.asarray, ivf.ann_topk(fresh, x, k=5, n_probe=16))
+        assert np.array_equal(ia, if_)
+        assert np.array_equal(da, df)
+
+    def test_append_into_empty_list(self, rng):
+        """An EMPTY list (a centroid that attracted zero rows — built
+        here verbatim via ``init_centroids`` with ``n_iters=0``, since
+        k-means++ duplicate seeds tie-break to the lower id and are
+        unreachable) must still accept tail rows and answer at sparse
+        probe widths."""
+        y = rng.random((40, 6)).astype(np.float32)     # rows in [0, 1)
+        far = np.full((1, 6), 8.0, np.float32)         # attracts nothing
+        init = np.concatenate([y[:7], far])
+        idx = ivf.build_ivf(jnp.asarray(y), nlist=8, n_iters=0,
+                            init_centroids=init)
+        assert int(np.asarray(idx.lengths)[7]) == 0    # list 7 is empty
+        live = LiveAnnIndex(y, nlist=8, n_iters=0, seed=0,
+                            tail_budget=16)
+        live.adopt(pack_ivf_index(idx), ivf_index_extra(idx))
+        row = far + rng.normal(0, 0.01, (1, 6)).astype(np.float32)
+        stats = live.append(row)
+        assert stats["appended"] == 1 and live.n_total == 41
+        assert int(live._t_len[7]) == 1  # landed in the empty list's tail
+        d, ids = map(np.asarray, live.query(jnp.asarray(row), k=1,
+                                            n_probe=1))
+        assert ids[0, 0] == 40       # the appended row IS its own nearest
+
+    def test_oversize_batch_rebuilds_inline(self, rng):
+        """A batch no legal tail can hold must not be refused: the base
+        index rebuilds over the union inline, tails reset."""
+        y = _clustered(rng, 600)
+        live = LiveAnnIndex(y, nlist=8, n_iters=6, seed=0, tail_budget=8)
+        big = _clustered(rng, 500)
+        stats = live.append(big)
+        assert stats["inline_rebuild"]
+        assert live.inline_rebuilds == 1 and live.version == 1
+        assert live.n_total == 1100
+        assert int(live._t_len.sum()) == 0       # all rows in the base
+        x = jnp.asarray(_clustered(rng, 16))
+        d, ids = map(np.asarray, live.query(x, k=5))
+        assert np.all((ids >= 0) & (ids < 1100))
+
+    def test_tail_doubling_recompiles_exactly_once(self, rng):
+        """The jit-cache-flatness contract: appends within the current
+        tail_cap compile NOTHING (the query program is keyed on tail_cap,
+        not tail fill); the doubling append stages a handful of new-shape
+        publish programs once, the next query compiles exactly ONE new
+        program, and then the cache is flat again at the new cap."""
+        from avenir_tpu.obs import runtime as obs_runtime
+        tracker = obs_runtime.CompileTracker()
+        if not tracker.available:
+            pytest.skip("jax.monitoring unavailable")
+        y = _clustered(rng, 800)
+        x = jnp.asarray(_clustered(rng, 16))
+        live = LiveAnnIndex(y, nlist=8, n_iters=6, seed=0,
+                            tail_budget=256)
+        live.query(x, k=5)                       # compile at cap0
+        live.append(_clustered(rng, 4))          # warm the append path
+        live.query(x, k=5)
+        cap0 = live.tail_cap
+        tracker.start()
+        while True:                              # fill within cap0...
+            live.append(_clustered(rng, 4))
+            if live.tail_cap != cap0:            # ...until one doubling
+                break
+            live.query(x, k=5)
+            assert tracker.snapshot()["backend_compile_count"] == 0
+        assert live.tail_cap == 2 * cap0
+        # the doubling append republished the tails at the new cap (a
+        # few one-time staging programs); the serving query program
+        # itself recompiles exactly once...
+        base = tracker.snapshot()["backend_compile_count"]
+        live.query(x, k=5)
+        assert tracker.snapshot()["backend_compile_count"] == base + 1
+        live.query(x, k=5)                       # ...and is then cached
+        assert tracker.snapshot()["backend_compile_count"] == base + 1
+        live.append(_clustered(rng, 4))          # within the new cap
+        live.query(x, k=5)
+        assert tracker.snapshot()["backend_compile_count"] == base + 1
+
+    def test_append_feature_split_mismatch_refused(self, rng):
+        y = _clustered(rng, 100)
+        live = LiveAnnIndex(y, nlist=8, n_iters=4, seed=0)
+        with pytest.raises(ValueError, match="feature split"):
+            live.append(None, np.zeros((4, 2), np.int32))
+
+    def test_tail_budget_floor(self, rng):
+        with pytest.raises(ValueError, match="tail_budget"):
+            LiveAnnIndex(_clustered(rng, 100), nlist=8, tail_budget=2)
+
+
+class TestRebuildSwap:
+    def test_snapshot_pack_unpack_roundtrip(self, rng):
+        y = _clustered(rng, 500)
+        x = jnp.asarray(_clustered(rng, 16))
+        index = ivf.build_ivf(jnp.asarray(y), nlist=8, n_iters=6, seed=2)
+        back = unpack_ivf_index(pack_ivf_index(index),
+                                ivf_index_extra(index))
+        d0, i0 = map(np.asarray, ivf.ann_topk(index, x, k=5))
+        d1, i1 = map(np.asarray, ivf.ann_topk(back, x, k=5))
+        assert np.array_equal(d0, d1) and np.array_equal(i0, i1)
+
+    def test_wave_swap_replays_post_snapshot_rows(self, rng, tmp_path):
+        """The zero-loss swap contract: rows appended AFTER the rebuild
+        wave's snapshot point survive the adoption — replayed into the
+        fresh index's tails, none lost, none duplicated."""
+        from avenir_tpu.lifecycle.registry import SnapshotRegistry
+        from avenir_tpu.lifecycle.retrain import RetrainDaemon
+        registry = SnapshotRegistry(str(tmp_path / "reg"))
+        y = _clustered(rng, 900)
+        live = LiveAnnIndex(y, nlist=8, n_iters=6, seed=0,
+                            tail_budget=256, rebuild_tail_fill=0.05,
+                            registry=registry)
+        daemon = RetrainDaemon(registry, live.make_train_fn())
+        live.bind_daemon(daemon)
+        live.append(_clustered(rng, 200))
+        assert live.rebuild_requests >= 1        # trigger crossed
+        assert daemon.run_once() is not None     # the wave, synchronous
+        live.append(_clustered(rng, 150))        # post-snapshot rows
+        assert live.maybe_swap() == 1
+        assert live.swaps == 1 and live.version == 1
+        assert live.index.n_real == 1100         # snapshot = 900 + 200
+        assert int(live._t_len.sum()) == 150     # replayed, not lost
+        assert live.n_total == 1250
+        x = jnp.asarray(_clustered(rng, 16))
+        d, ids = map(np.asarray, live.query(x, k=5))
+        assert np.all((ids >= 0) & (ids < 1250))
+
+    def test_foreign_snapshot_kind_ignored(self, rng, tmp_path):
+        """A learner-state publisher sharing the registry must never be
+        adopted as an index."""
+        from avenir_tpu.lifecycle.registry import SnapshotRegistry
+        registry = SnapshotRegistry(str(tmp_path / "reg"))
+        live = LiveAnnIndex(_clustered(rng, 300), nlist=8, n_iters=4,
+                            seed=0, registry=registry)
+        registry.publish({"w": np.zeros(3)}, kind="learner-state")
+        assert live.maybe_swap() is None
+        assert live.swaps == 0
+
+    def test_engine_install_state_delegates_to_adopt(self, rng):
+        """The ServingEngine swap seam: install_state on an
+        AnnServingLearner routes through LiveAnnIndex.adopt (the learner
+        hook delegation in lifecycle/swap.py), replays the ledger tail,
+        and the learner keeps answering."""
+        from avenir_tpu.lifecycle.swap import install_state
+        from avenir_tpu.stream.engine import AnnServingLearner
+        y = _clustered(rng, 700)
+        live = LiveAnnIndex(y, nlist=8, n_iters=6, seed=0,
+                            tail_budget=64)
+        lrn = AnnServingLearner(live, _clustered(rng, 64), k=3)
+        handle = lrn.next_action_batch_async(4)
+        assert len(lrn.resolve_action_batch(handle)) == 4
+        # a re-clustered index published elsewhere (snapshot point = the
+        # 700 base rows), installed mid-serve: rows appended since must
+        # replay into the fresh tails
+        fresh = ivf.build_ivf(jnp.asarray(y), nlist=8, n_iters=6, seed=5)
+        live.append(_clustered(rng, 100))
+        install_state(lrn, (pack_ivf_index(fresh),
+                            ivf_index_extra(fresh)))
+        assert live.swaps == 1
+        assert live.index.n_real == 700
+        assert int(live._t_len.sum()) == 100     # replayed
+        assert live.n_total == 800
+        handle = lrn.next_action_batch_async(4)
+        assert len(lrn.resolve_action_batch(handle)) == 4
+
+
+class TestKnnLiveConfig:
+    @pytest.mark.parametrize("kwargs,match", [
+        ({"ann": False, "ann_live": True}, "knn.ann=true"),
+        ({"ann": True, "ann_live": True, "sharded": True},
+         "knn.sharded"),
+        ({"ann": True, "ann_live": True, "ann_live_tail_budget": 4},
+         r"tail\.budget"),
+    ])
+    def test_validation_matrix(self, kwargs, match):
+        from avenir_tpu.models import knn as K
+        with pytest.raises(ValueError, match=match):
+            K.validate_config(K.KnnConfig(**kwargs))
+
+    def test_live_routing_identity(self):
+        """knn.ann.live with no appends returns the frozen knn.ann
+        path's exact values (the CLI-output-unchanged gate)."""
+        import dataclasses
+        from avenir_tpu.datagen.generators import (retarget_rows,
+                                                   retarget_schema)
+        from avenir_tpu.models import knn as K
+        from avenir_tpu.utils.dataset import Featurizer
+        rows = retarget_rows(1000, seed=9)
+        fz = Featurizer(retarget_schema())
+        train = fz.fit_transform(rows[:800])
+        test = fz.transform(rows[800:])
+        cfg = K.KnnConfig(top_match_count=5, ann=True, ann_nlist=8,
+                          ann_nprobe=4)
+        d0, i0 = K.neighbors(train, test, cfg)
+        d1, i1 = K.neighbors(train, test,
+                             dataclasses.replace(cfg, ann_live=True))
+        assert np.array_equal(np.asarray(d0), np.asarray(d1))
+        assert np.array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_explain_carries_ann_provenance(self, tmp_path, capsys):
+        """--explain on a live-ANN knn job annotates the kernel node
+        with index provenance (nlist/nprobe/live/source), cold and
+        warm."""
+        from avenir_tpu.datagen import generators as G
+        rows = G.churn_rows(200, seed=7)
+        train = tmp_path / "train.csv"
+        test = tmp_path / "test.csv"
+        train.write_text("\n".join(",".join(r) for r in rows[:150]) + "\n")
+        test.write_text("\n".join(",".join(r) for r in rows[150:]) + "\n")
+        schema = tmp_path / "schema.json"
+        schema.write_text(json.dumps(G._CHURN_SCHEMA_JSON))
+        props = tmp_path / "job.properties"
+        props.write_text(
+            "field.delim.regex=,\nfield.delim=,\n"
+            f"feature.schema.file.path={schema}\n"
+            f"train.data.path={train}\n"
+            "top.match.count=3\nknn.ann=true\nknn.ann.live=true\n"
+            "knn.ann.nlist=8\nknn.ann.nprobe=4\n")
+        from avenir_tpu.cli.main import main as cli
+        rc = cli(["NearestNeighbor", str(test), str(tmp_path / "o.txt"),
+                  "--conf", str(props), "--explain"])
+        assert rc == 0
+        txt = capsys.readouterr().out
+        assert "ann=live nlist=8 nprobe=4 index=" in txt
+        # warm slot: run for real, explain again -> cached + version
+        rc = cli(["NearestNeighbor", str(test), str(tmp_path / "o.txt"),
+                  "--conf", str(props)])
+        assert rc == 0
+        rc = cli(["NearestNeighbor", str(test), str(tmp_path / "o2.txt"),
+                  "--conf", str(props), "--explain"])
+        assert rc == 0
+        txt = capsys.readouterr().out
+        assert "index=cached v=0" in txt
+        assert "live slot is warm" in txt
+
+
+def test_live_ann_smoke_script():
+    """Tier-1 hook: scripts/live_ann_smoke.py gates sustained appends
+    under serve load, >= 1 background rebuild + swap mid-stream, zero
+    query errors, ingest throughput, recall over the union table,
+    full-probe parity with a from-scratch build, and the swap p99 SLO
+    in one in-process run."""
+    script = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "scripts", "live_ann_smoke.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    for attempt in (1, 2):
+        proc = subprocess.run([sys.executable, script],
+                              capture_output=True, text=True, timeout=300,
+                              env=env)
+        if proc.returncode == 0:
+            break
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["live_ann_smoke"] == "ok"
+    assert report["swaps"] >= 1 and report["query_errors"] == 0
+    assert report["full_probe_parity_vs_fresh_build"]
+    assert report["recall"] >= 0.98
+    assert report["swap_p99_ms"] <= report["swap_p99_bound_ms"]
